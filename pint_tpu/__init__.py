@@ -1,0 +1,46 @@
+"""pint_tpu — a TPU-native pulsar-timing framework.
+
+A from-scratch JAX/XLA re-design with the capabilities of the reference
+PINT package (pulsar timing: TOAs -> delay chain -> phase -> residuals
+-> least-squares / GLS fitting), built TPU-first:
+
+- host layer (numpy/C++): parsing, clock chains, ephemerides, packing
+  into device-ready ``TOABatch`` pytrees;
+- device layer (JAX): pure jit-compiled functions over
+  (parameter pytree, TOABatch) with double-double precision where the
+  reference used x86 longdouble;
+- batch layer: vmap over pulsars, pjit/shard_map over a
+  (pulsar, toa) device mesh for PTA-scale fits.
+
+Float64 is enabled globally at import: nanosecond timing over decade
+spans is meaningless in f32.
+"""
+
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+from .constants import DMconst, C_M_S, AU_LS, SECS_PER_DAY, TSUN_S  # noqa: E402,F401
+
+__version__ = "0.1.0"
+
+
+def _lazy(name):
+    import importlib
+
+    return importlib.import_module(f".{name}", __name__)
+
+
+def get_model(parfile, **kw):
+    """Load a par file into a TimingModel (reference: pint.models.get_model)."""
+    return _lazy("models.builder").get_model(parfile, **kw)
+
+
+def get_model_and_toas(parfile, timfile, **kw):
+    """(reference: pint.models.get_model_and_toas)"""
+    return _lazy("models.builder").get_model_and_toas(parfile, timfile, **kw)
+
+
+def get_TOAs(timfile, **kw):
+    """(reference: pint.toa.get_TOAs)"""
+    return _lazy("toa").get_TOAs(timfile, **kw)

@@ -1,0 +1,68 @@
+"""Physical and timing constants.
+
+TPU-native equivalent of the reference's package constants
+(reference: src/pint/__init__.py::DMconst, light-second, and the
+astropy constants it pulls in). Values are plain floats — units are
+documented per constant; the framework carries units at the host
+boundary only (see pint_tpu.units).
+"""
+
+import math
+
+# --- fundamental ---
+C_M_S = 299792458.0  # speed of light [m/s] (exact, SI)
+AU_M = 149597870700.0  # astronomical unit [m] (IAU 2012, exact)
+AU_LS = AU_M / C_M_S  # astronomical unit [light-seconds] ~ 499.004783836...
+PC_M = 3.0856775814913673e16  # parsec [m]
+
+# --- time ---
+SECS_PER_DAY = 86400.0
+DAYS_PER_JULIAN_YEAR = 365.25
+SECS_PER_JULIAN_YEAR = SECS_PER_DAY * DAYS_PER_JULIAN_YEAR
+MJD_J2000 = 51544.5  # J2000.0 epoch as MJD (TT)
+JD_MJD_OFFSET = 2400000.5  # JD = MJD + this
+TT_MINUS_TAI_S = 32.184  # TT − TAI [s] (definition)
+GPS_MINUS_TAI_S = -19.0  # TAI − GPS = 19 s → GPS→TAI adds +19 s
+
+# --- dispersion ---
+# DM delay = DMconst * DM / freq^2, DM in pc cm^-3, freq in MHz, delay in s.
+# The reference uses 1/2.41e-4 exactly (reference: src/pint/__init__.py::DMconst).
+DMconst = 1.0 / 2.41e-4  # s MHz^2 pc^-1 cm^3 = 4149.377593360996
+
+# --- solar system masses as light-time, GM/c^3 [s] ---
+# (reference: solar_system_shapiro.py uses astropy GM constants)
+TSUN_S = 4.925490947000518e-06  # GM_sun/c^3 [s] (IAU nominal)
+GM_C3_S = {
+    "sun": TSUN_S,
+    "mercury": TSUN_S / 6.0236e6,
+    "venus": TSUN_S / 4.08523719e5,
+    "earth": TSUN_S / 3.32946048e5,
+    "moon": TSUN_S / 2.7068703e7,
+    "mars": TSUN_S / 3.09870359e6,
+    "jupiter": TSUN_S / 1.047348644e3,
+    "saturn": TSUN_S / 3.4979018e3,
+    "uranus": TSUN_S / 2.290298e4,
+    "neptune": TSUN_S / 1.941226e4,
+}
+GMSUN_M3_S2 = TSUN_S * C_M_S**3  # GM_sun [m^3/s^2]
+
+# --- angles ---
+ARCSEC_TO_RAD = math.pi / (180.0 * 3600.0)
+MAS_TO_RAD = ARCSEC_TO_RAD / 1000.0
+# mas/yr -> rad/s
+MASYR_TO_RADS = MAS_TO_RAD / SECS_PER_JULIAN_YEAR
+
+# Obliquity of the ecliptic [arcsec] by convention name
+# (reference: src/pint/data/runtime/ecliptic.dat)
+OBLIQUITY_ARCSEC = {
+    "DEFAULT": 84381.406,  # IERS2010
+    "IERS2010": 84381.406,
+    "IERS2003": 84381.4059,
+    "IAU2006": 84381.406,
+    "IAU1976": 84381.448,
+}
+
+# Solar wind: electron density normalization.
+# delay = NE_SW [cm^-3] * geometry [AU-ish] * DMconst-like factor; see
+# models/solar_wind.py for the full expression.
+ONE_AU_PC = AU_M / PC_M  # AU expressed in parsec ~ 4.8481e-6

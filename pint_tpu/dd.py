@@ -1,0 +1,235 @@
+"""Double-double (Dekker) arithmetic for JAX on TPU.
+
+The reference relies on x86 80-bit ``np.longdouble`` for time and phase
+precision (reference: src/pint/pulsar_mjd.py, src/pint/phase.py). TPUs
+have no extended precision, so the hot accumulations (spindown Taylor
+series, long time intervals) run in *double-double*: an unevaluated sum
+``hi + lo`` of two float64 giving ~32 significant digits.
+
+Algorithms: Dekker (1971) / Knuth two_sum, split-based two_prod (no FMA
+dependence, works identically on TPU/CPU backends). All functions are
+jit/vmap-safe pure functions over (hi, lo) pairs.
+
+A DD value is a tuple ``(hi, lo)`` of equal-shape float64 arrays with
+|lo| <= ulp(hi)/2. This is a pytree, so DD values flow through jit
+boundaries transparently.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+_SPLITTER = 134217729.0  # 2^27 + 1, Dekker splitter for binary64
+
+
+class DD(NamedTuple):
+    """Double-double number: value = hi + lo (unevaluated)."""
+
+    hi: jnp.ndarray
+    lo: jnp.ndarray
+
+    def __add__(self, other):
+        return add(self, _coerce(other))
+
+    def __radd__(self, other):
+        return add(_coerce(other), self)
+
+    def __sub__(self, other):
+        return sub(self, _coerce(other))
+
+    def __rsub__(self, other):
+        return sub(_coerce(other), self)
+
+    def __mul__(self, other):
+        return mul(self, _coerce(other))
+
+    def __rmul__(self, other):
+        return mul(_coerce(other), self)
+
+    def __truediv__(self, other):
+        return div(self, _coerce(other))
+
+    def __rtruediv__(self, other):
+        return div(_coerce(other), self)
+
+    def __neg__(self):
+        return DD(-self.hi, -self.lo)
+
+    def to_f64(self):
+        return self.hi + self.lo
+
+
+def _coerce(x) -> DD:
+    if isinstance(x, DD):
+        return x
+    x = jnp.asarray(x, dtype=jnp.float64)
+    return DD(x, jnp.zeros_like(x))
+
+
+def from_f64(x) -> DD:
+    """Promote a float64 array to DD exactly."""
+    return _coerce(x)
+
+
+def from_2sum(a, b) -> DD:
+    """DD from the exact sum of two float64 arrays."""
+    return two_sum(jnp.asarray(a, jnp.float64), jnp.asarray(b, jnp.float64))
+
+
+def two_sum(a, b) -> DD:
+    """Knuth two-sum: s + e == a + b exactly."""
+    s = a + b
+    v = s - a
+    e = (a - (s - v)) + (b - v)
+    return DD(s, e)
+
+
+def quick_two_sum(a, b) -> DD:
+    """Fast two-sum assuming |a| >= |b|."""
+    s = a + b
+    e = b - (s - a)
+    return DD(s, e)
+
+
+def _split(a):
+    t = _SPLITTER * a
+    a_hi = t - (t - a)
+    a_lo = a - a_hi
+    return a_hi, a_lo
+
+
+def two_prod(a, b) -> DD:
+    """Dekker product: p + e == a*b exactly (no FMA required)."""
+    p = a * b
+    a_hi, a_lo = _split(a)
+    b_hi, b_lo = _split(b)
+    e = ((a_hi * b_hi - p) + a_hi * b_lo + a_lo * b_hi) + a_lo * b_lo
+    return DD(p, e)
+
+
+def add(x: DD, y: DD) -> DD:
+    s = two_sum(x.hi, y.hi)
+    t = two_sum(x.lo, y.lo)
+    c = s.lo + t.hi
+    v = quick_two_sum(s.hi, c)
+    w = t.lo + v.lo
+    return quick_two_sum(v.hi, w)
+
+
+def sub(x: DD, y: DD) -> DD:
+    return add(x, DD(-y.hi, -y.lo))
+
+
+def mul(x: DD, y: DD) -> DD:
+    p = two_prod(x.hi, y.hi)
+    e = p.lo + (x.hi * y.lo + x.lo * y.hi)
+    return quick_two_sum(p.hi, e)
+
+
+def mul_f(x: DD, f) -> DD:
+    """DD * float64."""
+    p = two_prod(x.hi, f)
+    e = p.lo + x.lo * f
+    return quick_two_sum(p.hi, e)
+
+
+def div(x: DD, y: DD) -> DD:
+    q1 = x.hi / y.hi
+    r = sub(x, mul_f(y, q1))
+    q2 = r.hi / y.hi
+    r = sub(r, mul_f(y, q2))
+    q3 = r.hi / y.hi
+    q = quick_two_sum(q1, q2)
+    return add(q, DD(q3, jnp.zeros_like(q3)))
+
+
+def neg(x: DD) -> DD:
+    return DD(-x.hi, -x.lo)
+
+
+def abs_(x: DD) -> DD:
+    s = jnp.where(x.hi < 0, -1.0, 1.0)
+    return DD(x.hi * s, x.lo * s)
+
+
+def floor(x: DD) -> DD:
+    """Elementwise floor of a DD value, exact."""
+    fhi = jnp.floor(x.hi)
+    is_int = fhi == x.hi
+    flo = jnp.where(is_int, jnp.floor(x.lo), jnp.zeros_like(x.lo))
+    return two_sum(fhi, flo)
+
+
+def round_half(x: DD) -> DD:
+    """Round to nearest integer (ties toward +inf), exact."""
+    return floor(add(x, _coerce(0.5)))
+
+
+def fmod1(x: DD) -> DD:
+    """Fractional part in [-0.5, 0.5): x - round(x)."""
+    return sub(x, round_half(x))
+
+
+def to_f64(x: DD):
+    return x.hi + x.lo
+
+
+def horner(dt: DD, coeffs) -> DD:
+    """Evaluate sum_i coeffs[i] * dt^i / i! in DD (Taylor-Horner).
+
+    TPU-native equivalent of the reference's hot-path
+    ``taylor_horner`` (reference: src/pint/utils.py::taylor_horner),
+    run in double-double so ~decades*kHz spindown phase keeps
+    sub-nanosecond fractional precision.
+
+    coeffs: list of scalars / arrays / DD, constant term first.
+    """
+    n = len(coeffs)
+    # fact[i] = i!
+    fact = 1.0
+    result: DD = _coerce(0.0)
+    # Horner from highest term: r = c_n/n! + dt*r
+    facts = []
+    for i in range(n):
+        facts.append(fact)
+        fact *= i + 1
+    for i in reversed(range(n)):
+        c = _coerce(coeffs[i])
+        term = mul_f(c, 1.0 / facts[i])
+        result = add(term, mul(dt, result))
+    return result
+
+
+def horner_deriv(dt: DD, coeffs, deriv_order: int = 1) -> DD:
+    """d^k/dt^k of horner(dt, coeffs) (reference: utils.py::taylor_horner_deriv)."""
+    n = len(coeffs)
+    if deriv_order >= n:
+        return _coerce(jnp.zeros_like(dt.hi))
+    # derivative of sum c_i t^i/i! is sum_{i>=k} c_i t^(i-k)/(i-k)!
+    shifted = list(coeffs[deriv_order:])
+    return horner(dt, shifted)
+
+
+def sum_dd(x: DD, axis=None) -> DD:
+    """Sum a DD array along an axis with full compensation.
+
+    Sequential two_sum fold via lax.scan over the reduction axis —
+    exact on IEEE backends. O(n) depth; intended for modest reduction
+    sizes (chi2 over TOAs). For throughput-critical paths use plain
+    jnp.sum on .hi when f64 accuracy suffices.
+    """
+    import jax.lax as lax
+
+    hi = jnp.moveaxis(x.hi, axis if axis is not None else 0, 0)
+    lo = jnp.moveaxis(x.lo, axis if axis is not None else 0, 0)
+
+    def step(acc, pair):
+        h, l = pair
+        s = add(acc, DD(h, l))
+        return s, None
+
+    init = DD(jnp.zeros(hi.shape[1:], hi.dtype), jnp.zeros(hi.shape[1:], hi.dtype))
+    out, _ = lax.scan(step, init, (hi, lo))
+    return out

@@ -1,0 +1,140 @@
+"""High-precision epoch representation: (integer MJD, seconds-of-day).
+
+TPU-native replacement for the reference's longdouble MJD handling
+(reference: src/pint/pulsar_mjd.py — PulsarMJD Time format,
+mjds_to_jds/jds_to_mjds and the (jd1, jd2) split inside astropy Time).
+
+Design: an epoch is ``(day: int64, sec: float64)`` with 0 <= sec < 86400.
+- ``day`` is the integer MJD in the relevant timescale.
+- ``sec`` is seconds within the day; f64 resolution on 86400 is ~20 ps,
+  well under the ~1 ns target.
+Differences between epochs are formed as double-double seconds
+(day difference * 86400 is exact in f64 for any realistic span), which
+is what the device-side phase computation consumes (see pint_tpu.dd).
+
+Host-side only; device code receives plain f64 arrays.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+import numpy as np
+
+from .constants import SECS_PER_DAY
+
+LD = np.longdouble  # x86 80-bit on the host; never on device
+
+
+@dataclass
+class Epochs:
+    """Array-of-epochs in some timescale: integer day + seconds-of-day."""
+
+    day: np.ndarray  # int64 MJD
+    sec: np.ndarray  # float64 seconds of day, [0, 86400)
+    scale: str = "utc"
+
+    def __post_init__(self):
+        self.day = np.atleast_1d(np.asarray(self.day, dtype=np.int64))
+        self.sec = np.atleast_1d(np.asarray(self.sec, dtype=np.float64))
+
+    def __len__(self):
+        return len(self.day)
+
+    def normalized(self) -> "Epochs":
+        """Carry sec into [0, 86400)."""
+        extra = np.floor(self.sec / SECS_PER_DAY).astype(np.int64)
+        day = self.day + extra
+        sec = self.sec - extra.astype(np.float64) * SECS_PER_DAY
+        # a tiny negative sec can round back up to exactly 86400.0 after the
+        # borrow; snap it to the next day so the [0, 86400) invariant (which
+        # leap-second lookup depends on) always holds
+        hit = sec >= SECS_PER_DAY
+        day = np.where(hit, day + 1, day)
+        sec = np.where(hit, sec - SECS_PER_DAY, sec)
+        sec = np.where(sec < 0.0, 0.0, sec)
+        return Epochs(day, sec, self.scale)
+
+    def mjd_longdouble(self) -> np.ndarray:
+        return LD(self.day) + LD(self.sec) / LD(SECS_PER_DAY)
+
+    def mjd_float(self) -> np.ndarray:
+        return np.asarray(self.day, dtype=np.float64) + self.sec / SECS_PER_DAY
+
+    def add_seconds(self, s) -> "Epochs":
+        return Epochs(self.day, self.sec + np.asarray(s, np.float64), self.scale).normalized()
+
+    def diff_seconds_dd(self, other: "Epochs"):
+        """(self - other) in seconds as a (hi, lo) double-double pair."""
+        dday = (self.day - other.day).astype(np.float64) * SECS_PER_DAY  # exact
+        dsec = self.sec - other.sec  # exact-ish (both < 86400)
+        hi = dday + dsec
+        lo = (dday - hi) + dsec
+        return hi, lo
+
+
+_MJD_RE = re.compile(r"^([+-]?\d+)(?:\.(\d+))?$")
+
+
+def parse_mjd_string(s: str) -> tuple[int, float]:
+    """Parse a decimal MJD string exactly into (int day, frac seconds).
+
+    The reference parses tim-file MJDs into longdouble
+    (reference: src/pint/toa.py tim parsing, pulsar_mjd.py::str2longdouble);
+    we split digits so no precision is lost regardless of digit count.
+    """
+    m = _MJD_RE.match(s.strip())
+    if not m:
+        raise ValueError(f"bad MJD string: {s!r}")
+    day = int(m.group(1))
+    frac_digits = m.group(2) or ""
+    if frac_digits:
+        # longdouble keeps sub-ns accuracy however many digits are given
+        sec = float(LD(int(frac_digits)) * LD(SECS_PER_DAY) / LD(10) ** len(frac_digits))
+    else:
+        sec = 0.0
+    if day < 0 and sec > 0.0:
+        # value = day + frac: for negative MJDs the fractional digits
+        # still count *forward* from the integer part, so floor the day
+        # and keep 0 <= sec < 86400 (e.g. "-1.5" -> (-2, 43200))
+        day -= 1
+        sec = SECS_PER_DAY - sec
+    return day, sec
+
+
+def format_mjd(day: int, sec: float, ndigits: int = 16) -> str:
+    """Format (day, sec) as a decimal MJD string with ndigits fractional digits."""
+    frac = LD(sec) / LD(SECS_PER_DAY)
+    # handle carry
+    if frac >= 1:
+        day += int(np.floor(float(frac)))
+        frac = frac - np.floor(frac)
+    scaled = int(np.rint(frac * LD(10) ** ndigits))
+    if scaled >= 10**ndigits:
+        scaled -= 10**ndigits
+        day += 1
+    return f"{day}.{scaled:0{ndigits}d}"
+
+
+def mjd_to_caldate(mjd: int) -> tuple[int, int, int]:
+    """MJD -> (year, month, day), proleptic Gregorian. Fliegel–Van Flandern."""
+    jd = mjd + 2400001  # JDN at noon of that civil day
+    a = jd + 32044
+    b = (4 * a + 3) // 146097
+    c = a - 146097 * b // 4
+    d = (4 * c + 3) // 1461
+    e = c - 1461 * d // 4
+    m = (5 * e + 2) // 153
+    day = e - (153 * m + 2) // 5 + 1
+    month = m + 3 - 12 * (m // 10)
+    year = 100 * b + d - 4800 + m // 10
+    return year, month, day
+
+
+def caldate_to_mjd(year: int, month: int, day: int) -> int:
+    a = (14 - month) // 12
+    y = year + 4800 - a
+    m = month + 12 * a - 3
+    jdn = day + (153 * m + 2) // 5 + 365 * y + y // 4 - y // 100 + y // 400 - 32045
+    return jdn - 2400001

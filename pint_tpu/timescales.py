@@ -1,0 +1,158 @@
+"""Time-scale conversions: UTC <-> TAI <-> TT <-> TDB (host-side).
+
+The reference gets all of this from astropy.time + ERFA C
+(reference: src/pint/toa.py::TOAs.compute_TDBs, src/pint/pulsar_mjd.py).
+astropy is not in the build environment, so this module owns the chain:
+
+  UTC --(leap seconds)--> TAI --(+32.184s)--> TT --(series)--> TDB
+
+Leap seconds are vendored (pint_tpu/data/leap-seconds.list, IETF/NIST
+format) with a hardcoded fallback table. TDB-TT uses a truncated
+Fairhead & Bretagnon (1990) harmonic series — top terms, documented
+accuracy ~10 us absolute; see ``tdb_minus_tt``. Self-consistency
+(simulate->fit with the same chain) is exact; absolute accuracy can be
+upgraded by dropping in a DE440t TT-TDB SPK segment (io/spk.py) without
+touching callers.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .constants import SECS_PER_DAY, TT_MINUS_TAI_S
+from .mjd import Epochs
+
+# (MJD of effectivity, TAI-UTC seconds from that date) — post-1972 only.
+# Fallback if the vendored leap-seconds.list is unreadable.
+_LEAP_TABLE_FALLBACK = [
+    (41317, 10), (41499, 11), (41683, 12), (42048, 13), (42413, 14),
+    (42778, 15), (43144, 16), (43509, 17), (43874, 18), (44239, 19),
+    (44786, 20), (45151, 21), (45516, 22), (46247, 23), (47161, 24),
+    (47892, 25), (48257, 26), (48804, 27), (49169, 28), (49534, 29),
+    (50083, 30), (50630, 31), (51179, 32), (53736, 33), (54832, 34),
+    (56109, 35), (57204, 36), (57754, 37),
+]
+
+_NTP_EPOCH_MJD = 15020  # 1900-01-01
+
+
+def _load_leap_table():
+    path = os.path.join(os.path.dirname(__file__), "data", "leap-seconds.list")
+    table = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                parts = line.split()
+                ntp_sec, tai_utc = int(parts[0]), int(parts[1])
+                mjd = _NTP_EPOCH_MJD + ntp_sec // 86400
+                table.append((mjd, tai_utc))
+    except Exception:
+        # unreadable OR malformed vendored file: fall back to the
+        # hardcoded table rather than failing at import time
+        table = []
+    table = [t for t in table if t[1] >= 10]  # post-1972 regime only
+    return table or list(_LEAP_TABLE_FALLBACK)
+
+
+_LEAPS = _load_leap_table()
+_LEAP_MJDS = np.array([m for m, _ in _LEAPS], dtype=np.int64)
+_LEAP_VALS = np.array([v for _, v in _LEAPS], dtype=np.float64)
+
+
+def tai_minus_utc(mjd_utc_day) -> np.ndarray:
+    """TAI-UTC [s] for integer UTC MJD days (post-1972)."""
+    day = np.atleast_1d(np.asarray(mjd_utc_day, dtype=np.int64))
+    idx = np.searchsorted(_LEAP_MJDS, day, side="right") - 1
+    if np.any(idx < 0):
+        raise ValueError("pre-1972 UTC not supported (no rubber-second handling)")
+    return _LEAP_VALS[idx]
+
+
+def utc_to_tai(t: Epochs) -> Epochs:
+    assert t.scale == "utc"
+    dt = tai_minus_utc(t.day)
+    out = Epochs(t.day, t.sec + dt, "tai").normalized()
+    return out
+
+
+def tai_to_utc(t: Epochs) -> Epochs:
+    assert t.scale == "tai"
+    # iterate: leap count at (tai - guess) may differ near boundaries
+    dt = tai_minus_utc(t.day)
+    for _ in range(2):
+        guess = Epochs(t.day, t.sec - dt, "utc").normalized()
+        dt = tai_minus_utc(guess.day)
+    return Epochs(t.day, t.sec - dt, "utc").normalized()
+
+
+def tai_to_tt(t: Epochs) -> Epochs:
+    assert t.scale == "tai"
+    return Epochs(t.day, t.sec + TT_MINUS_TAI_S, "tt").normalized()
+
+
+def tt_to_tai(t: Epochs) -> Epochs:
+    assert t.scale == "tt"
+    return Epochs(t.day, t.sec - TT_MINUS_TAI_S, "tai").normalized()
+
+
+def utc_to_tt(t: Epochs) -> Epochs:
+    return tai_to_tt(utc_to_tai(t))
+
+
+# --- TDB-TT -----------------------------------------------------------------
+# Truncated Fairhead & Bretagnon (1990) series; T = Julian centuries TT from
+# J2000. Terms with amplitude >= ~2 us plus the secular-mixed term.
+# (reference equivalent: ERFA dtdb via astropy Time; full series there.)
+_TDB_TERMS = np.array([
+    # amplitude [s], rate [rad/century], phase [rad]
+    (0.001656675, 628.3075850, 6.2400580),
+    (0.000022418, 575.3384885, 4.2969771),
+    (0.000013840, 1256.6151700, 6.1968992),
+    (0.000004770, 52.9690965, 0.4444038),
+    (0.000004677, 606.9776754, 4.0211665),
+    (0.000002257, 21.3299095, 5.5431320),
+    (0.000001694, 0.3523118, 5.0251207),
+    (0.000001556, 1203.6460735, 4.1698465),
+    (0.000001276, 1414.3495242, 4.2781490),
+    (0.000001193, 1097.7078770, 6.1798441),
+])
+_TDB_T_TERM = (0.0000102, 628.3075850, 4.2490)  # amplitude*T mixed term
+
+
+def tdb_minus_tt(tt: Epochs) -> np.ndarray:
+    """TDB-TT [s] at TT epochs, truncated FB1990 series (~10 us absolute).
+
+    Geocentric TDB (topocentric ~2 us diurnal term omitted, as the
+    reference also evaluates TDB at the geocenter for its default
+    T2CMETHOD; reference: toa.py::TOAs.compute_TDBs grid).
+    """
+    assert tt.scale == "tt"
+    T = ((tt.day - 51544) - 0.5 + tt.sec / SECS_PER_DAY) / 36525.0
+    out = np.zeros_like(T)
+    for amp, rate, phase in _TDB_TERMS:
+        out += amp * np.sin(rate * T + phase)
+    amp, rate, phase = _TDB_T_TERM
+    out += amp * T * np.sin(rate * T + phase)
+    return out
+
+
+def tt_to_tdb(t: Epochs) -> Epochs:
+    assert t.scale == "tt"
+    return Epochs(t.day, t.sec + tdb_minus_tt(t), "tdb").normalized()
+
+
+def tdb_to_tt(t: Epochs) -> Epochs:
+    assert t.scale == "tdb"
+    # one fixed-point iteration is ample (d(TDB-TT)/dt ~ 1e-8)
+    approx_tt = Epochs(t.day, t.sec, "tt")
+    d = tdb_minus_tt(approx_tt)
+    return Epochs(t.day, t.sec - d, "tt").normalized()
+
+
+def utc_to_tdb(t: Epochs) -> Epochs:
+    return tt_to_tdb(utc_to_tt(t))

@@ -1,0 +1,22 @@
+"""Test harness: force JAX onto CPU with 8 virtual devices.
+
+Mirrors the reference's CI strategy of running everything on plain
+hosts (reference: no GPU in CI); multi-chip sharding tests run on the
+virtual CPU mesh exactly as the driver's dryrun does.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize registers the TPU plugin at interpreter start and
+# overrides JAX_PLATFORMS, so the env var alone is not enough: force CPU via
+# config. Tests must run on CPU — the axon TPU's emulated f64 is ~47-bit and
+# not correctly rounded, while tests validate exact-IEEE numerics.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,97 @@
+"""Double-double arithmetic precision tests.
+
+Equivalent of the reference's longdouble precision tests
+(reference: tests/test_precision.py) — the DD layer must beat x86
+longdouble (64-bit mantissa) so golden comparisons hold at <1 ns.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pint_tpu import dd
+
+LD = np.longdouble
+
+
+def dd_to_ld(x: dd.DD):
+    return LD(np.asarray(x.hi)) + LD(np.asarray(x.lo))
+
+
+def test_two_sum_exact():
+    a = jnp.float64(1e16)
+    b = jnp.float64(1.0)
+    s = dd.two_sum(a, b)
+    assert float(s.hi) == 1e16 + 1.0 or float(s.lo) != 0.0
+    assert dd_to_ld(s) == LD(1e16) + LD(1.0)
+
+
+def test_two_prod_exact():
+    a = jnp.float64(1.1)
+    b = jnp.float64(1e9 + 1 / 3)
+    p = dd.two_prod(a, b)
+    # exact product of the two representable doubles
+    expected = LD(float(a)) * LD(float(b))
+    assert abs(float(dd_to_ld(p) - expected)) < 1e-25 * abs(float(expected))
+
+
+def test_add_mul_precision():
+    rng = np.random.default_rng(0)
+    a = rng.uniform(1e8, 1e9, 100)
+    b = rng.uniform(-1e-9, 1e-9, 100)
+    x = dd.from_2sum(jnp.array(a), jnp.array(b))
+    y = dd.mul(x, x)
+    expected = (LD(a) + LD(b)) ** 2
+    got = dd_to_ld(y)
+    rel = np.abs((got - expected) / expected).astype(float)
+    # comparison is limited by the longdouble reference itself (~5e-20)
+    assert rel.max() < 5e-19
+
+
+def test_div():
+    x = dd.from_f64(jnp.float64(1.0))
+    y = dd.from_f64(jnp.float64(3.0))
+    q = dd.div(x, y)
+    expected = LD(1) / LD(3)
+    assert abs(float(dd_to_ld(q) - expected)) < 1e-31
+
+
+def test_horner_spindown_scale():
+    """Phase over 20 years at F0=339 Hz must keep frac-phase to <1e-9 cycles."""
+    F0 = 339.31568729824
+    F1 = -1.6e-15
+    dt = dd.from_2sum(jnp.float64(20 * 365.25 * 86400.0), jnp.float64(0.123456789))
+    ph = dd.horner(dt, [0.0, F0, F1])
+    dt_ld = LD(20 * 365.25 * 86400.0) + LD(0.123456789)
+    expected = LD(F0) * dt_ld + LD(F1) * dt_ld**2 / 2
+    got = dd_to_ld(ph)
+    # ~2e11 cycles total; fractional agreement to <1e-9 cycles
+    assert abs(float(got - expected)) < 1e-9
+
+
+def test_floor_round():
+    x = dd.from_2sum(jnp.float64(2.5), jnp.float64(-1e-20))
+    f = dd.floor(x)
+    assert float(dd.to_f64(f)) == 2.0
+    r = dd.round_half(dd.from_2sum(jnp.float64(2.5), jnp.float64(1e-20)))
+    assert float(dd.to_f64(r)) == 3.0
+
+
+def test_jit_and_vmap():
+    @jax.jit
+    def f(hi, lo):
+        x = dd.DD(hi, lo)
+        return dd.to_f64(dd.mul(x, x))
+
+    hi = jnp.arange(1.0, 5.0)
+    lo = jnp.zeros(4)
+    np.testing.assert_allclose(np.asarray(f(hi, lo)), np.arange(1.0, 5.0) ** 2)
+
+
+def test_horner_deriv():
+    dt = dd.from_f64(jnp.float64(100.0))
+    coeffs = [0.0, 2.0, 3.0, 4.0]
+    d1 = dd.horner_deriv(dt, coeffs, 1)
+    # d/dt [2t + 3t^2/2 + 4t^3/6] = 2 + 3t + 2t^2
+    assert float(dd.to_f64(d1)) == pytest.approx(2 + 3 * 100 + 2 * 100**2, rel=1e-12)

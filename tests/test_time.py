@@ -1,0 +1,111 @@
+"""Time-scale and MJD precision tests.
+
+(reference: tests/test_pulsar_mjd.py, tests/test_precision.py patterns —
+round-trips, leap-second days, known scale offsets.)
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu.mjd import (
+    Epochs,
+    caldate_to_mjd,
+    format_mjd,
+    mjd_to_caldate,
+    parse_mjd_string,
+)
+from pint_tpu import timescales as ts
+
+
+def test_parse_format_roundtrip():
+    s = "55555.123456789012345"
+    day, sec = parse_mjd_string(s)
+    assert day == 55555
+    out = format_mjd(day, sec, ndigits=15)
+    assert out == s
+
+
+def test_parse_precision_ns():
+    # 1 ns = 1.157e-14 day; 16 fractional digits resolves it
+    day, sec = parse_mjd_string("58000.0000000000000116")
+    assert sec == pytest.approx(1.16e-14 * 86400.0, rel=1e-6)
+
+
+def test_caldate():
+    assert caldate_to_mjd(2000, 1, 1) == 51544
+    assert mjd_to_caldate(51544) == (2000, 1, 1)
+    assert caldate_to_mjd(2017, 1, 1) == 57754
+    for mjd in [40000, 50000, 58849, 60000]:
+        y, m, d = mjd_to_caldate(mjd)
+        assert caldate_to_mjd(y, m, d) == mjd
+
+
+def test_leap_seconds():
+    # TAI-UTC was 32 s during 1999-2005, 37 s from 2017
+    assert ts.tai_minus_utc(51544)[0] == 32.0
+    assert ts.tai_minus_utc(58000)[0] == 37.0
+    # boundary: 2016-12-31 (57753) -> 36; 2017-01-01 (57754) -> 37
+    assert ts.tai_minus_utc(57753)[0] == 36.0
+    assert ts.tai_minus_utc(57754)[0] == 37.0
+
+
+def test_utc_tt_roundtrip():
+    t = Epochs([58000, 51000], [12345.6789, 86399.5], "utc")
+    tt = ts.utc_to_tt(t)
+    back = ts.tai_to_utc(ts.tt_to_tai(tt))
+    np.testing.assert_array_equal(back.day, t.day)
+    np.testing.assert_allclose(back.sec, t.sec, atol=1e-9)
+
+
+def test_tt_scale_value():
+    # TT - UTC = 32.184 + 37 = 69.184 s in 2018
+    t = Epochs([58119], [0.0], "utc")
+    tt = ts.utc_to_tt(t)
+    dt = (tt.day[0] - t.day[0]) * 86400.0 + (tt.sec[0] - t.sec[0])
+    assert dt == pytest.approx(69.184, abs=1e-9)
+
+
+def test_tdb_tt_magnitude():
+    # TDB-TT is bounded by ~1.7 ms and annual-periodic
+    days = np.arange(50000, 51000, 7)
+    tt = Epochs(days, np.zeros_like(days, dtype=float), "tt")
+    d = ts.tdb_minus_tt(tt)
+    assert np.max(np.abs(d)) < 2e-3
+    assert np.max(np.abs(d)) > 1e-3  # annual term should show up over a year
+
+
+def test_tdb_roundtrip():
+    t = Epochs([55000], [43200.0], "tt")
+    tdb = ts.tt_to_tdb(t)
+    back = ts.tdb_to_tt(tdb)
+    assert back.day[0] == t.day[0]
+    assert back.sec[0] == pytest.approx(t.sec[0], abs=1e-12)
+
+
+def test_diff_seconds_dd():
+    a = Epochs([58000], [0.125], "tdb")
+    b = Epochs([51000], [86399.875], "tdb")
+    hi, lo = a.diff_seconds_dd(b)
+    expected = np.longdouble(7000 * 86400) - np.longdouble(86399.75)
+    got = np.longdouble(hi[0]) + np.longdouble(lo[0])
+    assert float(got - expected) == 0.0
+
+
+def test_normalized_carry():
+    t = Epochs([58000], [86400.0 + 1.5], "utc").normalized()
+    assert t.day[0] == 58001
+    assert t.sec[0] == pytest.approx(1.5)
+
+
+def test_phase_split():
+    import jax.numpy as jnp
+
+    from pint_tpu import dd, phase
+
+    x = dd.from_2sum(jnp.float64(1e11), jnp.float64(0.25))
+    p = phase.from_dd(x)
+    assert float(p.int_) == 1e11
+    assert float(p.frac) == 0.25
+    q = p + phase.from_f64(jnp.float64(0.5))
+    assert float(q.frac) == -0.25
+    assert float(q.int_) == 1e11 + 1
