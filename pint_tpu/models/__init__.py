@@ -1,0 +1,2 @@
+from .builder import get_model, get_model_and_toas  # noqa: F401
+from .timing_model import TimingModel, Component  # noqa: F401

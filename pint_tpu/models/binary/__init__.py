@@ -1,0 +1,33 @@
+"""Binary pulsar components (reference: src/pint/models/pulsar_binary.py
++ stand_alone_psr_binaries/). Populated by model family: ELL1 first
+(closed form), BT/DD (Kepler iteration under jit), extensions after.
+"""
+
+from __future__ import annotations
+
+
+def add_binary_component(model, binary_name: str, keys: dict):
+    import importlib
+
+    name = binary_name.upper()
+    if importlib.util.find_spec(f"{__name__}.ell1") is None:
+        raise NotImplementedError(
+            f"BINARY {name}: binary components not yet built in this tree")
+    if name in ("ELL1", "ELL1H", "ELL1K"):
+        from .ell1 import BinaryELL1, BinaryELL1H
+
+        comp = BinaryELL1H() if name == "ELL1H" else BinaryELL1()
+    elif name in ("BT", "BTX"):
+        from .bt import BinaryBT
+
+        comp = BinaryBT()
+    elif name in ("DD", "DDS", "DDGR", "DDK"):
+        from .dd import BinaryDD, BinaryDDS, BinaryDDK
+
+        comp = {"DD": BinaryDD, "DDS": BinaryDDS, "DDK": BinaryDDK,
+                "DDGR": BinaryDD}[name]()
+    else:
+        raise ValueError(f"unsupported BINARY model {binary_name!r}")
+    model.add_component(comp)
+    comp.add_prefix_members(keys)
+    return comp

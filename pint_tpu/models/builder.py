@@ -1,0 +1,185 @@
+"""Par file -> TimingModel construction.
+
+(reference: src/pint/models/model_builder.py — ModelBuilder /
+AllComponents: parse par lines, resolve aliases, choose components from
+content (BINARY line, DMX_* -> DispersionDMX, GLEP_* -> Glitch, ...),
+report unrecognized lines.)
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import warnings
+
+from ..utils import interesting_lines, split_prefixed_name
+from .parameter import strParameter, floatParameter, MJDParameter, maskParameter
+from .timing_model import TimingModel
+from .spindown import Spindown
+from .astrometry import AstrometryEquatorial, AstrometryEcliptic
+from .dispersion import DispersionDM, DispersionDMX
+from .solar_system_shapiro import SolarSystemShapiro
+from .jump import PhaseJump
+
+# par-file key aliases -> canonical names (reference: each Parameter's aliases)
+ALIASES = {
+    "E": "ECC", "PSRJ": "PSR", "PSRB": "PSR", "DEC": "DECJ", "RA": "RAJ",
+    "LAMBDA": "ELONG", "BETA": "ELAT", "PMLAMBDA": "PMELONG", "PMBETA": "PMELAT",
+    "CLK": "CLOCK", "T2EFAC": "EFAC", "T2EQUAD": "EQUAD", "NE1AU": "NE_SW",
+    "SOLARN0": "NE_SW",
+}
+
+TOP_LEVEL_STR = ("PSR", "EPHEM", "CLOCK", "UNITS", "TIMEEPH", "T2CMETHOD",
+                 "TZRSITE", "INFO", "DCOVFILE", "TRACK", "MODE", "EPHVER",
+                 "CHI2", "CHI2R", "DMDATA", "NITS", "IBOOT")
+TOP_LEVEL_FLOAT = ("NTOA", "TRES", "TZRFRQ", "DMRES")
+TOP_LEVEL_MJD = ("START", "FINISH", "TZRMJD")
+
+
+def parse_parfile(parfile) -> list[tuple[str, list[str]]]:
+    """par file path or content string -> [(KEY, fields)] preserving order."""
+    if isinstance(parfile, str) and ("\n" in parfile or not os.path.exists(parfile)):
+        if "\n" not in parfile and not os.path.exists(parfile):
+            raise FileNotFoundError(parfile)
+        fh = io.StringIO(parfile)
+    else:
+        fh = open(parfile)
+    out = []
+    with fh:
+        for line in interesting_lines(fh, comments=("#", "C ", "c ")):
+            parts = line.split()
+            out.append((parts[0].upper(), parts[1:]))
+    return out
+
+
+def get_model(parfile, allow_name_mixing=False) -> TimingModel:
+    """(reference: model_builder.py::get_model)"""
+    entries = parse_parfile(parfile)
+    keys = {}
+    repeats = []
+    for k, fields in entries:
+        canon = ALIASES.get(k, k)
+        if canon in ("JUMP", "EFAC", "EQUAD", "ECORR", "DMEFAC", "DMEQUAD"):
+            repeats.append((canon, fields))
+        else:
+            keys[canon] = fields
+
+    model = TimingModel(name=str(parfile) if isinstance(parfile, (str, os.PathLike)) else "")
+    unrecognized = {}
+
+    # --- component selection ---
+    model.add_component(Spindown())
+    if "RAJ" in keys or "DECJ" in keys:
+        model.add_component(AstrometryEquatorial())
+    elif "ELONG" in keys or "ELAT" in keys:
+        model.add_component(AstrometryEcliptic())
+    if "DM" in keys or "DM1" in keys:
+        model.add_component(DispersionDM())
+    if any(k.startswith("DMX_") for k in keys):
+        model.add_component(DispersionDMX())
+    model.add_component(SolarSystemShapiro())
+    if any(c == "JUMP" for c, _ in repeats):
+        model.add_component(PhaseJump())
+    if "BINARY" in keys:
+        from .binary import add_binary_component
+
+        add_binary_component(model, keys["BINARY"][0], keys)
+    if any(c in ("EFAC", "EQUAD", "ECORR", "DMEFAC", "DMEQUAD") for c, _ in repeats) or any(
+            k.startswith(("RNAMP", "RNIDX", "TNRED")) for k in keys):
+        from .noise import ScaleToaError, EcorrNoise, PLRedNoise
+
+        if any(c in ("EFAC", "EQUAD", "DMEFAC", "DMEQUAD") for c, _ in repeats):
+            model.add_component(ScaleToaError())
+        if any(c == "ECORR" for c, _ in repeats):
+            model.add_component(EcorrNoise())
+        if any(k.startswith(("RNAMP", "RNIDX", "TNRED")) for k in keys):
+            model.add_component(PLRedNoise())
+
+    # dynamic prefix families before value assignment
+    sd = model.components["Spindown"]
+    i = 1
+    while f"F{i}" in keys:
+        sd.add_fterm(i)
+        i += 1
+    if "DispersionDM" in model.components:
+        dd = model.components["DispersionDM"]
+        i = 1
+        while f"DM{i}" in keys:
+            dd.add_dmterm(i)
+            i += 1
+    if "DispersionDMX" in model.components:
+        dx = model.components["DispersionDMX"]
+        ids = sorted({split_prefixed_name(k)[1] for k in keys if k.startswith("DMX_")})
+        for idx in ids:
+            lo = float(keys.get(f"DMXR1_{idx:04d}", ["0"])[0])
+            hi = float(keys.get(f"DMXR2_{idx:04d}", ["0"])[0])
+            dx.add_dmx_range(idx, lo, hi)
+
+    # --- assign values ---
+    param_index = {}
+    for comp in model.components.values():
+        for pname in comp.params:
+            par = getattr(comp, pname)
+            param_index[pname.upper()] = par
+            for a in par.aliases:
+                param_index[a.upper()] = par
+
+    for key, fields in keys.items():
+        if key in ("BINARY",):
+            continue
+        if key in TOP_LEVEL_STR:
+            p = strParameter(key)
+            p.value = fields[0] if fields else ""
+            model.add_top_param(p)
+        elif key in TOP_LEVEL_FLOAT:
+            p = floatParameter(key)
+            if fields:
+                p.from_parfile_fields(fields)
+            model.add_top_param(p)
+        elif key in TOP_LEVEL_MJD:
+            p = MJDParameter(key)
+            if fields:
+                p.from_parfile_fields(fields)
+            model.add_top_param(p)
+        elif key == "PLANET_SHAPIRO":
+            model.PLANET_SHAPIRO.from_parfile_fields(fields)
+        elif key in param_index:
+            try:
+                param_index[key].from_parfile_fields(fields)
+            except (ValueError, IndexError) as e:
+                warnings.warn(f"bad par line {key} {fields}: {e}")
+        else:
+            unrecognized[key] = fields
+
+    # --- repeated mask parameters ---
+    jump_comp = model.components.get("PhaseJump")
+    noise_comp = model.components.get("ScaleToaError")
+    ecorr_comp = model.components.get("EcorrNoise")
+    for canon, fields in repeats:
+        if canon == "JUMP" and jump_comp is not None:
+            p = jump_comp.add_jump()
+            p.from_parfile_fields(fields)
+        elif canon in ("EFAC", "EQUAD", "DMEFAC", "DMEQUAD") and noise_comp is not None:
+            noise_comp.add_mask_param(canon, fields)
+        elif canon == "ECORR" and ecorr_comp is not None:
+            ecorr_comp.add_mask_param(fields)
+
+    model.unrecognized = unrecognized
+    if unrecognized:
+        warnings.warn(f"unrecognized par lines: {sorted(unrecognized)}")
+    model.setup()
+    model.validate()
+    return model
+
+
+def get_model_and_toas(parfile, timfile, **kw):
+    """(reference: model_builder.py::get_model_and_toas)"""
+    from ..toa import get_TOAs
+
+    model = get_model(parfile)
+    ephem = "de440s"
+    if "EPHEM" in model.params and model.EPHEM.value:
+        ephem = model.EPHEM.value.lower()
+    planets = bool(model.PLANET_SHAPIRO.value) if "PLANET_SHAPIRO" in model.params else False
+    toas = get_TOAs(timfile, ephem=ephem, planets=planets, **kw)
+    return model, toas

@@ -1,0 +1,143 @@
+"""Dispersion delay components.
+
+(reference: src/pint/models/dispersion_model.py — Dispersion base with
+dispersion_time_delay = DMconst*DM/freq^2, DispersionDM (DM Taylor
+series at DMEPOCH), DispersionDMX (piecewise-constant windows
+DMX_####/DMXR1_####/DMXR2_####).)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..constants import DMconst, SECS_PER_DAY
+from .parameter import MJDParameter, prefixParameter
+from .timing_model import DelayComponent, MissingParameter
+
+
+class DispersionDM(DelayComponent):
+    category = "dispersion"
+    order = 30
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(prefixParameter("DM", "DM", 0, units="pc cm^-3",
+                                       description="Dispersion measure"))
+        self.add_param(MJDParameter("DMEPOCH", units="MJD",
+                                    description="Epoch of DM measurement"))
+
+    def validate(self):
+        if self.DM.value is None:
+            raise MissingParameter("DispersionDM", "DM")
+
+    def n_terms(self):
+        n = 0
+        while f"DM{n + 1}" in self.params:
+            n += 1
+        return n + 1
+
+    def add_dmterm(self, index, value=0.0, frozen=True):
+        p = prefixParameter(f"DM{index}", "DM", index,
+                            units=f"pc cm^-3/yr^{index}", frozen=frozen)
+        p.value = value
+        self.add_param(p)
+
+    def device_slot(self, pname):
+        if pname == "DM":
+            return "DM", 0
+        return "DM", int(pname[2:])
+
+    def pack(self, model, toas, prep, params0):
+        import jax.numpy as jnp
+
+        vals = np.array([getattr(self, f"DM{i}" if i else "DM").value or 0.0
+                         for i in range(self.n_terms())], dtype=np.float64)
+        params0["DM"] = vals
+        de = self.DMEPOCH
+        if de is not None and de.day is not None:
+            day, sec = de.day, de.sec
+        else:
+            day, sec = prep["pepoch_day"], prep["pepoch_sec"]
+        dt = ((toas.tdb.day - day).astype(np.float64) * SECS_PER_DAY
+              + (toas.tdb.sec - sec))
+        prep["dmepoch_dt"] = jnp.asarray(dt)
+
+    def dm_value(self, params, prep):
+        """DM(t) Taylor series [pc/cm^3].
+
+        DM1, DM2, ... follow the par-file convention pc cm^-3 / yr^i
+        (reference: dispersion_model.py DM derivative units), so the
+        Taylor expansion runs in Julian years since DMEPOCH.
+        """
+        from ..constants import SECS_PER_JULIAN_YEAR
+
+        dm = params["DM"]
+        dt = prep["dmepoch_dt"] / SECS_PER_JULIAN_YEAR
+        out = 0.0 * dt
+        fact = 1.0
+        tp = 1.0
+        for i in range(dm.shape[0]):
+            if i > 0:
+                fact *= i
+            out = out + dm[i] * tp / fact
+            tp = tp * dt
+        return out
+
+    def delay(self, params, batch, prep, delay_accum):
+        import jax.numpy as jnp
+
+        dm = self.dm_value(params, prep)
+        f2 = jnp.square(batch.freq_mhz)
+        return jnp.where(jnp.isfinite(f2), DMconst * dm / f2, 0.0)
+
+
+class DispersionDMX(DelayComponent):
+    """Piecewise-constant DM offsets (reference: DispersionDMX)."""
+
+    category = "dispersion_dmx"
+    order = 31
+
+    def __init__(self):
+        super().__init__()
+        self.dmx_ids: list[int] = []
+
+    def add_dmx_range(self, index, mjd_start, mjd_end, value=0.0, frozen=True):
+        from .parameter import floatParameter
+
+        p = prefixParameter(f"DMX_{index:04d}", "DMX_", index,
+                            units="pc cm^-3", frozen=frozen)
+        p.value = value
+        self.add_param(p)
+        r1 = MJDParameter(f"DMXR1_{index:04d}", units="MJD")
+        r1.set_mjd(int(mjd_start), (mjd_start % 1) * SECS_PER_DAY)
+        self.add_param(r1)
+        r2 = MJDParameter(f"DMXR2_{index:04d}", units="MJD")
+        r2.set_mjd(int(mjd_end), (mjd_end % 1) * SECS_PER_DAY)
+        self.add_param(r2)
+        self.dmx_ids.append(index)
+
+    def device_slot(self, pname):
+        if pname.startswith("DMX_"):
+            return "DMX", self.dmx_ids.index(int(pname[4:]))
+        raise KeyError(pname)
+
+    def pack(self, model, toas, prep, params0):
+        import jax.numpy as jnp
+
+        vals = np.array([getattr(self, f"DMX_{i:04d}").value or 0.0
+                         for i in self.dmx_ids], dtype=np.float64)
+        params0["DMX"] = vals
+        mjds = toas.get_mjds()
+        masks = np.zeros((len(self.dmx_ids), len(toas)))
+        for k, i in enumerate(self.dmx_ids):
+            lo = getattr(self, f"DMXR1_{i:04d}").value
+            hi = getattr(self, f"DMXR2_{i:04d}").value
+            masks[k] = (mjds >= lo) & (mjds <= hi)
+        prep["dmx_masks"] = jnp.asarray(masks)
+
+    def delay(self, params, batch, prep, delay_accum):
+        import jax.numpy as jnp
+
+        dm_per_toa = params["DMX"] @ prep["dmx_masks"]
+        f2 = jnp.square(batch.freq_mhz)
+        return jnp.where(jnp.isfinite(f2), DMconst * dm_per_toa / f2, 0.0)

@@ -1,0 +1,53 @@
+"""Phase jumps over TOA subsets.
+
+(reference: src/pint/models/jump.py::PhaseJump — JUMP maskParameters;
+jump_phase = -F0 * JUMP over the selected TOAs.)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .parameter import maskParameter
+from .timing_model import PhaseComponent
+
+
+class PhaseJump(PhaseComponent):
+    category = "phase_jump"
+    order = 40
+
+    def __init__(self):
+        super().__init__()
+        self.jump_ids: list[int] = []
+
+    def add_jump(self, key="", key_value=(), value=0.0, frozen=False, index=None):
+        index = index if index is not None else len(self.jump_ids) + 1
+        p = maskParameter(f"JUMP{index}", "JUMP", index, units="s", frozen=frozen)
+        p.key = key
+        p.key_value = list(key_value)
+        p.value = value
+        self.add_param(p)
+        self.jump_ids.append(index)
+        return p
+
+    def device_slot(self, pname):
+        return "JUMP", self.jump_ids.index(int(pname[4:]))
+
+    def pack(self, model, toas, prep, params0):
+        import jax.numpy as jnp
+
+        if not self.jump_ids:
+            params0["JUMP"] = np.zeros(0)
+            prep["jump_masks"] = jnp.zeros((0, len(toas)))
+            return
+        vals = np.array([getattr(self, f"JUMP{i}").value or 0.0
+                         for i in self.jump_ids])
+        params0["JUMP"] = vals
+        masks = np.stack([getattr(self, f"JUMP{i}").resolve_mask(toas)
+                          for i in self.jump_ids]).astype(np.float64)
+        prep["jump_masks"] = jnp.asarray(masks)
+
+    def phase(self, params, batch, prep, delay_total):
+        # jump in seconds of time; phase shift = -F0 * jump on masked TOAs
+        jump_per_toa = params["JUMP"] @ prep["jump_masks"]
+        return -params["F"][0] * jump_per_toa

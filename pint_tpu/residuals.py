@@ -1,0 +1,159 @@
+"""Residuals: model phase vs observed TOAs.
+
+(reference: src/pint/residuals.py::Residuals — calc_phase_resids with
+nearest-integer or pulse-number tracking, optional weighted-mean
+subtraction; calc_time_resids = phase/F0; chi2/dof/rms.)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .utils import weighted_mean
+
+
+class Residuals:
+    """(reference: residuals.py::Residuals — same public surface).
+
+    Device math happens inside PreparedTiming; this class is the thin
+    host wrapper holding (toas, model) and exposing numpy results.
+    """
+
+    def __init__(self, toas, model, subtract_mean=True, use_weighted_mean=True,
+                 track_mode=None, prepared=None):
+        self.toas = toas
+        self.model = model
+        self.subtract_mean = subtract_mean
+        self.use_weighted_mean = use_weighted_mean
+        if track_mode is None:
+            tm = getattr(model, "TRACK", None)
+            track_mode = ("use_pulse_numbers"
+                          if tm is not None and tm.value == "-2" else "nearest")
+        self.track_mode = track_mode
+        self.prepared = prepared if prepared is not None else model.prepare(toas)
+        self._phase_resids = None
+        self._time_resids = None
+
+    # ---- core ----
+
+    def calc_phase_resids(self, params=None):
+        import jax.numpy as jnp
+
+        frac, pulse_int = self.prepared.phase_frac_and_int(params)
+        if self.track_mode == "use_pulse_numbers":
+            pn = self.prepared.batch.pulse_number
+            resid = jnp.where(jnp.isnan(pn), frac, (pulse_int - pn) + frac)
+        else:
+            resid = frac
+        if self.subtract_mean:
+            if self.use_weighted_mean:
+                sigma = self.prepared.scaled_sigma_us(params)
+                resid = resid - weighted_mean(resid, sigma)
+            else:
+                resid = resid - jnp.mean(resid)
+        return resid
+
+    def calc_time_resids(self, params=None):
+        """Seconds (reference: residuals.py::calc_time_resids)."""
+        f0 = (self.prepared.params0 if params is None else params)["F"][0]
+        return self.calc_phase_resids(params) / f0
+
+    # ---- numpy-facing conveniences ----
+
+    @property
+    def phase_resids(self):
+        if self._phase_resids is None:
+            self._phase_resids = np.asarray(self.calc_phase_resids())
+        return self._phase_resids
+
+    @property
+    def time_resids(self):
+        if self._time_resids is None:
+            self._time_resids = np.asarray(self.calc_time_resids())
+        return self._time_resids
+
+    def rms_weighted(self):
+        """Weighted RMS [s]."""
+        r = self.time_resids
+        w = 1.0 / (np.asarray(self.prepared.scaled_sigma_us()) * 1e-6) ** 2
+        mean = np.sum(r * w) / np.sum(w)
+        return float(np.sqrt(np.sum(w * (r - mean) ** 2) / np.sum(w)))
+
+    def calc_chi2(self, params=None):
+        import jax.numpy as jnp
+
+        r = self.calc_time_resids(params)
+        sigma_s = self.prepared.scaled_sigma_us(params) * 1e-6
+        return jnp.sum(jnp.square(r / sigma_s))
+
+    @property
+    def chi2(self):
+        return float(self.calc_chi2())
+
+    @property
+    def dof(self):
+        n_free = len(self.model.free_params)
+        return len(self.toas) - n_free - 1  # -1 for implicit offset
+
+    @property
+    def reduced_chi2(self):
+        return self.chi2 / self.dof
+
+
+class WidebandDMResiduals:
+    """DM residuals from wideband TOA flags (reference: residuals.py::WidebandDMResiduals).
+
+    Observed DM per TOA comes from -pp_dm/-pp_dme flags; model DM is
+    the DispersionDM/DMX prediction.
+    """
+
+    def __init__(self, toas, model, prepared=None):
+        self.toas = toas
+        self.model = model
+        self.prepared = prepared if prepared is not None else model.prepare(toas)
+        dmvals = toas.get_flag_value("pp_dm", fill="nan")
+        dmerr = toas.get_flag_value("pp_dme", fill="nan")
+        self.dm_observed = np.array([float(v) if v not in ("", "nan") else np.nan
+                                     for v in dmvals])
+        self.dm_error = np.array([float(v) if v not in ("", "nan") else np.nan
+                                  for v in dmerr])
+        self.valid = ~np.isnan(self.dm_observed)
+
+    def calc_dm_resids(self, params=None):
+        p = self.prepared.params0 if params is None else params
+        comp = self.model.components.get("DispersionDM")
+        dm_model = comp.dm_value(p, self.prepared.prep)
+        if "DispersionDMX" in self.model.components:
+            import jax.numpy as jnp
+
+            dmx = p["DMX"] @ self.prepared.prep["dmx_masks"]
+            dm_model = dm_model + dmx
+        return self.dm_observed - np.asarray(dm_model)
+
+    @property
+    def resids(self):
+        return self.calc_dm_resids()[self.valid]
+
+    @property
+    def chi2(self):
+        r = self.calc_dm_resids()
+        return float(np.nansum((r[self.valid] / self.dm_error[self.valid]) ** 2))
+
+
+class WidebandTOAResiduals:
+    """Joint (time, DM) residuals (reference: residuals.py::WidebandTOAResiduals)."""
+
+    def __init__(self, toas, model, prepared=None):
+        self.prepared = prepared if prepared is not None else model.prepare(toas)
+        self.toa = Residuals(toas, model, prepared=self.prepared)
+        self.dm = WidebandDMResiduals(toas, model, prepared=self.prepared)
+        self.model = model
+        self.toas = toas
+
+    @property
+    def chi2(self):
+        return self.toa.chi2 + self.dm.chi2
+
+    @property
+    def dof(self):
+        return self.toa.dof + int(self.dm.valid.sum())

@@ -1,0 +1,122 @@
+"""Simulation: zero-residual fake TOAs + randomized model draws.
+
+(reference: src/pint/simulation.py — make_fake_toas_uniform /
+make_fake_toas_fromMJDs / make_fake_toas_fromtim: iterate the
+phase->time inversion until residuals vanish, then optionally add
+Gaussian measurement noise and correlated noise realizations;
+calculate_random_models.)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .mjd import Epochs
+from .toa import TOA, TOAs
+from .residuals import Residuals
+
+
+def _iterate_zero_residuals(toas: TOAs, model, iterations=4):
+    """Shift TOA times until model residuals are ~0 (sub-ns).
+
+    (reference: simulation.py internal zero_residual iteration)
+    """
+    for _ in range(iterations):
+        toas.apply_clock_corrections()
+        toas.compute_TDBs()
+        toas.compute_posvels()
+        r = Residuals(toas, model, subtract_mean=False, track_mode="nearest")
+        shift = np.asarray(r.calc_time_resids())
+        toas.sec = toas.sec - shift
+        norm = Epochs(toas.day, toas.sec, "utc").normalized()
+        toas.day, toas.sec = norm.day, norm.sec
+        toas.tdb = None
+        toas.ssb_obs = None
+        toas._clock_applied = False
+    toas.apply_clock_corrections()
+    toas.compute_TDBs()
+    toas.compute_posvels()
+    return toas
+
+
+def make_fake_toas_uniform(startMJD, endMJD, ntoas, model, error_us=1.0,
+                           freq_mhz=1400.0, obs="gbt", add_noise=False,
+                           seed=None, iterations=4) -> TOAs:
+    """(reference: simulation.py::make_fake_toas_uniform)"""
+    mjds = np.linspace(startMJD, endMJD, ntoas)
+    return make_fake_toas_fromMJDs(mjds, model, error_us=error_us,
+                                   freq_mhz=freq_mhz, obs=obs,
+                                   add_noise=add_noise, seed=seed,
+                                   iterations=iterations)
+
+
+def make_fake_toas_fromMJDs(mjds, model, error_us=1.0, freq_mhz=1400.0,
+                            obs="gbt", add_noise=False, seed=None,
+                            iterations=4) -> TOAs:
+    """(reference: simulation.py::make_fake_toas_fromMJDs)"""
+    mjds = np.asarray(mjds, dtype=np.float64)
+    freq = np.broadcast_to(np.asarray(freq_mhz, dtype=np.float64), mjds.shape)
+    err = np.broadcast_to(np.asarray(error_us, dtype=np.float64), mjds.shape)
+    toalist = [
+        TOA(int(m), (m - int(m)) * 86400.0, error_us=float(e), freq_mhz=float(f),
+            obs=obs, flags={"simulated": "1"})
+        for m, e, f in zip(mjds, err, freq)
+    ]
+    ephem = "de440s"
+    if "EPHEM" in model.params and model.EPHEM.value:
+        ephem = model.EPHEM.value.lower()
+    planets = bool(model.PLANET_SHAPIRO.value) if "PLANET_SHAPIRO" in model.params else False
+    toas = TOAs(toalist, ephem=ephem, planets=planets)
+    _iterate_zero_residuals(toas, model, iterations=iterations)
+    if add_noise:
+        rng = np.random.default_rng(seed)
+        toas.sec = toas.sec + rng.standard_normal(len(toas)) * err * 1e-6
+        norm = Epochs(toas.day, toas.sec, "utc").normalized()
+        toas.day, toas.sec = norm.day, norm.sec
+        toas.tdb = None
+        toas.ssb_obs = None
+        toas._clock_applied = False
+        toas.apply_clock_corrections()
+        toas.compute_TDBs()
+        toas.compute_posvels()
+    return toas
+
+
+def make_fake_toas_fromtim(timfile, model, add_noise=False, seed=None) -> TOAs:
+    """(reference: simulation.py::make_fake_toas_fromtim)"""
+    from .toa import read_tim_file
+
+    toalist, _ = read_tim_file(str(timfile))
+    ephem = "de440s"
+    if "EPHEM" in model.params and model.EPHEM.value:
+        ephem = model.EPHEM.value.lower()
+    toas = TOAs(toalist, ephem=ephem)
+    _iterate_zero_residuals(toas, model)
+    if add_noise:
+        rng = np.random.default_rng(seed)
+        toas.sec = toas.sec + rng.standard_normal(len(toas)) * toas.error_us * 1e-6
+        toas.tdb = None
+        toas.ssb_obs = None
+        toas._clock_applied = False
+        toas.apply_clock_corrections()
+        toas.compute_TDBs()
+        toas.compute_posvels()
+    return toas
+
+
+def calculate_random_models(fitter, toas, n_models=100, seed=None):
+    """Sample models from the fit covariance; return residual spread [s].
+
+    (reference: simulation.py::calculate_random_models)
+    """
+    rng = np.random.default_rng(seed)
+    prepared = fitter.model.prepare(toas)
+    x0 = np.asarray(prepared.vector_from_params())
+    cov = fitter.parameter_covariance_matrix
+    draws = rng.multivariate_normal(x0, cov, size=n_models)
+    out = np.empty((n_models, len(toas)))
+    r = Residuals(toas, fitter.model, prepared=prepared)
+    for i, x in enumerate(draws):
+        params = prepared.params_with_vector(x)
+        out[i] = np.asarray(r.calc_time_resids(params))
+    return out

@@ -1,0 +1,416 @@
+"""TOA loading, preparation, and device packing.
+
+TPU-native equivalent of the reference's data layer
+(reference: src/pint/toa.py — TOA/TOAs/get_TOAs/read_toa_file). The
+host side parses tim files, applies clock chains, computes TDB and
+solar-system positions; ``TOAs.to_batch()`` then packs everything into
+a ``TOABatch`` pytree of JAX arrays — the single host->device boundary.
+All downstream physics (delays, phases, fits) consumes the batch on
+device; nothing below this layer touches Python objects per-TOA.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from dataclasses import dataclass, field
+from typing import NamedTuple
+
+import numpy as np
+
+from .constants import C_M_S, SECS_PER_DAY
+from .mjd import Epochs, format_mjd, parse_mjd_string
+from . import timescales as ts
+from .utils import PosVel
+
+
+class TOABatch(NamedTuple):
+    """Device-side TOA tensor bundle (all jnp f64 unless noted).
+
+    The reference keeps these as astropy Table columns
+    (reference: toa.py::TOAs.table — 'tdbld', 'freq', 'error',
+    'ssb_obs_pos/vel', 'obs_sun_pos'); here they are plain arrays in
+    fixed units: seconds, MHz, microseconds, light-seconds.
+    """
+
+    tdb_day: object  # f64 integer-valued TDB MJD day
+    tdb_sec: object  # f64 seconds of day
+    freq_mhz: object  # observing frequency (inf = infinite-frequency TOA)
+    error_us: object  # TOA uncertainty
+    obs_pos_ls: object  # (n,3) observatory wrt SSB, light-seconds
+    obs_vel_ls: object  # (n,3) light-seconds/second
+    obs_sun_ls: object  # (n,3) sun wrt observatory, light-seconds
+    planet_pos_ls: object  # (n_planets, n, 3) planets wrt observatory (may be empty)
+    pulse_number: object  # f64 tracked pulse numbers (nan = untracked)
+
+    @property
+    def n_toas(self):
+        return self.tdb_day.shape[-1]
+
+
+@dataclass
+class TOA:
+    """One arrival time (host-side record; reference: toa.py::TOA)."""
+
+    day: int
+    sec: float
+    error_us: float = 1.0
+    freq_mhz: float = np.inf
+    obs: str = "barycenter"
+    flags: dict = field(default_factory=dict)
+
+
+class TOAs:
+    """Host-side TOA table (reference: toa.py::TOAs).
+
+    Columns are numpy arrays; ``flags`` is a list of dicts. Clock,
+    TDB, and posvel computations populate derived columns in place,
+    mirroring the reference pipeline order
+    (apply_clock_corrections -> compute_TDBs -> compute_posvels).
+    """
+
+    PLANETS = ("venus", "mars", "jupiter", "saturn", "uranus", "neptune")
+
+    def __init__(self, toalist: list[TOA], ephem="de440s", planets=False,
+                 include_gps=True, include_bipm=True, bipm_version="BIPM2019"):
+        self.ephem = ephem
+        self.planets = planets
+        self.include_gps = include_gps
+        self.include_bipm = include_bipm
+        self.bipm_version = bipm_version
+        self.commands: list[str] = []
+        self.filename = None
+        n = len(toalist)
+        self.day = np.array([t.day for t in toalist], dtype=np.int64)
+        self.sec = np.array([t.sec for t in toalist], dtype=np.float64)
+        self.error_us = np.array([t.error_us for t in toalist], dtype=np.float64)
+        self.freq_mhz = np.array([t.freq_mhz for t in toalist], dtype=np.float64)
+        self.obs = np.array([t.obs for t in toalist], dtype=object)
+        self.flags = [dict(t.flags) for t in toalist]
+        self.clock_corr_s = np.zeros(n)
+        self.tdb: Epochs | None = None
+        self.ssb_obs: PosVel | None = None
+        self.obs_sun: PosVel | None = None
+        self.planet_pos: dict[str, np.ndarray] = {}
+        self._clock_applied = False
+
+    def __len__(self):
+        return len(self.day)
+
+    # ---- pipeline steps (reference: toa.py same names) ----
+
+    def apply_clock_corrections(self, limits="warn"):
+        from .observatory import get_observatory
+
+        if self._clock_applied:
+            return
+        utc = Epochs(self.day, self.sec, "utc")
+        for obs_name in np.unique(self.obs.astype(str)):
+            ob = get_observatory(obs_name)
+            mask = self.obs.astype(str) == obs_name
+            if ob.timescale == "utc":
+                sub = Epochs(self.day[mask], self.sec[mask], "utc")
+                self.clock_corr_s[mask] = ob.clock_corrections(
+                    sub, include_gps=self.include_gps,
+                    include_bipm=self.include_bipm,
+                    bipm_version=self.bipm_version, limits=limits)
+        self._clock_applied = True
+
+    def compute_TDBs(self):
+        corrected = Epochs(self.day, self.sec + self.clock_corr_s, "utc").normalized()
+        bary = self.obs.astype(str) == "barycenter"
+        if bary.all():
+            self.tdb = Epochs(corrected.day, corrected.sec, "tdb")
+        else:
+            self.tdb = ts.utc_to_tdb(corrected)
+            if bary.any():
+                self.tdb.day[bary] = corrected.day[bary]
+                self.tdb.sec[bary] = corrected.sec[bary]
+
+    def compute_posvels(self):
+        from .observatory import get_observatory
+        from .ephemeris import objPosVel_wrt_SSB
+
+        if self.tdb is None:
+            self.compute_TDBs()
+        n = len(self)
+        pos = np.zeros((n, 3))
+        vel = np.zeros((n, 3))
+        sun = np.zeros((n, 3))
+        utc = Epochs(self.day, self.sec + self.clock_corr_s, "utc").normalized()
+        planet_pos = {p: np.zeros((n, 3)) for p in (self.PLANETS if self.planets else ())}
+        for obs_name in np.unique(self.obs.astype(str)):
+            ob = get_observatory(obs_name)
+            mask = self.obs.astype(str) == obs_name
+            tdb_sub = Epochs(self.tdb.day[mask], self.tdb.sec[mask], "tdb")
+            utc_sub = Epochs(utc.day[mask], utc.sec[mask], "utc")
+            pv = ob.posvel_ssb(tdb_sub, utc_sub, self.ephem)
+            pos[mask] = pv.pos
+            vel[mask] = pv.vel
+            sun_pv = objPosVel_wrt_SSB("sun", tdb_sub, self.ephem)
+            sun[mask] = sun_pv.pos - pv.pos
+            for p in planet_pos:
+                ppv = objPosVel_wrt_SSB(p, tdb_sub, self.ephem)
+                planet_pos[p][mask] = ppv.pos - pv.pos
+        self.ssb_obs = PosVel(pos, vel, origin="ssb", obj="obs")
+        self.obs_sun = PosVel(sun, np.zeros_like(sun), origin="obs", obj="sun")
+        self.planet_pos = planet_pos
+
+    # ---- selection (reference: toa.py::TOAs.select) ----
+
+    def mask(self, condition: np.ndarray) -> "TOAs":
+        out = TOAs([], ephem=self.ephem, planets=self.planets)
+        for attr in ("day", "sec", "error_us", "freq_mhz", "obs", "clock_corr_s"):
+            setattr(out, attr, getattr(self, attr)[condition])
+        out.flags = [f for f, keep in zip(self.flags, condition) if keep]
+        if self.tdb is not None:
+            out.tdb = Epochs(self.tdb.day[condition], self.tdb.sec[condition], "tdb")
+        if self.ssb_obs is not None:
+            out.ssb_obs = PosVel(self.ssb_obs.pos[condition], self.ssb_obs.vel[condition],
+                                 origin="ssb", obj="obs")
+            out.obs_sun = PosVel(self.obs_sun.pos[condition],
+                                 np.zeros((condition.sum(), 3)), origin="obs", obj="sun")
+            out.planet_pos = {p: v[condition] for p, v in self.planet_pos.items()}
+        out._clock_applied = self._clock_applied
+        return out
+
+    def get_flag_value(self, flag: str, fill=""):
+        return np.array([f.get(flag, fill) for f in self.flags], dtype=object)
+
+    def get_pulse_numbers(self):
+        pn = np.full(len(self), np.nan)
+        for i, f in enumerate(self.flags):
+            if "pn" in f:
+                pn[i] = float(f["pn"])
+        return pn
+
+    def get_mjds(self) -> np.ndarray:
+        return Epochs(self.day, self.sec, "utc").mjd_float()
+
+    def first_mjd(self) -> float:
+        return float(self.get_mjds().min())
+
+    def last_mjd(self) -> float:
+        return float(self.get_mjds().max())
+
+    def get_summary(self) -> str:
+        """(reference: toa.py::TOAs.get_summary)"""
+        lines = [f"Number of TOAs: {len(self)}"]
+        for obs_name in np.unique(self.obs.astype(str)):
+            m = self.obs.astype(str) == obs_name
+            lines.append(f"  {obs_name}: {int(m.sum())}")
+        mjds = self.get_mjds()
+        lines.append(f"MJD span: {mjds.min():.3f} to {mjds.max():.3f}")
+        err = self.error_us
+        lines.append(f"TOA errors [us]: min {err.min():.3g}, median "
+                     f"{np.median(err):.3g}, max {err.max():.3g}")
+        return "\n".join(lines)
+
+    # ---- device packing ----
+
+    def to_batch(self) -> TOABatch:
+        import jax.numpy as jnp
+
+        if self.ssb_obs is None:
+            self.compute_posvels()
+        ls = C_M_S  # meters per light-second
+        planet = (np.stack([self.planet_pos[p] for p in self.PLANETS]) / ls
+                  if self.planet_pos else np.zeros((0, len(self), 3)))
+        return TOABatch(
+            tdb_day=jnp.asarray(self.tdb.day, jnp.float64),
+            tdb_sec=jnp.asarray(self.tdb.sec, jnp.float64),
+            freq_mhz=jnp.asarray(self.freq_mhz),
+            error_us=jnp.asarray(self.error_us),
+            obs_pos_ls=jnp.asarray(self.ssb_obs.pos / ls),
+            obs_vel_ls=jnp.asarray(self.ssb_obs.vel / ls),
+            obs_sun_ls=jnp.asarray(self.obs_sun.pos / ls),
+            planet_pos_ls=jnp.asarray(planet),
+            pulse_number=jnp.asarray(self.get_pulse_numbers()),
+        )
+
+    # ---- writing (reference: toa.py::TOAs.write_TOA_file) ----
+
+    def write_TOA_file(self, path, name="pint_tpu", format="tempo2"):
+        with open(path, "w") as f:
+            f.write("FORMAT 1\n")
+            for i in range(len(self)):
+                mjd_str = format_mjd(int(self.day[i]), float(self.sec[i]), 16)
+                flags = " ".join(f"-{k} {v}" for k, v in self.flags[i].items())
+                f.write(f"{name} {self.freq_mhz[i]:.6f} {mjd_str} "
+                        f"{self.error_us[i]:.3f} {self.obs[i]} {flags}\n".rstrip() + "\n")
+
+
+# --------------------------------------------------------------------------
+# tim parsing (reference: toa.py::read_toa_file / _parse_TOA_line)
+# --------------------------------------------------------------------------
+
+_COMMANDS = {"FORMAT", "MODE", "INFO", "INCLUDE", "TIME", "EFAC", "EQUAD",
+             "EMIN", "EMAX", "SKIP", "NOSKIP", "JUMP", "PHASE", "TRACK", "END"}
+
+
+def _parse_tempo2_line(parts):
+    name = parts[0]
+    freq = float(parts[1])
+    day, sec = parse_mjd_string(parts[2])
+    err = float(parts[3])
+    obs = parts[4]
+    flags = {}
+    i = 5
+    while i < len(parts):
+        if parts[i].startswith("-") and not _is_number(parts[i]):
+            key = parts[i][1:]
+            if i + 1 < len(parts) and not (parts[i + 1].startswith("-")
+                                           and not _is_number(parts[i + 1])):
+                flags[key] = parts[i + 1]
+                i += 2
+            else:
+                flags[key] = ""
+                i += 1
+        else:
+            i += 1
+    flags.setdefault("name", name)
+    return TOA(day, sec, err, freq, obs.lower(), flags)
+
+
+def _is_number(s):
+    try:
+        float(s)
+        return True
+    except ValueError:
+        return False
+
+
+def _parse_princeton_line(line):
+    """Princeton format: obs code col 0, freq cols 15-24, MJD 24-44, err 44-53."""
+    obs_code = line[0]
+    freq = float(line[15:24])
+    day, sec = parse_mjd_string(line[24:44].strip())
+    err = float(line[44:53])
+    return TOA(day, sec, err, freq, obs_code.lower(), {})
+
+
+def read_tim_file(path: str, _depth=0) -> tuple[list[TOA], list[str]]:
+    """Parse a tim file into TOA records + commands seen.
+
+    Handles FORMAT 1 (tempo2), princeton fallback, INCLUDE recursion,
+    TIME/EFAC/EQUAD/SKIP/JUMP/PHASE inline commands
+    (reference: toa.py::read_toa_file).
+    """
+    if _depth > 10:
+        raise RuntimeError("INCLUDE recursion too deep")
+    toas: list[TOA] = []
+    commands: list[str] = []
+    fmt = "princeton"
+    skipping = False
+    time_offset = 0.0
+    efac = 1.0
+    equad_us = 0.0
+    jump_level = 0
+    phase_offset = 0
+    with open(path) as f:
+        for raw in f:
+            line = raw.rstrip("\n")
+            ls = line.strip()
+            if not ls or ls.startswith(("#", "C ", "c ")):
+                continue
+            parts = ls.split()
+            head = parts[0].upper()
+            if head in _COMMANDS:
+                commands.append(ls)
+                if head == "FORMAT" and len(parts) > 1 and parts[1] == "1":
+                    fmt = "tempo2"
+                elif head == "INCLUDE":
+                    inc = parts[1]
+                    if not os.path.isabs(inc):
+                        inc = os.path.join(os.path.dirname(path), inc)
+                    sub, subcmd = read_tim_file(inc, _depth + 1)
+                    toas.extend(sub)
+                    commands.extend(subcmd)
+                elif head == "TIME":
+                    time_offset += float(parts[1])
+                elif head == "EFAC":
+                    efac = float(parts[1])
+                elif head == "EQUAD":
+                    equad_us = float(parts[1])
+                elif head == "SKIP":
+                    skipping = True
+                elif head == "NOSKIP":
+                    skipping = False
+                elif head == "JUMP":
+                    jump_level = 1 - jump_level
+                elif head == "PHASE":
+                    phase_offset += int(float(parts[1]))
+                elif head == "END":
+                    break
+                continue
+            if skipping:
+                continue
+            try:
+                if fmt == "tempo2":
+                    toa = _parse_tempo2_line(parts)
+                else:
+                    toa = _parse_princeton_line(line)
+            except (ValueError, IndexError) as e:
+                warnings.warn(f"{path}: unparseable TOA line {ls[:60]!r}: {e}")
+                continue
+            if time_offset:
+                toa.sec += time_offset
+                carry = int(np.floor(toa.sec / SECS_PER_DAY))
+                toa.day += carry
+                toa.sec -= carry * SECS_PER_DAY
+            if efac != 1.0:
+                toa.error_us *= efac
+            if equad_us:
+                toa.error_us = float(np.hypot(toa.error_us, equad_us))
+            if jump_level:
+                toa.flags["tim_jump"] = "1"
+            if phase_offset:
+                toa.flags["phase_offset"] = str(phase_offset)
+            toas.append(toa)
+    return toas, commands
+
+
+def get_TOAs(timfile, ephem="de440s", planets=False, model=None,
+             include_gps=True, include_bipm=True, bipm_version="BIPM2019",
+             limits="warn") -> TOAs:
+    """Load + fully prepare TOAs (reference: toa.py::get_TOAs).
+
+    When ``model`` is given, EPHEM/PLANET_SHAPIRO/CLOCK settings are
+    taken from it, mirroring get_model_and_toas behavior.
+    """
+    if model is not None:
+        ephem = getattr(model, "EPHEM", None) and model.EPHEM.value or ephem
+        if getattr(model, "PLANET_SHAPIRO", None) is not None and model.PLANET_SHAPIRO.value:
+            planets = True
+    toalist, commands = read_tim_file(str(timfile))
+    t = TOAs(toalist, ephem=ephem, planets=planets, include_gps=include_gps,
+             include_bipm=include_bipm, bipm_version=bipm_version)
+    t.commands = commands
+    t.filename = str(timfile)
+    t.apply_clock_corrections(limits=limits)
+    t.compute_TDBs()
+    t.compute_posvels()
+    return t
+
+
+def merge_TOAs(toas_list) -> TOAs:
+    """(reference: toa.py::merge_TOAs)"""
+    first = toas_list[0]
+    out = TOAs([], ephem=first.ephem, planets=first.planets)
+    for attr in ("day", "sec", "error_us", "freq_mhz", "obs", "clock_corr_s"):
+        setattr(out, attr, np.concatenate([getattr(t, attr) for t in toas_list]))
+    out.flags = sum((t.flags for t in toas_list), [])
+    if all(t.tdb is not None for t in toas_list):
+        out.tdb = Epochs(np.concatenate([t.tdb.day for t in toas_list]),
+                         np.concatenate([t.tdb.sec for t in toas_list]), "tdb")
+    if all(t.ssb_obs is not None for t in toas_list):
+        out.ssb_obs = PosVel(np.concatenate([t.ssb_obs.pos for t in toas_list]),
+                             np.concatenate([t.ssb_obs.vel for t in toas_list]),
+                             origin="ssb", obj="obs")
+        out.obs_sun = PosVel(np.concatenate([t.obs_sun.pos for t in toas_list]),
+                             np.zeros((len(out.day), 3)), origin="obs", obj="sun")
+        if all(t.planet_pos for t in toas_list):
+            out.planet_pos = {p: np.concatenate([t.planet_pos[p] for t in toas_list])
+                              for p in toas_list[0].planet_pos}
+    out._clock_applied = all(t._clock_applied for t in toas_list)
+    return out

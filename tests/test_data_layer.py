@@ -1,0 +1,128 @@
+"""Data-layer tests: tim parsing, observatories, ephemeris sanity.
+
+(reference test patterns: tests/test_toa_reader.py, tests/test_observatory.py,
+tests/test_ephemeris.py equivalents.)
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+warnings.simplefilter("ignore")
+
+from pint_tpu.mjd import Epochs
+from pint_tpu.toa import read_tim_file, TOAs, get_TOAs
+from pint_tpu.observatory import get_observatory, list_observatories
+from pint_tpu.ephemeris import objPosVel_wrt_SSB
+
+
+def test_tim_tempo2_format(tmp_path):
+    p = tmp_path / "t.tim"
+    p.write_text(
+        "FORMAT 1\n"
+        "# a comment\n"
+        "fake 1440.0 55000.1234567890123 1.5 gbt -f L-wide -be GUPPI\n"
+        "fake 430.0 55001.5 2.5 ao\n"
+    )
+    toas, commands = read_tim_file(str(p))
+    assert len(toas) == 2
+    assert toas[0].freq_mhz == 1440.0
+    assert toas[0].obs == "gbt"
+    assert toas[0].flags["f"] == "L-wide"
+    assert toas[0].flags["be"] == "GUPPI"
+    assert toas[1].error_us == 2.5
+    assert toas[1].day == 55001 and toas[1].sec == pytest.approx(43200.0)
+
+
+def test_tim_commands(tmp_path):
+    p = tmp_path / "t.tim"
+    p.write_text(
+        "FORMAT 1\n"
+        "EFAC 2.0\n"
+        "a 1440.0 55000.5 1.0 gbt\n"
+        "EFAC 1.0\n"
+        "SKIP\n"
+        "b 1440.0 55001.5 1.0 gbt\n"
+        "NOSKIP\n"
+        "TIME 0.5\n"
+        "cc 1440.0 55002.5 1.0 gbt\n"
+    )
+    toas, _ = read_tim_file(str(p))
+    assert len(toas) == 2
+    assert toas[0].error_us == 2.0
+    assert toas[1].sec == pytest.approx(43200.5)
+
+
+def test_tim_include(tmp_path):
+    inc = tmp_path / "inc.tim"
+    inc.write_text("FORMAT 1\nx 1440.0 55003.5 1.0 gbt\n")
+    p = tmp_path / "t.tim"
+    p.write_text(f"FORMAT 1\nINCLUDE {inc.name}\ny 1440.0 55004.5 1.0 gbt\n")
+    toas, _ = read_tim_file(str(p))
+    assert len(toas) == 2
+
+
+def test_observatory_registry():
+    gbt = get_observatory("gbt")
+    assert np.linalg.norm(gbt.itrf_xyz) == pytest.approx(6.37e6, rel=0.01)
+    assert get_observatory("1") is gbt  # tempo code alias
+    assert get_observatory("GBT") is gbt
+    bat = get_observatory("@")
+    assert bat.timescale == "tdb"
+    assert "parkes" in list_observatories()
+    with pytest.raises(KeyError):
+        get_observatory("not-a-telescope")
+
+
+def test_barycentered_toas_skip_clock_and_geometry():
+    from pint_tpu.toa import TOA
+
+    t = TOAs([TOA(55000, 43200.0, obs="barycenter")])
+    t.apply_clock_corrections()
+    t.compute_TDBs()
+    # barycenter TOAs are already TDB: day/sec unchanged
+    assert t.tdb.day[0] == 55000
+    assert t.tdb.sec[0] == pytest.approx(43200.0)
+    t.compute_posvels()
+    assert np.allclose(t.ssb_obs.pos, 0.0)
+
+
+def test_ephemeris_earth_orbit():
+    t = Epochs(np.arange(54000, 54370, 10), np.zeros(37), "tdb")
+    e = objPosVel_wrt_SSB("earth", t)
+    s = objPosVel_wrt_SSB("sun", t)
+    d_au = np.linalg.norm(e.pos - s.pos, axis=1) / 1.495978707e11
+    assert 0.975 < d_au.min() < 0.985
+    assert 1.013 < d_au.max() < 1.022
+    speed = np.linalg.norm(e.vel, axis=1)
+    assert 2.88e4 < speed.min() and speed.max() < 3.06e4
+
+
+def test_observatory_diurnal_motion():
+    from pint_tpu.earth import gcrs_posvel_from_itrf
+
+    gbt = get_observatory("gbt")
+    utc = Epochs(np.full(25, 55000), np.linspace(0, 86400, 25), "utc")
+    pos, vel = gcrs_posvel_from_itrf(gbt.itrf_xyz, utc)
+    # one sidereal-ish rotation: start/end nearly aligned
+    assert np.linalg.norm(pos[0] - pos[-1]) < 1.2e5  # ~4 min sidereal lag
+    assert np.abs(np.linalg.norm(pos, axis=1) - 6.37e6).max() < 2e4
+    v = np.linalg.norm(vel, axis=1)
+    assert np.allclose(v, v[0], rtol=3e-3)
+
+
+def test_toas_summary_and_select(tmp_path):
+    p = tmp_path / "t.tim"
+    p.write_text(
+        "FORMAT 1\n"
+        "a 1440.0 55000.5 1.0 gbt\n"
+        "b 430.0 55001.5 2.0 ao\n"
+        "cc 1440.0 55002.5 1.5 gbt\n"
+    )
+    t = get_TOAs(str(p))
+    s = t.get_summary()
+    assert "Number of TOAs: 3" in s
+    sub = t.mask(t.freq_mhz > 1000)
+    assert len(sub) == 2
+    assert all(o == "gbt" for o in sub.obs)

@@ -1,0 +1,144 @@
+"""End-to-end slice tests: par+tim -> residuals -> WLS fit.
+
+(reference test patterns: tests/test_B1855.py-style golden comparison —
+here golden = self-consistency of simulate->fit since no external
+TEMPO outputs can exist in this offline environment; plus
+tests/test_fitter.py-style recovery checks.)
+"""
+
+import copy
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+warnings.simplefilter("ignore")
+
+import pint_tpu
+from pint_tpu.models import get_model
+from pint_tpu.residuals import Residuals
+from pint_tpu.fitter import WLSFitter, DownhillWLSFitter
+from pint_tpu.simulation import make_fake_toas_fromMJDs, make_fake_toas_uniform
+
+EXAMPLES = os.path.join(os.path.dirname(pint_tpu.__file__), "data", "examples")
+
+PAR = """
+PSR TEST1
+RAJ 17:48:52.75 1
+DECJ -20:21:29.0 1
+F0 61.485476554 1
+F1 -1.181e-15 1
+PEPOCH 53750
+POSEPOCH 53750
+DM 223.9 1
+"""
+
+
+@pytest.fixture(scope="module")
+def model():
+    return get_model(PAR)
+
+
+@pytest.fixture(scope="module")
+def toas(model):
+    mjds = np.linspace(53000, 54500, 40)
+    freqs = np.where(np.arange(40) % 2, 1400.0, 430.0)
+    return make_fake_toas_fromMJDs(mjds, model, error_us=1.0, freq_mhz=freqs,
+                                   obs="gbt", add_noise=True, seed=42)
+
+
+def test_simulation_zero_residuals(model):
+    t = make_fake_toas_uniform(53100, 54400, 25, model, error_us=1.0,
+                               obs="gbt", add_noise=False)
+    r = Residuals(t, model)
+    # zero-residual iteration should leave < 1 ns
+    assert r.rms_weighted() < 1e-9
+
+
+def test_residual_chi2_sane(model, toas):
+    r = Residuals(toas, model)
+    assert 0.3 < r.reduced_chi2 < 3.0
+
+
+def test_design_matrix_matches_finite_difference(model, toas):
+    prep = model.prepare(toas)
+    M, labels = prep.designmatrix()
+    base = np.asarray(prep._phase_continuous(prep.params0))
+    for pname, eps in [("DM", 1e-6), ("DECJ", 1e-9)]:
+        m2 = copy.deepcopy(model)
+        par = getattr(m2, pname)
+        par.value = par.value + eps
+        p2 = m2.prepare(toas)
+        fd = (np.asarray(p2._phase_continuous(p2.params0)) - base) / eps
+        ad = np.asarray(M[:, labels.index(pname)])
+        np.testing.assert_allclose(fd, ad, rtol=1e-4,
+                                   atol=1e-4 * np.abs(ad).max())
+
+
+def test_wls_recovers_perturbation(model, toas):
+    m2 = copy.deepcopy(model)
+    m2.F0.value += 1e-9
+    m2.F1.value += 2e-17
+    m2.DM.value += 1e-3
+    m2.RAJ.value += 2e-7
+    m2.DECJ.value += 2e-7
+    f = DownhillWLSFitter(toas, m2)
+    f.fit_toas()
+    assert f.resids.reduced_chi2 < 2.0
+    for p in ["F0", "F1", "DM", "RAJ", "DECJ"]:
+        true = getattr(model, p).value
+        fit = getattr(f.model, p).value
+        unc = getattr(f.model, p).uncertainty
+        assert abs(fit - true) < 5 * unc, f"{p} off by {(fit-true)/unc:.1f} sigma"
+
+
+def test_wls_uncertainty_scale(model, toas):
+    f = WLSFitter(toas, copy.deepcopy(model))
+    f.fit_toas()
+    # F0 uncertainty should be roughly sigma_phase/(F0 span scale):
+    # 1 us over 1500 days at 40 TOAs -> ~1e-13 Hz
+    unc = f.model.F0.uncertainty
+    assert 1e-14 < unc < 1e-11
+
+
+def test_example_files_fit():
+    m = get_model(os.path.join(EXAMPLES, "NGC6440E.par"))
+    from pint_tpu.toa import get_TOAs
+
+    t = get_TOAs(os.path.join(EXAMPLES, "NGC6440E.tim"))
+    assert len(t) == 62
+    f = WLSFitter(t, m)
+    f.fit_toas()
+    assert f.resids.reduced_chi2 < 1.6
+    summary = f.get_summary()
+    assert "Chi2" in summary and "F0" in summary
+
+
+def test_parfile_roundtrip(model):
+    s = model.as_parfile()
+    m2 = get_model(s)
+    assert set(m2.free_params) == set(model.free_params)
+    assert m2.F0.value == pytest.approx(model.F0.value, rel=1e-14)
+    assert m2.RAJ.value == pytest.approx(model.RAJ.value, abs=1e-12)
+    assert m2.PEPOCH.day == model.PEPOCH.day
+
+
+def test_tim_roundtrip(model, toas, tmp_path):
+    p = tmp_path / "out.tim"
+    toas.write_TOA_file(p)
+    from pint_tpu.toa import get_TOAs
+
+    t2 = get_TOAs(p)
+    assert len(t2) == len(toas)
+    np.testing.assert_array_equal(t2.day, toas.day)
+    np.testing.assert_allclose(t2.sec, toas.sec, atol=1e-7)  # 16-digit MJD ~ 0.1 ns
+    np.testing.assert_allclose(t2.error_us, toas.error_us, atol=1e-3)
+
+
+def test_phase_connection_across_span(model):
+    """Pulse numbering must be exact across a decade gap."""
+    t = make_fake_toas_uniform(50000, 58000, 30, model, error_us=1.0,
+                               obs="gbt", add_noise=False)
+    r = Residuals(t, model)
+    assert r.rms_weighted() < 1e-9
