@@ -1,0 +1,105 @@
+"""Benchmark: PTA-batch WLS refit throughput on the available chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Workload: 68 synthetic pulsars x N TOAs (default 1000; override with
+PINT_TPU_BENCH_TOAS), one vmapped 3-iteration WLS refit as a single
+jitted program — the BASELINE.json config-5 shape (NANOGrav-15yr-like
+refit; 68 pulsars, ~670k TOAs at full scale).
+
+vs_baseline: the reference publishes no benchmarks (BASELINE.md); the
+driver-set north star is "68 pulsars / 670k TOAs full refit < 60 s".
+We report vs_baseline = 60 s / projected-670k-refit-seconds (>1 beats
+the target), with the projection linear in TOA count.
+"""
+
+import json
+import os
+import time
+import warnings
+
+warnings.simplefilter("ignore")
+
+import numpy as np
+
+
+def build_batch(n_psr, n_toa, seed=0):
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    rng = np.random.default_rng(seed)
+    models, toas_list = [], []
+    for i in range(n_psr):
+        par = (f"PSR BEN{i}\nRAJ {i % 24}:{(7 * i) % 60:02d}:00.0\n"
+               f"DECJ {(i * 3) % 60 - 30}:30:00.0\n"
+               f"F0 {150 + 5 * (i % 40)}.318 1\nF1 -{2 + i % 7}e-16 1\n"
+               f"PEPOCH 55500\nDM {8 + i}.21 1\n")
+        m = get_model(par)
+        mjds = np.sort(rng.uniform(54000, 57000, n_toa))
+        freqs = np.where(np.arange(n_toa) % 2, 1400.0, 800.0)
+        # iterations=0: throughput benchmark doesn't need zero residuals
+        t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=freqs,
+                                    obs="gbt", add_noise=False, iterations=0)
+        models.append(m)
+        toas_list.append(t)
+    return models, toas_list
+
+
+def main():
+    import jax
+
+    from pint_tpu.parallel import PTABatch, make_mesh
+
+    n_psr = int(os.environ.get("PINT_TPU_BENCH_PULSARS", "68"))
+    n_toa = int(os.environ.get("PINT_TPU_BENCH_TOAS", "1000"))
+    maxiter = 3
+
+    t0 = time.time()
+    models, toas_list = build_batch(n_psr, n_toa)
+    host_prep_s = time.time() - t0
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh(min(n_dev, n_psr))
+    t0 = time.time()
+    pta = PTABatch(models, toas_list, mesh=mesh)
+    pack_s = time.time() - t0
+
+    # compile + first run
+    t0 = time.time()
+    x, chi2, cov = pta.wls_fit(maxiter=maxiter)
+    jax.block_until_ready(chi2)
+    compile_s = time.time() - t0
+
+    # steady-state refit
+    runs = 3
+    t0 = time.time()
+    for _ in range(runs):
+        x, chi2, cov = pta.wls_fit(maxiter=maxiter)
+        jax.block_until_ready(chi2)
+    refit_s = (time.time() - t0) / runs
+
+    total_toas = n_psr * n_toa
+    rate = total_toas / refit_s  # TOAs fit per second (3-iter refit)
+    projected_670k = refit_s * (670_000 / total_toas)
+    vs_baseline = 60.0 / projected_670k
+
+    meta = {
+        "n_pulsars": n_psr, "n_toas_per_pulsar": n_toa,
+        "devices": n_dev, "maxiter": maxiter,
+        "host_prep_s": round(host_prep_s, 2), "pack_s": round(pack_s, 2),
+        "compile_s": round(compile_s, 2), "refit_wall_s": round(refit_s, 4),
+        "projected_670k_refit_s": round(projected_670k, 2),
+        "platform": jax.devices()[0].platform,
+    }
+    print(json.dumps({
+        "metric": "pta_wls_refit_toas_per_sec",
+        "value": round(rate, 1),
+        "unit": "TOA/s",
+        "vs_baseline": round(vs_baseline, 3),
+        "detail": meta,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -31,6 +31,11 @@ class Fitter:
         self.resids = self.resids_init
         self.converged = False
 
+    def _track_mode(self):
+        tm = getattr(self.model, "TRACK", None)
+        return ("use_pulse_numbers"
+                if tm is not None and tm.value == "-2" else "nearest")
+
     def get_fitparams(self):
         return {p: getattr(self.model, p) for p in self.model.free_params}
 
@@ -100,29 +105,31 @@ def wls_step(Mw, rw, threshold=1e-12):
 
 
 class WLSFitter(Fitter):
-    """Weighted least squares via SVD (reference: fitter.py::WLSFitter)."""
+    """Weighted least squares via SVD (reference: fitter.py::WLSFitter).
+
+    Prepares + jits once, then iterates the free-parameter vector on
+    device — the exact-delta phase formulation means no host re-pack is
+    needed between iterations.
+    """
 
     def fit_toas(self, maxiter=2, threshold=1e-12):
-        import jax.numpy as jnp
-
-        chi2 = None
+        prepared = self.model.prepare(self.toas)
+        resid_fn = prepared.residual_vector_fn(track_mode=self._track_mode())
+        dm_fn, labels = prepared.designmatrix_fn()
+        x = prepared.vector_from_params()
+        cov_all = None
         for _ in range(maxiter):
-            prepared = self.model.prepare(self.toas)
-            resid = Residuals(self.toas, self.model, prepared=prepared)
-            r = resid.calc_time_resids()
-            sigma_s = prepared.scaled_sigma_us() * 1e-6
-            M, labels = prepared.designmatrix()  # cycles / par-unit
+            r = resid_fn(x)
+            sigma_s = prepared.scaled_sigma_us(prepared.params_with_vector(x)) * 1e-6
+            M = dm_fn(x)
             f0 = prepared.params0["F"][0]
             Mw = (M / f0) / sigma_s[:, None]
             rw = r / sigma_s
             dx_all, cov_all = wls_step(Mw, rw, threshold)
-            # drop the implicit Offset column 0 from the parameter update
-            dx = dx_all[1:]
-            x0 = prepared.vector_from_params()
-            x1 = x0 - dx
-            self._sync_model_from_vector(prepared, x1)
+            x = x - dx_all[1:]
+        self._sync_model_from_vector(prepared, x)
+        if cov_all is not None:
             self._set_uncertainties(prepared, cov_all[1:, 1:])
-            chi2 = float(jnp.sum(jnp.square(rw)))
         self.resids = Residuals(self.toas, self.model)
         self.converged = True
         return self.resids.chi2
@@ -132,36 +139,44 @@ class DownhillWLSFitter(WLSFitter):
     """Step-halving line search on chi2 (reference: fitter.py::DownhillWLSFitter)."""
 
     def fit_toas(self, maxiter=20, threshold=1e-12, min_lambda=1e-3, tol=1e-10):
-        best_chi2 = Residuals(self.toas, self.model).chi2
+        import jax.numpy as jnp
+
+        prepared = self.model.prepare(self.toas)
+        resid_fn = prepared.residual_vector_fn(track_mode=self._track_mode())
+        dm_fn, labels = prepared.designmatrix_fn()
+
+        def chi2_of(x):
+            r = resid_fn(x)
+            sigma_s = prepared.scaled_sigma_us(prepared.params_with_vector(x)) * 1e-6
+            return float(jnp.sum(jnp.square(r / sigma_s)))
+
+        x = prepared.vector_from_params()
+        best_chi2 = chi2_of(x)
+        cov_all = None
         for it in range(maxiter):
-            prepared = self.model.prepare(self.toas)
-            resid = Residuals(self.toas, self.model, prepared=prepared)
-            r = resid.calc_time_resids()
-            sigma_s = prepared.scaled_sigma_us() * 1e-6
-            M, labels = prepared.designmatrix()
+            r = resid_fn(x)
+            sigma_s = prepared.scaled_sigma_us(prepared.params_with_vector(x)) * 1e-6
+            M = dm_fn(x)
             f0 = prepared.params0["F"][0]
             Mw = (M / f0) / sigma_s[:, None]
             rw = r / sigma_s
             dx_all, cov_all = wls_step(Mw, rw, threshold)
             dx = dx_all[1:]
-            cov = cov_all[1:, 1:]
-            x0 = prepared.vector_from_params()
             lam = 1.0
             improved = False
             while lam >= min_lambda:
-                self._sync_model_from_vector(prepared, x0 - lam * dx)
-                chi2 = Residuals(self.toas, self.model).chi2
+                chi2 = chi2_of(x - lam * dx)
                 if chi2 <= best_chi2 + 1e-12:
                     improved = chi2 < best_chi2 - tol * max(1.0, best_chi2)
                     best_chi2 = min(best_chi2, chi2)
+                    x = x - lam * dx
                     break
                 lam *= 0.5
-            else:
-                self._sync_model_from_vector(prepared, x0)  # restore best
+            if lam < min_lambda or not improved:
                 break
-            self._set_uncertainties(prepared, cov)
-            if not improved:
-                break
+        self._sync_model_from_vector(prepared, x)
+        if cov_all is not None:
+            self._set_uncertainties(prepared, cov_all[1:, 1:])
         self.resids = Residuals(self.toas, self.model)
         self.converged = True
         return self.resids.chi2
@@ -178,16 +193,17 @@ class GLSFitter(Fitter):
     batched solve that XLA maps onto the MXU.
     """
 
-    def _noise_bases(self, prepared):
+    def _noise_bases(self, prepared, params=None):
         import jax.numpy as jnp
 
+        p = prepared.params0 if params is None else params
         bases = []
         weights = []
         for comp in self.model.components.values():
             bw = getattr(comp, "basis_weight", None)
             if bw is None:
                 continue
-            B, w = bw(prepared.params0, prepared.prep)
+            B, w = bw(p, prepared.prep)
             if B.shape[1]:
                 bases.append(B)
                 weights.append(w)
@@ -195,20 +211,25 @@ class GLSFitter(Fitter):
             return jnp.concatenate(bases, axis=1), jnp.concatenate(weights)
         return None, None
 
-    def fit_toas(self, maxiter=2, threshold=1e-12):
+    def fit_toas(self, maxiter=2, threshold=1e-12, tol=0.0):
         import jax.numpy as jnp
 
         chi2 = None
+        prepared = self.model.prepare(self.toas)
+        resid_fn = prepared.residual_vector_fn(track_mode=self._track_mode())
+        dm_fn, labels = prepared.designmatrix_fn()
+        x = prepared.vector_from_params()
+        cov = None
+        last_chi2 = None
         for _ in range(maxiter):
-            prepared = self.model.prepare(self.toas)
-            resid = Residuals(self.toas, self.model, prepared=prepared)
-            r = resid.calc_time_resids()  # s
-            sigma_s = prepared.scaled_sigma_us() * 1e-6
-            M, labels = prepared.designmatrix()
+            p = prepared.params_with_vector(x)
+            r = resid_fn(x)
+            sigma_s = prepared.scaled_sigma_us(p) * 1e-6
+            M = dm_fn(x)
             f0 = prepared.params0["F"][0]
             M = M / f0
             nparam = M.shape[1]
-            B, w_us2 = self._noise_bases(prepared)
+            B, w_us2 = self._noise_bases(prepared, p)
             if B is not None:
                 Mfull = jnp.concatenate([M, B], axis=1)
                 phi_inv = jnp.concatenate([
@@ -218,30 +239,45 @@ class GLSFitter(Fitter):
             else:
                 Mfull = M
                 phi_inv = jnp.zeros(nparam)
-            # column normalization for conditioning
-            norm = jnp.sqrt(jnp.sum(jnp.square(Mfull), axis=0))
-            norm = jnp.where(norm == 0, 1.0, norm)
-            Mn = Mfull / norm
+            # whiten, then normalize columns of the whitened matrix so the
+            # eigenvalue threshold measures true degeneracy, not units
             Ninv = 1.0 / jnp.square(sigma_s)
-            # prior penalty on original amplitudes a = dxn/norm:
-            # a^T diag(phi_inv) a -> diag(phi_inv/norm^2) in normalized space
-            A = Mn.T @ (Mn * Ninv[:, None]) + jnp.diag(phi_inv / norm**2)
-            b = Mn.T @ (r * Ninv)
-            L = jnp.linalg.cholesky(A + threshold * jnp.eye(A.shape[0]))
-            dxn = jax_cho_solve(L, b)
+            Mw = Mfull / sigma_s[:, None]
+            norm = jnp.sqrt(jnp.sum(jnp.square(Mw), axis=0))
+            norm = jnp.where(norm == 0, 1.0, norm)
+            Mn = Mw / norm
+            # prior on original amplitudes a = dxn/norm ->
+            # diag(phi_inv/norm^2) in normalized space
+            A = Mn.T @ Mn + jnp.diag(phi_inv / norm**2)
+            b = Mn.T @ (r / sigma_s)
+            # eigh + threshold: degenerate directions get zero update,
+            # matching the reference's SVD small-singular-value drop
+            # (reference: fitter.py::GLSFitter cholesky-with-SVD-fallback)
+            evals, evecs = jnp.linalg.eigh(A)
+            # eigenvalues of the normal matrix are squared singular values,
+            # so threshold**2 matches wls_step's s > threshold*smax cut —
+            # clamped at the f64 eigh noise floor so exactly-degenerate
+            # directions (noise eigenvalues ~eps*max) are still dropped
+            cut = max(threshold**2, 3e-14)
+            good = evals > cut * jnp.max(evals)
+            einv = jnp.where(good, 1.0 / jnp.where(good, evals, 1.0), 0.0)
+            dxn = evecs @ (einv * (evecs.T @ b))
             dx = dxn / norm
-            cov_n = jax_cho_inverse(L)
-            cov = cov_n / jnp.outer(norm, norm)
-            x0 = prepared.vector_from_params()
-            x1 = x0 - dx[1:nparam]
-            self._sync_model_from_vector(prepared, x1)
-            self._set_uncertainties(prepared, cov[1:nparam, 1:nparam])
+            cov = (evecs @ jnp.diag(einv) @ evecs.T) / jnp.outer(norm, norm)
+            x = x - dx[1:nparam]
             # whitened chi2: r^T C^-1 r via the Woodbury identity
             # (with no noise bases this reduces to the plain whitened chi2
             # minus the fitted-parameter improvement, same formula)
             rw2 = jnp.sum(r**2 * Ninv)
             chi2 = float(rw2 - b @ dxn)
             self.noise_ampls = np.asarray(dx[nparam:]) if B is not None else None
+            if (tol and last_chi2 is not None
+                    and abs(last_chi2 - chi2) < tol * max(1.0, abs(last_chi2))):
+                break
+            last_chi2 = chi2
+        self._sync_model_from_vector(prepared, x)
+        if cov is not None:
+            self._set_uncertainties(prepared, cov[1:nparam, 1:nparam])
         self.resids = Residuals(self.toas, self.model)
         self.converged = True
         self.chi2_whitened = chi2
@@ -263,16 +299,14 @@ def jax_cho_inverse(L):
 
 
 class DownhillGLSFitter(GLSFitter):
-    """(reference: fitter.py::DownhillGLSFitter)."""
+    """Iterate GLS to chi2 convergence (reference: fitter.py::DownhillGLSFitter).
 
-    def fit_toas(self, maxiter=10, threshold=1e-12):
-        last = None
-        for _ in range(maxiter):
-            chi2 = super().fit_toas(maxiter=1, threshold=threshold)
-            if last is not None and abs(last - chi2) < 1e-8 * max(1.0, abs(last)):
-                break
-            last = chi2
-        return chi2
+    Delegates to GLSFitter's internal loop (prepare+jit once) with a
+    convergence tolerance rather than re-preparing per outer step.
+    """
+
+    def fit_toas(self, maxiter=10, threshold=1e-12, tol=1e-8):
+        return super().fit_toas(maxiter=maxiter, threshold=threshold, tol=tol)
 
 
 class WidebandTOAFitter(GLSFitter):

@@ -1,0 +1,195 @@
+"""Binary component base: orbital phase with host-reference precision.
+
+(reference: src/pint/models/pulsar_binary.py::PulsarBinary +
+stand_alone_psr_binaries/binary_generic.py::PSR_BINARY and
+binary_orbits.py::OrbitPB/OrbitFBX.)
+
+The reference strips astropy units and calls a standalone numpy model;
+here the analogous split is host/device: the host packs the orbit
+count n_orb(t) at reference parameters in longdouble (exact int+frac,
+like the spindown phi_ref), and the device evaluates only exact small
+deltas — parameter shifts (Sterbenz-exact near-equal subtractions) and
+the accumulated delay shift — so mean anomaly survives TPU's ~47-bit
+f64 for arbitrarily wide orbits and decade spans.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...constants import SECS_PER_DAY, SECS_PER_JULIAN_YEAR
+from ...mjd import LD
+from ..parameter import MJDParameter, floatParameter, prefixParameter
+from ..timing_model import DelayComponent, MissingParameter
+
+_DEG2RAD = np.pi / 180.0
+_TWO_PI = 2.0 * np.pi
+
+
+class PulsarBinary(DelayComponent):
+    category = "pulsar_system"
+    order = 40
+    binary_model_name = "base"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("PB", units="d", description="Orbital period"))
+        self.add_param(floatParameter("PBDOT", units="s/s", description="Orbital period derivative"))
+        self.add_param(floatParameter("A1", units="ls", description="Projected semi-major axis"))
+        self.add_param(floatParameter("A1DOT", units="ls/s", aliases=("XDOT",),
+                                      description="Rate of change of A1"))
+        self.add_param(MJDParameter("T0", units="MJD", description="Epoch of periastron"))
+        self.fb_ids: list[int] = []
+
+    def add_prefix_members(self, keys):
+        """Add FBn orbital-frequency terms found in the par file."""
+        i = 0
+        while f"FB{i}" in keys:
+            p = prefixParameter(f"FB{i}", "FB", i, units=f"1/s^{i+1}")
+            self.add_param(p)
+            self.fb_ids.append(i)
+            i += 1
+
+    def device_slot(self, pname):
+        if pname.startswith("FB") and pname[2:].isdigit():
+            return "FB", self.fb_ids.index(int(pname[2:]))
+        return pname, None
+
+    # ---- epoch helpers ----
+
+    def _epoch_param(self):
+        """The orbital reference epoch parameter (T0 or TASC)."""
+        return self.T0
+
+    def validate(self):
+        if self.A1.value is None:
+            raise MissingParameter(type(self).__name__, "A1")
+        if not self.fb_ids and self.PB.value is None:
+            raise MissingParameter(type(self).__name__, "PB (or FB0)")
+        if self._epoch_param().value is None:
+            raise MissingParameter(type(self).__name__,
+                                   self._epoch_param().name)
+
+    # ---- host pack ----
+
+    def pack(self, model, toas, prep, params0):
+        import jax.numpy as jnp
+
+        ep = self._epoch_param()
+        t0_day, t0_sec = ep.day, ep.sec
+        dt_hi = (toas.tdb.day - t0_day).astype(np.float64) * SECS_PER_DAY
+        dt_lo = toas.tdb.sec - t0_sec
+        prep["orb_dt_hi"] = jnp.asarray(dt_hi)
+        prep["orb_dt_lo"] = jnp.asarray(dt_lo)
+        dt_ld = LD(dt_hi) + LD(dt_lo)
+        pbdot = self.PBDOT.value or 0.0
+        if self.fb_ids:
+            fb = np.array([getattr(self, f"FB{i}").value or 0.0 for i in self.fb_ids])
+            params0["FB"] = fb
+            prep["FB_ref"] = fb
+            norb = np.zeros_like(dt_ld)
+            fact = LD(1.0)
+            for i, f in enumerate(fb):
+                fact = fact * LD(i + 1)
+                norb = norb + LD(f) * dt_ld ** (i + 1) / fact
+            prep["orb_mode_fb"] = True
+        else:
+            pb_s = LD(self.PB.value) * LD(SECS_PER_DAY)
+            phi = dt_ld / pb_s
+            norb = phi - LD(0.5) * LD(pbdot) * phi * phi
+            prep["orb_mode_fb"] = False
+        n_int = np.floor(norb + LD(0.5))
+        prep["norb_ref_frac"] = jnp.asarray((norb - n_int).astype(np.float64))
+        prep["norb_ref_int"] = jnp.asarray(n_int.astype(np.float64))
+        prep["PB_ref"] = self.PB.value or 0.0
+        prep["PBDOT_ref"] = pbdot
+        prep["T0_ref"] = ep.value
+        for pname in self.params:
+            par = getattr(self, pname)
+            if pname.startswith("FB"):
+                continue
+            params0[pname] = par.value if par.value is not None else 0.0
+
+    # ---- device orbital phase ----
+
+    def orbital_phase(self, params, prep, delay_accum):
+        """Mean orbital phase [rad], exact modulo 2*pi.
+
+        (reference: binary_orbits.py::OrbitPB.orbit_phase / OrbitFBX)
+        """
+        import jax.numpy as jnp
+
+        dt = prep["orb_dt_hi"] + prep["orb_dt_lo"]  # f64 collapse, ~8e-6 s err
+        frac = prep["norb_ref_frac"]
+        ep_name = self._epoch_param().name
+        d_epoch_s = (params[ep_name] - prep["T0_ref"]) * SECS_PER_DAY
+        teff_shift = -(delay_accum + d_epoch_s)  # binary time minus ref time
+        if prep["orb_mode_fb"]:
+            FB = params["FB"]
+            FB_ref = prep["FB_ref"]
+            f_orb = jnp.zeros_like(dt)
+            dnorb = jnp.zeros_like(dt)
+            fact = 1.0
+            tp = dt
+            for i in range(FB.shape[0]):
+                fact *= i + 1
+                dnorb = dnorb + (FB[i] - FB_ref[i]) * tp / fact
+                tp = tp * dt
+            # instantaneous orbital frequency for the time-shift term
+            fact = 1.0
+            tp = jnp.ones_like(dt)
+            for i in range(FB.shape[0]):
+                if i > 0:
+                    fact *= i
+                f_orb = f_orb + FB[i] * tp / fact
+                tp = tp * dt
+            dnorb = dnorb + f_orb * teff_shift
+        else:
+            pb_ref_s = prep["PB_ref"] * SECS_PER_DAY
+            pb_s = params["PB"] * SECS_PER_DAY
+            # (1/PB - 1/PB_ref), exact for near-equal values
+            dinv = (prep["PB_ref"] - params["PB"]) / (params["PB"] * prep["PB_ref"] * SECS_PER_DAY)
+            phi_ref = dt / pb_ref_s
+            dnorb = dt * dinv + teff_shift / pb_s
+            # PBDOT delta + cross terms (all small)
+            dnorb = dnorb - 0.5 * (params["PBDOT"] - prep["PBDOT_ref"]) * phi_ref**2
+            dnorb = dnorb - prep["PBDOT_ref"] * phi_ref * (dt * dinv + teff_shift / pb_s)
+        total_frac = frac + dnorb
+        return _TWO_PI * (total_frac - jnp.floor(total_frac + 0.5))
+
+    # ---- shared element helpers (device) ----
+
+    def x_ls(self, params, prep, delay_accum):
+        """Projected semimajor axis x(t) [ls] with A1DOT."""
+        dt = prep["orb_dt_hi"] + prep["orb_dt_lo"] - delay_accum
+        return params["A1"] + params.get("A1DOT", 0.0) * dt
+
+    def omega_rad(self, params, prep, delay_accum, nu=None):
+        """Longitude of periastron [rad]; OMDOT applied linearly in time
+        (or via true anomaly when nu is given, DD-style)."""
+        om = params.get("OM", 0.0) * _DEG2RAD
+        omdot = params.get("OMDOT", 0.0) * _DEG2RAD / SECS_PER_JULIAN_YEAR
+        if nu is not None and "PB" in params:
+            n_orb = _TWO_PI / (params["PB"] * SECS_PER_DAY)
+            return om + (params.get("OMDOT", 0.0) * _DEG2RAD / SECS_PER_JULIAN_YEAR / n_orb) * nu
+        dt = prep["orb_dt_hi"] + prep["orb_dt_lo"] - delay_accum
+        return om + omdot * dt
+
+    def ecc(self, params, prep, delay_accum):
+        dt = prep["orb_dt_hi"] + prep["orb_dt_lo"] - delay_accum
+        return params.get("ECC", 0.0) + params.get("EDOT", 0.0) * dt
+
+
+def kepler_solve(M, e, iters=8):
+    """Eccentric anomaly from mean anomaly, fixed-iteration Newton.
+
+    Fixed count (no data-dependent control flow) so the solve is
+    jit/vmap-safe and differentiable (reference: BT_model.py Newton
+    loop; SURVEY.md 7.3 item 6).
+    """
+    import jax.numpy as jnp
+
+    E = M + e * jnp.sin(M)
+    for _ in range(iters):
+        E = E - (E - e * jnp.sin(E) - M) / (1.0 - e * jnp.cos(E))
+    return E

@@ -1,0 +1,186 @@
+"""DD (Damour & Deruelle 1986) binary model family: DD, DDS, DDK.
+
+(reference: src/pint/models/stand_alone_psr_binaries/DD_model.py::DDmodel,
+DDS_model.py, DDK_model.py; wrappers binary_dd.py, binary_ddk.py.)
+
+Full relativistic timing model: Roemer with e_r/e_theta, Einstein
+(GAMMA sin u), Shapiro (M2/SINI log term), aberration (A0/B0), with
+periastron advance applied via true anomaly.
+
+DDS: SINI reparameterized as 1 - exp(-SHAPMAX).
+DDK: Kopeikin (1995/1996) corrections — annual-orbital parallax and
+proper-motion-induced secular changes of x and omega, from KIN/KOM and
+the packed observatory positions.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...constants import TSUN_S, MASYR_TO_RADS, MAS_TO_RAD, PC_M, C_M_S
+from ..parameter import floatParameter
+from .base import PulsarBinary, kepler_solve
+
+_DEG2RAD = np.pi / 180.0
+
+
+class BinaryDD(PulsarBinary):
+    binary_model_name = "DD"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("ECC", units="", aliases=("E",)))
+        self.add_param(floatParameter("EDOT", units="1/s"))
+        self.add_param(floatParameter("OM", units="deg"))
+        self.add_param(floatParameter("OMDOT", units="deg/yr"))
+        self.add_param(floatParameter("GAMMA", units="s"))
+        self.add_param(floatParameter("M2", units="Msun"))
+        self.add_param(floatParameter("SINI", units=""))
+        self.add_param(floatParameter("DR", units=""))
+        self.add_param(floatParameter("DTH", units=""))
+        self.add_param(floatParameter("A0", units="s", description="Aberration A0"))
+        self.add_param(floatParameter("B0", units="s", description="Aberration B0"))
+
+    def sini(self, params):
+        return params.get("SINI", 0.0)
+
+    def _dd_delay_at(self, params, prep, delay_accum):
+        import jax.numpy as jnp
+
+        M = self.orbital_phase(params, prep, delay_accum)
+        e = self.ecc(params, prep, delay_accum)
+        u = kepler_solve(M, e)
+        su, cu = jnp.sin(u), jnp.cos(u)
+        # true anomaly
+        nu = 2.0 * jnp.arctan2(jnp.sqrt(1.0 + e) * jnp.sin(u / 2.0),
+                               jnp.sqrt(1.0 - e) * jnp.cos(u / 2.0))
+        om = self.omega_rad(params, prep, delay_accum, nu=nu)
+        so, co = jnp.sin(om), jnp.cos(om)
+        x = self.x_ls(params, prep, delay_accum)
+        er = e * (1.0 + params.get("DR", 0.0))
+        eth = e * (1.0 + params.get("DTH", 0.0))
+        # Roemer + Einstein (DD86 eq. 46-52)
+        alpha = x * so
+        beta = x * jnp.sqrt(1.0 - eth**2) * co
+        roemer = alpha * (cu - er) + beta * su
+        einstein = params.get("GAMMA", 0.0) * su
+        # Shapiro (DD86 eq. 26)
+        r = TSUN_S * params.get("M2", 0.0)
+        s = self.sini(params)
+        shapiro = -2.0 * r * jnp.log(1.0 - e * cu
+                                     - s * (so * (cu - e)
+                                            + jnp.sqrt(1.0 - e**2) * co * su))
+        # aberration (DD86 eq. 27)
+        a0 = params.get("A0", 0.0)
+        b0 = params.get("B0", 0.0)
+        aberr = (a0 * (jnp.sin(om + nu) + e * so)
+                 + b0 * (jnp.cos(om + nu) + e * co))
+        return roemer + einstein + shapiro + aberr
+
+    def delay(self, params, batch, prep, delay_accum):
+        d = self._dd_delay_at(params, prep, delay_accum)
+        d = self._dd_delay_at(params, prep, delay_accum + d)
+        return self._dd_delay_at(params, prep, delay_accum + d)
+
+
+class BinaryDDS(BinaryDD):
+    """DDS: high-inclination reparameterization SHAPMAX = -ln(1-SINI)
+    (reference: DDS_model.py::DDSmodel)."""
+
+    binary_model_name = "DDS"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("SHAPMAX", units=""))
+
+    def sini(self, params):
+        import jax.numpy as jnp
+
+        return 1.0 - jnp.exp(-params.get("SHAPMAX", 0.0))
+
+
+class BinaryDDK(BinaryDD):
+    """DDK: Kopeikin annual-orbital parallax + proper-motion terms
+    (reference: DDK_model.py::DDKmodel; params KIN, KOM).
+
+    x and omega acquire (a) secular drifts from proper motion and
+    (b) annual terms from the observatory's SSB orbit projected on the
+    sky basis (I0, J0) — both require KIN/KOM and PX from astrometry.
+    """
+
+    binary_model_name = "DDK"
+    needs_batch = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("KIN", units="deg", description="Inclination"))
+        self.add_param(floatParameter("KOM", units="deg",
+                                      description="Long. of ascending node"))
+        self.add_param(floatParameter("K96", units="", description="Apply K96 PM terms"))
+
+    def sini(self, params):
+        import jax.numpy as jnp
+
+        return jnp.sin(params.get("KIN", 0.0) * _DEG2RAD)
+
+    def pack(self, model, toas, prep, params0):
+        super().pack(model, toas, prep, params0)
+        # sky basis for Kopeikin terms: unit vectors east (I0) and
+        # north (J0) at the reference position
+        astrom = next(c for c in model.delay_components()
+                      if c.category == "astrometry")
+        import jax.numpy as jnp
+
+        n = np.asarray(astrom.ssb_to_psb_xyz(
+            {k: np.asarray(v) for k, v in params0.items()}, prep))[0]
+        zhat = np.array([0.0, 0.0, 1.0])
+        east = np.cross(zhat, n)
+        east /= np.linalg.norm(east)
+        north = np.cross(n, east)
+        prep["ddk_east"] = jnp.asarray(east)
+        prep["ddk_north"] = jnp.asarray(north)
+        # proper motion [rad/s] in (east, north)
+        pm_e = (model.PMRA.value or 0.0) if "PMRA" in model.params else (
+            model.PMELONG.value or 0.0)
+        pm_n = (model.PMDEC.value or 0.0) if "PMDEC" in model.params else (
+            model.PMELAT.value or 0.0)
+        prep["ddk_pm_e"] = pm_e * MASYR_TO_RADS
+        prep["ddk_pm_n"] = pm_n * MASYR_TO_RADS
+        px = model.PX.value if "PX" in model.params and model.PX.value else 0.0
+        prep["ddk_dist_ls"] = (1000.0 / px * PC_M / C_M_S) if px else np.inf
+
+    def _kopeikin_xom(self, params, batch, prep, delay_accum):
+        """(delta_x, delta_omega) from proper motion + annual parallax."""
+        import jax.numpy as jnp
+
+        kin = params.get("KIN", 0.0) * _DEG2RAD
+        kom = params.get("KOM", 0.0) * _DEG2RAD
+        sk, ck = jnp.sin(kom), jnp.cos(kom)
+        x = params["A1"]
+        dt = prep["orb_dt_hi"] + prep["orb_dt_lo"] - delay_accum
+        mu_e, mu_n = prep["ddk_pm_e"], prep["ddk_pm_n"]
+        cot_i = jnp.cos(kin) / jnp.sin(kin)
+        csc_i = 1.0 / jnp.sin(kin)
+        # K96 proper-motion secular terms (Kopeikin 1996 eq. 10-11)
+        dx_pm = x * cot_i * (-mu_e * sk + mu_n * ck) * dt
+        dom_pm = csc_i * (mu_e * ck + mu_n * sk) * dt
+        # annual-orbital parallax (Kopeikin 1995 eq. 15-16)
+        robs = batch.obs_pos_ls  # [ls]
+        d_ls = prep["ddk_dist_ls"]
+        de = jnp.sum(robs * prep["ddk_east"], axis=-1) / d_ls
+        dn = jnp.sum(robs * prep["ddk_north"], axis=-1) / d_ls
+        dx_px = x * cot_i * (de * sk - dn * ck)
+        dom_px = -csc_i * (de * ck + dn * sk)
+        return dx_pm + dx_px, dom_pm + dom_px
+
+    def delay(self, params, batch, prep, delay_accum):
+        self._batch = batch
+        return super().delay(params, batch, prep, delay_accum)
+
+    def x_ls(self, params, prep, delay_accum):
+        dx, _ = self._kopeikin_xom(params, self._batch, prep, delay_accum)
+        return super().x_ls(params, prep, delay_accum) + dx
+
+    def omega_rad(self, params, prep, delay_accum, nu=None):
+        _, dom = self._kopeikin_xom(params, self._batch, prep, delay_accum)
+        return super().omega_rad(params, prep, delay_accum, nu=nu) + dom

@@ -137,27 +137,40 @@ class strParameter(Parameter):
 class MJDParameter(Parameter):
     """Epoch parameter held as exact (day, sec) (reference: MJDParameter).
 
-    ``.value`` is float MJD (lossy, for display); ``.day``/``.sec`` are
-    exact and are what prepare() uses.
+    ``.value`` is float MJD; assigning it (e.g. from a fitter update)
+    re-derives ``.day``/``.sec``, which keep full precision when set
+    via ``from_parfile_fields``/``set_mjd``.
     """
 
     kind = "mjd"
 
     def __init__(self, *a, **kw):
-        super().__init__(*a, **kw)
         self.day = None
         self.sec = None
+        super().__init__(*a, **kw)
+
+    @property
+    def value(self):
+        return self._value
+
+    @value.setter
+    def value(self, v):
+        self._value = v
+        if v is not None:
+            day = int(np.floor(v))
+            self.day = day
+            self.sec = (v - day) * SECS_PER_DAY
 
     def from_parfile_fields(self, fields):
         self.day, self.sec = parse_mjd_string(fields[0])
-        self.value = self.day + self.sec / SECS_PER_DAY
+        self._value = self.day + self.sec / SECS_PER_DAY
         self.frozen, unc = _parse_fit_and_unc(fields[1:])
         if unc is not None:
             self.uncertainty = _float(unc)
 
     def set_mjd(self, day, sec):
         self.day, self.sec = int(day), float(sec)
-        self.value = self.day + self.sec / SECS_PER_DAY
+        self._value = self.day + self.sec / SECS_PER_DAY
 
     def _format_value(self):
         return format_mjd(self.day, self.sec, 11)

@@ -368,6 +368,70 @@ class PreparedTiming:
             self._fns[name] = jax.jit(fn)
         return self._fns[name]
 
+    def residual_vector_fn(self, subtract_mean=True, use_weighted_mean=True,
+                           track_mode="nearest"):
+        """Jitted x -> whitened-ready time residuals [s] as a function of
+        the free-param vector. The exact-delta phase formulation makes
+        this valid for any x without re-preparing (the host reference
+        terms are constants, not an approximation), so fit loops run
+        entirely on device.
+
+        track_mode 'use_pulse_numbers' honors tim-file pn flags /
+        TRACK -2 (reference: residuals.py track_mode) instead of
+        wrapping to the nearest turn.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from ..utils import weighted_mean
+
+        key = ("residfn", subtract_mean, use_weighted_mean, track_mode,
+               tuple(n for n, _, _ in self.free_param_map()))
+        if key not in self._fns:
+            def f(x):
+                p = self.params_with_vector(x)
+                frac = self._phase_continuous(p)
+                if track_mode == "use_pulse_numbers":
+                    # full phase minus assigned pulse number; untracked
+                    # TOAs fall back to nearest-turn wrapping
+                    pn = self.batch.pulse_number
+                    tracked = (self.prep["phi_ref_int"] - pn) + frac
+                    wrapped = frac - jnp.floor(frac + 0.5)
+                    resid = jnp.where(jnp.isnan(pn), wrapped, tracked)
+                else:
+                    resid = frac - jnp.floor(frac + 0.5)
+                if subtract_mean:
+                    if use_weighted_mean:
+                        sigma = self.scaled_sigma_us(p)
+                        resid = resid - weighted_mean(resid, sigma)
+                    else:
+                        resid = resid - jnp.mean(resid)
+                return resid / p["F"][0]
+
+            self._fns[key] = jax.jit(f)
+        return self._fns[key]
+
+    def designmatrix_fn(self, incoffset=True):
+        """Jitted x -> (n_toa, n_free[+1]) phase-derivative matrix."""
+        import jax
+        import jax.numpy as jnp
+
+        labels = [n for n, _, _ in self.free_param_map()]
+        key = ("dmfn", incoffset, tuple(labels))
+        if key not in self._fns:
+            def f(x):
+                return self._phase_continuous(self.params_with_vector(x))
+
+            def dm(x):
+                M = jax.jacfwd(f)(x)
+                if incoffset:
+                    M = jnp.concatenate([jnp.ones((M.shape[0], 1)), M], axis=1)
+                return M
+
+            self._fns[key] = jax.jit(dm)
+        labels_out = (["Offset"] + labels) if incoffset else labels
+        return self._fns[key], labels_out
+
     def designmatrix(self, params=None, incoffset=True):
         """M[i,j] = d(phase_i)/d(param_j) in cycles/par-unit, via jacfwd.
 
@@ -377,21 +441,6 @@ class PreparedTiming:
         columns, no 50-function registry. Column 0 is the implicit
         phase offset (reference: 'Offset' column).
         """
-        import jax
-        import jax.numpy as jnp
-
         p = self.params0 if params is None else params
-        x0 = self.vector_from_params(p)
-
-        def f(x):
-            return self._phase_continuous(self.params_with_vector(x))
-
-        key = ("dm", incoffset)
-        if key not in self._fns:
-            self._fns[key] = jax.jit(jax.jacfwd(f))
-        M = self._fns[key](x0)
-        labels = [name for (name, _, _) in self.free_param_map()]
-        if incoffset:
-            M = jnp.concatenate([jnp.ones((M.shape[0], 1)), M], axis=1)
-            labels = ["Offset"] + labels
-        return M, labels
+        fn, labels = self.designmatrix_fn(incoffset=incoffset)
+        return fn(self.vector_from_params(p)), labels

@@ -1,0 +1,38 @@
+"""Device-mesh helpers for PTA-scale fits.
+
+The reference has no distributed execution (SURVEY.md section 2.2);
+this layer is the TPU-native design: a (pulsar, toa) mesh where
+per-pulsar fits ride the 'pulsar' axis (pure data parallelism, zero
+collectives inside a fit) and the TOA axis of very long single-pulsar
+datasets can be sharded with psum-reductions for the few cross-TOA
+couplings (weighted mean, normal-equation accumulation). Collectives
+ride ICI within a slice; DCN multi-slice is out of scope for one host.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_pulsar_shards=None, devices=None) -> Mesh:
+    """1-D 'pulsar' mesh over available devices."""
+    devices = devices if devices is not None else jax.devices()
+    n = n_pulsar_shards or len(devices)
+    return Mesh(np.array(devices[:n]), axis_names=("pulsar",))
+
+
+def shard_batch(tree, mesh: Mesh):
+    """Place a stacked per-pulsar pytree with the pulsar axis sharded."""
+    sharding = NamedSharding(mesh, P("pulsar"))
+
+    def put(x):
+        return jax.device_put(x, sharding)
+
+    return jax.tree_util.tree_map(put, tree)
+
+
+def replicate(tree, mesh: Mesh):
+    sharding = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)

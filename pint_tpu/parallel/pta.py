@@ -1,0 +1,295 @@
+"""PTA batch fitting: vmap over pulsars, pjit over a device mesh.
+
+This is the BASELINE.json north-star path (no reference counterpart —
+the reference fits pulsars one at a time in a Python loop): stack many
+pulsars' prepared models into one pytree, vmap the whole WLS/GLS
+iteration, and shard the pulsar axis across TPU chips with
+jax.sharding. A full PTA refit is then ONE jitted program.
+
+Requirements: all pulsars share the same model *structure* (component
+set, F order, mask/basis counts — pad counts to the max). TOA counts
+are padded to the batch max with sigma=1e30 sentinels so padded rows
+vanish from every whitened reduction.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..models.timing_model import PreparedTiming
+
+_EXCLUDE_KEYS = ("T_ld", "pepoch_day", "pepoch_sec")
+_STATIC_KEYS = ("orb_mode_fb", "planet_shapiro", "obliquity")
+_PAD_SIGMA = 1e30
+
+
+def _toa_dim_pad(arr, n_toa, n_max):
+    """Pad only dimensions equal to this pulsar's own TOA count.
+
+    Non-TOA axes (Taylor orders, mask counts, basis columns) must NOT
+    be touched here — ragged counts there are padded with zeros later
+    by _pad_to across the batch.
+    """
+    a = np.asarray(arr)
+    if n_toa == n_max:
+        return a
+    if a.ndim == 1 and a.shape[0] == n_toa:
+        a = np.concatenate([a, np.repeat(a[-1:], n_max - n_toa, axis=0)])
+    elif a.ndim == 2:
+        if a.shape[1] == n_toa:  # (k, n_toa) masks
+            a = np.concatenate(
+                [a, np.zeros((a.shape[0], n_max - n_toa))], axis=1)
+        elif a.shape[0] == n_toa:  # (n_toa, k) bases
+            a = np.concatenate(
+                [a, np.zeros((n_max - n_toa, a.shape[1]))], axis=0)
+    return a
+
+
+def _pad_to(a, shape):
+    out = np.zeros(shape, dtype=np.asarray(a).dtype)
+    sl = tuple(slice(0, s) for s in np.asarray(a).shape)
+    out[sl] = np.asarray(a)
+    return out
+
+
+def stack_prepared(preps: list[PreparedTiming]):
+    """Stack same-structure PreparedTimings into batched pytrees.
+
+    Returns (params_stack, prep_stack, batch_stack, static, n_toas).
+    """
+    import jax.numpy as jnp
+
+    n_max = max(p.batch.n_toas for p in preps)
+    n_toas = np.array([p.batch.n_toas for p in preps])
+
+    # --- params: same keys; vector lengths padded to max
+    keys = preps[0].params0.keys()
+    params_stack = {}
+    for k in keys:
+        arrs = [np.atleast_1d(np.asarray(p.params0[k])) for p in preps]
+        klen = max(a.shape[0] for a in arrs)
+        params_stack[k] = jnp.asarray(
+            np.stack([_pad_to(a, (klen,)) if a.ndim else a for a in arrs]))
+        if np.asarray(preps[0].params0[k]).ndim == 0:
+            params_stack[k] = params_stack[k][:, 0]
+
+    # --- prep: pad TOA dims and ragged mask/basis counts
+    static = {}
+    prep_stack = {}
+    for k in preps[0].prep:
+        if k in _EXCLUDE_KEYS:
+            continue
+        vals = [p.prep[k] for p in preps]
+        if k in _STATIC_KEYS:
+            assert all(np.all(v == vals[0]) for v in vals), \
+                f"prep[{k}] must be uniform across the PTA batch"
+            static[k] = vals[0]
+            continue
+        arrs = [np.asarray(_toa_dim_pad(v, p.batch.n_toas, n_max))
+                for v, p in zip(vals, preps)]
+        shape = tuple(max(a.shape[i] for a in arrs) for i in range(arrs[0].ndim))
+        prep_stack[k] = jnp.asarray(np.stack([_pad_to(a, shape) for a in arrs]))
+
+    # --- batch: pad TOA axis; sentinel sigma on padded rows
+    from ..toa import TOABatch
+
+    fields = {}
+    for name in TOABatch._fields:
+        arrs = []
+        for p in preps:
+            a = np.asarray(getattr(p.batch, name))
+            n = p.batch.n_toas
+            if name == "error_us":
+                a = np.concatenate([a, np.full(n_max - n, _PAD_SIGMA)])
+            elif a.ndim >= 1 and a.shape[-1] == n and name != "planet_pos_ls":
+                pad = n_max - n
+                a = np.concatenate([a, np.repeat(a[-1:], pad, axis=0)], axis=0) \
+                    if a.ndim == 1 else a
+            if name == "obs_pos_ls" or name == "obs_vel_ls" or name == "obs_sun_ls":
+                if a.shape[0] != n_max:
+                    a = np.concatenate(
+                        [a, np.repeat(a[-1:], n_max - a.shape[0], axis=0)], axis=0)
+            if name == "planet_pos_ls":
+                if a.shape[0] and a.shape[1] != n_max:
+                    a = np.concatenate(
+                        [a, np.repeat(a[:, -1:], n_max - a.shape[1], axis=1)], axis=1)
+            if name in ("tdb_day", "tdb_sec", "freq_mhz", "pulse_number") \
+                    and a.shape[0] != n_max:
+                a = np.concatenate([a, np.repeat(a[-1:], n_max - a.shape[0])])
+            arrs.append(a)
+        shape = tuple(max(x.shape[i] for x in arrs) for i in range(arrs[0].ndim)) \
+            if arrs[0].ndim else ()
+        fields[name] = jnp.asarray(np.stack([_pad_to(a, shape) for a in arrs]))
+    batch_stack = TOABatch(**fields)
+    return params_stack, prep_stack, batch_stack, static, n_toas
+
+
+def pure_phase_fn(template_model, static):
+    """(params, batch, prep) -> continuous phase; pure, closure-free over
+    data so it vmaps over pulsars and shard_maps over the TOA axis."""
+    delay_comps = template_model.delay_components()
+    phase_comps = template_model.phase_components()
+
+    def phase(params, batch, prep):
+        import jax.numpy as jnp
+
+        full_prep = {**prep, **static}
+        d = jnp.zeros_like(batch.tdb_sec)
+        for c in delay_comps:
+            if getattr(c, "needs_batch", False):
+                c._batch = batch
+            d = d + c.delay(params, batch, full_prep, d)
+        ph = jnp.zeros_like(d)
+        for c in phase_comps:
+            ph = ph + c.phase(params, batch, full_prep, d)
+        return ph
+
+    return phase
+
+
+def pure_sigma_fn(template_model, static):
+    comps = [c for c in template_model.components.values()
+             if getattr(c, "scale_sigma", None) is not None]
+
+    def sigma_us(params, batch, prep):
+        s = batch.error_us
+        for c in comps:
+            s = c.scale_sigma(params, batch, {**prep, **static}, s)
+        return s
+
+    return sigma_us
+
+
+class PTABatch:
+    """Batched multi-pulsar fitting (the reference's per-pulsar Python
+    loop becomes one vmapped, mesh-sharded program).
+
+    All models must share component structure; see stack_prepared.
+    """
+
+    def __init__(self, models, toas_list, mesh=None):
+        self.models = models
+        self.toas_list = toas_list
+        self.preps = [m.prepare(t) for m, t in zip(models, toas_list)]
+        (self.params, self.prep, self.batch, self.static,
+         self.n_toas) = stack_prepared(self.preps)
+        self.template = models[0]
+        self.mesh = mesh
+        if mesh is not None:
+            from .mesh import shard_batch
+
+            self.params = shard_batch(self.params, mesh)
+            self.prep = shard_batch(self.prep, mesh)
+            self.batch = shard_batch(self.batch, mesh)
+        self._fns = {}
+
+    # -- single-pulsar kernel (closed over static config only) --
+
+    def _phase_fn(self):
+        return pure_phase_fn(self.template, self.static)
+
+    def _sigma_fn(self):
+        return pure_sigma_fn(self.template, self.static)
+
+    def _resid_fn(self):
+        phase = self._phase_fn()
+        sigma_fn = self._sigma_fn()
+
+        def resid_seconds(params, batch, prep):
+            import jax.numpy as jnp
+
+            ph = phase(params, batch, prep)
+            frac = ph - jnp.floor(ph + 0.5)
+            sig = sigma_fn(params, batch, prep)
+            w = 1.0 / jnp.square(sig)
+            frac = frac - jnp.sum(frac * w) / jnp.sum(w)
+            return frac / params["F"][0], sig
+
+        return resid_seconds
+
+    def free_map(self):
+        """Free-parameter layout of the template (uniform across batch)."""
+        return self.preps[0].free_param_map()
+
+    def _overlay(self, params, x):
+        out = dict(params)
+        for i, (_, key, idx) in enumerate(self.free_map()):
+            v = out[key]
+            if v.ndim == 0 or idx is None:
+                out[key] = x[i]
+            else:
+                out = {**out, key: v.at[idx].set(x[i])}
+        return out
+
+    def _x0(self):
+        import jax.numpy as jnp
+        import jax
+
+        def pull_one(params):
+            vals = []
+            for (_, key, idx) in self.free_map():
+                v = params[key]
+                vals.append(v if (v.ndim == 0 or idx is None) else v[idx])
+            return jnp.stack(vals)
+
+        return jax.vmap(pull_one)(self.params)
+
+    def wls_fit(self, maxiter=3, threshold=1e-12):
+        """Vmapped, mesh-sharded multi-pulsar WLS fit.
+
+        Returns (x_fit (n_psr, n_free), chi2 (n_psr,), cov (n_psr, k, k)).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        resid_fn = self._resid_fn()
+
+        def one_step(x, params, batch, prep):
+            p = self._overlay(params, x)
+            r, sig = resid_fn(p, batch, prep)
+            sigma_s = sig * 1e-6
+
+            def phase_of(xv):
+                pp = self._overlay(params, xv)
+                ph = self._phase_fn()(pp, batch, prep)
+                return ph
+
+            M = jax.jacfwd(phase_of)(x) / p["F"][0]
+            M = jnp.concatenate([jnp.ones((M.shape[0], 1)), M], axis=1)
+            Mw = M / sigma_s[:, None]
+            rw = r / sigma_s
+            norm = jnp.sqrt(jnp.sum(jnp.square(Mw), axis=0))
+            norm = jnp.where(norm == 0, 1.0, norm)
+            Mn = Mw / norm
+            U, s, Vt = jnp.linalg.svd(Mn, full_matrices=False)
+            sinv = jnp.where(s > threshold * jnp.max(s), 1.0 / s, 0.0)
+            dx = (Vt.T @ (sinv * (U.T @ rw))) / norm
+            cov = (Vt.T @ jnp.diag(sinv**2) @ Vt) / jnp.outer(norm, norm)
+            chi2 = jnp.sum(jnp.square(rw - Mw @ dx))
+            return x - dx[1:], chi2, cov[1:, 1:]
+
+        def fit_one(x0, params, batch, prep):
+            x = x0
+            for _ in range(maxiter):
+                x, chi2, cov = one_step(x, params, batch, prep)
+            return x, chi2, cov
+
+        key = ("wls", maxiter, threshold)
+        if key not in self._fns:
+            self._fns[key] = jax.jit(jax.vmap(fit_one))
+        return self._fns[key](self._x0(), self.params, self.batch, self.prep)
+
+    def time_residuals(self):
+        """(n_psr, n_toa_max) residual seconds + validity mask."""
+        import jax
+        import jax.numpy as jnp
+
+        resid_fn = self._resid_fn()
+
+        def one(params, batch, prep):
+            r, sig = resid_fn(params, batch, prep)
+            return r
+
+        r = jax.jit(jax.vmap(one))(self.params, self.batch, self.prep)
+        mask = np.arange(r.shape[1])[None, :] < self.n_toas[:, None]
+        return r, mask

@@ -1,0 +1,75 @@
+"""TOA-axis sharding: the sequence-parallel analog for pulsar timing.
+
+SURVEY.md section 5 ("long-context"): the reference's long axis is the
+TOA/photon axis (up to ~1e7 photons) processed in one address space.
+Here the axis is sharded across the device mesh with jax.shard_map —
+delays/phases are pointwise per TOA (zero communication); the only
+cross-TOA couplings are the weighted mean (one psum) and
+normal-equation accumulation M^T W M (psum of per-shard partials).
+Ring attention/Ulysses-style machinery is explicitly unnecessary —
+there is no all-to-all coupling along the axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def sharded_residuals(template_model, static, mesh: Mesh, params, batch, prep,
+                      axis="toa"):
+    """Residual seconds with the TOA axis sharded over ``mesh``.
+
+    params are replicated; batch/prep arrays are sharded on their TOA
+    dimension. Returns a sharded residual array.
+    """
+    from .pta import pure_phase_fn, pure_sigma_fn
+
+    phase = pure_phase_fn(template_model, static)
+    sigma_fn = pure_sigma_fn(template_model, static)
+
+    def local(params, batch, prep):
+        ph = phase(params, batch, prep)
+        frac = ph - jnp.floor(ph + 0.5)
+        sig = sigma_fn(params, batch, prep)
+        w = 1.0 / jnp.square(sig)
+        # weighted mean needs the global sums: one psum each
+        sw = jax.lax.psum(jnp.sum(frac * w), axis)
+        tw = jax.lax.psum(jnp.sum(w), axis)
+        frac = frac - sw / tw
+        return frac / params["F"][0]
+
+    def spec_for(x):
+        # shard the leading/TOA dimension where present
+        if getattr(x, "ndim", 0) == 0:
+            return P()
+        return P(axis) if x.shape[0] != 3 else P()
+
+    batch_specs = jax.tree_util.tree_map(
+        lambda x: P(axis) if getattr(x, "ndim", 0) >= 1 and x.shape[0] > 3 else P(),
+        batch)
+    prep_specs = jax.tree_util.tree_map(
+        lambda x: (P(axis) if getattr(x, "ndim", 0) >= 1
+                   and x.shape[-1] == batch.tdb_sec.shape[0] else P()), prep)
+    # masks (k, n_toa) shard on dim 1
+    prep_specs = {
+        k: (P(None, axis) if getattr(prep[k], "ndim", 0) == 2
+            and prep[k].shape[1] == batch.tdb_sec.shape[0] else v)
+        for k, v in prep_specs.items()
+    }
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(), params),
+                  batch_specs, prep_specs),
+        out_specs=P(axis))
+    return fn(params, batch, prep)
+
+
+def sharded_chi2(template_model, static, mesh, params, batch, prep, axis="toa"):
+    """Whitened chi2 with TOA-sharded reduction (psum)."""
+    r = sharded_residuals(template_model, static, mesh, params, batch, prep, axis)
+    from .pta import pure_sigma_fn
+
+    sig = pure_sigma_fn(template_model, static)(params, batch, prep) * 1e-6
+    return jnp.sum(jnp.square(r / sig))

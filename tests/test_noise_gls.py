@@ -1,0 +1,140 @@
+"""Noise model + GLS fitter tests.
+
+(reference test patterns: tests/test_gls_fitter.py, tests/test_ecorr*,
+tests/test_wls_wb_fitters* — golden NANOGrav comparisons there; here
+self-consistent injections: EFAC/EQUAD scaling formulas, ECORR
+quantization structure, GLS vs WLS behavior with correlated noise.)
+"""
+
+import copy
+import warnings
+
+import numpy as np
+import pytest
+
+warnings.simplefilter("ignore")
+
+from pint_tpu.models import get_model
+from pint_tpu.residuals import Residuals
+from pint_tpu.fitter import WLSFitter, GLSFitter, DownhillGLSFitter
+from pint_tpu.simulation import make_fake_toas_fromMJDs
+from pint_tpu.mjd import Epochs
+
+PAR = """
+PSR TESTN
+RAJ 12:00:00.0
+DECJ 15:00:00.0
+F0 218.8 1
+F1 -4e-16 1
+PEPOCH 55500
+DM 15.99 1
+EFAC -f L-wide 1.5
+EQUAD -f L-wide 1.2
+EFAC -f S-wide 0.9
+"""
+
+
+def _clustered_toas(model, n_epochs=25, per_epoch=4, seed=1):
+    rng = np.random.default_rng(seed)
+    epoch_days = np.linspace(55000, 56000, n_epochs)
+    mjds = []
+    for d in epoch_days:
+        # TOAs 0.5 s apart within an epoch (DM delays shift same-epoch
+        # TOAs by ~20 ms; keep gaps well inside the 2 s quantization)
+        mjds.extend(d + np.arange(per_epoch) * 0.5 / 86400.0)
+    mjds = np.array(mjds)
+    freqs = np.where(np.arange(len(mjds)) % 2, 1400.0, 2300.0)
+    t = make_fake_toas_fromMJDs(mjds, model, error_us=1.0, freq_mhz=freqs,
+                                obs="gbt", add_noise=False)
+    for i, f in enumerate(t.flags):
+        f["f"] = "L-wide" if freqs[i] < 2000 else "S-wide"
+    return t
+
+
+def test_efac_equad_scaling():
+    m = get_model(PAR)
+    t = _clustered_toas(m)
+    sigma = np.asarray(m.scaled_toa_uncertainty(t))
+    lmask = np.array([f["f"] == "L-wide" for f in t.flags])
+    expected_l = np.sqrt((1.5 * 1.0) ** 2 + 1.2**2)
+    np.testing.assert_allclose(sigma[lmask], expected_l, rtol=1e-10)
+    np.testing.assert_allclose(sigma[~lmask], 0.9, rtol=1e-10)
+
+
+def test_ecorr_quantization():
+    m = get_model(PAR + "ECORR -f L-wide 0.8\n")
+    t = _clustered_toas(m)
+    prep = m.prepare(t)
+    U = np.asarray(prep.prep["ecorr_U"])
+    # every L-wide epoch (25 epochs, 2 L-wide TOAs each) becomes a column
+    assert U.shape[1] == 25
+    assert set(U.sum(axis=0)) == {2.0}
+    # columns are disjoint
+    assert (U.sum(axis=1) <= 1).all()
+
+
+def test_gls_with_ecorr_downweights_epochs():
+    m = get_model(PAR + "ECORR -f L-wide 5.0\n")
+    t = _clustered_toas(m)
+    rng = np.random.default_rng(5)
+    # inject: white per-TOA + strong common offset per L-wide epoch
+    lmask = np.array([f["f"] == "L-wide" for f in t.flags])
+    epoch_id = np.repeat(np.arange(25), 4)
+    epoch_noise = rng.standard_normal(25) * 5e-6
+    white = rng.standard_normal(len(t)) * 1e-6
+    t.sec = t.sec + white + np.where(lmask, epoch_noise[epoch_id], 0.0)
+    t.tdb = None; t.ssb_obs = None; t._clock_applied = False
+    t.apply_clock_corrections(); t.compute_TDBs(); t.compute_posvels()
+
+    m_wls = copy.deepcopy(m)
+    m_wls.remove_component("EcorrNoise")
+    f_wls = WLSFitter(t, m_wls); f_wls.fit_toas()
+    f_gls = GLSFitter(t, copy.deepcopy(m)); chi2_gls = f_gls.fit_toas()
+    # whitened chi2 must be ~dof once ECORR absorbs the epoch noise,
+    # while the unmodeled WLS fit shows the inflation
+    dof = len(t) - len(m.free_params) - 1
+    assert chi2_gls / dof < 2.0
+    assert f_wls.resids.reduced_chi2 > 2.5
+    # modeling epoch correlations cannot shrink the uncertainty
+    assert f_gls.model.F0.uncertainty > f_wls.model.F0.uncertainty
+
+
+def test_plrednoise_basis():
+    m = get_model(PAR + "TNREDAMP -13.5\nTNREDGAM 3.5\nTNREDC 15\n")
+    t = _clustered_toas(m)
+    prep = m.prepare(t)
+    comp = m.components["PLRedNoise"]
+    F, phi = comp.basis_weight(prep.params0, prep.prep)
+    assert F.shape == (len(t), 30)
+    phi = np.asarray(phi)
+    assert (phi > 0).all()
+    # power-law: lowest harmonic carries the most variance
+    assert phi[0] > phi[-2]
+    # sin/cos pairs share weights
+    np.testing.assert_allclose(phi[0::2], phi[1::2])
+
+
+def test_gls_red_noise_whitening():
+    par = PAR + "TNREDAMP -12.3\nTNREDGAM 4.0\nTNREDC 20\n"
+    m = get_model(par)
+    t = _clustered_toas(m, n_epochs=40, per_epoch=2)
+    rng = np.random.default_rng(11)
+    # inject a smooth wandering signal (red-ish) + white noise
+    mjds = t.get_mjds()
+    span = mjds.max() - mjds.min()
+    red = sum(
+        (5e-6 / (k ** 2)) * np.sin(2 * np.pi * k * (mjds - mjds.min()) / span
+                                   + rng.uniform(0, 2 * np.pi))
+        for k in range(1, 6))
+    t.sec = t.sec + red + rng.standard_normal(len(t)) * 1e-6
+    t.tdb = None; t.ssb_obs = None; t._clock_applied = False
+    t.apply_clock_corrections(); t.compute_TDBs(); t.compute_posvels()
+
+    f = DownhillGLSFitter(t, copy.deepcopy(m))
+    chi2 = f.fit_toas()
+    dof = len(t) - len(m.free_params) - 1
+    # red signal absorbed by Fourier basis -> whitened chi2 near dof
+    assert chi2 / dof < 2.5
+    # and the noise amplitudes are actually nonzero
+    assert f.noise_ampls is not None
+    assert np.abs(f.noise_ampls).max() > 0
