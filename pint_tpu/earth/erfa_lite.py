@@ -49,6 +49,23 @@ def geodetic_to_itrf(lat_deg, lon_deg, height_m):
     return np.array([x, y, z])
 
 
+def itrf_to_geodetic(xyz_m):
+    """ITRF XYZ [m] -> geodetic (lat_deg, lon_deg, height_m)
+    (reference: erfa gc2gd; Bowring's iterative method, WGS84)."""
+    x, y, z = np.asarray(xyz_m, dtype=np.float64)
+    e2 = _WGS84_F * (2 - _WGS84_F)
+    lon = np.arctan2(y, x)
+    p = np.hypot(x, y)
+    lat = np.arctan2(z, p * (1 - e2))
+    for _ in range(4):
+        n = _WGS84_A / np.sqrt(1 - e2 * np.sin(lat) ** 2)
+        h = p / np.cos(lat) - n
+        lat = np.arctan2(z, p * (1 - e2 * n / (n + h)))
+    n = _WGS84_A / np.sqrt(1 - e2 * np.sin(lat) ** 2)
+    h = p / np.cos(lat) - n
+    return np.rad2deg(lat), np.rad2deg(lon), h
+
+
 def _jc_tt(tt: Epochs) -> np.ndarray:
     """Julian centuries of TT since J2000.0."""
     return ((tt.day - 51544) - 0.5 + tt.sec / SECS_PER_DAY) / 36525.0

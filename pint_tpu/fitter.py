@@ -47,7 +47,7 @@ class Fitter:
     def _sync_model_from_vector(self, prepared, x):
         """Write fitted vector + uncertainties back into host Parameters."""
         for (pname, _, _), val in zip(prepared.free_param_map(), np.asarray(x)):
-            getattr(self.model, pname).value = float(val)
+            getattr(self.model, pname).set_fitted_value(float(val))
 
     def _set_uncertainties(self, prepared, cov):
         sig = np.sqrt(np.diag(np.asarray(cov)))
@@ -79,6 +79,12 @@ class Fitter:
         from .utils import ftest
 
         return ftest(other_chi2, other_dof, self.resids.chi2, self.resids.dof)
+
+
+def _n_offset(labels):
+    """Count of leading non-parameter columns (the implicit 'Offset');
+    0 when a free PHOFF replaced it (reference: PhaseOffset)."""
+    return 1 if labels and labels[0] == "Offset" else 0
 
 
 def wls_step(Mw, rw, threshold=1e-12):
@@ -116,6 +122,7 @@ class WLSFitter(Fitter):
         prepared = self.model.prepare(self.toas)
         resid_fn = prepared.residual_vector_fn(track_mode=self._track_mode())
         dm_fn, labels = prepared.designmatrix_fn()
+        noff = _n_offset(labels)
         x = prepared.vector_from_params()
         cov_all = None
         for _ in range(maxiter):
@@ -126,10 +133,10 @@ class WLSFitter(Fitter):
             Mw = (M / f0) / sigma_s[:, None]
             rw = r / sigma_s
             dx_all, cov_all = wls_step(Mw, rw, threshold)
-            x = x - dx_all[1:]
+            x = x - dx_all[noff:]
         self._sync_model_from_vector(prepared, x)
         if cov_all is not None:
-            self._set_uncertainties(prepared, cov_all[1:, 1:])
+            self._set_uncertainties(prepared, cov_all[noff:, noff:])
         self.resids = Residuals(self.toas, self.model)
         self.converged = True
         return self.resids.chi2
@@ -144,6 +151,7 @@ class DownhillWLSFitter(WLSFitter):
         prepared = self.model.prepare(self.toas)
         resid_fn = prepared.residual_vector_fn(track_mode=self._track_mode())
         dm_fn, labels = prepared.designmatrix_fn()
+        noff = _n_offset(labels)
 
         def chi2_of(x):
             r = resid_fn(x)
@@ -161,7 +169,7 @@ class DownhillWLSFitter(WLSFitter):
             Mw = (M / f0) / sigma_s[:, None]
             rw = r / sigma_s
             dx_all, cov_all = wls_step(Mw, rw, threshold)
-            dx = dx_all[1:]
+            dx = dx_all[noff:]
             lam = 1.0
             improved = False
             while lam >= min_lambda:
@@ -176,7 +184,7 @@ class DownhillWLSFitter(WLSFitter):
                 break
         self._sync_model_from_vector(prepared, x)
         if cov_all is not None:
-            self._set_uncertainties(prepared, cov_all[1:, 1:])
+            self._set_uncertainties(prepared, cov_all[noff:, noff:])
         self.resids = Residuals(self.toas, self.model)
         self.converged = True
         return self.resids.chi2
@@ -218,6 +226,7 @@ class GLSFitter(Fitter):
         prepared = self.model.prepare(self.toas)
         resid_fn = prepared.residual_vector_fn(track_mode=self._track_mode())
         dm_fn, labels = prepared.designmatrix_fn()
+        noff = _n_offset(labels)
         x = prepared.vector_from_params()
         cov = None
         last_chi2 = None
@@ -264,7 +273,7 @@ class GLSFitter(Fitter):
             dxn = evecs @ (einv * (evecs.T @ b))
             dx = dxn / norm
             cov = (evecs @ jnp.diag(einv) @ evecs.T) / jnp.outer(norm, norm)
-            x = x - dx[1:nparam]
+            x = x - dx[noff:nparam]
             # whitened chi2: r^T C^-1 r via the Woodbury identity
             # (with no noise bases this reduces to the plain whitened chi2
             # minus the fitted-parameter improvement, same formula)
@@ -277,25 +286,11 @@ class GLSFitter(Fitter):
             last_chi2 = chi2
         self._sync_model_from_vector(prepared, x)
         if cov is not None:
-            self._set_uncertainties(prepared, cov[1:nparam, 1:nparam])
+            self._set_uncertainties(prepared, cov[noff:nparam, noff:nparam])
         self.resids = Residuals(self.toas, self.model)
         self.converged = True
         self.chi2_whitened = chi2
         return chi2
-
-
-def jax_cho_solve(L, b):
-    import jax.scipy.linalg as jsl
-
-    return jsl.cho_solve((L, True), b)
-
-
-def jax_cho_inverse(L):
-    import jax.numpy as jnp
-    import jax.scipy.linalg as jsl
-
-    n = L.shape[0]
-    return jsl.cho_solve((L, True), jnp.eye(n))
 
 
 class DownhillGLSFitter(GLSFitter):
@@ -330,6 +325,7 @@ class WidebandTOAFitter(GLSFitter):
             sigma_t = prepared.scaled_sigma_us() * 1e-6
             sigma_dm = jnp.asarray(wb.dm.dm_error[valid])
             M_t, labels = prepared.designmatrix()
+            noff = _n_offset(labels)
             f0 = prepared.params0["F"][0]
             M_t = M_t / f0
 
@@ -344,15 +340,16 @@ class WidebandTOAFitter(GLSFitter):
 
             x0 = prepared.vector_from_params()
             M_dm = jax.jacfwd(dm_model)(x0)
-            M_dm = -jnp.concatenate([jnp.zeros((M_dm.shape[0], 1)), M_dm], axis=1)
+            M_dm = -jnp.concatenate(
+                [jnp.zeros((M_dm.shape[0], noff)), M_dm], axis=1)
             M = jnp.concatenate([M_t, M_dm], axis=0)
             r = jnp.concatenate([r_t, r_dm])
             sigma = jnp.concatenate([sigma_t, sigma_dm])
             Mw = M / sigma[:, None]
             rw = r / sigma
             dx_all, cov_all = wls_step(Mw, rw, threshold)
-            self._sync_model_from_vector(prepared, x0 - dx_all[1:])
-            self._set_uncertainties(prepared, cov_all[1:, 1:])
+            self._sync_model_from_vector(prepared, x0 - dx_all[noff:])
+            self._set_uncertainties(prepared, cov_all[noff:, noff:])
         self.resids = WidebandTOAResiduals(self.toas, self.model)
         self.converged = True
         return self.resids.chi2

@@ -169,9 +169,14 @@ class PulsarBinary(DelayComponent):
         (or via true anomaly when nu is given, DD-style)."""
         om = params.get("OM", 0.0) * _DEG2RAD
         omdot = params.get("OMDOT", 0.0) * _DEG2RAD / SECS_PER_JULIAN_YEAR
-        if nu is not None and "PB" in params:
-            n_orb = _TWO_PI / (params["PB"] * SECS_PER_DAY)
-            return om + (params.get("OMDOT", 0.0) * _DEG2RAD / SECS_PER_JULIAN_YEAR / n_orb) * nu
+        if nu is not None:
+            # mean orbital angular frequency, from FB0 in FBn mode
+            # (PB is packed as 0.0 there) else from PB
+            if prep["orb_mode_fb"]:
+                n_orb = _TWO_PI * params["FB"][0]
+            else:
+                n_orb = _TWO_PI / (params["PB"] * SECS_PER_DAY)
+            return om + (omdot / n_orb) * nu
         dt = prep["orb_dt_hi"] + prep["orb_dt_lo"] - delay_accum
         return om + omdot * dt
 

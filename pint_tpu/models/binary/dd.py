@@ -109,7 +109,6 @@ class BinaryDDK(BinaryDD):
     """
 
     binary_model_name = "DDK"
-    needs_batch = True
 
     def __init__(self):
         super().__init__()
@@ -148,8 +147,13 @@ class BinaryDDK(BinaryDD):
         prep["ddk_pm_n"] = pm_n * MASYR_TO_RADS
         px = model.PX.value if "PX" in model.params and model.PX.value else 0.0
         prep["ddk_dist_ls"] = (1000.0 / px * PC_M / C_M_S) if px else np.inf
+        # observatory SSB positions [ls], packed so the Kopeikin terms
+        # never need the TOABatch threaded through x_ls/omega_rad
+        if toas.ssb_obs is None:
+            toas.compute_posvels()
+        prep["ddk_obs_ls"] = jnp.asarray(toas.ssb_obs.pos / C_M_S)
 
-    def _kopeikin_xom(self, params, batch, prep, delay_accum):
+    def _kopeikin_xom(self, params, prep, delay_accum):
         """(delta_x, delta_omega) from proper motion + annual parallax."""
         import jax.numpy as jnp
 
@@ -165,7 +169,7 @@ class BinaryDDK(BinaryDD):
         dx_pm = x * cot_i * (-mu_e * sk + mu_n * ck) * dt
         dom_pm = csc_i * (mu_e * ck + mu_n * sk) * dt
         # annual-orbital parallax (Kopeikin 1995 eq. 15-16)
-        robs = batch.obs_pos_ls  # [ls]
+        robs = prep["ddk_obs_ls"]  # [ls]
         d_ls = prep["ddk_dist_ls"]
         de = jnp.sum(robs * prep["ddk_east"], axis=-1) / d_ls
         dn = jnp.sum(robs * prep["ddk_north"], axis=-1) / d_ls
@@ -173,14 +177,10 @@ class BinaryDDK(BinaryDD):
         dom_px = -csc_i * (de * ck + dn * sk)
         return dx_pm + dx_px, dom_pm + dom_px
 
-    def delay(self, params, batch, prep, delay_accum):
-        self._batch = batch
-        return super().delay(params, batch, prep, delay_accum)
-
     def x_ls(self, params, prep, delay_accum):
-        dx, _ = self._kopeikin_xom(params, self._batch, prep, delay_accum)
+        dx, _ = self._kopeikin_xom(params, prep, delay_accum)
         return super().x_ls(params, prep, delay_accum) + dx
 
     def omega_rad(self, params, prep, delay_accum, nu=None):
-        _, dom = self._kopeikin_xom(params, self._batch, prep, delay_accum)
+        _, dom = self._kopeikin_xom(params, prep, delay_accum)
         return super().omega_rad(params, prep, delay_accum, nu=nu) + dom

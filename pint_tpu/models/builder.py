@@ -31,7 +31,7 @@ ALIASES = {
 
 TOP_LEVEL_STR = ("PSR", "EPHEM", "CLOCK", "UNITS", "TIMEEPH", "T2CMETHOD",
                  "TZRSITE", "INFO", "DCOVFILE", "TRACK", "MODE", "EPHVER",
-                 "CHI2", "CHI2R", "DMDATA", "NITS", "IBOOT")
+                 "CHI2", "CHI2R", "DMDATA", "NITS", "IBOOT", "DILATEFREQ")
 TOP_LEVEL_FLOAT = ("NTOA", "TRES", "TZRFRQ", "DMRES")
 TOP_LEVEL_MJD = ("START", "FINISH", "TZRMJD")
 
@@ -78,6 +78,38 @@ def get_model(parfile, allow_name_mixing=False) -> TimingModel:
     if any(k.startswith("DMX_") for k in keys):
         model.add_component(DispersionDMX())
     model.add_component(SolarSystemShapiro())
+    if "NE_SW" in keys or "SWM" in keys:
+        from .solar_wind import SolarWindDispersion
+
+        model.add_component(SolarWindDispersion())
+    if "CORRECT_TROPOSPHERE" in keys:
+        from .troposphere import TroposphereDelay
+
+        model.add_component(TroposphereDelay())
+    if any(k.startswith("GLEP_") for k in keys):
+        from .glitch import Glitch
+
+        model.add_component(Glitch())
+    if "WAVE_OM" in keys or any(k.startswith("WAVE") and k[4:].isdigit() for k in keys):
+        from .wave import Wave
+
+        model.add_component(Wave())
+    if any(k.startswith("WXFREQ_") for k in keys):
+        from .wave import WaveX
+
+        model.add_component(WaveX())
+    if any(k.startswith("FD") and k[2:].isdigit() for k in keys):
+        from .frequency_dependent import FD
+
+        model.add_component(FD())
+    if "SIFUNC" in keys or any(k.startswith("IFUNC") and k[5:].isdigit() for k in keys):
+        from .ifunc import IFunc
+
+        model.add_component(IFunc())
+    if "PHOFF" in keys:
+        from .phase_offset import PhaseOffset
+
+        model.add_component(PhaseOffset())
     if any(c == "JUMP" for c, _ in repeats):
         model.add_component(PhaseJump())
     if "BINARY" in keys:
@@ -106,6 +138,34 @@ def get_model(parfile, allow_name_mixing=False) -> TimingModel:
         i = 1
         while f"DM{i}" in keys:
             dd.add_dmterm(i)
+            i += 1
+    if "Glitch" in model.components:
+        gl = model.components["Glitch"]
+        ids = sorted({int(k.split("_")[1]) for k in keys if k.startswith("GLEP_")})
+        for idx in ids:
+            gl.add_glitch(idx)
+    if "Wave" in model.components:
+        wv = model.components["Wave"]
+        i = 1
+        while f"WAVE{i}" in keys:
+            wv.add_wave(i)
+            i += 1
+    if "WaveX" in model.components:
+        wx = model.components["WaveX"]
+        ids = sorted({int(k.split("_")[1]) for k in keys if k.startswith("WXFREQ_")})
+        for idx in ids:
+            wx.add_wavex(idx)
+    if "FD" in model.components:
+        fd = model.components["FD"]
+        i = 1
+        while f"FD{i}" in keys:
+            fd.add_fd(i)
+            i += 1
+    if "IFunc" in model.components:
+        ifc = model.components["IFunc"]
+        i = 1
+        while f"IFUNC{i}" in keys:
+            ifc.add_ifunc(i)
             i += 1
     if "DispersionDMX" in model.components:
         dx = model.components["DispersionDMX"]

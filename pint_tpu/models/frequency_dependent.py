@@ -1,0 +1,49 @@
+"""Frequency-dependent (profile evolution) delay.
+
+(reference: src/pint/models/frequency_dependent.py::FD — FD1..FDn;
+delay = sum_i FDi * log(freq/1 GHz)^i, FDi in seconds.)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .parameter import prefixParameter
+from .timing_model import DelayComponent
+
+
+class FD(DelayComponent):
+    category = "frequency_dependent"
+    order = 40
+
+    def __init__(self):
+        super().__init__()
+        self.fd_ids: list[int] = []
+
+    def add_fd(self, index=None):
+        index = index if index is not None else len(self.fd_ids) + 1
+        p = prefixParameter(f"FD{index}", "FD", index, units="s",
+                            description=f"FD delay term, log(GHz)^{index}")
+        p.value = 0.0
+        self.add_param(p)
+        self.fd_ids.append(index)
+        return index
+
+    def device_slot(self, pname):
+        return "FD", self.fd_ids.index(int(pname[2:]))
+
+    def pack(self, model, toas, prep, params0):
+        params0["FD"] = np.array([getattr(self, f"FD{i}").value or 0.0
+                                  for i in self.fd_ids], dtype=np.float64)
+
+    def delay(self, params, batch, prep, delay_accum):
+        import jax.numpy as jnp
+
+        logf = jnp.log(batch.freq_mhz / 1000.0)  # log(freq/GHz)
+        logf = jnp.where(jnp.isfinite(logf), logf, 0.0)
+        out = jnp.zeros_like(logf)
+        lp = logf
+        for i in range(params["FD"].shape[0]):
+            out = out + params["FD"][i] * lp
+            lp = lp * logf
+        return jnp.where(jnp.isfinite(batch.freq_mhz), out, 0.0)

@@ -1,0 +1,95 @@
+"""Pulsar glitches: step changes in phase / spin with exponential recovery.
+
+(reference: src/pint/models/glitch.py::Glitch — prefix families
+GLEP_n (epoch), GLPH_n (phase step), GLF0_n/GLF1_n/GLF2_n (permanent
+frequency/derivative steps), GLF0D_n + GLTD_n (decaying frequency step
+with timescale)).
+
+Phase contribution for each glitch, for t after GLEP (dt in seconds):
+
+    dphi = GLPH + GLF0*dt + GLF1*dt^2/2 + GLF2*dt^3/6
+         + GLF0D * tau * (1 - exp(-dt/tau)),  tau = GLTD [days -> s]
+
+All glitch parameters live in flat device arrays indexed by glitch, so
+any of them (including GLEP, away from the step) is differentiable for
+the design matrix.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..constants import SECS_PER_DAY
+from .parameter import MJDParameter, prefixParameter
+from .timing_model import PhaseComponent, MissingParameter
+
+_FIELDS = ("GLPH", "GLF0", "GLF1", "GLF2", "GLF0D", "GLTD")
+_UNITS = {"GLPH": "pulse phase", "GLF0": "Hz", "GLF1": "Hz/s",
+          "GLF2": "Hz/s^2", "GLF0D": "Hz", "GLTD": "d"}
+
+
+class Glitch(PhaseComponent):
+    category = "glitch"
+    order = 30
+
+    def __init__(self):
+        super().__init__()
+        self.glitch_ids: list[int] = []
+
+    def add_glitch(self, index=None):
+        index = index if index is not None else len(self.glitch_ids) + 1
+        ep = MJDParameter(f"GLEP_{index}", units="MJD",
+                          description=f"Epoch of glitch {index}")
+        self.add_param(ep)
+        for f in _FIELDS:
+            p = prefixParameter(f"{f}_{index}", f, index, units=_UNITS[f],
+                                description=f"{f} of glitch {index}")
+            p.value = 0.0
+            self.add_param(p)
+        self.glitch_ids.append(index)
+        return index
+
+    def validate(self):
+        for i in self.glitch_ids:
+            if getattr(self, f"GLEP_{i}").value is None:
+                raise MissingParameter("Glitch", f"GLEP_{i}")
+
+    def device_slot(self, pname):
+        stem, idx = pname.rsplit("_", 1)
+        if stem == "GLEP":
+            return "GLEP", self.glitch_ids.index(int(idx))
+        if stem in _FIELDS:
+            return stem, self.glitch_ids.index(int(idx))
+        raise KeyError(pname)
+
+    def pack(self, model, toas, prep, params0):
+        ids = self.glitch_ids
+        # GLEP stays in MJD on device (so fit sync round-trips); the
+        # conversion to seconds-since-PEPOCH happens in phase() against
+        # this packed static epoch
+        params0["GLEP"] = np.array([getattr(self, f"GLEP_{i}").value
+                                    for i in ids], dtype=np.float64)
+        prep["glitch_pepoch_mjd"] = (float(prep["pepoch_day"])
+                                     + prep["pepoch_sec"] / SECS_PER_DAY)
+        for f in _FIELDS:
+            params0[f] = np.array([getattr(self, f"{f}_{i}").value or 0.0
+                                   for i in ids], dtype=np.float64)
+
+    def phase(self, params, batch, prep, delay_total):
+        import jax.numpy as jnp
+
+        T = prep["T_hi"] + prep["T_lo"] - delay_total  # (n,)
+        ep_s = (params["GLEP"] - prep["glitch_pepoch_mjd"]) * SECS_PER_DAY
+        dt = T[:, None] - ep_s[None, :]                # (n, nglitch)
+        on = (dt > 0).astype(dt.dtype)
+        dtp = jnp.where(dt > 0, dt, 0.0)
+        tau = params["GLTD"] * SECS_PER_DAY
+        # guard tau=0 (no decaying term): exp factor forced to 0 contribution
+        safe_tau = jnp.where(tau > 0, tau, 1.0)
+        decay = jnp.where(tau > 0,
+                          params["GLF0D"] * safe_tau
+                          * (1.0 - jnp.exp(-dtp / safe_tau)), 0.0)
+        dphi = (params["GLPH"] + params["GLF0"] * dtp
+                + params["GLF1"] * dtp**2 / 2.0
+                + params["GLF2"] * dtp**3 / 6.0 + decay)
+        return jnp.sum(on * dphi, axis=-1)

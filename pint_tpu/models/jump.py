@@ -9,7 +9,7 @@ from __future__ import annotations
 import numpy as np
 
 from .parameter import maskParameter
-from .timing_model import PhaseComponent
+from .timing_model import DelayComponent, PhaseComponent
 
 
 class PhaseJump(PhaseComponent):
@@ -51,3 +51,45 @@ class PhaseJump(PhaseComponent):
         # jump in seconds of time; phase shift = -F0 * jump on masked TOAs
         jump_per_toa = params["JUMP"] @ prep["jump_masks"]
         return -params["F"][0] * jump_per_toa
+
+
+class DelayJump(DelayComponent):
+    """Per-subset constant time offsets applied as delays
+    (reference: jump.py::DelayJump — rare; tempo2 'JUMP' semantics)."""
+
+    category = "delay_jump"
+    order = 45
+
+    def __init__(self):
+        super().__init__()
+        self.jump_ids: list[int] = []
+
+    def add_jump(self, key="", key_value=(), value=0.0, frozen=False, index=None):
+        index = index if index is not None else len(self.jump_ids) + 1
+        p = maskParameter(f"DJUMP{index}", "DJUMP", index, units="s", frozen=frozen)
+        p.key = key
+        p.key_value = list(key_value)
+        p.value = value
+        self.add_param(p)
+        self.jump_ids.append(index)
+        return p
+
+    def device_slot(self, pname):
+        return "DJUMP", self.jump_ids.index(int(pname[5:]))
+
+    def pack(self, model, toas, prep, params0):
+        import jax.numpy as jnp
+
+        if not self.jump_ids:
+            params0["DJUMP"] = np.zeros(0)
+            prep["djump_masks"] = jnp.zeros((0, len(toas)))
+            return
+        vals = np.array([getattr(self, f"DJUMP{i}").value or 0.0
+                         for i in self.jump_ids])
+        params0["DJUMP"] = vals
+        masks = np.stack([getattr(self, f"DJUMP{i}").resolve_mask(toas)
+                          for i in self.jump_ids]).astype(np.float64)
+        prep["djump_masks"] = jnp.asarray(masks)
+
+    def delay(self, params, batch, prep, delay_accum):
+        return params["DJUMP"] @ prep["djump_masks"]

@@ -92,6 +92,11 @@ class Parameter:
     def _format_unc(self):
         return f"{float(self.uncertainty):.5g}"
 
+    def set_fitted_value(self, v):
+        """Write a fitted device-vector entry back (same units as
+        ``.value``; overridden where the device layout differs)."""
+        self.value = v
+
     def __repr__(self):
         state = "frozen" if self.frozen else "free"
         return f"<{type(self).__name__} {self.name}={self.value} ({state})>"
@@ -231,6 +236,38 @@ class prefixParameter(floatParameter):
         super().__init__(name, **kw)
         self.prefix = prefix
         self.index = index
+
+
+class pairParameter(Parameter):
+    """Two-component parameter, e.g. WAVEn 'A B' sin/cos amplitudes
+    (reference: parameter.py::pairParameter). ``.value`` is (a, b)."""
+
+    kind = "pair"
+
+    def __init__(self, name, prefix="", index=0, **kw):
+        super().__init__(name, **kw)
+        self.prefix = prefix
+        self.index = index
+
+    def from_parfile_fields(self, fields):
+        self.value = (_float(fields[0]), _float(fields[1]))
+        if len(fields) > 2:
+            self.frozen, unc = _parse_fit_and_unc(fields[2:])
+            if unc is not None:
+                self.uncertainty = _float(unc)
+
+    def _format_value(self):
+        a, b = self.value
+        return f"{float(a)!r} {float(b)!r}"
+
+    def set_fitted_value(self, v):
+        # device exposes only the amplitude (second element)
+        self.value = (self.value[0] if self.value else 0.0, v)
+
+    def as_parfile_line(self):
+        if self.value is None:
+            return ""
+        return f"{self.name:<15} {self._format_value()}\n"
 
 
 class maskParameter(floatParameter):

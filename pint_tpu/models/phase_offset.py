@@ -1,0 +1,34 @@
+"""Explicit overall phase offset.
+
+(reference: src/pint/models/phase_offset.py::PhaseOffset — PHOFF; the
+explicit alternative to the implicit 'Offset' design-matrix column.
+When PHOFF is free, fitters drop the implicit offset column.)
+"""
+
+from __future__ import annotations
+
+from .parameter import floatParameter
+from .timing_model import PhaseComponent
+
+
+class PhaseOffset(PhaseComponent):
+    category = "phase_offset"
+    order = 45
+
+    def __init__(self):
+        super().__init__()
+        p = floatParameter("PHOFF", units="pulse phase",
+                           description="Overall phase offset")
+        p.value = 0.0
+        self.add_param(p)
+
+    def device_slot(self, pname):
+        return "PHOFF", None
+
+    def pack(self, model, toas, prep, params0):
+        params0["PHOFF"] = self.PHOFF.value or 0.0
+
+    def phase(self, params, batch, prep, delay_total):
+        import jax.numpy as jnp
+
+        return -params["PHOFF"] * jnp.ones_like(prep["T_hi"])

@@ -1,0 +1,74 @@
+"""Solar-wind dispersion delay.
+
+(reference: src/pint/models/solar_wind_dispersion.py::SolarWindDispersion
+— NE_SW electron density at 1 AU, spherically-symmetric n ~ r^-2 wind,
+delay = DMconst * DM_sw / freq^2 with the (pi - theta)/(r sin theta)
+line-of-sight geometry factor.)
+
+Geometry: for n(d) = NE_SW (AU/d)^2 integrated from the observatory to
+infinity along the line of sight,
+
+    DM_sw = NE_SW * AU^2 * (pi - theta) / (r * sin(theta))
+
+where r = |observatory -> Sun| and theta is the angle between the
+observatory->Sun vector and the pulsar direction (elongation). All on
+device and differentiable in NE_SW and the pulsar position.
+"""
+
+from __future__ import annotations
+
+from ..constants import AU_LS, DMconst, ONE_AU_PC
+from .parameter import floatParameter
+from .timing_model import DelayComponent
+
+
+class SolarWindDispersion(DelayComponent):
+    category = "solar_wind"
+    order = 32
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter(
+            "NE_SW", units="cm^-3", aliases=("NE1AU", "SOLARN0"),
+            description="Solar wind electron density at 1 AU"))
+        self.add_param(floatParameter(
+            "SWM", units="", description="Solar wind model index (0 supported)"))
+        self.NE_SW.value = 0.0
+        self.SWM.value = 0.0
+
+    def validate(self):
+        if self.SWM.value not in (None, 0, 0.0):
+            raise ValueError("only SWM 0 (spherical r^-2 wind) is supported")
+
+    def device_slot(self, pname):
+        if pname == "NE_SW":
+            return "NE_SW", None
+        raise KeyError(pname)
+
+    def pack(self, model, toas, prep, params0):
+        params0["NE_SW"] = self.NE_SW.value or 0.0
+
+    def solar_wind_dm(self, params, batch, prep):
+        """DM_sw per TOA [pc cm^-3]; differentiable."""
+        import jax.numpy as jnp
+
+        astrom = next((c for c in self._parent.delay_components()
+                       if c.category == "astrometry"), None)
+        if astrom is None:
+            return jnp.zeros_like(batch.tdb_sec)
+        n = astrom.ssb_to_psb_xyz(params, prep)
+        sun = batch.obs_sun_ls
+        r_ls = jnp.linalg.norm(sun, axis=-1)
+        cos_t = jnp.clip(jnp.sum(sun * n, axis=-1) / r_ls, -1.0, 1.0)
+        theta = jnp.arccos(cos_t)
+        sin_t = jnp.clip(jnp.sin(theta), 1e-6, None)
+        r_au = r_ls / AU_LS
+        geom_pc = ONE_AU_PC * (jnp.pi - theta) / (r_au * sin_t)
+        return params["NE_SW"] * geom_pc
+
+    def delay(self, params, batch, prep, delay_accum):
+        import jax.numpy as jnp
+
+        dm = self.solar_wind_dm(params, batch, prep)
+        f2 = jnp.square(batch.freq_mhz)
+        return jnp.where(jnp.isfinite(f2), DMconst * dm / f2, 0.0)

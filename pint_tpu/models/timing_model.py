@@ -417,6 +417,10 @@ class PreparedTiming:
         import jax.numpy as jnp
 
         labels = [n for n, _, _ in self.free_param_map()]
+        # PHOFF free -> it IS the offset column; drop the implicit one
+        # (reference: phase_offset.py PhaseOffset vs 'Offset' column)
+        if incoffset and "PHOFF" in labels:
+            incoffset = False
         key = ("dmfn", incoffset, tuple(labels))
         if key not in self._fns:
             def f(x):

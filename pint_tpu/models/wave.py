@@ -1,0 +1,130 @@
+"""Harmonic whitening terms: Wave (Tempo-style) and WaveX.
+
+(reference: src/pint/models/wave.py::Wave — WAVEEPOCH, WAVE_OM
+[rad/day], WAVEn pair parameters (sin, cos amplitudes in seconds);
+phase += F0 * sum_k [A_k sin(k w t) + B_k cos(k w t)].
+reference: src/pint/models/wavex.py::WaveX — WXEPOCH, explicit
+per-term frequencies WXFREQ_#### [1/day] with WXSIN_####/WXCOS_####
+delay amplitudes in seconds.)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..constants import SECS_PER_DAY
+from .parameter import MJDParameter, floatParameter, pairParameter, prefixParameter
+from .timing_model import PhaseComponent, DelayComponent, MissingParameter
+
+
+class Wave(PhaseComponent):
+    category = "wave"
+    order = 35
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("WAVE_OM", units="rad/day",
+                                      description="Fundamental wave frequency"))
+        self.add_param(MJDParameter("WAVEEPOCH", units="MJD",
+                                    description="Reference epoch of wave terms"))
+        self.wave_ids: list[int] = []
+
+    def add_wave(self, index=None):
+        index = index if index is not None else len(self.wave_ids) + 1
+        p = pairParameter(f"WAVE{index}", "WAVE", index, units="s",
+                          description=f"Wave harmonic {index} (sin, cos) [s]")
+        p.value = (0.0, 0.0)
+        self.add_param(p)
+        self.wave_ids.append(index)
+        return index
+
+    def validate(self):
+        if self.wave_ids and self.WAVE_OM.value is None:
+            raise MissingParameter("Wave", "WAVE_OM")
+
+    def device_slot(self, pname):
+        if pname == "WAVE_OM":
+            return "WAVE_OM", None
+        raise KeyError(pname)
+
+    def pack(self, model, toas, prep, params0):
+        import jax.numpy as jnp
+
+        params0["WAVE_OM"] = self.WAVE_OM.value or 0.0
+        a = np.array([getattr(self, f"WAVE{i}").value[0] for i in self.wave_ids])
+        b = np.array([getattr(self, f"WAVE{i}").value[1] for i in self.wave_ids])
+        params0["WAVEA"] = a
+        params0["WAVEB"] = b
+        we = self.WAVEEPOCH
+        if we is not None and we.day is not None:
+            day, sec = we.day, we.sec
+        else:
+            day, sec = prep["pepoch_day"], prep["pepoch_sec"]
+        dt_day = ((toas.tdb.day - day).astype(np.float64)
+                  + (toas.tdb.sec - sec) / SECS_PER_DAY)
+        prep["wave_dt_day"] = jnp.asarray(dt_day)
+
+    def phase(self, params, batch, prep, delay_total):
+        import jax.numpy as jnp
+
+        t = prep["wave_dt_day"] - delay_total / SECS_PER_DAY
+        k = jnp.arange(1, params["WAVEA"].shape[0] + 1, dtype=t.dtype)
+        arg = params["WAVE_OM"] * t[:, None] * k[None, :]
+        wave_s = jnp.sum(params["WAVEA"] * jnp.sin(arg)
+                         + params["WAVEB"] * jnp.cos(arg), axis=-1)
+        return params["F"][0] * wave_s
+
+
+class WaveX(DelayComponent):
+    """Explicit-frequency harmonic delays (reference: wavex.py::WaveX)."""
+
+    category = "wavex"
+    order = 36
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(MJDParameter("WXEPOCH", units="MJD",
+                                    description="Reference epoch of WaveX terms"))
+        self.wx_ids: list[int] = []
+
+    def add_wavex(self, index=None, freq_per_day=None):
+        index = index if index is not None else len(self.wx_ids) + 1
+        f = prefixParameter(f"WXFREQ_{index:04d}", "WXFREQ_", index, units="1/d")
+        f.value = freq_per_day if freq_per_day is not None else 0.0
+        self.add_param(f)
+        for stem in ("WXSIN", "WXCOS"):
+            p = prefixParameter(f"{stem}_{index:04d}", f"{stem}_", index, units="s")
+            p.value = 0.0
+            self.add_param(p)
+        self.wx_ids.append(index)
+        return index
+
+    def device_slot(self, pname):
+        stem, idx = pname.rsplit("_", 1)
+        if stem in ("WXSIN", "WXCOS", "WXFREQ"):
+            return stem, self.wx_ids.index(int(idx))
+        raise KeyError(pname)
+
+    def pack(self, model, toas, prep, params0):
+        import jax.numpy as jnp
+
+        for stem in ("WXFREQ", "WXSIN", "WXCOS"):
+            params0[stem] = np.array(
+                [getattr(self, f"{stem}_{i:04d}").value or 0.0
+                 for i in self.wx_ids], dtype=np.float64)
+        we = self.WXEPOCH
+        if we is not None and we.day is not None:
+            day, sec = we.day, we.sec
+        else:
+            day, sec = prep["pepoch_day"], prep["pepoch_sec"]
+        dt_day = ((toas.tdb.day - day).astype(np.float64)
+                  + (toas.tdb.sec - sec) / SECS_PER_DAY)
+        prep["wavex_dt_day"] = jnp.asarray(dt_day)
+
+    def delay(self, params, batch, prep, delay_accum):
+        import jax.numpy as jnp
+
+        t = prep["wavex_dt_day"]
+        arg = 2.0 * jnp.pi * params["WXFREQ"] * t[:, None]
+        return jnp.sum(params["WXSIN"] * jnp.sin(arg)
+                       + params["WXCOS"] * jnp.cos(arg), axis=-1)

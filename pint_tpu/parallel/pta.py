@@ -19,7 +19,15 @@ import numpy as np
 from ..models.timing_model import PreparedTiming
 
 _EXCLUDE_KEYS = ("T_ld", "pepoch_day", "pepoch_sec")
-_STATIC_KEYS = ("orb_mode_fb", "planet_shapiro", "obliquity")
+_STATIC_KEYS = ("orb_mode_fb", "planet_shapiro", "obliquity",
+                "tropo_on", "ifunc_mode")
+
+
+def _is_static(key, value):
+    """Control-flow config (bools/strs/known keys) must stay Python
+    scalars — stacking them into traced arrays breaks `if` branches
+    inside the jitted phase functions."""
+    return key in _STATIC_KEYS or isinstance(value, (bool, str))
 _PAD_SIGMA = 1e30
 
 
@@ -80,7 +88,7 @@ def stack_prepared(preps: list[PreparedTiming]):
         if k in _EXCLUDE_KEYS:
             continue
         vals = [p.prep[k] for p in preps]
-        if k in _STATIC_KEYS:
+        if _is_static(k, vals[0]):
             assert all(np.all(v == vals[0]) for v in vals), \
                 f"prep[{k}] must be uniform across the PTA batch"
             static[k] = vals[0]
@@ -136,8 +144,6 @@ def pure_phase_fn(template_model, static):
         full_prep = {**prep, **static}
         d = jnp.zeros_like(batch.tdb_sec)
         for c in delay_comps:
-            if getattr(c, "needs_batch", False):
-                c._batch = batch
             d = d + c.delay(params, batch, full_prep, d)
         ph = jnp.zeros_like(d)
         for c in phase_comps:

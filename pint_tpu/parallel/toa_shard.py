@@ -40,24 +40,24 @@ def sharded_residuals(template_model, static, mesh: Mesh, params, batch, prep,
         frac = frac - sw / tw
         return frac / params["F"][0]
 
-    def spec_for(x):
-        # shard the leading/TOA dimension where present
-        if getattr(x, "ndim", 0) == 0:
-            return P()
-        return P(axis) if x.shape[0] != 3 else P()
+    n_toa = batch.tdb_sec.shape[0]
 
-    batch_specs = jax.tree_util.tree_map(
-        lambda x: P(axis) if getattr(x, "ndim", 0) >= 1 and x.shape[0] > 3 else P(),
-        batch)
-    prep_specs = jax.tree_util.tree_map(
-        lambda x: (P(axis) if getattr(x, "ndim", 0) >= 1
-                   and x.shape[-1] == batch.tdb_sec.shape[0] else P()), prep)
-    # masks (k, n_toa) shard on dim 1
-    prep_specs = {
-        k: (P(None, axis) if getattr(prep[k], "ndim", 0) == 2
-            and prep[k].shape[1] == batch.tdb_sec.shape[0] else v)
-        for k, v in prep_specs.items()
-    }
+    def data_spec(x):
+        """Shard whichever dimension carries the TOA axis; replicate
+        everything else. Handles (n,), (n, 3), (k, n) masks/bases, and
+        (n_planets, n, 3) planet tensors by shape, not position."""
+        nd = getattr(x, "ndim", 0)
+        if nd == 0:
+            return P()
+        dims = [None] * nd
+        for i, s in enumerate(x.shape):
+            if s == n_toa:
+                dims[i] = axis
+                break
+        return P(*dims)
+
+    batch_specs = jax.tree_util.tree_map(data_spec, batch)
+    prep_specs = jax.tree_util.tree_map(data_spec, prep)
     fn = jax.shard_map(
         local, mesh=mesh,
         in_specs=(jax.tree_util.tree_map(lambda _: P(), params),
