@@ -1,0 +1,122 @@
+"""Photon-phase periodicity statistics: H-test, Z^2_m, significances.
+
+(reference: src/pint/eventstats.py — hm, hmw, z2m, z2mw, sf_hm,
+sf_z2m, sig2sigma, h2sig.)
+
+All statistics are pure jnp reductions over the photon-phase axis, so
+they vmap/shard trivially over pulsars or energy bands — the TPU win
+the reference's numpy loops can't have (SURVEY.md 3.5: 1e5-1e7 photon
+phases is the natural device workload).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def z2m(phases, m=2):
+    """Z^2_m test statistic for each harmonic count 1..m.
+
+    Returns array [Z^2_1, ..., Z^2_m]
+    (reference: eventstats.py::z2m).
+    """
+    jnp = _jnp()
+    ph = jnp.asarray(phases) * (2.0 * jnp.pi)
+    n = ph.shape[-1]
+    k = jnp.arange(1, m + 1)[:, None]
+    c = jnp.sum(jnp.cos(k * ph[None, :]), axis=-1)
+    s = jnp.sum(jnp.sin(k * ph[None, :]), axis=-1)
+    terms = (2.0 / n) * (c**2 + s**2)
+    return jnp.cumsum(terms)
+
+
+def z2mw(phases, weights, m=2):
+    """Weighted Z^2_m (reference: eventstats.py::z2mw)."""
+    jnp = _jnp()
+    ph = jnp.asarray(phases) * (2.0 * jnp.pi)
+    w = jnp.asarray(weights)
+    k = jnp.arange(1, m + 1)[:, None]
+    c = jnp.sum(w[None, :] * jnp.cos(k * ph[None, :]), axis=-1)
+    s = jnp.sum(w[None, :] * jnp.sin(k * ph[None, :]), axis=-1)
+    norm = jnp.sum(w**2) / 2.0
+    return jnp.cumsum((c**2 + s**2) / norm)
+
+
+def hm(phases, m=20):
+    """H-test statistic (de Jager, Raubenheimer & Swanepoel 1989):
+    H = max_{1<=k<=m} (Z^2_k - 4k + 4)  (reference: eventstats.py::hm)."""
+    jnp = _jnp()
+    z = z2m(phases, m=m)
+    k = jnp.arange(1, m + 1)
+    return jnp.max(z - 4.0 * k + 4.0)
+
+
+def hmw(phases, weights, m=20):
+    """Weighted H-test (reference: eventstats.py::hmw)."""
+    jnp = _jnp()
+    z = z2mw(phases, weights, m=m)
+    k = jnp.arange(1, m + 1)
+    return jnp.max(z - 4.0 * k + 4.0)
+
+
+def sf_hm(h, logprob=False):
+    """Survival function (false-alarm probability) of the H-test.
+
+    de Jager & Busching 2010 calibration: sf = exp(-0.4 H)
+    (reference: eventstats.py::sf_hm).
+    """
+    h = float(h)
+    logsf = -0.4 * h
+    return logsf if logprob else math.exp(max(logsf, -745.0))
+
+
+def sf_z2m(z, m=2):
+    """Survival function of Z^2_m: chi^2 with 2m dof
+    (reference: eventstats.py::sf_z2m)."""
+    from scipy.stats import chi2
+
+    return float(chi2.sf(float(z), 2 * m))
+
+
+def sig2sigma(sig, logprob=False):
+    """One-sided survival probability -> Gaussian sigma
+    (reference: eventstats.py::sig2sigma; e.g. 2.866e-7 -> 5.0).
+    With logprob=True, sig is ln(prob) and the deep tail uses the
+    asymptotic inversion sigma ~ sqrt(-2 ln p - ln(2 pi) - 2 ln sigma).
+    """
+    from scipy.stats import norm
+
+    if logprob:
+        logp = float(sig)
+        if logp < -700.0:
+            # fixed-point on the Gaussian tail expansion
+            s = math.sqrt(-2.0 * logp)
+            for _ in range(30):
+                s = math.sqrt(-2.0 * (logp + math.log(s) + 0.5 * math.log(2 * math.pi)))
+            return s
+        sig = math.exp(logp)
+    return float(norm.isf(sig))
+
+
+def h2sig(h):
+    """H-test statistic -> Gaussian sigma (reference: eventstats.py::h2sig)."""
+    return sig2sigma(sf_hm(h))
+
+
+def hm_scan(phases_fn, f0_grid, m=20):
+    """vmap an H-test over a frequency grid: phases_fn(f0) -> phases.
+
+    TPU-native replacement for the reference's loop-over-trials in
+    event searches; the whole scan is one device program.
+    """
+    import jax
+
+    return jax.vmap(lambda f: hm(phases_fn(f), m=m))(np.asarray(f0_grid))

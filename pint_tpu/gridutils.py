@@ -1,0 +1,106 @@
+"""Grid search over fixed parameter values with refit of the rest.
+
+(reference: src/pint/gridutils.py — grid_chisq, grid_chisq_derived.)
+
+The reference farms grid points to a multiprocessing pool; here the
+whole grid is ONE device program: a fixed-iteration WLS refit is
+vmapped over grid points (SURVEY.md 2.2 "DP" row — vmap replaces the
+process pool), so a 100-point chi2 surface costs one compile plus one
+batched execution on the MXU.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _grid_fit_fn(fitter, parnames, maxiter=3, threshold=1e-12):
+    """Build (gridvals_vector -> chi2) for one grid point, jit/vmap-safe."""
+    import jax.numpy as jnp
+
+    from .fitter import wls_step
+
+    model = fitter.model
+    # grid params must live in the free-param vector to be settable on
+    # device; unfreeze temporarily (they are NOT refit: their vector
+    # entries are pinned each iteration)
+    refrozen = []
+    for p in parnames:
+        par = getattr(model, p)
+        if par.frozen:
+            par.frozen = False
+            refrozen.append(par)
+    prepared = model.prepare(fitter.toas)
+    for par in refrozen:
+        par.frozen = True
+    fmap = [n for n, _, _ in prepared.free_param_map()]
+    missing = set(parnames) - set(fmap)
+    if missing:
+        raise KeyError(f"parameters not in model free set: {missing}")
+    grid_idx = jnp.asarray([fmap.index(p) for p in parnames])
+    free_cols = np.asarray([i for i in range(len(fmap)) if fmap[i] not in parnames])
+    resid_fn = prepared.residual_vector_fn()
+    dm_fn, labels = prepared.designmatrix_fn()
+    noff = 1 if labels and labels[0] == "Offset" else 0
+    # columns of the design matrix to keep: offset + non-grid free params
+    keep_cols = np.concatenate([np.arange(noff), noff + free_cols]).astype(int)
+    x0 = prepared.vector_from_params()
+    free_idx = jnp.asarray(free_cols)
+    f0 = prepared.params0["F"][0]
+
+    def fit_point(gridvals):
+        x = x0.at[grid_idx].set(gridvals)
+        for _ in range(maxiter):
+            r = resid_fn(x)
+            sigma = prepared.scaled_sigma_us(prepared.params_with_vector(x)) * 1e-6
+            M = dm_fn(x)[:, keep_cols] / f0
+            dx, _ = wls_step(M / sigma[:, None], r / sigma, threshold)
+            x = x.at[free_idx].set(x[free_idx] - dx[noff:])
+        r = resid_fn(x)
+        sigma = prepared.scaled_sigma_us(prepared.params_with_vector(x)) * 1e-6
+        return jnp.sum(jnp.square(r / sigma))
+
+    return fit_point
+
+
+def grid_chisq(fitter, parnames, parvalues, maxiter=3, threshold=1e-12):
+    """chi2 over the outer-product grid of parvalues.
+
+    parnames: sequence of free-parameter names to hold fixed;
+    parvalues: same-length sequence of 1-D arrays. Returns an array of
+    shape (len(v0), len(v1), ...) of chi2 with all OTHER free params
+    refit at each point (reference: gridutils.py::grid_chisq; the
+    'ncpu' knob is gone — vmap covers the grid in one launch).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    grids = np.meshgrid(*[np.asarray(v, float) for v in parvalues], indexing="ij")
+    shape = grids[0].shape
+    pts = jnp.asarray(np.stack([g.ravel() for g in grids], axis=-1))
+    fit_point = _grid_fit_fn(fitter, list(parnames), maxiter, threshold)
+    chi2 = jax.jit(jax.vmap(fit_point))(pts)
+    return np.asarray(chi2).reshape(shape)
+
+
+def grid_chisq_derived(fitter, parnames, parfuncs, gridnames, gridvalues,
+                       maxiter=3, threshold=1e-12):
+    """Grid over derived quantities: parfuncs map grid coordinates to
+    the model parameters in parnames
+    (reference: gridutils.py::grid_chisq_derived).
+
+    parfuncs[i](*gridpoint) -> value of parnames[i].
+    """
+    import jax
+    import jax.numpy as jnp
+
+    grids = np.meshgrid(*[np.asarray(v, float) for v in gridvalues], indexing="ij")
+    shape = grids[0].shape
+    coords = np.stack([g.ravel() for g in grids], axis=-1)
+    # evaluate the derived->param mapping on host (cheap, python funcs)
+    pts = np.stack(
+        [[f(*c) for f in parfuncs] for c in coords], axis=0
+    ).astype(float)
+    fit_point = _grid_fit_fn(fitter, list(parnames), maxiter, threshold)
+    chi2 = jax.jit(jax.vmap(fit_point))(jnp.asarray(pts))
+    return np.asarray(chi2).reshape(shape)

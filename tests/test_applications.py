@@ -1,0 +1,170 @@
+"""Applications-layer tests: derived quantities, event statistics,
+grid search.
+
+(reference test patterns: tests/test_derived_quantities.py,
+tests/test_eventstats.py, tests/test_gridutils.py.)
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+warnings.simplefilter("ignore")
+
+from pint_tpu import derived_quantities as dq
+from pint_tpu import eventstats
+from pint_tpu.gridutils import grid_chisq, grid_chisq_derived
+from pint_tpu.fitter import WLSFitter
+from pint_tpu.models import get_model
+from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+
+# ---------------- derived quantities ----------------
+
+
+def test_p_to_f_roundtrip():
+    f, fd = dq.p_to_f(*dq.p_to_f(0.016, 1e-20))
+    assert f == pytest.approx(0.016)
+    assert fd == pytest.approx(1e-20)
+
+
+def test_mass_function_j1909():
+    # J1909-3744: Pb=1.533449 d, x=1.89799 ls -> f ~ 0.00312 Msun
+    f = dq.mass_function(1.533449, 1.89799)
+    assert f == pytest.approx(3.12e-3, rel=0.02)
+
+
+def test_companion_mass_consistency():
+    mc = dq.companion_mass(1.533449, 1.89799, sini=0.998, mp=1.45)
+    # solving forward must reproduce the mass function
+    f = dq.mass_funct2(1.45, mc, 0.998)
+    assert f == pytest.approx(dq.mass_function(1.533449, 1.89799), rel=1e-10)
+    assert 0.15 < mc < 0.30  # known ~0.21 Msun
+
+
+def test_pulsar_mass_inverts_companion_mass():
+    mc = dq.companion_mass(10.0, 5.0, sini=0.9, mp=1.6)
+    mp = dq.pulsar_mass(10.0, 5.0, mc, 0.9)
+    assert mp == pytest.approx(1.6, rel=1e-8)
+
+
+def test_age_b_edot_crab_scale():
+    # Crab-like: F0=29.946923, F1=-3.77535e-10
+    f0, f1 = 29.946923, -3.77535e-10
+    assert dq.pulsar_age(f0, f1) == pytest.approx(1256, rel=0.01)  # ~1.26 kyr
+    assert dq.pulsar_B(f0, f1) == pytest.approx(3.78e12, rel=0.01)
+    assert dq.pulsar_edot(f0, f1) == pytest.approx(4.46e31, rel=0.01)  # W
+
+
+def test_gr_pk_params_hulse_taylor():
+    # PSR B1913+16: Pb=0.322997 d, e=0.6171, mp=1.438, mc=1.390
+    mp, mc, pb, e = 1.438, 1.390, 0.322997448918, 0.6171338
+    assert dq.omdot(mp, mc, pb, e) == pytest.approx(4.226, rel=5e-3)  # deg/yr
+    assert dq.gamma(mp, mc, pb, e) == pytest.approx(4.29e-3, rel=2e-2)  # s
+    assert dq.pbdot(mp, mc, pb, e) == pytest.approx(-2.40e-12, rel=2e-2)
+
+
+def test_shklovskii():
+    # mu=10 mas/yr at 1 kpc: ~2.43e-21 1/s
+    a = dq.shklovskii_factor(10.0, 1.0)
+    assert a == pytest.approx(2.43e-21, rel=0.01)
+
+
+# ---------------- event statistics ----------------
+
+
+def test_z2m_uniform_phases_small():
+    rng = np.random.default_rng(0)
+    ph = rng.random(4000)
+    z = np.asarray(eventstats.z2m(ph, m=2))
+    # uniform phases: each Z^2_k ~ chi2(2); sum of 2 ~ chi2(4), mean 4
+    assert z[-1] < 20.0
+
+
+def test_hm_detects_pulsation():
+    rng = np.random.default_rng(1)
+    # strongly peaked phases
+    ph = (0.1 * rng.standard_normal(2000)) % 1.0
+    h = float(eventstats.hm(ph))
+    assert h > 100.0
+    assert eventstats.sf_hm(h) < 1e-17
+    assert eventstats.h2sig(h) > 5.0
+
+
+def test_hmw_weights_reduce_to_hm():
+    rng = np.random.default_rng(2)
+    ph = rng.random(500)
+    h1 = float(eventstats.hm(ph, m=5))
+    h2 = float(eventstats.hmw(ph, np.ones(500), m=5))
+    assert h1 == pytest.approx(h2, rel=1e-10)
+
+
+def test_sf_z2m_matches_chi2():
+    assert eventstats.sf_z2m(9.49, m=2) == pytest.approx(0.05, rel=0.01)
+
+
+def test_sig2sigma():
+    assert eventstats.sig2sigma(2.866e-7) == pytest.approx(5.0, rel=1e-3)
+
+
+# ---------------- grid search ----------------
+
+
+PAR = """
+PSR GRIDTEST
+RAJ 12:00:00.0
+DECJ 10:00:00.0
+F0 100.0 1
+F1 -1e-14 1
+PEPOCH 55000
+DM 15.0 1
+"""
+
+
+@pytest.fixture(scope="module")
+def grid_fitter():
+    model = get_model(PAR)
+    mjds = np.linspace(54500, 55500, 30)
+    freqs = np.where(np.arange(30) % 2, 1400.0, 800.0)
+    toas = make_fake_toas_fromMJDs(mjds, model, error_us=1.0, freq_mhz=freqs,
+                                   obs="gbt", add_noise=True, seed=7)
+    f = WLSFitter(toas, model)
+    f.fit_toas()
+    return f
+
+
+def test_grid_chisq_minimum_at_fit(grid_fitter):
+    f0_fit = grid_fitter.model.F0.value
+    df = 5e-11
+    vals = np.array([f0_fit - 40 * df, f0_fit - df, f0_fit,
+                     f0_fit + df, f0_fit + 40 * df])
+    chi2 = grid_chisq(grid_fitter, ["F0"], [vals])
+    assert chi2.shape == (5,)
+    # minimum at (or adjacent to) the fitted value; edges clearly worse
+    assert np.argmin(chi2) in (1, 2, 3)
+    assert chi2[0] > chi2[2] + 1.0
+    assert chi2[4] > chi2[2] + 1.0
+
+
+def test_grid_chisq_2d_shape(grid_fitter):
+    f0 = grid_fitter.model.F0.value
+    f1 = grid_fitter.model.F1.value
+    chi2 = grid_chisq(grid_fitter, ["F0", "F1"],
+                      [f0 + np.array([-1e-10, 0.0, 1e-10]),
+                       f1 + np.array([-1e-16, 0.0, 1e-16])])
+    assert chi2.shape == (3, 3)
+    assert np.isfinite(chi2).all()
+    # center should be the best (or tied)
+    assert chi2[1, 1] <= chi2.max()
+
+
+def test_grid_chisq_derived(grid_fitter):
+    # grid over period P, mapping to F0 = 1/P
+    f0 = grid_fitter.model.F0.value
+    p0 = 1.0 / f0
+    ps = p0 + np.array([-1e-14, 0.0, 1e-14])
+    chi2 = grid_chisq_derived(grid_fitter, ["F0"], [lambda p: 1.0 / p],
+                              ["P"], [ps])
+    assert chi2.shape == (3,)
+    assert np.isfinite(chi2).all()
