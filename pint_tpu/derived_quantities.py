@@ -48,7 +48,7 @@ def pferrs(p, perr, pd=None, pderr=None):
         return 1.0 / p, perr / p**2
     f, fd = p_to_f(p, pd)
     ferr = perr / p**2
-    fderr = math.sqrt((4.0 * pd**2 * perr**2 / p**6) + pderr**2 / p**4)
+    fderr = ((4.0 * pd**2 * perr**2 / p**6) + pderr**2 / p**4) ** 0.5
     return f, ferr, fd, fderr
 
 
@@ -77,7 +77,7 @@ def companion_mass(pb_days, a1_ls, sini=1.0, mp=1.4, iters=64):
     jit-friendly and converges monotonically).
     """
     f = mass_function(pb_days, a1_ls)
-    mc = max(f, 1e-6) if not hasattr(f, "shape") else f
+    mc = f + 1e-6  # start left of the root; Newton ascends monotonically
     for _ in range(iters):
         g = (mc * sini) ** 3 / (mp + mc) ** 2 - f
         dg = (3.0 * sini**3 * mc**2 * (mp + mc) - 2.0 * (mc * sini) ** 3) / (
@@ -91,7 +91,7 @@ def pulsar_mass(pb_days, a1_ls, mc, sini):
     """Mp [Msun] from the mass function given Mc and sin(i)
     (reference: derived_quantities.py::pulsar_mass)."""
     f = mass_function(pb_days, a1_ls)
-    return math.sqrt((mc * sini) ** 3 / f) - mc
+    return ((mc * sini) ** 3 / f) ** 0.5 - mc
 
 
 def pulsar_age(f0, f1, n=3, fo=1e99):
@@ -110,14 +110,14 @@ def pulsar_edot(f0, f1, I=_I_NS_SI):
 def pulsar_B(f0, f1):
     """Surface dipole field [Gauss]: 3.2e19 sqrt(-F1/F0^3)
     (reference: derived_quantities.py::pulsar_B)."""
-    return 3.2e19 * math.sqrt(-f1 / f0**3)
+    return 3.2e19 * (-f1 / f0**3) ** 0.5
 
 
 def pulsar_B_lightcyl(f0, f1):
     """Field at the light cylinder [Gauss]
     (reference: derived_quantities.py::pulsar_B_lightcyl)."""
     p, pd = 1.0 / f0, -f1 / f0**2
-    return 2.9e8 * p ** (-5.0 / 2.0) * math.sqrt(pd)
+    return 2.9e8 * p ** (-5.0 / 2.0) * pd ** 0.5
 
 
 def omdot(mp, mc, pb_days, e):
@@ -189,4 +189,4 @@ def dispersion_slope(dm):
 
 def pmtot(pmra_or_elong, pmdec_or_elat):
     """Total proper motion [mas/yr] (reference: utils.py::pmtot)."""
-    return math.hypot(pmra_or_elong, pmdec_or_elat)
+    return (pmra_or_elong**2 + pmdec_or_elat**2) ** 0.5

@@ -107,8 +107,10 @@ def sig2sigma(sig, logprob=False):
 
 
 def h2sig(h):
-    """H-test statistic -> Gaussian sigma (reference: eventstats.py::h2sig)."""
-    return sig2sigma(sf_hm(h))
+    """H-test statistic -> Gaussian sigma (reference: eventstats.py::h2sig).
+    Routed through log-probability so huge H (bright pulsars, 1e6+
+    photons) doesn't saturate at the f64 underflow floor."""
+    return sig2sigma(sf_hm(h, logprob=True), logprob=True)
 
 
 def hm_scan(phases_fn, f0_grid, m=20):

@@ -25,15 +25,21 @@ def _grid_fit_fn(fitter, parnames, maxiter=3, threshold=1e-12):
     # device; unfreeze temporarily (they are NOT refit: their vector
     # entries are pinned each iteration)
     refrozen = []
-    for p in parnames:
-        par = getattr(model, p)
-        if par.frozen:
-            par.frozen = False
-            refrozen.append(par)
-    prepared = model.prepare(fitter.toas)
-    for par in refrozen:
-        par.frozen = True
-    fmap = [n for n, _, _ in prepared.free_param_map()]
+    try:
+        for p in parnames:
+            par = getattr(model, p)
+            if par.frozen:
+                par.frozen = False
+                refrozen.append(par)
+        prepared = model.prepare(fitter.toas)
+        # free_param_map reads frozen flags live: snapshot while the
+        # grid params are still unfrozen
+        fmap = [n for n, _, _ in prepared.free_param_map()]
+        fpm_snapshot = prepared.free_param_map()
+        prepared.free_param_map = lambda: fpm_snapshot
+    finally:
+        for par in refrozen:
+            par.frozen = True
     missing = set(parnames) - set(fmap)
     if missing:
         raise KeyError(f"parameters not in model free set: {missing}")

@@ -1,0 +1,280 @@
+"""Polycos: piecewise-polynomial phase predictors, TEMPO format.
+
+(reference: src/pint/polycos.py — Polycos.generate_polycos,
+read_polyco_file, eval_abs_phase, eval_spin_freq, write_polyco_file.)
+
+TEMPO polyco.dat convention (per segment)::
+
+    phase(t) = RPHASE + 60 * F0 * DT + sum_k COEFF[k] * DT^k
+    freq(t)  = F0 + (1/60) * sum_k k * COEFF[k] * DT^(k-1)
+
+with DT = (t - TMID) [minutes]. Generation fits the coefficients to
+the full timing-model phase at Chebyshev nodes inside each segment —
+one vmapped least-squares per segment batch instead of the reference's
+per-segment numpy loop.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .mjd import LD
+from .toa import TOA, TOAs
+
+
+class PolycoEntry:
+    """One polyco segment (reference: polycos.py::PolycoEntry)."""
+
+    def __init__(self, tmid_mjd, mjdspan_min, rphase_int, rphase_frac,
+                 f0, ncoeff, coeffs, obs="gbt", obsfreq=1400.0, psrname="PSR"):
+        self.tmid = float(tmid_mjd)
+        self.mjdspan = float(mjdspan_min)
+        self.rphase_int = int(rphase_int)
+        self.rphase_frac = float(rphase_frac)
+        self.f0 = float(f0)
+        self.ncoeff = int(ncoeff)
+        self.coeffs = np.asarray(coeffs, dtype=np.float64)
+        self.obs = obs
+        self.obsfreq = float(obsfreq)
+        self.psrname = psrname
+
+    @property
+    def start(self):
+        return self.tmid - self.mjdspan / 2880.0
+
+    @property
+    def stop(self):
+        return self.tmid + self.mjdspan / 2880.0
+
+    def covers(self, mjd):
+        return (mjd >= self.start) & (mjd <= self.stop)
+
+    def abs_phase(self, mjd):
+        """Absolute phase (int, frac) at topocentric MJD(s)."""
+        dt_min = (np.asarray(mjd, np.float64) - self.tmid) * 1440.0
+        poly = np.polynomial.polynomial.polyval(dt_min, self.coeffs)
+        ph = self.rphase_frac + 60.0 * self.f0 * dt_min + poly
+        n = np.floor(ph)
+        return self.rphase_int + n.astype(np.int64), ph - n
+
+    def spin_freq(self, mjd):
+        """Apparent spin frequency [Hz] (reference: evalfreq)."""
+        dt_min = (np.asarray(mjd, np.float64) - self.tmid) * 1440.0
+        k = np.arange(1, self.ncoeff)
+        dpoly = np.polynomial.polynomial.polyval(dt_min, k * self.coeffs[1:])
+        return self.f0 + dpoly / 60.0
+
+
+class Polycos:
+    """Set of polyco segments (reference: polycos.py::Polycos)."""
+
+    def __init__(self, entries=()):
+        self.entries: list[PolycoEntry] = list(entries)
+
+    # ---------------- generation ----------------
+
+    @classmethod
+    def generate_polycos(cls, model, mjd_start, mjd_end, obs="gbt",
+                         segLength=60, ncoeff=12, obsFreq=1400.0,
+                         nodes_per_seg=None):
+        """Fit polyco segments to the model phase.
+
+        segLength in minutes (reference: generate_polycos signature).
+        The model phase is evaluated through the full topocentric
+        pipeline at Chebyshev nodes, then each segment's coefficients
+        come from one well-conditioned Chebyshev-Vandermonde lstsq.
+        """
+        nodes = nodes_per_seg or max(2 * ncoeff, 24)
+        seg_days = segLength / 1440.0
+        n_seg = max(1, int(math.ceil((mjd_end - mjd_start) / seg_days - 1e-9)))
+        psrname = model.PSR.value if "PSR" in model.params else "PSR"
+        entries = []
+        # Chebyshev nodes in [-1, 1] shared by all segments
+        xk = np.cos(np.pi * (2 * np.arange(nodes) + 1) / (2.0 * nodes))[::-1]
+        for i in range(n_seg):
+            t0 = mjd_start + i * seg_days
+            # quantize tmid to its file representation so the written
+            # polyco reproduces the generation-time phases exactly
+            tmid = float(f"{t0 + seg_days / 2.0:.15f}")
+            mjds = tmid + xk * seg_days / 2.0
+            ph_int, ph_frac = _model_abs_phase(model, mjds, obs, obsFreq)
+            # reference phase at tmid: nearest node's int part anchors;
+            # work in exact (int - int0) + frac space in longdouble
+            mid_idx = nodes // 2
+            rph_int = int(ph_int[mid_idx])
+            dphi = (ph_int - rph_int).astype(np.float64) + ph_frac
+            dt_min = (mjds - tmid) * 1440.0
+            f0 = float(model.F0.value)
+            resid_ph = dphi - 60.0 * f0 * dt_min
+            # Chebyshev-basis lstsq, then convert to power basis for the
+            # TEMPO file convention
+            T = np.polynomial.chebyshev.chebvander(xk, ncoeff - 1)
+            c_cheb, *_ = np.linalg.lstsq(T, resid_ph, rcond=None)
+            c_pow = np.polynomial.chebyshev.cheb2poly(c_cheb)
+            # rescale from x in [-1,1] to dt_min: x = dt_min / half_min
+            half_min = seg_days / 2.0 * 1440.0
+            c_dt = c_pow / half_min ** np.arange(len(c_pow))
+            c_dt = np.pad(c_dt, (0, ncoeff - len(c_dt)))
+            rphase_frac = float(np.polynomial.polynomial.polyval(0.0, c_dt))
+            c_dt[0] -= rphase_frac  # fold the constant into RPHASE
+            # renormalize so RPHASE = int.frac with frac in [0, 1)
+            carry = math.floor(rphase_frac)
+            rph_int += carry
+            rphase_frac -= carry
+            entries.append(PolycoEntry(
+                tmid, segLength, rph_int, rphase_frac, f0, ncoeff, c_dt,
+                obs=obs, obsfreq=obsFreq, psrname=psrname))
+        return cls(entries)
+
+    # ---------------- evaluation ----------------
+
+    def _find(self, mjds):
+        mjds = np.atleast_1d(np.asarray(mjds, np.float64))
+        idx = np.full(mjds.shape, -1, dtype=int)
+        for i, e in enumerate(self.entries):
+            m = e.covers(mjds) & (idx < 0)
+            idx[m] = i
+        if (idx < 0).any():
+            bad = mjds[idx < 0]
+            raise ValueError(f"MJDs outside polyco span: {bad[:3]}...")
+        return mjds, idx
+
+    def eval_abs_phase(self, mjds):
+        """(int, frac) absolute phase (reference: eval_abs_phase)."""
+        mjds, idx = self._find(mjds)
+        pi_ = np.empty(mjds.shape, np.int64)
+        pf = np.empty(mjds.shape, np.float64)
+        for i, e in enumerate(self.entries):
+            m = idx == i
+            if m.any():
+                pi_[m], pf[m] = e.abs_phase(mjds[m])
+        return pi_, pf
+
+    def eval_phase(self, mjds):
+        """Fractional phase in [-0.5, 0.5) (reference: eval_phase)."""
+        _, pf = self.eval_abs_phase(mjds)
+        return pf - np.round(pf)
+
+    def eval_spin_freq(self, mjds):
+        """(reference: eval_spin_freq)"""
+        mjds, idx = self._find(mjds)
+        out = np.empty(mjds.shape, np.float64)
+        for i, e in enumerate(self.entries):
+            m = idx == i
+            if m.any():
+                out[m] = e.spin_freq(mjds[m])
+        return out
+
+    # ---------------- TEMPO format I/O ----------------
+
+    def write_polyco_file(self, path):
+        """(reference: polycos.py format writer; TEMPO polyco.dat)"""
+        with open(path, "w") as f:
+            for e in self.entries:
+                date = _mjd_to_datestr(e.tmid)
+                utc = _mjd_to_utcstr(e.tmid)
+                f.write(f"{e.psrname:<10s} {date:>9s}{utc:>11s}"
+                        f"{e.tmid:24.15f}{0.0:21.6f} 0.000 0.000\n")
+                # sign-magnitude decimal: external readers parse the
+                # whole field as one signed number, so a negative
+                # absolute phase must print as -(|int|.|frac|)
+                total_neg = e.rphase_int < 0 or (e.rphase_int == 0
+                                                 and e.rphase_frac < 0)
+                if total_neg:
+                    if e.rphase_frac == 0.0:
+                        ip, fr = -e.rphase_int, 0.0
+                    else:
+                        ip, fr = -(e.rphase_int + 1), 1.0 - e.rphase_frac
+                    rph = f"-{ip}.{min(int(round(fr * 1e6)), 999999):06d}"
+                else:
+                    rph = f"{e.rphase_int}.{min(int(round(e.rphase_frac * 1e6)), 999999):06d}"
+                f.write(f"{rph:>20s}{e.f0:18.12f}{_obs_code(e.obs):>5s}"
+                        f"{e.mjdspan:10.0f}{e.ncoeff:5d}{e.obsfreq:10.3f}\n")
+                for j in range(0, e.ncoeff, 3):
+                    f.write("".join(f"{c:25.17e}" for c in e.coeffs[j:j + 3]) + "\n")
+
+    @classmethod
+    def read_polyco_file(cls, path):
+        """(reference: polycos.py::Polycos.read_polyco_file)"""
+        entries = []
+        with open(path) as f:
+            lines = [l for l in f.read().splitlines() if l.strip()]
+        i = 0
+        while i < len(lines):
+            hdr1 = lines[i].split()
+            psrname = hdr1[0]
+            tmid = float(hdr1[3])
+            hdr2 = lines[i + 1].split()
+            rph = hdr2[0]
+            # signed decimal: value = sign * (|int|.|frac|); renormalize
+            # to rphase_int + frac with frac in [0, 1)
+            neg = rph.lstrip().startswith("-")
+            body = rph.lstrip().lstrip("-")
+            if "." in body:
+                ip, fp = body.split(".")
+                rphase_int, rphase_frac = int(ip or 0), float("0." + fp)
+            else:
+                rphase_int, rphase_frac = int(body), 0.0
+            if neg:
+                if rphase_frac:
+                    rphase_int = -rphase_int - 1
+                    rphase_frac = 1.0 - rphase_frac
+                else:
+                    rphase_int = -rphase_int
+            f0 = float(hdr2[1])
+            obs = hdr2[2]
+            span = float(hdr2[3])
+            ncoeff = int(hdr2[4])
+            obsfreq = float(hdr2[5])
+            ncl = (ncoeff + 2) // 3
+            coeffs = []
+            for l in lines[i + 2: i + 2 + ncl]:
+                coeffs.extend(float(x.replace("D", "e")) for x in l.split())
+            entries.append(PolycoEntry(tmid, span, rphase_int, rphase_frac,
+                                       f0, ncoeff, coeffs, obs=obs,
+                                       obsfreq=obsfreq, psrname=psrname))
+            i += 2 + ncl
+        return cls(entries)
+
+
+def _model_abs_phase(model, mjds, obs, freq_mhz):
+    """Absolute model phase at topocentric UTC MJDs via the full pipeline."""
+    toalist = [TOA(int(m), (m - int(m)) * 86400.0, error_us=1.0,
+                   freq_mhz=freq_mhz, obs=obs) for m in mjds]
+    ephem = "de440s"
+    if "EPHEM" in model.params and model.EPHEM.value:
+        ephem = model.EPHEM.value.lower()
+    toas = TOAs(toalist, ephem=ephem)
+    toas.apply_clock_corrections()
+    toas.compute_TDBs()
+    toas.compute_posvels()
+    ph = model.prepare(toas, subtract_mean=False).phase()
+    return (np.asarray(ph.int_, np.int64), np.asarray(ph.frac, np.float64))
+
+
+def _mjd_to_datestr(mjd):
+    """MJD -> TEMPO DDMonYY-ish numeric date (uses MJD day directly)."""
+    from .mjd import mjd_to_caldate
+
+    y, mo, d = mjd_to_caldate(int(mjd))
+    return f"{d:02d}-{mo:02d}-{y % 100:02d}"
+
+
+def _mjd_to_utcstr(mjd):
+    frac = mjd - int(mjd)
+    s = frac * 86400.0
+    h = int(s // 3600)
+    m = int((s - 3600 * h) // 60)
+    sec = s - 3600 * h - 60 * m
+    return f"{h:02d}{m:02d}{sec:05.2f}"
+
+
+_OBS_CODES = {"gbt": "1", "arecibo": "3", "ao": "3", "parkes": "7",
+              "jodrell": "8", "jbo": "8", "vla": "6", "effelsberg": "g",
+              "meerkat": "m", "@": "@", "bat": "@", "geocenter": "0"}
+
+
+def _obs_code(obs):
+    return _OBS_CODES.get(str(obs).lower(), str(obs)[:1])

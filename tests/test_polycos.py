@@ -1,0 +1,100 @@
+"""Polycos tests (reference test pattern: tests/test_polycos.py —
+generate from a model, verify phase prediction against the full model,
+round-trip through the TEMPO file format)."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+warnings.simplefilter("ignore")
+
+from pint_tpu.models import get_model
+from pint_tpu.polycos import Polycos, _model_abs_phase
+
+PAR = """
+PSR POLYTEST
+RAJ 05:00:00.0
+DECJ 20:00:00.0
+F0 29.946923 1
+F1 -3.77535e-10 1
+PEPOCH 55555
+DM 56.77
+"""
+
+
+@pytest.fixture(scope="module")
+def model():
+    return get_model(PAR)
+
+
+@pytest.fixture(scope="module")
+def polycos(model):
+    return Polycos.generate_polycos(model, 55555.0, 55555.25, obs="gbt",
+                                    segLength=60, ncoeff=12)
+
+
+def test_segment_count(polycos):
+    # 0.25 d / 60 min = 6 segments
+    assert len(polycos.entries) == 6
+
+
+def test_phase_prediction_matches_model(model, polycos):
+    rng = np.random.default_rng(3)
+    mjds = 55555.0 + 0.25 * rng.random(16)
+    pi_ref, pf_ref = _model_abs_phase(model, mjds, "gbt", 1400.0)
+    pi_pc, pf_pc = polycos.eval_abs_phase(mjds)
+    dphi = (pi_pc - pi_ref).astype(float) + (pf_pc - pf_ref)
+    # reference targets ~1e-8 cycles; Chebyshev fit over 60-min segments
+    assert np.max(np.abs(dphi)) < 1e-7
+
+
+def test_spin_freq_close_to_f0(model, polycos):
+    mjds = np.array([55555.05, 55555.15])
+    f = polycos.eval_spin_freq(mjds)
+    # topocentric frequency differs from F0 by Doppler ~1e-4 fractional
+    assert np.allclose(f, model.F0.value, rtol=1e-4)
+    assert not np.allclose(f, model.F0.value, rtol=1e-9)
+
+
+def test_polyco_file_roundtrip(tmp_path, polycos):
+    path = tmp_path / "polyco.dat"
+    polycos.write_polyco_file(path)
+    back = Polycos.read_polyco_file(path)
+    assert len(back.entries) == len(polycos.entries)
+    mjds = np.array([55555.03, 55555.21])
+    pi1, pf1 = polycos.eval_abs_phase(mjds)
+    pi2, pf2 = back.eval_abs_phase(mjds)
+    dphi = (pi2 - pi1).astype(float) + (pf2 - pf1)
+    # rphase stored to 1e-6 cycles in the text format
+    assert np.max(np.abs(dphi)) < 2e-6
+    np.testing.assert_array_equal(pi1, pi2)
+
+
+def test_out_of_span_raises(polycos):
+    with pytest.raises(ValueError):
+        polycos.eval_abs_phase([55560.0])
+
+
+def test_negative_rphase_roundtrip(tmp_path):
+    # phases before the anchor are negative: the signed-decimal RPHASE
+    # field must round-trip (external readers parse it as one number)
+    from pint_tpu.polycos import PolycoEntry, Polycos
+
+    e = PolycoEntry(55000.0, 60, -12345, 0.6789, 30.0, 3,
+                    [0.0, 1e-8, 1e-12])
+    pc = Polycos([e])
+    path = tmp_path / "neg.dat"
+    pc.write_polyco_file(path)
+    # the written field must equal the true signed value
+    line2 = open(path).read().splitlines()[1].split()[0]
+    assert float(line2) == pytest.approx(-12345 + 0.6789, abs=1e-6)
+    back = Polycos.read_polyco_file(path)
+    b = back.entries[0]
+    got = b.rphase_int + b.rphase_frac
+    assert got == pytest.approx(-12345 + 0.6789, abs=1e-6)
+
+
+def test_eval_phase_wrapped(polycos):
+    ph = polycos.eval_phase(np.linspace(55555.01, 55555.24, 10))
+    assert np.all(np.abs(ph) <= 0.5)
