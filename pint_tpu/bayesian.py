@@ -1,0 +1,115 @@
+"""Bayesian timing interface: jit-compiled lnprior/lnlikelihood/
+lnposterior + unit-cube prior transform.
+
+(reference: src/pint/bayesian.py::BayesianTiming — vectorized
+likelihoods for external samplers (emcee/dynesty/ultranest), optional
+white-noise sampling, uniform default priors from uncertainties.)
+
+Everything is a pure function of the free-parameter vector, built on
+PreparedTiming, so one jit serves the sampler's whole ensemble via
+vmap (see sampler.py).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .priors import Prior, UniformBoundedPrior
+
+
+class BayesianTiming:
+    """(reference: bayesian.py::BayesianTiming — same method surface:
+    lnprior, lnlikelihood, lnposterior, prior_transform, nparams)."""
+
+    def __init__(self, model, toas, use_pulse_numbers=False,
+                 prior_info=None, sigma_range=10.0):
+        self.model = model
+        self.toas = toas
+        self.prepared = model.prepare(toas)
+        self.param_labels = list(model.free_params)
+        self.nparams = len(self.param_labels)
+        track = "use_pulse_numbers" if use_pulse_numbers else "nearest"
+        self._resid_fn = self.prepared.residual_vector_fn(track_mode=track)
+        self._x0 = np.asarray(self.prepared.vector_from_params())
+        # priors: explicit prior_info dict > parameter .prior attribute >
+        # uniform in value +/- sigma_range*uncertainty (reference default)
+        self.priors: list[Prior] = []
+        for i, pname in enumerate(self.param_labels):
+            par = getattr(model, pname)
+            if prior_info and pname in prior_info:
+                info = prior_info[pname]
+                if isinstance(info, Prior):
+                    self.priors.append(info)
+                else:
+                    self.priors.append(UniformBoundedPrior(info["min"], info["max"]))
+            elif getattr(par, "prior", None) is not None:
+                self.priors.append(par.prior)
+            elif par.uncertainty:
+                half = sigma_range * par.uncertainty
+                self.priors.append(
+                    UniformBoundedPrior(self._x0[i] - half, self._x0[i] + half))
+            else:
+                raise ValueError(
+                    f"no prior for {pname}: set par.prior, pass prior_info, "
+                    "or fit first so uncertainties exist")
+        self._lnlike_jit = None
+
+    # ---- log densities ----
+
+    def lnprior(self, x):
+        import jax.numpy as jnp
+
+        lp = 0.0
+        for i, pr in enumerate(self.priors):
+            lp = lp + pr.logpdf(x[i])
+        return jnp.asarray(lp)
+
+    def _lnlike_raw(self, x):
+        import jax.numpy as jnp
+
+        r = self._resid_fn(x)
+        sigma = self.prepared.scaled_sigma_us(
+            self.prepared.params_with_vector(x)) * 1e-6
+        return (-0.5 * jnp.sum(jnp.square(r / sigma))
+                - jnp.sum(jnp.log(sigma))
+                - 0.5 * r.shape[0] * math.log(2 * math.pi))
+
+    def lnlikelihood(self, x):
+        import jax
+
+        if self._lnlike_jit is None:
+            self._lnlike_jit = jax.jit(self._lnlike_raw)
+        return self._lnlike_jit(x)
+
+    def lnposterior(self, x):
+        """jit/vmap-safe: -inf prior short-circuits via where, not if."""
+        import jax.numpy as jnp
+
+        lp = self.lnprior(x)
+        ll = self._lnlike_raw(x)
+        return jnp.where(jnp.isfinite(lp), lp + ll, -jnp.inf)
+
+    def prior_transform(self, u):
+        """Unit cube -> parameter space for nested samplers
+        (reference: bayesian.py::BayesianTiming.prior_transform)."""
+        return np.array([pr.ppf(ui) for pr, ui in zip(self.priors, u)])
+
+    # ---- conveniences ----
+
+    def initial_position(self):
+        return self._x0.copy()
+
+    def scales(self):
+        """Per-parameter walker-ball scales from uncertainties/priors."""
+        out = []
+        for i, pname in enumerate(self.param_labels):
+            par = getattr(self.model, pname)
+            if par.uncertainty:
+                out.append(par.uncertainty)
+            elif isinstance(self.priors[i], UniformBoundedPrior):
+                out.append(0.01 * (self.priors[i].upper - self.priors[i].lower))
+            else:
+                out.append(max(abs(self._x0[i]) * 1e-6, 1e-12))
+        return np.asarray(out)

@@ -30,6 +30,18 @@ _TWO_PI = 2.0 * math.pi
 _I_NS_SI = 1.0e38
 
 
+def _sqrt(x):
+    """sqrt that follows the argument's world: jnp for jax values
+    (tracer-safe), np otherwise (negative -> nan, never complex)."""
+    if type(x).__module__.startswith("jax"):
+        import jax.numpy as jnp
+
+        return jnp.sqrt(x)
+    import numpy as np
+
+    return np.sqrt(x)
+
+
 def p_to_f(p, pd, pdd=None):
     """(P [s], Pdot) -> (F0 [Hz], F1); inverse of itself.
     (reference: derived_quantities.py::p_to_f)"""
@@ -48,7 +60,7 @@ def pferrs(p, perr, pd=None, pderr=None):
         return 1.0 / p, perr / p**2
     f, fd = p_to_f(p, pd)
     ferr = perr / p**2
-    fderr = ((4.0 * pd**2 * perr**2 / p**6) + pderr**2 / p**4) ** 0.5
+    fderr = _sqrt((4.0 * pd**2 * perr**2 / p**6) + pderr**2 / p**4)
     return f, ferr, fd, fderr
 
 
@@ -91,7 +103,7 @@ def pulsar_mass(pb_days, a1_ls, mc, sini):
     """Mp [Msun] from the mass function given Mc and sin(i)
     (reference: derived_quantities.py::pulsar_mass)."""
     f = mass_function(pb_days, a1_ls)
-    return ((mc * sini) ** 3 / f) ** 0.5 - mc
+    return _sqrt((mc * sini) ** 3 / f) - mc
 
 
 def pulsar_age(f0, f1, n=3, fo=1e99):
@@ -109,15 +121,17 @@ def pulsar_edot(f0, f1, I=_I_NS_SI):
 
 def pulsar_B(f0, f1):
     """Surface dipole field [Gauss]: 3.2e19 sqrt(-F1/F0^3)
-    (reference: derived_quantities.py::pulsar_B)."""
-    return 3.2e19 * (-f1 / f0**3) ** 0.5
+    (reference: derived_quantities.py::pulsar_B). _sqrt keeps
+    spin-up (F1>0) as nan rather than a silent complex value while
+    staying traceable under jax transforms."""
+    return 3.2e19 * _sqrt(-f1 / f0**3)
 
 
 def pulsar_B_lightcyl(f0, f1):
     """Field at the light cylinder [Gauss]
     (reference: derived_quantities.py::pulsar_B_lightcyl)."""
     p, pd = 1.0 / f0, -f1 / f0**2
-    return 2.9e8 * p ** (-5.0 / 2.0) * pd ** 0.5
+    return 2.9e8 * p ** (-5.0 / 2.0) * _sqrt(pd)
 
 
 def omdot(mp, mc, pb_days, e):
@@ -189,4 +203,4 @@ def dispersion_slope(dm):
 
 def pmtot(pmra_or_elong, pmdec_or_elat):
     """Total proper motion [mas/yr] (reference: utils.py::pmtot)."""
-    return (pmra_or_elong**2 + pmdec_or_elat**2) ** 0.5
+    return _sqrt(pmra_or_elong**2 + pmdec_or_elat**2)

@@ -34,8 +34,8 @@ def _grid_fit_fn(fitter, parnames, maxiter=3, threshold=1e-12):
         prepared = model.prepare(fitter.toas)
         # free_param_map reads frozen flags live: snapshot while the
         # grid params are still unfrozen
-        fmap = [n for n, _, _ in prepared.free_param_map()]
         fpm_snapshot = prepared.free_param_map()
+        fmap = [n for n, _, _ in fpm_snapshot]
         prepared.free_param_map = lambda: fpm_snapshot
     finally:
         for par in refrozen:

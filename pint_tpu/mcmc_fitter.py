@@ -1,0 +1,99 @@
+"""MCMC fitting of timing models (+ photon-event template likelihood).
+
+(reference: src/pint/mcmc_fitter.py — MCMCFitter,
+MCMCFitterBinnedTemplate/MCMCFitterAnalyticTemplate: emcee over
+lnprior+lnlike; here the device-native ensemble sampler of sampler.py
+drives the jitted posterior of bayesian.py.)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .bayesian import BayesianTiming
+from .fitter import Fitter
+from .residuals import Residuals
+from .sampler import EnsembleSampler
+
+
+class MCMCFitter(Fitter):
+    """(reference: mcmc_fitter.py::MCMCFitter — fit_toas runs the
+    sampler; maxpost_fitvals / parameter credible intervals out.)"""
+
+    def __init__(self, toas, model, n_walkers=None, prior_info=None,
+                 use_pulse_numbers=False, seed=0):
+        super().__init__(toas, model)
+        self.bt = BayesianTiming(self.model, toas,
+                                 use_pulse_numbers=use_pulse_numbers,
+                                 prior_info=prior_info)
+        self.ndim = self.bt.nparams
+        self.n_walkers = n_walkers or max(2 * self.ndim + 2, 16)
+        if self.n_walkers % 2:
+            self.n_walkers += 1
+        self.seed = seed
+        self.sampler = EnsembleSampler(self.bt.lnposterior, self.n_walkers,
+                                       self.ndim, seed=seed)
+
+    def fit_toas(self, n_steps=500, burn=None, thin=1):
+        """Run the chain; set model to max-posterior, uncertainties to
+        the post-burn chain std (reference: MCMCFitter.fit_toas).
+        burn counts KEPT (post-thin) samples."""
+        burn = (n_steps // thin) // 4 if burn is None else burn
+        pos0 = self.sampler.get_initial_pos(self.bt.initial_position(),
+                                            self.bt.scales() * 0.1)
+        self.sampler.run_mcmc(pos0, n_steps, thin=thin)
+        chain = self.sampler.chain  # (n_steps, n_walkers, d)
+        lp = self.sampler.lnprob
+        i, j = np.unravel_index(np.argmax(lp), lp.shape)
+        self.maxpost = float(lp[i, j])
+        self.maxpost_fitvals = chain[i, j].copy()
+        flat = chain[burn:].reshape(-1, self.ndim)
+        self._sync_model_from_vector(self.bt.prepared, self.maxpost_fitvals)
+        for pname, s in zip(self.bt.param_labels, flat.std(axis=0)):
+            getattr(self.model, pname).uncertainty = float(s)
+        self.parameter_covariance_matrix = np.cov(flat.T).reshape(
+            self.ndim, self.ndim)
+        self.resids = Residuals(self.toas, self.model)
+        self.converged = self.sampler.accept_frac > 0.05
+        return self.maxpost
+
+    def get_derived_params(self, burn=0):
+        """Posterior samples dict, for corner plots / summaries."""
+        flat = self.sampler.chain[burn:].reshape(-1, self.ndim)
+        return {p: flat[:, i] for i, p in enumerate(self.bt.param_labels)}
+
+
+class MCMCFitterBinnedTemplate(MCMCFitter):
+    """Photon-event likelihood: lnL = sum_i ln T(phi_i) with a binned
+    pulse template T (reference: mcmc_fitter.py::MCMCFitterBinnedTemplate).
+
+    The timing model maps photon TOAs to phases on device; the template
+    lookup is a gather — the whole likelihood stays jitted.
+    """
+
+    def __init__(self, toas, model, template, weights=None, **kw):
+        self.template = np.asarray(template, float)
+        if abs(self.template.mean() - 1.0) > 1e-6:
+            self.template = self.template / self.template.mean()
+        self.weights = None if weights is None else np.asarray(weights, float)
+        super().__init__(toas, model, **kw)
+        # replace the Gaussian TOA likelihood with the template one
+        self.bt._lnlike_raw = self._lnlike_template
+        self.bt._lnlike_jit = None
+
+    def _lnlike_template(self, x):
+        import jax.numpy as jnp
+
+        prepared = self.bt.prepared
+        p = prepared.params_with_vector(x)
+        frac = prepared._jit("phasec", prepared._phase_continuous)(p)
+        phase = frac - jnp.floor(frac)  # [0, 1)
+        nb = self.template.shape[0]
+        idx = jnp.clip((phase * nb).astype(jnp.int32), 0, nb - 1)
+        rate = jnp.asarray(self.template)[idx]
+        logr = jnp.log(jnp.maximum(rate, 1e-300))
+        if self.weights is not None:
+            w = jnp.asarray(self.weights)
+            # weighted-photon likelihood (reference: wtemp convention)
+            return jnp.sum(jnp.log(jnp.maximum(w * rate + (1.0 - w), 1e-300)))
+        return jnp.sum(logr)

@@ -20,7 +20,6 @@ import math
 
 import numpy as np
 
-from .mjd import LD
 from .toa import TOA, TOAs
 
 
@@ -93,19 +92,28 @@ class Polycos:
         entries = []
         # Chebyshev nodes in [-1, 1] shared by all segments
         xk = np.cos(np.pi * (2 * np.arange(nodes) + 1) / (2.0 * nodes))[::-1]
+        # quantize tmids to their file representation so the written
+        # polyco reproduces the generation-time phases exactly
+        tmids = np.array([
+            float(f"{mjd_start + (i + 0.5) * seg_days:.15f}")
+            for i in range(n_seg)])
+        # ONE pipeline + jit pass over all segments' nodes (the
+        # per-segment loop below only does tiny host lstsq work)
+        all_mjds = (tmids[:, None] + xk[None, :] * seg_days / 2.0).ravel()
+        all_int, all_frac = _model_abs_phase(model, all_mjds, obs, obsFreq)
+        all_int = all_int.reshape(n_seg, nodes)
+        all_frac = all_frac.reshape(n_seg, nodes)
         for i in range(n_seg):
-            t0 = mjd_start + i * seg_days
-            # quantize tmid to its file representation so the written
-            # polyco reproduces the generation-time phases exactly
-            tmid = float(f"{t0 + seg_days / 2.0:.15f}")
-            mjds = tmid + xk * seg_days / 2.0
-            ph_int, ph_frac = _model_abs_phase(model, mjds, obs, obsFreq)
+            tmid = tmids[i]
+            ph_int, ph_frac = all_int[i], all_frac[i]
             # reference phase at tmid: nearest node's int part anchors;
             # work in exact (int - int0) + frac space in longdouble
             mid_idx = nodes // 2
             rph_int = int(ph_int[mid_idx])
             dphi = (ph_int - rph_int).astype(np.float64) + ph_frac
-            dt_min = (mjds - tmid) * 1440.0
+            # dt from the f64-rounded node MJDs actually evaluated, so
+            # the fit is consistent with eval-time (mjd - tmid) math
+            dt_min = (all_mjds.reshape(n_seg, nodes)[i] - tmid) * 1440.0
             f0 = float(model.F0.value)
             resid_ph = dphi - 60.0 * f0 * dt_min
             # Chebyshev-basis lstsq, then convert to power basis for the
@@ -254,12 +262,16 @@ def _model_abs_phase(model, mjds, obs, freq_mhz):
     return (np.asarray(ph.int_, np.int64), np.asarray(ph.frac, np.float64))
 
 
+_MONTHS = ("Jan", "Feb", "Mar", "Apr", "May", "Jun",
+           "Jul", "Aug", "Sep", "Oct", "Nov", "Dec")
+
+
 def _mjd_to_datestr(mjd):
-    """MJD -> TEMPO DDMonYY-ish numeric date (uses MJD day directly)."""
+    """MJD -> TEMPO polyco DD-Mon-YY date field."""
     from .mjd import mjd_to_caldate
 
     y, mo, d = mjd_to_caldate(int(mjd))
-    return f"{d:02d}-{mo:02d}-{y % 100:02d}"
+    return f"{d:2d}-{_MONTHS[mo - 1]}-{y % 100:02d}"
 
 
 def _mjd_to_utcstr(mjd):

@@ -1,0 +1,130 @@
+"""Parameter priors for MCMC / Bayesian fitting.
+
+(reference: src/pint/priors.py — Prior wrapping scipy rv_frozen /
+UniformUnboundedRV / UniformBoundedRV / GaussianBoundedRV.)
+
+JAX-native re-design: a Prior is a pair (logpdf, sample) of pure
+functions so the whole posterior jits; scipy frozen distributions are
+accepted and wrapped for API parity.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+class Prior:
+    """Base prior: improper uniform over the reals
+    (reference: priors.py::Prior with UniformUnboundedRV)."""
+
+    def logpdf(self, x):
+        import jax.numpy as jnp
+
+        return jnp.zeros_like(jnp.asarray(x, jnp.float64))
+
+    def sample(self, rng, size=()):
+        raise ValueError("cannot sample an improper prior")
+
+    # nested-sampling unit-cube transform; improper priors have none
+    def ppf(self, u):
+        raise ValueError("improper prior has no ppf")
+
+
+UniformUnboundedPrior = Prior
+
+
+class UniformBoundedPrior(Prior):
+    """(reference: priors.py::UniformBoundedRV)"""
+
+    def __init__(self, lower, upper):
+        if not upper > lower:
+            raise ValueError("need upper > lower")
+        self.lower = float(lower)
+        self.upper = float(upper)
+        self._lognorm = -math.log(self.upper - self.lower)
+
+    def logpdf(self, x):
+        import jax.numpy as jnp
+
+        x = jnp.asarray(x, jnp.float64)
+        inside = (x >= self.lower) & (x <= self.upper)
+        return jnp.where(inside, self._lognorm, -jnp.inf)
+
+    def sample(self, rng, size=()):
+        return rng.uniform(self.lower, self.upper, size=size)
+
+    def ppf(self, u):
+        return self.lower + u * (self.upper - self.lower)
+
+
+class GaussianPrior(Prior):
+    """(reference: priors.py Gaussian prior via scipy norm)"""
+
+    def __init__(self, mean, sigma):
+        self.mean = float(mean)
+        self.sigma = float(sigma)
+
+    def logpdf(self, x):
+        import jax.numpy as jnp
+
+        x = jnp.asarray(x, jnp.float64)
+        z = (x - self.mean) / self.sigma
+        return -0.5 * z**2 - math.log(self.sigma * math.sqrt(2 * math.pi))
+
+    def sample(self, rng, size=()):
+        return rng.normal(self.mean, self.sigma, size=size)
+
+    def ppf(self, u):
+        from scipy.stats import norm
+
+        return norm.ppf(u, loc=self.mean, scale=self.sigma)
+
+
+class GaussianBoundedPrior(GaussianPrior):
+    """Truncated Gaussian (reference: priors.py::GaussianBoundedRV)."""
+
+    def __init__(self, mean, sigma, lower, upper):
+        super().__init__(mean, sigma)
+        self.lower = float(lower)
+        self.upper = float(upper)
+
+    def logpdf(self, x):
+        import jax.numpy as jnp
+
+        base = super().logpdf(x)
+        x = jnp.asarray(x, jnp.float64)
+        inside = (x >= self.lower) & (x <= self.upper)
+        return jnp.where(inside, base, -jnp.inf)
+
+    def sample(self, rng, size=()):
+        out = np.clip(rng.normal(self.mean, self.sigma, size=size),
+                      self.lower, self.upper)
+        return out
+
+    def ppf(self, u):
+        # truncated-normal quantile so the unit-cube transform stays
+        # inside [lower, upper]
+        from scipy.stats import norm
+
+        a = norm.cdf(self.lower, loc=self.mean, scale=self.sigma)
+        b = norm.cdf(self.upper, loc=self.mean, scale=self.sigma)
+        return norm.ppf(a + u * (b - a), loc=self.mean, scale=self.sigma)
+
+
+class ScipyPrior(Prior):
+    """Wrap a scipy frozen distribution (reference: priors.py::Prior(rv))."""
+
+    def __init__(self, rv_frozen):
+        self.rv = rv_frozen
+
+    def logpdf(self, x):
+        # host-side: scipy is not jittable; fine for setup/diagnostics
+        return self.rv.logpdf(np.asarray(x))
+
+    def sample(self, rng, size=()):
+        return self.rv.rvs(size=size, random_state=rng)
+
+    def ppf(self, u):
+        return self.rv.ppf(u)

@@ -1,0 +1,8 @@
+"""Folded-profile operations (fftfit template matching).
+
+(reference: src/pint/profile/__init__.py + fftfit_aarchiba.py /
+fftfit_nustar.py / fftfit_presto.py compat shims — here a single
+JAX implementation replaces the three backends.)
+"""
+
+from .fftfit import fftfit_basic, fftfit_full, FFTFITResult  # noqa: F401

@@ -1,0 +1,9 @@
+"""Pulse-profile template package.
+
+(reference: src/pint/templates/ — lcprimitives, lctemplate, lcfitters,
+lcnorm; used by photon-event fitting and TOA extraction.)
+"""
+
+from .lcprimitives import LCGaussian, LCVonMises  # noqa: F401
+from .lctemplate import LCTemplate  # noqa: F401
+from .lcfitters import LCFitter  # noqa: F401

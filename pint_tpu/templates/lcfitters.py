@@ -1,0 +1,100 @@
+"""LCFitter: unbinned maximum-likelihood fit of a template to photon
+phases.
+
+(reference: src/pint/templates/lcfitters.py — LCFitter.fit with
+unbinned loglikelihood sum(log f(phi_i)) [optionally weighted],
+scipy minimize backend.)
+
+TPU-native: the log-likelihood and its gradient are one jitted reduce
+over the photon axis; optimization is a small fixed-iteration Adam
+loop on host driving device grads (no scipy dependency in the hot
+path, and 1e6+ photons batch cleanly).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class LCFitter:
+    def __init__(self, template, phases, weights=None):
+        self.template = template
+        self.phases = np.asarray(phases, float) % 1.0
+        self.weights = None if weights is None else np.asarray(weights, float)
+
+    def loglikelihood(self, vec=None):
+        import jax.numpy as jnp
+
+        fn, vec0 = self.template.gradient_ready()
+        v = jnp.asarray(vec0 if vec is None else vec)
+        f = fn(v, jnp.asarray(self.phases))
+        if self.weights is None:
+            return jnp.sum(jnp.log(jnp.maximum(f, 1e-300)))
+        w = jnp.asarray(self.weights)
+        return jnp.sum(jnp.log(jnp.maximum(w * f + (1.0 - w), 1e-300)))
+
+    def fit(self, steps=400, lr=3e-3):
+        """Maximize the unbinned likelihood; returns final logL.
+
+        Positivity/simplex constraints are enforced by projection after
+        each step (norms in [0, 1], widths > 1e-4), matching the
+        reference's bounded fit behavior.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        fn, vec0 = self.template.gradient_ready()
+        ph = jnp.asarray(self.phases)
+        w = None if self.weights is None else jnp.asarray(self.weights)
+        n_norm = len(self.template.primitives)
+
+        def negll(v):
+            f = fn(v, ph)
+            if w is None:
+                return -jnp.sum(jnp.log(jnp.maximum(f, 1e-300)))
+            return -jnp.sum(jnp.log(jnp.maximum(w * f + (1.0 - w), 1e-300)))
+
+        grad = jax.jit(jax.grad(negll))
+        val = jax.jit(negll)
+        v = jnp.asarray(vec0)
+        # Adam
+        m = jnp.zeros_like(v)
+        s = jnp.zeros_like(v)
+        b1, b2, eps = 0.9, 0.999, 1e-8
+        for t in range(1, steps + 1):
+            g = grad(v)
+            m = b1 * m + (1 - b1) * g
+            s = b2 * s + (1 - b2) * g**2
+            mhat = m / (1 - b1**t)
+            shat = s / (1 - b2**t)
+            v = v - lr * mhat / (jnp.sqrt(shat) + eps)
+            # project: norms within [1e-5, 1-1e-5] (and simplex), widths positive
+            norms = jnp.clip(v[:n_norm], 1e-5, 1.0 - 1e-5)
+            total = jnp.sum(norms)
+            norms = jnp.where(total > 1.0 - 1e-5,
+                              norms * (1.0 - 1e-5) / total, norms)
+            v = v.at[:n_norm].set(norms)
+            i = n_norm
+            for pr in self.template.primitives:
+                v = v.at[i].set(jnp.maximum(v[i], 1e-4))  # width param
+                v = v.at[i + pr.n_params - 1].set(v[i + pr.n_params - 1] % 1.0)
+                i += pr.n_params
+        self.template.set_parameters(np.asarray(v))
+        self.ll = -float(val(v))
+        return self.ll
+
+    def phase_shift_uncertainty(self):
+        """Cramer-Rao sigma of an overall phase shift, from the Fisher
+        information of the fitted template (used for TOA errors)."""
+        import jax
+        import jax.numpy as jnp
+
+        fn, vec0 = self.template.gradient_ready()
+        ph = jnp.asarray(self.phases)
+
+        def ll_of_shift(dphi):
+            f = fn(jnp.asarray(vec0), (ph + dphi) % 1.0)
+            return jnp.sum(jnp.log(jnp.maximum(f, 1e-300)))
+
+        info = -jax.hessian(ll_of_shift)(0.0)
+        return float(1.0 / jnp.sqrt(jnp.maximum(info, 1e-300)))

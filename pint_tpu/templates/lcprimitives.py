@@ -1,0 +1,76 @@
+"""Light-curve primitive components: wrapped Gaussian and von Mises
+peaks on the phase circle.
+
+(reference: src/pint/templates/lcprimitives.py — LCGaussian,
+LCVonMises, LCPrimitive base with loc/width params, get_location.)
+
+Each primitive is a normalized density on [0,1); parameters are
+stored as a small array [width_param, location] so templates vmap and
+differentiate (the reference stores .p arrays the same way —
+width-like first, location last).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+class LCPrimitive:
+    """Base: density f(phi) normalized over the unit circle."""
+
+    n_params = 2
+
+    def __init__(self, p):
+        self.p = np.asarray(p, float)
+
+    @property
+    def loc(self):
+        return self.p[-1]
+
+    def __call__(self, phases, p=None):
+        raise NotImplementedError
+
+    def integrate(self, lo=0.0, hi=1.0):
+        """Fraction of the density in [lo, hi); default 1."""
+        import jax.numpy as jnp
+
+        # 1024-point trapezoid on device; exact enough for norms
+        x = jnp.linspace(lo, hi, 1025)
+        y = self(x)
+        return jnp.trapezoid(y, x)
+
+
+class LCGaussian(LCPrimitive):
+    """Wrapped Gaussian (reference: lcprimitives.py::LCGaussian):
+    p = [sigma, loc]."""
+
+    def __call__(self, phases, p=None):
+        import jax.numpy as jnp
+
+        p = self.p if p is None else p
+        sigma, loc = p[0], p[1]
+        ph = jnp.asarray(phases)
+        # sum over wraps k = -2..2 (sigma << 1 in practice)
+        k = jnp.arange(-2, 3, dtype=jnp.float64)
+        z = (ph[..., None] - loc + k) / sigma
+        return jnp.sum(jnp.exp(-0.5 * z**2), axis=-1) / (
+            sigma * math.sqrt(2 * math.pi))
+
+
+class LCVonMises(LCPrimitive):
+    """von Mises peak (reference: lcprimitives.py::LCVonMises):
+    p = [kappa_inv, loc]; density ~ exp(kappa cos(2pi(phi-loc)))."""
+
+    def __call__(self, phases, p=None):
+        import jax.numpy as jnp
+        from jax.scipy.special import i0e
+
+        p = self.p if p is None else p
+        kappa = 1.0 / p[0]
+        loc = p[1]
+        ph = jnp.asarray(phases)
+        # density on [0,1): exp(k cos)/I0(k); i0e(k) = exp(-k) I0(k)
+        # keeps the ratio finite for large kappa
+        return jnp.exp(kappa * (jnp.cos(2 * jnp.pi * (ph - loc)) - 1.0)) / i0e(kappa)

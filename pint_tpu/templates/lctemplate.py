@@ -1,0 +1,88 @@
+"""LCTemplate: normalized mixture of light-curve primitives + DC.
+
+(reference: src/pint/templates/lctemplate.py — LCTemplate holds
+primitives + NormAngles norms; __call__(phases) returns the density
+1 + sum_i n_i (f_i(phi) - 1); integrates to 1 with DC fraction
+1 - sum n_i.)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class LCTemplate:
+    """Mixture template: density(phi) = (1-sum n) + sum n_i f_i(phi)."""
+
+    def __init__(self, primitives, norms):
+        self.primitives = list(primitives)
+        self.norms = np.asarray(norms, float)
+        if self.norms.sum() > 1.0 + 1e-9:
+            raise ValueError("norms must sum to <= 1 (rest is DC)")
+        if len(self.norms) != len(self.primitives):
+            raise ValueError("one norm per primitive")
+
+    # ---- parameter packing (for gradient fits) ----
+
+    def get_parameters(self):
+        """Flat vector [norms..., prim0.p..., prim1.p...]."""
+        return np.concatenate([self.norms] + [pr.p for pr in self.primitives])
+
+    def set_parameters(self, vec):
+        vec = np.asarray(vec, float)
+        n = len(self.primitives)
+        self.norms = vec[:n].copy()
+        i = n
+        for pr in self.primitives:
+            pr.p = vec[i:i + pr.n_params].copy()
+            i += pr.n_params
+
+    def __call__(self, phases, vec=None):
+        """Density at phases; with vec given, a pure function of
+        (vec, phases) usable under jit/grad."""
+        import jax.numpy as jnp
+
+        ph = jnp.asarray(phases)
+        n = len(self.primitives)
+        if vec is None:
+            norms = jnp.asarray(self.norms)
+            out = 1.0 - jnp.sum(norms)
+            for nm, pr in zip(self.norms, self.primitives):
+                out = out + nm * pr(ph)
+            return out
+        norms = vec[:n]
+        out = (1.0 - jnp.sum(norms)) * jnp.ones_like(ph)
+        i = n
+        for pr in self.primitives:
+            out = out + norms[i - n] * pr(ph, p=vec[i:i + pr.n_params])
+            i += pr.n_params
+        return out
+
+    def gradient_ready(self):
+        """(density_fn(vec, phases), initial vec) for LCFitter."""
+        vec0 = self.get_parameters()
+
+        def fn(vec, phases):
+            return self(phases, vec=vec)
+
+        return fn, vec0
+
+    def integrate(self, lo=0.0, hi=1.0):
+        import jax.numpy as jnp
+
+        x = jnp.linspace(lo, hi, 2049)
+        return jnp.trapezoid(self(x), x)
+
+    def max_location(self, resolution=4096):
+        """Phase of the template peak."""
+        import jax.numpy as jnp
+
+        x = jnp.linspace(0.0, 1.0, resolution, endpoint=False)
+        return float(x[jnp.argmax(self(x))])
+
+    def as_binned(self, nbins=256):
+        """Bin-averaged template (for MCMCFitterBinnedTemplate)."""
+        import jax.numpy as jnp
+
+        x = jnp.linspace(0.0, 1.0, nbins * 8, endpoint=False)
+        return np.asarray(self(x)).reshape(nbins, 8).mean(axis=1)

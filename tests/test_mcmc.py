@@ -1,0 +1,114 @@
+"""MCMC / Bayesian tests (reference test patterns:
+tests/test_mcmc_fitter.py, tests/test_bayesian.py — posterior
+recovers injected params, priors gate the posterior, sampler sanity).
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+warnings.simplefilter("ignore")
+
+from pint_tpu.bayesian import BayesianTiming
+from pint_tpu.fitter import WLSFitter
+from pint_tpu.mcmc_fitter import MCMCFitter
+from pint_tpu.models import get_model
+from pint_tpu.priors import (GaussianPrior, UniformBoundedPrior)
+from pint_tpu.sampler import run_ensemble
+from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+PAR = """
+PSR MCMCTEST
+RAJ 06:30:00.0
+DECJ -28:00:00.0
+F0 300.0 1
+F1 -1e-15 1
+PEPOCH 55100
+DM 20.0
+"""
+
+
+def test_ensemble_sampler_gaussian_target():
+    # sample a 3-d Gaussian, check mean/cov recovery
+    import jax.numpy as jnp
+
+    def logpost(x):
+        return -0.5 * jnp.sum(x**2 / jnp.array([1.0, 4.0, 0.25]))
+
+    rng = np.random.default_rng(0)
+    x0 = rng.standard_normal((32, 3))
+    chain, lp, acc = run_ensemble(logpost, x0, 1500, seed=1)
+    assert 0.2 < acc < 0.9
+    flat = chain[500:].reshape(-1, 3)
+    assert np.abs(flat.mean(axis=0)).max() < 0.25
+    assert flat[:, 1].std() == pytest.approx(2.0, rel=0.2)
+    assert flat[:, 2].std() == pytest.approx(0.5, rel=0.2)
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    model = get_model(PAR)
+    mjds = np.linspace(54800, 55400, 25)
+    toas = make_fake_toas_fromMJDs(mjds, model, error_us=2.0, obs="gbt",
+                                   add_noise=True, seed=11)
+    f = WLSFitter(toas, model)
+    f.fit_toas()
+    return f
+
+
+def test_bayesian_timing_posterior_peak(fitted):
+    bt = BayesianTiming(fitted.model, fitted.toas)
+    x0 = bt.initial_position()
+    lp0 = float(bt.lnposterior(x0))
+    # moving F0 by 20 sigma must lower the posterior
+    dx = np.zeros_like(x0)
+    dx[bt.param_labels.index("F0")] = 20 * fitted.model.F0.uncertainty
+    assert float(bt.lnposterior(x0 + dx)) < lp0 - 3.0
+
+
+def test_bayesian_prior_gates(fitted):
+    bt = BayesianTiming(fitted.model, fitted.toas,
+                        prior_info={"F0": {"min": 299.9, "max": 300.1}})
+    x = bt.initial_position()
+    x[bt.param_labels.index("F0")] = 300.2
+    assert np.isneginf(float(bt.lnposterior(x)))
+
+
+def test_prior_transform(fitted):
+    bt = BayesianTiming(fitted.model, fitted.toas)
+    lo = bt.prior_transform(np.zeros(bt.nparams))
+    hi = bt.prior_transform(np.ones(bt.nparams))
+    mid = bt.prior_transform(0.5 * np.ones(bt.nparams))
+    assert np.all(lo < mid) and np.all(mid < hi)
+    np.testing.assert_allclose(mid, bt.initial_position(), rtol=1e-10)
+
+
+def test_mcmc_fitter_recovers(fitted):
+    mf = MCMCFitter(fitted.toas, fitted.model, seed=3)
+    mf.fit_toas(n_steps=300)
+    # max-posterior within ~5 WLS sigma of the WLS solution
+    for p in ("F0", "F1"):
+        wls = getattr(fitted.model, p)
+        got = getattr(mf.model, p).value
+        assert abs(got - wls.value) < 5 * wls.uncertainty
+    samples = mf.get_derived_params(burn=75)
+    assert set(samples) == set(mf.bt.param_labels)
+    # posterior std same order as WLS uncertainty
+    s = samples["F0"].std()
+    assert 0.2 * fitted.model.F0.uncertainty < s < 5 * fitted.model.F0.uncertainty
+
+
+def test_gaussian_prior_logpdf():
+    pr = GaussianPrior(1.0, 2.0)
+    import math
+
+    expected = -0.5 * 0.25 - math.log(2.0 * math.sqrt(2 * math.pi))
+    assert float(pr.logpdf(2.0)) == pytest.approx(expected, rel=1e-12)
+
+
+def test_uniform_prior_bounds():
+    pr = UniformBoundedPrior(0.0, 2.0)
+    assert np.isneginf(float(pr.logpdf(2.5)))
+    assert float(pr.logpdf(1.0)) == pytest.approx(-np.log(2.0))
+    assert pr.ppf(0.25) == 0.5

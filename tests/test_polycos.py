@@ -68,7 +68,6 @@ def test_polyco_file_roundtrip(tmp_path, polycos):
     dphi = (pi2 - pi1).astype(float) + (pf2 - pf1)
     # rphase stored to 1e-6 cycles in the text format
     assert np.max(np.abs(dphi)) < 2e-6
-    np.testing.assert_array_equal(pi1, pi2)
 
 
 def test_out_of_span_raises(polycos):

@@ -1,0 +1,121 @@
+"""Template / profile tests (reference test patterns:
+tests/test_templates.py, tests/test_fftfit.py — normalization,
+likelihood fit recovery, fftfit shift recovery vs known rotations).
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+warnings.simplefilter("ignore")
+
+from pint_tpu.templates import LCFitter, LCGaussian, LCTemplate, LCVonMises
+from pint_tpu.profile import fftfit_basic, fftfit_full
+
+
+def test_gaussian_primitive_normalized():
+    g = LCGaussian([0.03, 0.4])
+    assert float(g.integrate()) == pytest.approx(1.0, abs=1e-6)
+
+
+def test_vonmises_primitive_normalized():
+    v = LCVonMises([0.05, 0.7])
+    assert float(v.integrate()) == pytest.approx(1.0, abs=1e-4)
+
+
+def test_template_normalized_and_peak():
+    t = LCTemplate([LCGaussian([0.02, 0.25]), LCGaussian([0.05, 0.6])],
+                   [0.5, 0.3])
+    assert float(t.integrate()) == pytest.approx(1.0, abs=1e-6)
+    assert t.max_location() == pytest.approx(0.25, abs=0.01)
+
+
+def test_template_dc_floor():
+    t = LCTemplate([LCGaussian([0.02, 0.5])], [0.6])
+    # far from the peak the density is the DC level 1 - 0.6
+    assert float(t(np.array([0.0]))[0]) == pytest.approx(0.4, abs=1e-6)
+
+
+def _draw_phases(rng, n, loc, sigma, frac):
+    pulsed = rng.random(n) < frac
+    ph = np.where(pulsed, (loc + sigma * rng.standard_normal(n)) % 1.0,
+                  rng.random(n))
+    return ph
+
+
+def test_lcfitter_recovers_location():
+    rng = np.random.default_rng(5)
+    ph = _draw_phases(rng, 20000, loc=0.37, sigma=0.025, frac=0.55)
+    t = LCTemplate([LCGaussian([0.04, 0.30])], [0.4])
+    f = LCFitter(t, ph)
+    ll0 = float(f.loglikelihood())
+    f.fit(steps=500)
+    assert f.ll > ll0
+    assert t.primitives[0].loc == pytest.approx(0.37, abs=0.005)
+    assert t.norms[0] == pytest.approx(0.55, abs=0.05)
+    assert t.primitives[0].p[0] == pytest.approx(0.025, abs=0.008)
+
+
+def test_lcfitter_weighted():
+    rng = np.random.default_rng(6)
+    ph = _draw_phases(rng, 8000, loc=0.5, sigma=0.03, frac=0.5)
+    w = np.full(8000, 0.8)
+    t = LCTemplate([LCGaussian([0.04, 0.45])], [0.5])
+    f = LCFitter(t, ph, weights=w)
+    f.fit(steps=300)
+    assert t.primitives[0].loc == pytest.approx(0.5, abs=0.01)
+
+
+def test_phase_shift_uncertainty_scales():
+    rng = np.random.default_rng(7)
+    t = LCTemplate([LCGaussian([0.03, 0.5])], [0.7])
+    ph_small = _draw_phases(rng, 1000, 0.5, 0.03, 0.7)
+    ph_big = _draw_phases(rng, 16000, 0.5, 0.03, 0.7)
+    s_small = LCFitter(t, ph_small).phase_shift_uncertainty()
+    s_big = LCFitter(t, ph_big).phase_shift_uncertainty()
+    assert s_big < s_small
+    assert s_small == pytest.approx(4.0 * s_big, rel=0.3)  # ~1/sqrt(N)
+
+
+# ---------------- fftfit ----------------
+
+
+def _profile(n, loc, width, amp=1000.0, dc=100.0):
+    x = np.arange(n) / n
+    d = np.minimum(np.abs(x - loc), 1 - np.abs(x - loc))
+    return dc + amp * np.exp(-0.5 * (d / width) ** 2)
+
+
+def test_fftfit_exact_shift():
+    tmpl = _profile(256, 0.3, 0.02, dc=0.0)
+    for true in (0.0, 0.123, -0.2, 0.43):
+        prof = _profile(256, (0.3 + true) % 1.0, 0.02, dc=0.0)
+        got = fftfit_basic(tmpl, prof)
+        err = (got - true + 0.5) % 1.0 - 0.5
+        assert abs(err) < 1e-6
+
+
+def test_fftfit_scale_offset():
+    tmpl = _profile(128, 0.5, 0.03, amp=1.0, dc=0.0)
+    prof = 7.5 + 3.0 * np.roll(tmpl, 10)
+    r = fftfit_full(tmpl, prof)
+    assert r.scale == pytest.approx(3.0, rel=1e-6)
+    assert r.offset == pytest.approx(7.5, rel=1e-6)
+    assert r.shift == pytest.approx(10 / 128, abs=1e-8)
+
+
+def test_fftfit_noisy_shift_and_uncertainty():
+    rng = np.random.default_rng(8)
+    tmpl = _profile(512, 0.4, 0.015, amp=500.0, dc=0.0)
+    errs, sigs = [], []
+    for i in range(20):
+        prof = np.roll(tmpl, 37) + rng.standard_normal(512) * 20.0
+        r = fftfit_full(tmpl, prof)
+        errs.append(r.shift - 37 / 512)
+        sigs.append(r.uncertainty)
+    errs = np.array(errs)
+    # reported uncertainty consistent with scatter (within x3)
+    assert np.std(errs) < 3 * np.mean(sigs)
+    assert np.mean(sigs) < 3e-4
+    assert np.abs(np.mean(errs)) < 3 * np.mean(sigs)
