@@ -91,9 +91,7 @@ class MCMCFitterBinnedTemplate(MCMCFitter):
         nb = self.template.shape[0]
         idx = jnp.clip((phase * nb).astype(jnp.int32), 0, nb - 1)
         rate = jnp.asarray(self.template)[idx]
-        logr = jnp.log(jnp.maximum(rate, 1e-300))
-        if self.weights is not None:
-            w = jnp.asarray(self.weights)
-            # weighted-photon likelihood (reference: wtemp convention)
-            return jnp.sum(jnp.log(jnp.maximum(w * rate + (1.0 - w), 1e-300)))
-        return jnp.sum(logr)
+        from .templates import photon_loglike
+
+        w = None if self.weights is None else jnp.asarray(self.weights)
+        return photon_loglike(rate, w)

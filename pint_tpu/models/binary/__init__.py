@@ -14,18 +14,19 @@ def add_binary_component(model, binary_name: str, keys: dict):
         raise NotImplementedError(
             f"BINARY {name}: binary components not yet built in this tree")
     if name in ("ELL1", "ELL1H", "ELL1K"):
-        from .ell1 import BinaryELL1, BinaryELL1H
+        from .ell1 import BinaryELL1, BinaryELL1H, BinaryELL1k
 
-        comp = BinaryELL1H() if name == "ELL1H" else BinaryELL1()
+        comp = {"ELL1": BinaryELL1, "ELL1H": BinaryELL1H,
+                "ELL1K": BinaryELL1k}[name]()
     elif name in ("BT", "BTX"):
-        from .bt import BinaryBT
+        from .bt import BinaryBT, BinaryBTX
 
-        comp = BinaryBT()
+        comp = BinaryBTX() if name == "BTX" else BinaryBT()
     elif name in ("DD", "DDS", "DDGR", "DDK"):
-        from .dd import BinaryDD, BinaryDDS, BinaryDDK
+        from .dd import BinaryDD, BinaryDDS, BinaryDDK, BinaryDDGR
 
         comp = {"DD": BinaryDD, "DDS": BinaryDDS, "DDK": BinaryDDK,
-                "DDGR": BinaryDD}[name]()
+                "DDGR": BinaryDDGR}[name]()
     else:
         raise ValueError(f"unsupported BINARY model {binary_name!r}")
     model.add_component(comp)

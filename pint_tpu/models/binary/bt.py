@@ -46,3 +46,13 @@ class BinaryBT(PulsarBinary):
         d = self._bt_delay_at(params, prep, delay_accum)
         d = self._bt_delay_at(params, prep, delay_accum + d)
         return self._bt_delay_at(params, prep, delay_accum + d)
+
+
+class BinaryBTX(BinaryBT):
+    """BTX (reference: BT_model.py BTX mode): BT orbit parameterized by
+    orbital-frequency harmonics FB0, FB1, ... instead of PB/PBDOT.
+    The FBn Taylor orbit itself lives in PulsarBinary.orbital_phase
+    (base.py, OrbitFBX equivalent); this subclass only fixes the name
+    so par files with BINARY BTX round-trip."""
+
+    binary_model_name = "BTX"

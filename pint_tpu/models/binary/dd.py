@@ -17,9 +17,11 @@ from __future__ import annotations
 
 import numpy as np
 
-from ...constants import TSUN_S, MASYR_TO_RADS, MAS_TO_RAD, PC_M, C_M_S
+from ...constants import (TSUN_S, MASYR_TO_RADS, MAS_TO_RAD, PC_M, C_M_S,
+                          SECS_PER_DAY, SECS_PER_JULIAN_YEAR)
 from ..parameter import floatParameter
-from .base import PulsarBinary, kepler_solve
+from ..timing_model import MissingParameter
+from .base import PulsarBinary, kepler_solve, _TWO_PI
 
 _DEG2RAD = np.pi / 180.0
 
@@ -81,6 +83,89 @@ class BinaryDD(PulsarBinary):
         d = self._dd_delay_at(params, prep, delay_accum)
         d = self._dd_delay_at(params, prep, delay_accum + d)
         return self._dd_delay_at(params, prep, delay_accum + d)
+
+
+class BinaryDDGR(BinaryDD):
+    """DDGR: GR-constrained DD (reference: DDGR_model.py::DDGRmodel).
+
+    The post-Keplerian parameters (OMDOT, GAMMA, PBDOT, SINI, DR, DTH)
+    are not free: they are derived from the total mass MTOT and the
+    companion mass M2 via the GR relations (Damour & Deruelle 1986;
+    Taylor & Weisberg 1989). XOMDOT/XPBDOT are additive non-GR excess
+    terms. Because the whole delay is jax-differentiable, the design
+    matrix w.r.t. MTOT/M2 flows through these relations via jacfwd.
+    """
+
+    binary_model_name = "DDGR"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("MTOT", units="Msun", aliases=("M",),
+                                      description="Total system mass"))
+        self.add_param(floatParameter("XOMDOT", units="deg/yr",
+                                      description="Excess periastron advance"))
+        self.add_param(floatParameter("XPBDOT", units="s/s",
+                                      description="Excess orbital period decay"))
+
+    def validate(self):
+        super().validate()
+        if self.MTOT.value is None:
+            raise MissingParameter("BinaryDDGR", "MTOT")
+        if self.M2.value is None:
+            raise MissingParameter("BinaryDDGR", "M2")
+
+    def _gr_params(self, params, prep):
+        """Derived PK parameters from (MTOT, M2) — all dimensionless or
+        in seconds; masses in Msun via TSUN_S."""
+        import jax.numpy as jnp
+
+        M = params["MTOT"]
+        m2 = params["M2"]
+        m1 = M - m2
+        e = params.get("ECC", 0.0)
+        if prep["orb_mode_fb"]:
+            n = _TWO_PI * params["FB"][0]
+        else:
+            n = _TWO_PI / (params["PB"] * SECS_PER_DAY)
+        u2 = (TSUN_S * M * n) ** (2.0 / 3.0)  # (GM n / c^3)^(2/3), dimensionless
+        k = 3.0 * u2 / (1.0 - e**2)  # periastron advance per radian of nu
+        gamma = (e * (TSUN_S ** (2.0 / 3.0)) * n ** (-1.0 / 3.0)
+                 * m2 * (m1 + 2.0 * m2) * M ** (-4.0 / 3.0))
+        pbdot = (-(192.0 * jnp.pi / 5.0) * (TSUN_S * n) ** (5.0 / 3.0)
+                 * m1 * m2 * M ** (-1.0 / 3.0)
+                 * (1.0 + (73.0 / 24.0) * e**2 + (37.0 / 96.0) * e**4)
+                 * (1.0 - e**2) ** (-3.5))
+        sini = (params["A1"] * n ** (2.0 / 3.0) * M ** (2.0 / 3.0)
+                / (TSUN_S ** (1.0 / 3.0) * m2))
+        dr = (3.0 * m1**2 + 6.0 * m1 * m2 + 2.0 * m2**2) / M**2 * u2
+        dth = (3.5 * m1**2 + 6.0 * m1 * m2 + 2.0 * m2**2) / M**2 * u2
+        return {"k": k, "GAMMA": gamma, "PBDOT": pbdot, "SINI": sini,
+                "DR": dr, "DTH": dth, "n": n}
+
+    def _merged(self, params, prep):
+        if "_GR_MERGED" in params:
+            return params
+        gr = self._gr_params(params, prep)
+        # OMDOT equivalent: omega advances by k per radian of true
+        # anomaly; omega_rad applies OMDOT/n_orb * nu, so the
+        # effective OMDOT [rad/s] is k*n (+ excess XOMDOT).
+        omdot = (gr["k"] * gr["n"] * SECS_PER_JULIAN_YEAR / _DEG2RAD
+                 + params.get("XOMDOT", 0.0))
+        out = dict(params)
+        out.update(GAMMA=gr["GAMMA"], SINI=gr["SINI"], DR=gr["DR"],
+                   DTH=gr["DTH"], OMDOT=omdot,
+                   PBDOT=params.get("PBDOT", 0.0) + gr["PBDOT"]
+                   + params.get("XPBDOT", 0.0))
+        out["_GR_MERGED"] = True
+        return out
+
+    def orbital_phase(self, params, prep, delay_accum):
+        return super().orbital_phase(self._merged(params, prep), prep,
+                                     delay_accum)
+
+    def _dd_delay_at(self, params, prep, delay_accum):
+        return super()._dd_delay_at(self._merged(params, prep), prep,
+                                    delay_accum)
 
 
 class BinaryDDS(BinaryDD):

@@ -16,10 +16,14 @@ r = H3/sigma^3.
 
 from __future__ import annotations
 
-from ...constants import TSUN_S, SECS_PER_DAY
+import numpy as np
+
+from ...constants import TSUN_S, SECS_PER_DAY, SECS_PER_JULIAN_YEAR
 from ..parameter import MJDParameter, floatParameter
 from ..timing_model import MissingParameter
 from .base import PulsarBinary, _TWO_PI
+
+_DEG2RAD = np.pi / 180.0
 
 
 class BinaryELL1(PulsarBinary):
@@ -73,6 +77,42 @@ class BinaryELL1(PulsarBinary):
         d = self._ell1_delay_at(params, prep, delay_accum)
         d = self._ell1_delay_at(params, prep, delay_accum + d)
         return self._ell1_delay_at(params, prep, delay_accum + d)
+
+
+class BinaryELL1k(BinaryELL1):
+    """ELL1k (reference: ELL1k_model.py): variant for rapid periastron
+    advance. Instead of EPS1DOT/EPS2DOT linearization, the eccentricity
+    vector rotates rigidly with OMDOT and its magnitude evolves as
+    e(t) = e0 * (1 + LNEDOT * dt):
+
+      eps1(t) = (1 + LNEDOT dt) [ eps1 cos(w) + eps2 sin(w) ]
+      eps2(t) = (1 + LNEDOT dt) [ eps2 cos(w) - eps1 sin(w) ],
+      w = OMDOT * dt.
+    """
+
+    binary_model_name = "ELL1K"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("OMDOT", units="deg/yr",
+                                      description="Periastron advance rate"))
+        self.add_param(floatParameter("LNEDOT", units="1/s",
+                                      description="d(ln e)/dt"))
+        # the rotation model replaces the linearized eccentricity-vector
+        # rates; keeping them would create silently-dead (zero-column)
+        # fit parameters (reference: ELL1k removes EPS1DOT/EPS2DOT)
+        self.remove_param("EPS1DOT")
+        self.remove_param("EPS2DOT")
+
+    def eps(self, params, prep, delay_accum):
+        import jax.numpy as jnp
+
+        dt = prep["orb_dt_hi"] + prep["orb_dt_lo"] - delay_accum
+        w = (params.get("OMDOT", 0.0) * _DEG2RAD / SECS_PER_JULIAN_YEAR) * dt
+        scale = 1.0 + params.get("LNEDOT", 0.0) * dt
+        e1, e2 = params.get("EPS1", 0.0), params.get("EPS2", 0.0)
+        cw, sw = jnp.cos(w), jnp.sin(w)
+        return scale * (e1 * cw + e2 * sw), scale * (e2 * cw - e1 * sw)
 
 
 class BinaryELL1H(BinaryELL1):

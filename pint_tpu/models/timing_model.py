@@ -74,6 +74,12 @@ class Component:
         par._component = self
         self.params.append(par.name)
 
+    def remove_param(self, name: str):
+        """Drop a parameter inherited from a superclass that this
+        variant does not support (reference: Component.remove_param)."""
+        self.params.remove(name)
+        delattr(self, name)
+
     def setup(self):
         pass
 

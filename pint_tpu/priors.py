@@ -92,16 +92,21 @@ class GaussianBoundedPrior(GaussianPrior):
 
     def logpdf(self, x):
         import jax.numpy as jnp
+        from scipy.stats import norm
 
         base = super().logpdf(x)
         x = jnp.asarray(x, jnp.float64)
         inside = (x >= self.lower) & (x <= self.upper)
-        return jnp.where(inside, base, -jnp.inf)
+        # truncation normalization so logpdf integrates to 1 over
+        # [lower, upper] — must match what ppf/prior_transform assume
+        z = (norm.cdf(self.upper, loc=self.mean, scale=self.sigma)
+             - norm.cdf(self.lower, loc=self.mean, scale=self.sigma))
+        return jnp.where(inside, base - np.log(z), -jnp.inf)
 
     def sample(self, rng, size=()):
-        out = np.clip(rng.normal(self.mean, self.sigma, size=size),
-                      self.lower, self.upper)
-        return out
+        # inverse-CDF truncated sampling (clipping would pile point
+        # masses onto the bounds)
+        return self.ppf(rng.uniform(size=size))
 
     def ppf(self, u):
         # truncated-normal quantile so the unit-cube transform stays

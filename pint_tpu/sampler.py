@@ -33,7 +33,13 @@ def run_ensemble(logpost, x0, n_steps, seed=0, a=2.0, thin=1):
     if n_w % 2:
         raise ValueError("need an even number of walkers")
     half = n_w // 2
-    v_logpost = jax.vmap(logpost)
+    _v = jax.vmap(logpost)
+
+    def v_logpost(x):
+        # NaN posteriors (e.g. negative scale params from the initial
+        # ball) must reject, not freeze the walker forever
+        lp = _v(x)
+        return jnp.where(jnp.isnan(lp), -jnp.inf, lp)
 
     def half_step(key, movers, movers_lp, others):
         k1, k2, k3 = jax.random.split(key, 3)
@@ -60,12 +66,23 @@ def run_ensemble(logpost, x0, n_steps, seed=0, a=2.0, thin=1):
         n_acc = jnp.sum(acc_a) + jnp.sum(acc_b)
         return (x, lp), (x, lp, n_acc)
 
-    keys = jax.random.split(jax.random.PRNGKey(seed), n_steps)
+    # fold thinning into the scan so only n_steps//thin samples are
+    # ever materialized on device (a (n_steps, n_w, d) chain is the
+    # thing thinning exists to avoid)
+    thin = max(int(thin), 1)
+    n_kept = max(n_steps // thin, 1)
+
+    def outer(carry, keys_block):
+        carry, (_, _, n_acc) = jax.lax.scan(step, carry, keys_block)
+        x, lp = carry
+        return carry, (x, lp, jnp.sum(n_acc))
+
+    keys = jax.random.split(jax.random.PRNGKey(seed), n_kept * thin)
     init = (x0, v_logpost(x0))
-    (_, _), (chain, lp_chain, n_acc) = jax.lax.scan(step, init, keys)
-    accept_frac = float(jnp.sum(n_acc)) / (n_steps * n_w)
-    return (np.asarray(chain[::thin]), np.asarray(lp_chain[::thin]),
-            accept_frac)
+    _, (chain, lp_chain, n_acc) = jax.lax.scan(
+        outer, init, keys.reshape(n_kept, thin, 2))
+    accept_frac = float(jnp.sum(n_acc)) / (n_kept * thin * n_w)
+    return np.asarray(chain), np.asarray(lp_chain), accept_frac
 
 
 class EnsembleSampler:

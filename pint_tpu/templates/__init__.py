@@ -4,6 +4,19 @@
 lcnorm; used by photon-event fitting and TOA extraction.)
 """
 
-from .lcprimitives import LCGaussian, LCVonMises  # noqa: F401
-from .lctemplate import LCTemplate  # noqa: F401
-from .lcfitters import LCFitter  # noqa: F401
+def photon_loglike(f, weights=None):
+    """Unbinned photon log-likelihood sum(log f) — weighted form
+    sum(log(w f + 1 - w)) per the reference's wtemp convention
+    (reference: lcfitters.py::LCFitter.loglikelihood). Single home for
+    the expression so the floor and weight convention can't diverge
+    between template fitting and MCMC."""
+    import jax.numpy as jnp
+
+    if weights is None:
+        return jnp.sum(jnp.log(jnp.maximum(f, 1e-300)))
+    return jnp.sum(jnp.log(jnp.maximum(weights * f + (1.0 - weights), 1e-300)))
+
+
+from .lcprimitives import LCGaussian, LCVonMises  # noqa: E402,F401
+from .lctemplate import LCTemplate  # noqa: E402,F401
+from .lcfitters import LCFitter  # noqa: E402,F401

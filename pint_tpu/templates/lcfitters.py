@@ -25,13 +25,13 @@ class LCFitter:
     def loglikelihood(self, vec=None):
         import jax.numpy as jnp
 
+        from . import photon_loglike
+
         fn, vec0 = self.template.gradient_ready()
         v = jnp.asarray(vec0 if vec is None else vec)
         f = fn(v, jnp.asarray(self.phases))
-        if self.weights is None:
-            return jnp.sum(jnp.log(jnp.maximum(f, 1e-300)))
-        w = jnp.asarray(self.weights)
-        return jnp.sum(jnp.log(jnp.maximum(w * f + (1.0 - w), 1e-300)))
+        w = None if self.weights is None else jnp.asarray(self.weights)
+        return photon_loglike(f, w)
 
     def fit(self, steps=400, lr=3e-3):
         """Maximize the unbinned likelihood; returns final logL.
@@ -48,11 +48,10 @@ class LCFitter:
         w = None if self.weights is None else jnp.asarray(self.weights)
         n_norm = len(self.template.primitives)
 
+        from . import photon_loglike
+
         def negll(v):
-            f = fn(v, ph)
-            if w is None:
-                return -jnp.sum(jnp.log(jnp.maximum(f, 1e-300)))
-            return -jnp.sum(jnp.log(jnp.maximum(w * f + (1.0 - w), 1e-300)))
+            return -photon_loglike(fn(v, ph), w)
 
         grad = jax.jit(jax.grad(negll))
         val = jax.jit(negll)
@@ -92,9 +91,12 @@ class LCFitter:
         fn, vec0 = self.template.gradient_ready()
         ph = jnp.asarray(self.phases)
 
+        from . import photon_loglike
+
         def ll_of_shift(dphi):
             f = fn(jnp.asarray(vec0), (ph + dphi) % 1.0)
-            return jnp.sum(jnp.log(jnp.maximum(f, 1e-300)))
+            return photon_loglike(f, None if self.weights is None
+                                  else jnp.asarray(self.weights))
 
         info = -jax.hessian(ll_of_shift)(0.0)
         return float(1.0 / jnp.sqrt(jnp.maximum(info, 1e-300)))

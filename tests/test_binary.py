@@ -149,3 +149,82 @@ def test_fb_orbit_mode():
                                 obs="gbt", add_noise=False)
     r = Residuals(t, m)
     assert r.rms_weighted() < 1e-9
+
+
+def test_ddgr_matches_dd_with_explicit_pk():
+    """DDGR's mass-derived PK params must equal a DD model with the
+    same values written explicitly (GR relations, DD86/TW89)."""
+    from pint_tpu.constants import TSUN_S, SECS_PER_JULIAN_YEAR
+
+    pb, a1, ecc, om, t0 = 0.323, 2.34, 0.617, 226.0, 55005.0
+    mtot, m2 = 2.83, 1.39
+    m1 = mtot - m2
+    n = 2 * np.pi / (pb * 86400.0)
+    u2 = (TSUN_S * mtot * n) ** (2.0 / 3.0)
+    k = 3.0 * u2 / (1.0 - ecc**2)
+    omdot = k * n * SECS_PER_JULIAN_YEAR / np.deg2rad(1.0)
+    gamma = (ecc * TSUN_S ** (2 / 3) * n ** (-1 / 3) * m2 * (m1 + 2 * m2)
+             * mtot ** (-4 / 3))
+    pbdot = (-(192 * np.pi / 5) * (TSUN_S * n) ** (5 / 3) * m1 * m2
+             * mtot ** (-1 / 3)
+             * (1 + (73 / 24) * ecc**2 + (37 / 96) * ecc**4)
+             * (1 - ecc**2) ** -3.5)
+    sini = a1 * n ** (2 / 3) * mtot ** (2 / 3) / (TSUN_S ** (1 / 3) * m2)
+    dr = (3 * m1**2 + 6 * m1 * m2 + 2 * m2**2) / mtot**2 * u2
+    dth = (3.5 * m1**2 + 6 * m1 * m2 + 2 * m2**2) / mtot**2 * u2
+    par_gr = BASE + (f"BINARY DDGR\nPB {pb} 1\nA1 {a1} 1\nT0 {t0}\n"
+                     f"ECC {ecc} 1\nOM {om}\nMTOT {mtot}\nM2 {m2}\n")
+    par_dd = BASE + (f"BINARY DD\nPB {pb} 1\nA1 {a1} 1\nT0 {t0}\n"
+                     f"ECC {ecc} 1\nOM {om}\nOMDOT {omdot:.10f}\n"
+                     f"GAMMA {gamma:.8e}\nPBDOT {pbdot:.8e}\nM2 {m2}\n"
+                     f"SINI {sini:.10f}\nDR {dr:.8e}\nDTH {dth:.8e}\n")
+    m_gr = get_model(par_gr)
+    m_dd = get_model(par_dd)
+    mjds = np.linspace(55000, 55100, 80)
+    t = make_fake_toas_fromMJDs(mjds, m_gr, error_us=1.0, freq_mhz=1400.0,
+                                obs="gbt", add_noise=False)
+    r = np.asarray(Residuals(t, m_dd, subtract_mean=False).calc_time_resids())
+    assert np.abs(r).max() < 5e-9
+
+
+def test_ddgr_fit_recovers_mtot():
+    par = BASE + ("BINARY DDGR\nPB 0.323 1\nA1 2.34 1\nT0 55005.0 1\n"
+                  "ECC 0.617 1\nOM 226.0 1\nMTOT 2.83 1\nM2 1.39\n")
+    _fit_roundtrip(par, {"MTOT": 1e-4}, ntoa=120)
+
+
+def test_ell1k_matches_ell1_for_small_rotation():
+    """ELL1k's rigid eccentricity-vector rotation linearizes to
+    EPS1DOT/EPS2DOT for small OMDOT*dt."""
+    omdot = 1.0  # deg/yr
+    wdot = np.deg2rad(omdot) / (365.25 * 86400.0)  # rad/s
+    eps1, eps2 = 1e-7, 2e-7
+    par_k = BASE + ("BINARY ELL1K\nPB 1.5 1\nA1 2.0 1\nTASC 55001.0\n"
+                    f"EPS1 {eps1}\nEPS2 {eps2}\nOMDOT {omdot}\n")
+    par_l = BASE + ("BINARY ELL1\nPB 1.5 1\nA1 2.0 1\nTASC 55001.0\n"
+                    f"EPS1 {eps1}\nEPS2 {eps2}\n"
+                    f"EPS1DOT {eps2 * wdot:.10e}\nEPS2DOT {-eps1 * wdot:.10e}\n")
+    m_k = get_model(par_k)
+    m_l = get_model(par_l)
+    mjds = np.linspace(55000, 55100, 50)
+    t = make_fake_toas_fromMJDs(mjds, m_k, error_us=1.0, freq_mhz=1400.0,
+                                obs="gbt", add_noise=False)
+    r = np.asarray(Residuals(t, m_l, subtract_mean=False).calc_time_resids())
+    assert np.abs(r).max() < 1e-10
+
+
+def test_btx_fb_harmonics():
+    """BTX (FBn orbit) equals BT with the equivalent PB."""
+    fb0 = 1.0 / (10.5 * 86400.0)
+    par_x = BASE + (f"BINARY BTX\nFB0 {fb0:.15e} 1\nA1 12.3 1\nT0 55005.5\n"
+                    "ECC 0.21\nOM 75.3\nGAMMA 0.002\n")
+    par_b = BASE + ("BINARY BT\nPB 10.5 1\nA1 12.3 1\nT0 55005.5\n"
+                    "ECC 0.21\nOM 75.3\nGAMMA 0.002\n")
+    m_x = get_model(par_x)
+    m_b = get_model(par_b)
+    assert type(m_x.components["BinaryBTX"]).__name__ == "BinaryBTX"
+    mjds = np.linspace(55000, 55200, 60)
+    t = make_fake_toas_fromMJDs(mjds, m_x, error_us=1.0, freq_mhz=1400.0,
+                                obs="gbt", add_noise=False)
+    r = np.asarray(Residuals(t, m_b, subtract_mean=False).calc_time_resids())
+    assert np.abs(r).max() < 2e-9
