@@ -87,27 +87,53 @@ def _n_offset(labels):
     return 1 if labels and labels[0] == "Offset" else 0
 
 
+def column_norms(Mw):
+    """Exponent-range-safe L2 column norms.
+
+    TPU-emulated f64 carries an f32-like exponent range (~1e+-38):
+    the F1 design column reaches ~1e19, so ``sum(col**2)`` overflows
+    on device. Peak-scale each column first so the squared terms stay
+    <= 1 (reference analog: utils.py::normalize_designmatrix).
+    """
+    import jax.numpy as jnp
+
+    amax = jnp.max(jnp.abs(Mw), axis=0)
+    amax = jnp.where(amax == 0, 1.0, amax)
+    n = jnp.sqrt(jnp.sum(jnp.square(Mw / amax), axis=0))
+    return amax * jnp.where(n == 0, 1.0, n)
+
+
+def cov_from_normalized(covn, norm) -> np.ndarray:
+    """Covariance in physical units, computed ON HOST in IEEE f64:
+    diag entries like var(F1) ~ 1e-38 and the norm outer product
+    ~ 1e+41 both leave the TPU's emulated-f64 exponent range."""
+    covn = np.asarray(covn, np.float64)
+    norm = np.asarray(norm, np.float64)
+    return covn / np.outer(norm, norm)
+
+
 def wls_step(Mw, rw, threshold=1e-12):
-    """Column-normalized whitened SVD solve: returns (dx, cov).
+    """Column-normalized whitened SVD solve: returns
+    (dx, cov_normalized, norm).
 
     Column normalization before the SVD (reference:
     utils.py::normalize_designmatrix) is essential: raw columns span
     ~20 decades (F1 vs DM), and a relative singular-value threshold on
     the unnormalized matrix silently deletes the small-scale
     parameters. After normalization, dropped singular values indicate
-    true degeneracies only.
+    true degeneracies only. The covariance is returned in normalized
+    space (O(1) entries); rescale on host via cov_from_normalized.
     """
     import jax.numpy as jnp
 
-    norm = jnp.sqrt(jnp.sum(jnp.square(Mw), axis=0))
-    norm = jnp.where(norm == 0, 1.0, norm)
+    norm = column_norms(Mw)
     Mn = Mw / norm
     U, s, Vt = jnp.linalg.svd(Mn, full_matrices=False)
     smax = jnp.max(s)
     sinv = jnp.where(s > threshold * smax, 1.0 / s, 0.0)
     dx = (Vt.T @ (sinv * (U.T @ rw))) / norm
-    cov = (Vt.T @ jnp.diag(sinv**2) @ Vt) / jnp.outer(norm, norm)
-    return dx, cov
+    covn = Vt.T @ jnp.diag(sinv**2) @ Vt
+    return dx, covn, norm
 
 
 class WLSFitter(Fitter):
@@ -124,7 +150,7 @@ class WLSFitter(Fitter):
         dm_fn, labels = prepared.designmatrix_fn()
         noff = _n_offset(labels)
         x = prepared.vector_from_params()
-        cov_all = None
+        covn = norm = None
         for _ in range(maxiter):
             r = resid_fn(x)
             sigma_s = prepared.scaled_sigma_us(prepared.params_with_vector(x)) * 1e-6
@@ -132,10 +158,11 @@ class WLSFitter(Fitter):
             f0 = prepared.params0["F"][0]
             Mw = (M / f0) / sigma_s[:, None]
             rw = r / sigma_s
-            dx_all, cov_all = wls_step(Mw, rw, threshold)
+            dx_all, covn, norm = wls_step(Mw, rw, threshold)
             x = x - dx_all[noff:]
         self._sync_model_from_vector(prepared, x)
-        if cov_all is not None:
+        if covn is not None:
+            cov_all = cov_from_normalized(covn, norm)
             self._set_uncertainties(prepared, cov_all[noff:, noff:])
         self.resids = Residuals(self.toas, self.model)
         self.converged = True
@@ -160,7 +187,7 @@ class DownhillWLSFitter(WLSFitter):
 
         x = prepared.vector_from_params()
         best_chi2 = chi2_of(x)
-        cov_all = None
+        covn = norm = None
         for it in range(maxiter):
             r = resid_fn(x)
             sigma_s = prepared.scaled_sigma_us(prepared.params_with_vector(x)) * 1e-6
@@ -168,7 +195,7 @@ class DownhillWLSFitter(WLSFitter):
             f0 = prepared.params0["F"][0]
             Mw = (M / f0) / sigma_s[:, None]
             rw = r / sigma_s
-            dx_all, cov_all = wls_step(Mw, rw, threshold)
+            dx_all, covn, norm = wls_step(Mw, rw, threshold)
             dx = dx_all[noff:]
             lam = 1.0
             improved = False
@@ -183,7 +210,8 @@ class DownhillWLSFitter(WLSFitter):
             if lam < min_lambda or not improved:
                 break
         self._sync_model_from_vector(prepared, x)
-        if cov_all is not None:
+        if covn is not None:
+            cov_all = cov_from_normalized(covn, norm)
             self._set_uncertainties(prepared, cov_all[noff:, noff:])
         self.resids = Residuals(self.toas, self.model)
         self.converged = True
@@ -252,12 +280,12 @@ class GLSFitter(Fitter):
             # eigenvalue threshold measures true degeneracy, not units
             Ninv = 1.0 / jnp.square(sigma_s)
             Mw = Mfull / sigma_s[:, None]
-            norm = jnp.sqrt(jnp.sum(jnp.square(Mw), axis=0))
-            norm = jnp.where(norm == 0, 1.0, norm)
+            norm = column_norms(Mw)
             Mn = Mw / norm
             # prior on original amplitudes a = dxn/norm ->
-            # diag(phi_inv/norm^2) in normalized space
-            A = Mn.T @ Mn + jnp.diag(phi_inv / norm**2)
+            # diag(phi_inv/norm^2) in normalized space; divide twice —
+            # norm**2 for the F1 column leaves the TPU f64 exponent range
+            A = Mn.T @ Mn + jnp.diag(phi_inv / norm / norm)
             b = Mn.T @ (r / sigma_s)
             # eigh + threshold: degenerate directions get zero update,
             # matching the reference's SVD small-singular-value drop
@@ -272,7 +300,7 @@ class GLSFitter(Fitter):
             einv = jnp.where(good, 1.0 / jnp.where(good, evals, 1.0), 0.0)
             dxn = evecs @ (einv * (evecs.T @ b))
             dx = dxn / norm
-            cov = (evecs @ jnp.diag(einv) @ evecs.T) / jnp.outer(norm, norm)
+            cov = (evecs @ jnp.diag(einv) @ evecs.T, norm)
             x = x - dx[noff:nparam]
             # whitened chi2: r^T C^-1 r via the Woodbury identity
             # (with no noise bases this reduces to the plain whitened chi2
@@ -286,7 +314,8 @@ class GLSFitter(Fitter):
             last_chi2 = chi2
         self._sync_model_from_vector(prepared, x)
         if cov is not None:
-            self._set_uncertainties(prepared, cov[noff:nparam, noff:nparam])
+            cov_host = cov_from_normalized(*cov)
+            self._set_uncertainties(prepared, cov_host[noff:nparam, noff:nparam])
         self.resids = Residuals(self.toas, self.model)
         self.converged = True
         self.chi2_whitened = chi2
@@ -347,8 +376,9 @@ class WidebandTOAFitter(GLSFitter):
             sigma = jnp.concatenate([sigma_t, sigma_dm])
             Mw = M / sigma[:, None]
             rw = r / sigma
-            dx_all, cov_all = wls_step(Mw, rw, threshold)
+            dx_all, covn, norm = wls_step(Mw, rw, threshold)
             self._sync_model_from_vector(prepared, x0 - dx_all[noff:])
+            cov_all = cov_from_normalized(covn, norm)
             self._set_uncertainties(prepared, cov_all[noff:, noff:])
         self.resids = WidebandTOAResiduals(self.toas, self.model)
         self.converged = True
@@ -359,7 +389,8 @@ def auto_fitter(toas, model):
     """Pick a fitter like the reference's Fitter.auto()."""
     has_noise = any(c.kind == "noise" and c.category != "scale_toa_error"
                     for c in model.components.values())
-    wideband = any("pp_dm" in f for f in toas.flags)
+    wideband = (toas._flags is not None
+                and any("pp_dm" in f for f in toas._flags))
     if wideband:
         return WidebandTOAFitter(toas, model)
     if has_noise:

@@ -60,7 +60,7 @@ def _grid_fit_fn(fitter, parnames, maxiter=3, threshold=1e-12):
             r = resid_fn(x)
             sigma = prepared.scaled_sigma_us(prepared.params_with_vector(x)) * 1e-6
             M = dm_fn(x)[:, keep_cols] / f0
-            dx, _ = wls_step(M / sigma[:, None], r / sigma, threshold)
+            dx, _, _ = wls_step(M / sigma[:, None], r / sigma, threshold)
             x = x.at[free_idx].set(x[free_idx] - dx[noff:])
         r = resid_fn(x)
         sigma = prepared.scaled_sigma_us(prepared.params_with_vector(x)) * 1e-6

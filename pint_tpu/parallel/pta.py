@@ -264,15 +264,18 @@ class PTABatch:
             M = jnp.concatenate([jnp.ones((M.shape[0], 1)), M], axis=1)
             Mw = M / sigma_s[:, None]
             rw = r / sigma_s
-            norm = jnp.sqrt(jnp.sum(jnp.square(Mw), axis=0))
-            norm = jnp.where(norm == 0, 1.0, norm)
+            # exponent-safe normalization + normalized-space covariance
+            # (TPU f64 has f32-like exponent range; see fitter.column_norms)
+            from ..fitter import column_norms
+
+            norm = column_norms(Mw)
             Mn = Mw / norm
             U, s, Vt = jnp.linalg.svd(Mn, full_matrices=False)
             sinv = jnp.where(s > threshold * jnp.max(s), 1.0 / s, 0.0)
             dx = (Vt.T @ (sinv * (U.T @ rw))) / norm
-            cov = (Vt.T @ jnp.diag(sinv**2) @ Vt) / jnp.outer(norm, norm)
+            covn = Vt.T @ jnp.diag(sinv**2) @ Vt
             chi2 = jnp.sum(jnp.square(rw - Mw @ dx))
-            return x - dx[1:], chi2, cov[1:, 1:]
+            return x - dx[1:], chi2, (covn[1:, 1:], norm[1:])
 
         def fit_one(x0, params, batch, prep):
             x = x0
@@ -283,7 +286,14 @@ class PTABatch:
         key = ("wls", maxiter, threshold)
         if key not in self._fns:
             self._fns[key] = jax.jit(jax.vmap(fit_one))
-        return self._fns[key](self._x0(), self.params, self.batch, self.prep)
+        x, chi2, (covn, norm) = self._fns[key](self._x0(), self.params,
+                                               self.batch, self.prep)
+        # physical-unit covariance on host in IEEE f64: variances like
+        # var(F1)~1e-38 leave the TPU emulated-f64 exponent range
+        covn = np.asarray(covn, np.float64)
+        norm = np.asarray(norm, np.float64)
+        cov = covn / (norm[:, :, None] * norm[:, None, :])
+        return x, chi2, cov
 
     def time_residuals(self):
         """(n_psr, n_toa_max) residual seconds + validity mask."""

@@ -90,18 +90,31 @@ class GaussianBoundedPrior(GaussianPrior):
         self.lower = float(lower)
         self.upper = float(upper)
 
+    def _log_z(self):
+        """log(Phi(upper) - Phi(lower)), tail-safe: the linear-domain
+        CDF difference underflows to 0 when both bounds sit in a far
+        tail, which would make logpdf +inf inside the bounds."""
+        from scipy.stats import norm
+
+        a = (self.lower - self.mean) / self.sigma
+        b = (self.upper - self.mean) / self.sigma
+        if a > 0:  # both in the upper tail: use survival functions
+            la, lb = norm.logsf(a), norm.logsf(b)
+            return la + np.log1p(-np.exp(lb - la))
+        if b < 0:  # both in the lower tail
+            la, lb = norm.logcdf(a), norm.logcdf(b)
+            return lb + np.log1p(-np.exp(la - lb))
+        return np.log(norm.cdf(b) - norm.cdf(a))
+
     def logpdf(self, x):
         import jax.numpy as jnp
-        from scipy.stats import norm
 
         base = super().logpdf(x)
         x = jnp.asarray(x, jnp.float64)
         inside = (x >= self.lower) & (x <= self.upper)
         # truncation normalization so logpdf integrates to 1 over
         # [lower, upper] — must match what ppf/prior_transform assume
-        z = (norm.cdf(self.upper, loc=self.mean, scale=self.sigma)
-             - norm.cdf(self.lower, loc=self.mean, scale=self.sigma))
-        return jnp.where(inside, base - np.log(z), -jnp.inf)
+        return jnp.where(inside, base - self._log_z(), -jnp.inf)
 
     def sample(self, rng, size=()):
         # inverse-CDF truncated sampling (clipping would pile point
@@ -110,12 +123,13 @@ class GaussianBoundedPrior(GaussianPrior):
 
     def ppf(self, u):
         # truncated-normal quantile so the unit-cube transform stays
-        # inside [lower, upper]
-        from scipy.stats import norm
+        # inside [lower, upper]; truncnorm handles far-tail bounds
+        # where cdf-interpolation degenerates
+        from scipy.stats import truncnorm
 
-        a = norm.cdf(self.lower, loc=self.mean, scale=self.sigma)
-        b = norm.cdf(self.upper, loc=self.mean, scale=self.sigma)
-        return norm.ppf(a + u * (b - a), loc=self.mean, scale=self.sigma)
+        a = (self.lower - self.mean) / self.sigma
+        b = (self.upper - self.mean) / self.sigma
+        return truncnorm.ppf(u, a, b, loc=self.mean, scale=self.sigma)
 
 
 class ScipyPrior(Prior):

@@ -68,9 +68,12 @@ def run_ensemble(logpost, x0, n_steps, seed=0, a=2.0, thin=1):
 
     # fold thinning into the scan so only n_steps//thin samples are
     # ever materialized on device (a (n_steps, n_w, d) chain is the
-    # thing thinning exists to avoid)
+    # thing thinning exists to avoid); total steps round UP to a
+    # multiple of thin so at least n_steps are always run
     thin = max(int(thin), 1)
-    n_kept = max(n_steps // thin, 1)
+    if thin > n_steps:
+        raise ValueError(f"thin={thin} exceeds n_steps={n_steps}")
+    n_kept = -(-n_steps // thin)
 
     def outer(carry, keys_block):
         carry, (_, _, n_acc) = jax.lax.scan(step, carry, keys_block)

@@ -1,0 +1,7 @@
+"""Console entry points (reference: src/pint/scripts/ — pintempo,
+zima, photonphase, fermiphase, pintbary, event_optimize, tcb2tdb,
+compare_parfiles, pintpublish; registered as console_scripts there).
+
+Each module exposes ``main(argv=None) -> int`` and can be run as
+``python -m pint_tpu.scripts.<name> ...``.
+"""

@@ -1,0 +1,28 @@
+"""Compare two par files parameter by parameter.
+
+(reference: src/pint/scripts/compare_parfiles.py ->
+TimingModel.compare().)
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="compare_parfiles")
+    p.add_argument("par1")
+    p.add_argument("par2")
+    args = p.parse_args(argv)
+
+    from ..models import get_model
+
+    m1 = get_model(args.par1)
+    m2 = get_model(args.par2)
+    print(m1.compare(m2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,85 @@
+"""Compute pulse phases for photon events; report H-test.
+
+(reference: src/pint/scripts/photonphase.py — event FITS + par
+[+ orbit file] -> per-photon phases, H-test significance, optional
+phase column written back and polyco mode.)
+
+The phase fold of 1e6+ photons is a single vmapped device call — this
+is the workload where the TPU build most outruns the reference.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="photonphase",
+                                description="Photon phases (pint_tpu)")
+    p.add_argument("eventfile")
+    p.add_argument("parfile")
+    p.add_argument("--mission", default=None,
+                   help="nicer/nustar/rxte/xmm/swift/fermi (default: "
+                   "TELESCOP header keyword)")
+    p.add_argument("--orbfile", help="spacecraft orbit FITS (needed unless "
+                   "the events are barycentered)")
+    p.add_argument("--weightcol", help="photon-weight column (Fermi)")
+    p.add_argument("--minMJD", type=float, default=float("-inf"))
+    p.add_argument("--maxMJD", type=float, default=float("inf"))
+    p.add_argument("--outfile", help="write an event FITS copy with a "
+                   "PULSE_PHASE column here")
+    p.add_argument("--absphase", action="store_true",
+                   help="include absolute pulse numbers (needs TZR*)")
+    args = p.parse_args(argv)
+
+    import numpy as np
+
+    from ..event_toas import load_event_TOAs, get_event_weights
+    from ..eventstats import hm, hmw, h2sig
+    from ..io.fits import get_table
+    from ..models import get_model
+
+    model = get_model(args.parfile)
+    mission = args.mission
+    if mission is None:
+        header, _ = get_table(args.eventfile, "EVENTS")
+        mission = str(header.get("TELESCOP", "generic")).strip().lower()
+    if args.orbfile:
+        from ..observatory.satellite_obs import get_satellite_observatory
+
+        get_satellite_observatory(mission, args.orbfile)
+    toas = load_event_TOAs(args.eventfile, mission,
+                           weightcolumn=args.weightcol,
+                           minmjd=args.minMJD, maxmjd=args.maxMJD)
+    print(f"Read {len(toas)} photons from {args.eventfile} ({mission})")
+    ph_obj = model.phase(toas)
+    phases = np.asarray(ph_obj.frac) % 1.0
+    w = get_event_weights(toas)
+    h = float(hmw(phases, w)) if w is not None else float(hm(phases))
+    print(f"Htest : {h:.2f}  ({h2sig(h):.2f} sigma)")
+    if args.outfile:
+        header, cols = get_table(args.eventfile, "EVENTS")
+        from ..event_toas import _mjdref_days, met_to_day_sec
+        from ..io.fits import write_fits_table
+
+        # apply the same MJD window the loader applied, so the phase
+        # column lines up with the written rows
+        tcol = next(k for k in cols if k.upper() == "TIME")
+        day, sec = met_to_day_sec(np.asarray(cols[tcol], np.float64),
+                                  _mjdref_days(header, mission))
+        mjd_f = day + sec / 86400.0
+        keep = (mjd_f >= args.minMJD) & (mjd_f <= args.maxMJD)
+        out_cols = {k: np.asarray(v)[keep] for k, v in cols.items()}
+        out_cols["PULSE_PHASE"] = phases
+        if args.absphase:
+            out_cols["PULSE_NUMBER"] = np.asarray(ph_obj.int, np.float64)
+        keep = {k: header[k] for k in ("MJDREFI", "MJDREFF", "MJDREF",
+                                       "TIMESYS", "TELESCOP") if k in header}
+        write_fits_table(args.outfile, out_cols, keep, extname="EVENTS")
+        print(f"Wrote {args.outfile}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

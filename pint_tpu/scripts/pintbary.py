@@ -1,0 +1,58 @@
+"""Quick barycentering: topocentric MJD(UTC) -> barycentric TDB.
+
+(reference: src/pint/scripts/pintbary.py — time + site + sky position
+-> SSB arrival time using the full delay chain.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="pintbary",
+                                description="Barycenter times (pint_tpu)")
+    p.add_argument("time", nargs="+", help="MJD(UTC) values")
+    p.add_argument("--parfile", help="par file for sky position/DM")
+    p.add_argument("--ra", help="RAJ hh:mm:ss.s (if no par)")
+    p.add_argument("--dec", help="DECJ dd:mm:ss.s (if no par)")
+    p.add_argument("--obs", default="geocenter")
+    p.add_argument("--freq", type=float, default=float("inf"), help="MHz")
+    p.add_argument("--dm", type=float, default=0.0)
+    p.add_argument("--ephem", default="de440s")
+    args = p.parse_args(argv)
+
+    import numpy as np
+
+    from ..models import get_model
+    from ..mjd import parse_mjd_string, format_mjd
+    from ..toa import TOA, TOAs
+
+    if args.parfile:
+        model = get_model(args.parfile)
+    else:
+        if not (args.ra and args.dec):
+            p.error("need --parfile or --ra/--dec")
+        model = get_model(f"PSR BARY\nRAJ {args.ra}\nDECJ {args.dec}\n"
+                          f"F0 1.0\nPEPOCH 55000\nDM {args.dm}\n")
+    toalist = []
+    for s in args.time:
+        day, sec = parse_mjd_string(s)
+        toalist.append(TOA(day, sec, error_us=0.0, freq_mhz=args.freq,
+                           obs=args.obs))
+    toas = TOAs(toalist, ephem=args.ephem)
+    toas.apply_clock_corrections()
+    toas.compute_TDBs()
+    toas.compute_posvels()
+    from ..mjd import Epochs as _E
+
+    delay = np.asarray(model.delay(toas))
+    bat = _E(toas.tdb.day, toas.tdb.sec - delay, "tdb").normalized()
+    for i in range(len(toas)):
+        print(format_mjd(int(bat.day[i]), float(bat.sec[i]), ndigits=13))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

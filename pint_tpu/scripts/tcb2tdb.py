@@ -1,0 +1,29 @@
+"""Convert a TCB par file to TDB units.
+
+(reference: src/pint/scripts/tcb2tdb.py -> models/tcb_conversion.py.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tcb2tdb")
+    p.add_argument("input_par")
+    p.add_argument("output_par")
+    args = p.parse_args(argv)
+
+    from ..models import get_model
+    from ..models.tcb_conversion import convert_tcb_tdb
+
+    model = get_model(args.input_par)
+    convert_tcb_tdb(model)
+    model.write_parfile(args.output_par)
+    print(f"Wrote TDB par file {args.output_par}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,48 @@
+"""Simulate fake TOAs from a timing model.
+
+(reference: src/pint/scripts/zima.py — par -> zero-residual TOAs +
+optional noise -> tim file.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="zima",
+                                description="Simulate TOAs (pint_tpu)")
+    p.add_argument("parfile")
+    p.add_argument("timfile", help="output tim file")
+    p.add_argument("--startMJD", type=float, default=56000.0)
+    p.add_argument("--duration", type=float, default=400.0, help="days")
+    p.add_argument("--ntoa", type=int, default=100)
+    p.add_argument("--error", type=float, default=1.0, help="TOA sigma (us)")
+    p.add_argument("--freq", type=float, default=1400.0, help="MHz")
+    p.add_argument("--obs", default="gbt")
+    p.add_argument("--addnoise", action="store_true")
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--inputtim", help="take MJDs/freqs/errors from this tim"
+                   " file instead of a uniform grid")
+    args = p.parse_args(argv)
+
+    from ..models import get_model
+    from ..simulation import make_fake_toas_uniform, make_fake_toas_fromtim
+
+    model = get_model(args.parfile)
+    if args.inputtim:
+        toas = make_fake_toas_fromtim(args.inputtim, model,
+                                      add_noise=args.addnoise, seed=args.seed)
+    else:
+        toas = make_fake_toas_uniform(
+            args.startMJD, args.startMJD + args.duration, args.ntoa, model,
+            error_us=args.error, freq_mhz=args.freq, obs=args.obs,
+            add_noise=args.addnoise, seed=args.seed)
+    toas.write_TOA_file(args.timfile, name="zima")
+    print(f"Wrote {len(toas)} simulated TOAs to {args.timfile}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

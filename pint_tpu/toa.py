@@ -86,7 +86,8 @@ class TOAs:
         self.error_us = np.array([t.error_us for t in toalist], dtype=np.float64)
         self.freq_mhz = np.array([t.freq_mhz for t in toalist], dtype=np.float64)
         self.obs = np.array([t.obs for t in toalist], dtype=object)
-        self.flags = [dict(t.flags) for t in toalist]
+        self._flags: list[dict] | None = [dict(t.flags) for t in toalist]
+        self.weights: np.ndarray | None = None  # per-photon probabilities
         self.clock_corr_s = np.zeros(n)
         self.tdb: Epochs | None = None
         self.ssb_obs: PosVel | None = None
@@ -96,6 +97,43 @@ class TOAs:
 
     def __len__(self):
         return len(self.day)
+
+    @property
+    def flags(self) -> list[dict]:
+        # flags are materialized lazily: photon-scale TOAs built via
+        # from_arrays carry millions of rows whose flags are all empty,
+        # and the hot fold path never touches them
+        if self._flags is None:
+            self._flags = [{} for _ in range(len(self))]
+        return self._flags
+
+    @flags.setter
+    def flags(self, value):
+        self._flags = value
+
+    @classmethod
+    def from_arrays(cls, day, sec, error_us=1.0, freq_mhz=np.inf,
+                    obs="barycenter", ephem="de440s", planets=False,
+                    weights=None, flags=None, **kw) -> "TOAs":
+        """Vectorized constructor — no per-row Python objects
+        (the reference's event loaders go through per-photon TOA
+        objects; at 1e6-1e7 photons that dominates load time)."""
+        t = cls([], ephem=ephem, planets=planets, **kw)
+        n = len(day)
+        t.day = np.asarray(day, np.int64)
+        t.sec = np.asarray(sec, np.float64)
+        t.error_us = np.broadcast_to(
+            np.asarray(error_us, np.float64), (n,)).copy()
+        t.freq_mhz = np.broadcast_to(
+            np.asarray(freq_mhz, np.float64), (n,)).copy()
+        if isinstance(obs, str):
+            t.obs = np.full(n, obs, dtype=object)
+        else:
+            t.obs = np.asarray(obs, dtype=object)
+        t.weights = None if weights is None else np.asarray(weights, float)
+        t._flags = flags
+        t.clock_corr_s = np.zeros(n)
+        return t
 
     # ---- pipeline steps (reference: toa.py same names) ----
 
@@ -117,15 +155,26 @@ class TOAs:
         self._clock_applied = True
 
     def compute_TDBs(self):
+        from .observatory import get_observatory
+
         corrected = Epochs(self.day, self.sec + self.clock_corr_s, "utc").normalized()
-        bary = self.obs.astype(str) == "barycenter"
-        if bary.all():
+        obs_names = self.obs.astype(str)
+        scales = np.array([get_observatory(o).timescale
+                           for o in np.unique(obs_names)])
+        scale_of = dict(zip(np.unique(obs_names), scales))
+        toa_scale = np.array([scale_of[o] for o in obs_names])
+        if (toa_scale == "tdb").all():
             self.tdb = Epochs(corrected.day, corrected.sec, "tdb")
-        else:
-            self.tdb = ts.utc_to_tdb(corrected)
-            if bary.any():
-                self.tdb.day[bary] = corrected.day[bary]
-                self.tdb.sec[bary] = corrected.sec[bary]
+            return
+        self.tdb = ts.utc_to_tdb(corrected)
+        for scale in ("tdb", "tt"):
+            m = toa_scale == scale
+            if not m.any():
+                continue
+            sub = Epochs(corrected.day[m], corrected.sec[m], scale)
+            out = sub if scale == "tdb" else ts.tt_to_tdb(sub)
+            self.tdb.day[m] = out.day
+            self.tdb.sec[m] = out.sec
 
     def compute_posvels(self):
         from .observatory import get_observatory
@@ -162,7 +211,10 @@ class TOAs:
         out = TOAs([], ephem=self.ephem, planets=self.planets)
         for attr in ("day", "sec", "error_us", "freq_mhz", "obs", "clock_corr_s"):
             setattr(out, attr, getattr(self, attr)[condition])
-        out.flags = [f for f, keep in zip(self.flags, condition) if keep]
+        out._flags = (None if self._flags is None else
+                      [f for f, keep in zip(self._flags, condition) if keep])
+        if self.weights is not None:
+            out.weights = self.weights[condition]
         if self.tdb is not None:
             out.tdb = Epochs(self.tdb.day[condition], self.tdb.sec[condition], "tdb")
         if self.ssb_obs is not None:
@@ -175,11 +227,15 @@ class TOAs:
         return out
 
     def get_flag_value(self, flag: str, fill=""):
-        return np.array([f.get(flag, fill) for f in self.flags], dtype=object)
+        if self._flags is None:
+            return np.full(len(self), fill, dtype=object)
+        return np.array([f.get(flag, fill) for f in self._flags], dtype=object)
 
     def get_pulse_numbers(self):
         pn = np.full(len(self), np.nan)
-        for i, f in enumerate(self.flags):
+        if self._flags is None:
+            return pn
+        for i, f in enumerate(self._flags):
             if "pn" in f:
                 pn[i] = float(f["pn"])
         return pn

@@ -1,0 +1,157 @@
+"""Event/photon pipeline tests: FITS I/O, event TOA loading, satellite
+observatories, H-test detection of an injected pulsation.
+
+(reference test patterns: tests/test_event_toas.py, tests/test_fermi.py,
+tests/test_satobs.py — there against small bundled mission FITS files;
+here against synthetic files written by pint_tpu.io.fits itself.)
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+warnings.simplefilter("ignore")
+
+from pint_tpu.io.fits import write_fits_table, read_fits, get_table
+from pint_tpu.event_toas import (load_event_TOAs, load_NICER_TOAs,
+                                 load_Fermi_TOAs, get_event_weights,
+                                 met_to_day_sec, MISSION_MJDREF)
+from pint_tpu.models import get_model
+
+PAR = """
+PSR TESTEV
+RAJ 10:00:00.0
+DECJ 15:00:00.0
+F0 29.946923 1
+F1 -3.77e-10 1
+PEPOCH 56700
+DM 0.0
+"""
+
+
+def test_fits_table_roundtrip(tmp_path):
+    path = tmp_path / "t.fits"
+    cols = {"TIME": np.linspace(0.0, 1e5, 50),
+            "PHA": np.arange(50, dtype=np.int32),
+            "POSITION": np.arange(150, dtype=float).reshape(50, 3)}
+    write_fits_table(path, cols, {"MJDREFI": 56658, "MJDREFF": 7.77e-4,
+                                  "TIMESYS": "TT"}, extname="EVENTS")
+    header, data = get_table(path, "EVENTS")
+    assert header["MJDREFI"] == 56658
+    assert header["TIMESYS"] == "TT"
+    np.testing.assert_allclose(data["TIME"], cols["TIME"])
+    np.testing.assert_array_equal(data["PHA"], cols["PHA"])
+    np.testing.assert_allclose(data["POSITION"], cols["POSITION"])
+    # multiple HDUs parse
+    hdus = read_fits(path)
+    assert hdus[0]["data"] is None and hdus[1]["name"] == "EVENTS"
+
+
+def test_met_to_day_sec_precision():
+    mjdref = MISSION_MJDREF["nicer"]
+    met = np.array([1e8 + 0.123456789])
+    day, sec = met_to_day_sec(met, mjdref)
+    total = np.longdouble(day[0]) + np.longdouble(sec[0]) / 86400
+    expected = np.longdouble(mjdref) + np.longdouble(met[0]) / 86400
+    assert abs(float((total - expected) * 86400)) < 1e-6  # < 1 us
+
+
+def _write_events(path, mjds_tdb, timesys="TDB", mission_ref=56658.000777592593,
+                  weights=None):
+    met = (np.asarray(mjds_tdb, np.longdouble) - mission_ref) * 86400.0
+    cols = {"TIME": np.asarray(met, np.float64)}
+    if weights is not None:
+        cols["PSRPROB"] = np.asarray(weights, float)
+    write_fits_table(path, cols,
+                     {"MJDREFI": int(mission_ref),
+                      "MJDREFF": mission_ref - int(mission_ref),
+                      "TIMESYS": timesys, "TELESCOP": "NICER"},
+                     extname="EVENTS")
+
+
+def test_htest_detects_injected_pulsation(tmp_path):
+    """Photon phases folded with the true model must give a huge
+    H-test; scrambled photons must not (the photonphase workflow,
+    reference: scripts/photonphase.py + eventstats)."""
+    from pint_tpu.eventstats import hm, sf_hm
+
+    m = get_model(PAR)
+    f0 = m.F0.value
+    rng = np.random.default_rng(5)
+    n_ph = 3000
+    pulse_n = rng.integers(0, int(0.5 * 86400 * f0), n_ph)
+    phases = (rng.vonmises(2 * np.pi * 0.3, 8.0, n_ph) / (2 * np.pi)) % 1.0
+    # invert the (F0, F1) Taylor phase to TDB times (barycentered events)
+    dt = (pulse_n + phases) / f0
+    f1 = m.F1.value
+    dt = dt - 0.5 * f1 * dt**2 / f0  # first-order F1 correction
+    mjds = 56700.0 + np.asarray(dt, np.longdouble) / 86400.0
+    path = tmp_path / "evt.fits"
+    _write_events(path, mjds, timesys="TDB")
+    toas = load_event_TOAs(path, "nicer")
+    assert len(toas) == n_ph
+    assert set(toas.obs.astype(str)) == {"barycenter"}
+    ph = np.asarray(m.phase(toas).frac) % 1.0
+    h = float(hm(ph))
+    h_scrambled = float(hm(rng.uniform(0, 1, n_ph)))
+    assert h > 500.0, h
+    assert h_scrambled < 50.0
+    assert sf_hm(h, logprob=True) < -100
+    # weighted loader path
+    _write_events(tmp_path / "evtw.fits", mjds, timesys="TDB",
+                  weights=np.full(n_ph, 0.7))
+    tw = load_Fermi_TOAs(tmp_path / "evtw.fits", weightcolumn="PSRPROB")
+    w = get_event_weights(tw)
+    assert w is not None and np.allclose(w, 0.7)
+
+
+def test_satellite_observatory(tmp_path):
+    """Orbit-file observatory: interpolated posvel must track the
+    analytic orbit, and TT-native TOAs must convert to TDB."""
+    from pint_tpu.observatory.satellite_obs import get_satellite_observatory
+    from pint_tpu.ephemeris import objPosVel_wrt_SSB
+    from pint_tpu.mjd import Epochs
+    from pint_tpu.timescales import tt_to_tdb
+
+    mjdref = MISSION_MJDREF["nicer"]
+    r_orb, period = 6.98e6, 5700.0
+    met_grid = np.arange(0.5 * 86400, 1.5 * 86400, 30.0) + (56700 - mjdref) * 86400
+    wt = 2 * np.pi / period
+
+    def orbit(met):
+        ang = wt * met
+        pos = np.stack([r_orb * np.cos(ang), r_orb * np.sin(ang),
+                        np.zeros_like(ang)], axis=-1)
+        vel = np.stack([-r_orb * wt * np.sin(ang), r_orb * wt * np.cos(ang),
+                        np.zeros_like(ang)], axis=-1)
+        return pos, vel
+
+    pos, vel = orbit(met_grid)
+    orb_path = tmp_path / "orb.fits"
+    write_fits_table(orb_path, {"TIME": met_grid, "POSITION": pos,
+                                "VELOCITY": vel},
+                     {"MJDREFI": int(mjdref), "MJDREFF": mjdref - int(mjdref),
+                      "TIMESYS": "TT"}, extname="ORBIT")
+    ob = get_satellite_observatory("nicer", orb_path)
+    assert ob.timescale == "tt"
+    # off-grid sample points, compare to analytic orbit
+    met_q = met_grid[0] + np.array([100.3, 1234.56, 40000.77])
+    tt_day = np.full(3, 56700, np.int64)
+    tt_sec = met_q - (56700 - mjdref) * 86400
+    tt = Epochs(tt_day, tt_sec, "tt").normalized()
+    tdb = tt_to_tdb(tt)
+    pv = ob.posvel_ssb(tdb, None, "de440s")
+    earth = objPosVel_wrt_SSB("earth", tdb, "de440s")
+    p_ana, v_ana = orbit(met_q)
+    assert np.abs(pv.pos - earth.pos - p_ana).max() < 1.0  # < 1 m
+    assert np.abs(pv.vel - earth.vel - v_ana).max() < 1e-2  # < 1 cm/s
+    # event TOAs tagged with the satellite obs flow through TDB+posvel
+    mjds_tt = mjdref + met_q / 86400.0
+    evt = tmp_path / "evt_tt.fits"
+    _write_events(evt, mjds_tt, timesys="TT", mission_ref=mjdref)
+    toas = load_event_TOAs(evt, "nicer")
+    toas.apply_clock_corrections()
+    toas.compute_TDBs()
+    toas.compute_posvels()
+    assert np.abs(np.asarray(toas.ssb_obs.pos) - pv.pos).max() < 1.0

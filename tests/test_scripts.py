@@ -1,0 +1,159 @@
+"""CLI scripts layer tests — each entry point invoked in-process.
+
+(reference test pattern: tests/test_zima.py, photonphase/fermiphase
+smoke tests via console entry points.)
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+warnings.simplefilter("ignore")
+
+PAR = """
+PSR J1744-1134
+RAJ 17:44:29.4
+DECJ -11:34:54.7
+F0 245.4261196 1
+F1 -5.38e-16 1
+PEPOCH 55000
+POSEPOCH 55000
+DM 3.139 1
+"""
+
+
+@pytest.fixture(scope="module")
+def parfile(tmp_path_factory):
+    p = tmp_path_factory.mktemp("scripts") / "test.par"
+    p.write_text(PAR)
+    return str(p)
+
+
+def test_zima_then_pintempo(parfile, tmp_path, capsys):
+    from pint_tpu.scripts import zima, pintempo
+
+    tim = str(tmp_path / "fake.tim")
+    assert zima.main([parfile, tim, "--ntoa", "25", "--startMJD", "54800",
+                      "--duration", "400", "--addnoise", "--seed", "42"]) == 0
+    out_par = str(tmp_path / "post.par")
+    plot = str(tmp_path / "r.png")
+    assert pintempo.main([parfile, tim, "--fitter", "downhill_wls",
+                          "--outfile", out_par, "--plot",
+                          "--plotfile", plot]) == 0
+    cap = capsys.readouterr().out
+    assert "Read 25 TOAs" in cap and "chi2" in cap.lower()
+    import os
+    assert os.path.exists(out_par) and os.path.exists(plot)
+    # post-fit par loads back
+    from pint_tpu.models import get_model
+
+    m = get_model(out_par)
+    assert abs(m.F0.value - 245.4261196) < 1e-6
+
+
+def test_photonphase_and_fermiphase(parfile, tmp_path, capsys):
+    from pint_tpu.io.fits import write_fits_table, get_table
+    from pint_tpu.models import get_model
+    from pint_tpu.scripts import photonphase
+
+    m = get_model(PAR)
+    f0 = m.F0.value
+    rng = np.random.default_rng(1)
+    n = 1500
+    phases = (rng.vonmises(0.0, 6.0, n) / (2 * np.pi)) % 1.0
+    pulse_n = rng.integers(0, int(2000 * f0), n)
+    mjds = 55000.0 + ((pulse_n + phases) / f0) / 86400.0
+    mjdref = 56658.000777592593
+    met = (np.asarray(mjds, np.longdouble) - mjdref) * 86400.0
+    evt = str(tmp_path / "evt.fits")
+    write_fits_table(evt, {"TIME": np.asarray(met, float)},
+                     {"MJDREFI": 56658, "MJDREFF": mjdref - 56658,
+                      "TIMESYS": "TDB", "TELESCOP": "NICER"})
+    out = str(tmp_path / "phased.fits")
+    assert photonphase.main([evt, parfile, "--outfile", out]) == 0
+    cap = capsys.readouterr().out
+    assert "Htest" in cap
+    h = float(cap.split("Htest :")[1].split()[0])
+    assert h > 200.0
+    _, cols = get_table(out, "EVENTS")
+    assert "PULSE_PHASE" in cols and len(cols["PULSE_PHASE"]) == n
+
+
+def test_pintbary(capsys):
+    from pint_tpu.scripts import pintbary
+
+    assert pintbary.main(["56000.0", "--ra", "10:00:00", "--dec", "15:00:00",
+                          "--obs", "geocenter"]) == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    mjd = float(line)
+    # Roemer delay to SSB is at most ~500 s
+    assert abs(mjd - 56000.0) < 600.0 / 86400.0
+
+
+def test_tcb2tdb(parfile, tmp_path, capsys):
+    from pint_tpu.models import get_model
+    from pint_tpu.models.tcb_conversion import convert_tcb_tdb, IFTE_K
+
+    m = get_model(PAR + "UNITS TCB\n")
+    f0_tcb = m.F0.value
+    pepoch_tcb = m.PEPOCH.value
+    convert_tcb_tdb(m)
+    assert m.F0.value == pytest.approx(f0_tcb * IFTE_K, rel=1e-15)
+    assert m.PEPOCH.value < pepoch_tcb  # pulled toward IFTE_MJD0
+    assert abs(m.PEPOCH.value - pepoch_tcb) < 1e-3
+    # round-trip back
+    convert_tcb_tdb(m, backwards=True)
+    assert m.F0.value == pytest.approx(f0_tcb, rel=1e-14)
+    assert m.PEPOCH.value == pytest.approx(pepoch_tcb, abs=1e-9)
+    # script end-to-end
+    from pint_tpu.scripts import tcb2tdb
+
+    src = tmp_path / "tcb.par"
+    src.write_text(PAR + "UNITS TCB\n")
+    dst = tmp_path / "tdb.par"
+    assert tcb2tdb.main([str(src), str(dst)]) == 0
+    m2 = get_model(str(dst))
+    assert m2.F0.value == pytest.approx(f0_tcb * IFTE_K, rel=1e-14)
+
+
+def test_compare_parfiles_and_pintpublish(parfile, tmp_path, capsys):
+    from pint_tpu.scripts import compare_parfiles, pintpublish
+
+    par2 = tmp_path / "b.par"
+    par2.write_text(PAR.replace("245.4261196", "245.4261197"))
+    assert compare_parfiles.main([parfile, str(par2)]) == 0
+    assert "F0" in capsys.readouterr().out
+    tex = tmp_path / "t.tex"
+    assert pintpublish.main([parfile, "--outfile", str(tex)]) == 0
+    text = tex.read_text()
+    assert "\\begin{table}" in text and "F0" in text
+
+
+def test_event_optimize_smoke(tmp_path, capsys):
+    """event_optimize runs a short chain and improves the posterior."""
+    from pint_tpu.io.fits import write_fits_table
+    from pint_tpu.models import get_model
+    from pint_tpu.scripts import event_optimize
+
+    par = "PSR TESTEO\nRAJ 05:00:00\nDECJ 20:00:00\nF0 10.0 1\nF1 0\nPEPOCH 56000\nDM 0\n"
+    parfile = tmp_path / "eo.par"
+    parfile.write_text(par)
+    rng = np.random.default_rng(3)
+    n = 800
+    phases = (rng.vonmises(np.pi, 5.0, n) / (2 * np.pi)) % 1.0
+    pulse_n = rng.integers(0, 10 * 86400 * 10, n)
+    mjds = 56000.0 + ((pulse_n + phases) / 10.0) / 86400.0
+    mjdref = 56658.000777592593
+    met = (np.asarray(mjds, np.longdouble) - mjdref) * 86400.0
+    evt = str(tmp_path / "eo.fits")
+    write_fits_table(evt, {"TIME": np.asarray(met, float)},
+                     {"MJDREFI": 56658, "MJDREFF": mjdref - 56658,
+                      "TIMESYS": "TDB", "TELESCOP": "NICER"})
+    out_par = str(tmp_path / "eo_post.par")
+    assert event_optimize.main([evt, str(parfile), "--nsteps", "60",
+                                "--outfile", out_par]) == 0
+    cap = capsys.readouterr().out
+    assert "max posterior" in cap
+    import os
+    assert os.path.exists(out_par)
