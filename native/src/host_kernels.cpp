@@ -1,0 +1,257 @@
+// pint_tpu native host kernels.
+//
+// C++ equivalents of the hot host-side precompute loops, matching the
+// Python implementations bit-for-bit in structure (same truncated
+// series, same constants) so either path may serve any call:
+//
+//  - tdb_minus_tt:   Fairhead–Bretagnon 1990 truncated series
+//                    (python: pint_tpu/timescales.py::tdb_minus_tt)
+//  - itrf_to_gcrs:   Earth-rotation chain bias/precession/nutation/
+//                    ERA/GAST/polar-motion
+//                    (python: pint_tpu/earth/erfa_lite.py)
+//  - cheby_posvel:   SPK type 2/3 Chebyshev record evaluation
+//                    (python: pint_tpu/io/spk.py::SPKKernel.posvel)
+//
+// The reference package leans on native code for exactly these jobs —
+// ERFA (C) for earth rotation and time scales, jplephem+numpy (C) for
+// ephemeris Chebyshev work (reference: src/pint/erfautils.py,
+// src/pint/solar_system_ephemerides.py) — so the TPU build carries
+// native host kernels too, per-TOA work being the host-side hot path
+// feeding the device TOABatch.
+//
+// C ABI, called from Python via ctypes (no pybind11 in the build env).
+
+#include <cmath>
+#include <cstdint>
+
+namespace {
+
+constexpr double TWO_PI = 6.283185307179586476925287;
+constexpr double ARCSEC_TO_RAD = TWO_PI / (360.0 * 3600.0);
+constexpr double SECS_PER_DAY = 86400.0;
+constexpr double OMEGA_EARTH = 7.292115855306589e-5;  // rad/s (IERS)
+
+inline double jc_from_epoch(std::int64_t day, double sec) {
+  // Julian centuries since J2000.0 (MJD 51544.5)
+  return ((static_cast<double>(day - 51544) - 0.5) + sec / SECS_PER_DAY) /
+         36525.0;
+}
+
+struct Mat3 {
+  double m[3][3];
+};
+
+inline Mat3 matmul(const Mat3& a, const Mat3& b) {
+  Mat3 r{};
+  for (int i = 0; i < 3; ++i)
+    for (int j = 0; j < 3; ++j) {
+      double s = 0.0;
+      for (int k = 0; k < 3; ++k) s += a.m[i][k] * b.m[k][j];
+      r.m[i][j] = s;
+    }
+  return r;
+}
+
+inline Mat3 rx(double a) {
+  double c = std::cos(a), s = std::sin(a);
+  return Mat3{{{1, 0, 0}, {0, c, s}, {0, -s, c}}};
+}
+inline Mat3 ry(double a) {
+  double c = std::cos(a), s = std::sin(a);
+  return Mat3{{{c, 0, -s}, {0, 1, 0}, {s, 0, c}}};
+}
+inline Mat3 rz(double a) {
+  double c = std::cos(a), s = std::sin(a);
+  return Mat3{{{c, s, 0}, {-s, c, 0}, {0, 0, 1}}};
+}
+
+// Truncated IAU2000B nutation — dominant 13 terms, 0.1 uas units
+// (same table as pint_tpu/earth/erfa_lite.py::_NUT_TERMS).
+constexpr double NUT[13][9] = {
+    {0, 0, 0, 0, 1, -172064161.0, -174666.0, 92052331.0, 9086.0},
+    {0, 0, 2, -2, 2, -13170906.0, -1675.0, 5730336.0, -3015.0},
+    {0, 0, 2, 0, 2, -2276413.0, -234.0, 978459.0, -485.0},
+    {0, 0, 0, 0, 2, 2074554.0, 207.0, -897492.0, 470.0},
+    {0, 1, 0, 0, 0, 1475877.0, -3633.0, 73871.0, -184.0},
+    {0, 1, 2, -2, 2, -516821.0, 1226.0, 224386.0, -677.0},
+    {1, 0, 0, 0, 0, 711159.0, 73.0, -6750.0, 0.0},
+    {0, 0, 2, 0, 1, -387298.0, -367.0, 200728.0, 18.0},
+    {1, 0, 2, 0, 2, -301461.0, -36.0, 129025.0, -63.0},
+    {0, -1, 2, -2, 2, 215829.0, -494.0, -95929.0, 299.0},
+    {0, 0, 2, -2, 1, 128227.0, 137.0, -68982.0, -9.0},
+    {-1, 0, 2, 0, 2, 123457.0, 11.0, -53311.0, 32.0},
+    {-1, 0, 0, 2, 0, 156994.0, 10.0, -1235.0, 0.0},
+};
+
+void nutation(double T, double* dpsi, double* deps) {
+  const double l =
+      (485868.249036 + 1717915923.2178 * T + 31.8792 * T * T) * ARCSEC_TO_RAD;
+  const double lp =
+      (1287104.79305 + 129596581.0481 * T - 0.5532 * T * T) * ARCSEC_TO_RAD;
+  const double F =
+      (335779.526232 + 1739527262.8478 * T - 12.7512 * T * T) * ARCSEC_TO_RAD;
+  const double D =
+      (1072260.70369 + 1602961601.2090 * T - 6.3706 * T * T) * ARCSEC_TO_RAD;
+  const double Om =
+      (450160.398036 - 6962890.5431 * T + 7.4722 * T * T) * ARCSEC_TO_RAD;
+  double dp = 0.0, de = 0.0;
+  for (const auto& row : NUT) {
+    const double arg =
+        row[0] * l + row[1] * lp + row[2] * F + row[3] * D + row[4] * Om;
+    dp += (row[5] + row[6] * T) * std::sin(arg);
+    de += (row[7] + row[8] * T) * std::cos(arg);
+  }
+  const double scale = 1e-7 * ARCSEC_TO_RAD;
+  *dpsi = dp * scale;
+  *deps = de * scale;
+}
+
+inline double mean_obliquity(double T) {
+  return (84381.406 - 46.836769 * T - 0.0001831 * T * T +
+          0.00200340 * T * T * T) *
+         ARCSEC_TO_RAD;
+}
+
+Mat3 bias_matrix() {
+  const double dpsi_b = -0.041775 * ARCSEC_TO_RAD;
+  const double deps_b = -0.0068192 * ARCSEC_TO_RAD;
+  const double dra0 = -0.0146 * ARCSEC_TO_RAD;
+  const double eps0 = 84381.406 * ARCSEC_TO_RAD;
+  return matmul(matmul(rx(deps_b), ry(dpsi_b * std::sin(eps0))), rz(-dra0));
+}
+
+Mat3 precession_matrix(double T) {
+  const double zeta =
+      (2306.2181 * T + 0.30188 * T * T + 0.017998 * T * T * T) * ARCSEC_TO_RAD;
+  const double z =
+      (2306.2181 * T + 1.09468 * T * T + 0.018203 * T * T * T) * ARCSEC_TO_RAD;
+  const double theta =
+      (2004.3109 * T - 0.42665 * T * T - 0.041833 * T * T * T) * ARCSEC_TO_RAD;
+  return matmul(matmul(rz(-z), ry(theta)), rz(-zeta));
+}
+
+Mat3 nutation_matrix(double T, double dpsi, double deps) {
+  const double eps = mean_obliquity(T);
+  return matmul(matmul(rx(-(eps + deps)), rz(-dpsi)), rx(eps));
+}
+
+inline double era(std::int64_t ut1_day, double ut1_sec) {
+  const double du =
+      (static_cast<double>(ut1_day - 51544) - 0.5) + ut1_sec / SECS_PER_DAY;
+  const double frac = ut1_sec / SECS_PER_DAY;
+  const double theta =
+      TWO_PI * (0.7790572732640 + 0.00273781191135448 * du + frac);
+  return std::fmod(theta, TWO_PI);
+}
+
+}  // namespace
+
+extern "C" {
+
+// TDB-TT [s] (FB1990 truncated, same terms as timescales.py).
+void pt_tdb_minus_tt(std::int64_t n, const std::int64_t* tt_day,
+                     const double* tt_sec, double* out) {
+  static constexpr double TERMS[10][3] = {
+      {0.001656675, 628.3075850, 6.2400580},
+      {0.000022418, 575.3384885, 4.2969771},
+      {0.000013840, 1256.6151700, 6.1968992},
+      {0.000004770, 52.9690965, 0.4444038},
+      {0.000004677, 606.9776754, 4.0211665},
+      {0.000002257, 21.3299095, 5.5431320},
+      {0.000001694, 0.3523118, 5.0251207},
+      {0.000001556, 1203.6460735, 4.1698465},
+      {0.000001276, 1414.3495242, 4.2781490},
+      {0.000001193, 1097.7078770, 6.1798441},
+  };
+  for (std::int64_t i = 0; i < n; ++i) {
+    const double T = jc_from_epoch(tt_day[i], tt_sec[i]);
+    double s = 0.0;
+    for (const auto& t : TERMS) s += t[0] * std::sin(t[1] * T + t[2]);
+    s += 0.0000102 * T * std::sin(628.3075850 * T + 4.2490);
+    out[i] = s;
+  }
+}
+
+// Observatory ITRF -> GCRS position [m] and velocity [m/s].
+// Epoch conversions (UTC->TT, UT1) and EOP lookups stay in Python so
+// leap-second policy lives in exactly one place.
+void pt_itrf_to_gcrs(std::int64_t n, const std::int64_t* tt_day,
+                     const double* tt_sec, const std::int64_t* ut1_day,
+                     const double* ut1_sec, const double* xp, const double* yp,
+                     const double* itrf, double* out_pos, double* out_vel) {
+  const Mat3 B = bias_matrix();
+  for (std::int64_t i = 0; i < n; ++i) {
+    const double T = jc_from_epoch(tt_day[i], tt_sec[i]);
+    double dpsi, deps;
+    nutation(T, &dpsi, &deps);
+    // GAST = ERA + GMST-poly + equation of equinoxes (main term)
+    const double poly = (0.014506 + 4612.156534 * T + 1.3915817 * T * T -
+                         0.00000044 * T * T * T) *
+                        ARCSEC_TO_RAD;
+    const double ee = dpsi * std::cos(mean_obliquity(T));
+    const double theta =
+        std::fmod(era(ut1_day[i], ut1_sec[i]) + poly + ee, TWO_PI);
+    const Mat3 W = matmul(ry(xp[i]), rx(yp[i]));
+    Mat3 c2t = matmul(
+        W, matmul(rz(theta), matmul(nutation_matrix(T, dpsi, deps),
+                                    matmul(precession_matrix(T), B))));
+    // transpose -> ITRF->GCRS; pos = M r
+    double p[3];
+    for (int r = 0; r < 3; ++r) {
+      p[r] = c2t.m[0][r] * itrf[0] + c2t.m[1][r] * itrf[1] +
+             c2t.m[2][r] * itrf[2];
+    }
+    out_pos[3 * i + 0] = p[0];
+    out_pos[3 * i + 1] = p[1];
+    out_pos[3 * i + 2] = p[2];
+    // vel = omega x pos (PN-rate terms ~1e5 x smaller)
+    out_vel[3 * i + 0] = -OMEGA_EARTH * p[1];
+    out_vel[3 * i + 1] = OMEGA_EARTH * p[0];
+    out_vel[3 * i + 2] = 0.0;
+  }
+}
+
+// SPK type 2/3 Chebyshev evaluation over gathered records.
+// rec: (n, rsize) rows [mid, radius, coeffs...]; matches spk.py::posvel.
+void pt_cheby_posvel(std::int64_t n, std::int64_t ncoef,
+                     std::int64_t data_type, std::int64_t rsize,
+                     const double* et, const double* rec, double* out_pos,
+                     double* out_vel) {
+  // stack buffers: DE kernels use <= 18 coefficients
+  double Tp[32], dTp[32];
+  for (std::int64_t i = 0; i < n; ++i) {
+    const double* r = rec + i * rsize;
+    const double mid = r[0], radius = r[1];
+    const double s = (et[i] - mid) / radius;
+    Tp[0] = 1.0;
+    dTp[0] = 0.0;
+    if (ncoef > 1) {
+      Tp[1] = s;
+      dTp[1] = 1.0;
+    }
+    for (std::int64_t k = 2; k < ncoef; ++k) {
+      Tp[k] = 2.0 * s * Tp[k - 1] - Tp[k - 2];
+      dTp[k] = 2.0 * Tp[k - 1] + 2.0 * s * dTp[k - 1] - dTp[k - 2];
+    }
+    for (int axis = 0; axis < 3; ++axis) {
+      const double* c = r + 2 + axis * ncoef;
+      double pos = 0.0, vel = 0.0;
+      for (std::int64_t k = 0; k < ncoef; ++k) {
+        pos += c[k] * Tp[k];
+        vel += c[k] * dTp[k];
+      }
+      out_pos[3 * i + axis] = pos;
+      out_vel[3 * i + axis] = vel / radius;
+    }
+    if (data_type == 3) {
+      for (int axis = 0; axis < 3; ++axis) {
+        const double* c = r + 2 + (3 + axis) * ncoef;
+        double vel = 0.0;
+        for (std::int64_t k = 0; k < ncoef; ++k) vel += c[k] * Tp[k];
+        out_vel[3 * i + axis] = vel;
+      }
+    }
+  }
+}
+
+}  // extern "C"

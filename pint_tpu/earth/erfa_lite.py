@@ -206,14 +206,10 @@ def gast(ut1: Epochs, T_tt) -> np.ndarray:
     return np.mod(era(ut1) + poly + ee, TWO_PI)
 
 
-def itrf_to_gcrs_matrix(utc: Epochs, eop: EOPTable | None = None) -> np.ndarray:
-    """Rotation matrices (n, 3, 3): r_GCRS = M @ r_ITRF.
-
-    Chain: GCRS = B^T P^T N^T R3(-GAST) W^T r_ITRF
-    (equinox-based; reference: erfa c2t06a equivalent).
-    """
+def _earth_rotation_inputs(utc: Epochs, eop: EOPTable | None):
+    """(tt, ut1, xp, yp) — the single home of the UTC->TT/UT1/EOP
+    precompute shared by the numpy and native paths."""
     tt = ts.utc_to_tt(utc)
-    T = _jc_tt(tt)
     if eop is not None:
         dut1 = eop.ut1_minus_utc(utc)
         xp, yp = eop.polar_motion(utc)
@@ -221,6 +217,18 @@ def itrf_to_gcrs_matrix(utc: Epochs, eop: EOPTable | None = None) -> np.ndarray:
         dut1 = np.zeros(len(utc))
         xp = yp = np.zeros(len(utc))
     ut1 = Epochs(utc.day, utc.sec + dut1, "ut1").normalized()
+    return tt, ut1, xp, yp
+
+
+def itrf_to_gcrs_matrix(utc: Epochs, eop: EOPTable | None = None,
+                        _inputs=None) -> np.ndarray:
+    """Rotation matrices (n, 3, 3): r_GCRS = M @ r_ITRF.
+
+    Chain: GCRS = B^T P^T N^T R3(-GAST) W^T r_ITRF
+    (equinox-based; reference: erfa c2t06a equivalent).
+    """
+    tt, ut1, xp, yp = _inputs or _earth_rotation_inputs(utc, eop)
+    T = _jc_tt(tt)
     theta = gast(ut1, T)
     # polar motion W = R1(yp) R2(xp) (s' neglected, <0.1 mas)
     W = _ry(xp) @ _rx(yp)
@@ -232,9 +240,20 @@ def gcrs_posvel_from_itrf(itrf_xyz_m, utc: Epochs, eop: EOPTable | None = None):
     """Observatory GCRS position [m] and velocity [m/s] at each epoch.
 
     (reference: src/pint/erfautils.py::gcrs_posvel_from_itrf)
+
+    Dispatches to the C++ host kernel (pint_tpu/native) when built —
+    same chain, same truncated series; the numpy path below is the
+    always-available mirror.
     """
-    M = itrf_to_gcrs_matrix(utc, eop)
+    from ..native import itrf_to_gcrs as _native
+
     r = np.asarray(itrf_xyz_m, dtype=np.float64)
+    inputs = _earth_rotation_inputs(utc, eop)
+    tt, ut1, xp, yp = inputs
+    nat = _native(tt.day, tt.sec, ut1.day, ut1.sec, xp, yp, r)
+    if nat is not None:
+        return nat
+    M = itrf_to_gcrs_matrix(utc, eop, _inputs=inputs)
     pos = (M @ r).reshape(len(utc), 3)
     # velocity: d/dt R3(-theta) only (PN terms ~1e5 x slower)
     omega = np.array([0.0, 0.0, OMEGA_EARTH])

@@ -137,6 +137,11 @@ class SPKKernel:
         all_rec = self._words(seg.start_word, seg.n_records * rsize)
         all_rec = all_rec.reshape(seg.n_records, rsize)
         rec = all_rec[idx]  # (n, rsize)
+        from ..native import cheby_posvel as _native
+
+        nat = _native(et, rec, ncoef, seg.data_type)
+        if nat is not None:
+            return nat
         mid, radius = rec[:, 0], rec[:, 1]
         s = (et - mid) / radius  # in [-1, 1]
         # Chebyshev polynomials T_k(s) and derivatives

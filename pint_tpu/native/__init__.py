@@ -1,0 +1,129 @@
+"""ctypes loader for the native host-kernel library.
+
+Builds native/src/host_kernels.cpp on first use (g++ is in the build
+image; no pybind11, so the C ABI + ctypes is the binding layer), and
+degrades silently to the pure-numpy implementations when a compiler
+is unavailable or PINT_TPU_NO_NATIVE is set. Every call site keeps
+its numpy path; the native library is a performance mirror, verified
+equal by tests/test_native.py.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_LIB: ctypes.CDLL | None | bool = None  # False = tried and failed
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libpint_host.so")
+_SRC = os.path.join(_HERE, "..", "..", "native", "src", "host_kernels.cpp")
+
+_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+
+
+def _build() -> bool:
+    src = os.path.abspath(_SRC)
+    if not os.path.exists(src):
+        return False
+    # compile to a temp path and atomically rename: an interrupted or
+    # concurrent build must never leave a truncated .so that the
+    # staleness check would treat as fresh
+    tmp = f"{_SO}.build.{os.getpid()}"
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-o", tmp, src],
+            check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def get_lib() -> ctypes.CDLL | None:
+    """The loaded native library, building it if needed; None if
+    unavailable (callers then use their numpy paths)."""
+    global _LIB
+    if _LIB is False:
+        return None
+    if _LIB is not None:
+        return _LIB
+    if os.environ.get("PINT_TPU_NO_NATIVE"):
+        _LIB = False
+        return None
+    stale = (not os.path.exists(_SO)
+             or (os.path.exists(_SRC)
+                 and os.path.getmtime(_SRC) > os.path.getmtime(_SO)))
+    if stale and not _build():
+        _LIB = False
+        return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        _LIB = False
+        return None
+    lib.pt_tdb_minus_tt.argtypes = [ctypes.c_int64, _i64p, _f64p, _f64p]
+    lib.pt_tdb_minus_tt.restype = None
+    lib.pt_itrf_to_gcrs.argtypes = [ctypes.c_int64, _i64p, _f64p, _i64p,
+                                    _f64p, _f64p, _f64p, _f64p, _f64p, _f64p]
+    lib.pt_itrf_to_gcrs.restype = None
+    lib.pt_cheby_posvel.argtypes = [ctypes.c_int64, ctypes.c_int64,
+                                    ctypes.c_int64, ctypes.c_int64,
+                                    _f64p, _f64p, _f64p, _f64p]
+    lib.pt_cheby_posvel.restype = None
+    _LIB = lib
+    return lib
+
+
+# ---- typed wrappers (None-safe callers check availability first) ----
+
+def tdb_minus_tt(tt_day, tt_sec) -> np.ndarray | None:
+    lib = get_lib()
+    if lib is None:
+        return None
+    day = np.ascontiguousarray(tt_day, np.int64)
+    sec = np.ascontiguousarray(tt_sec, np.float64)
+    out = np.empty(day.shape, np.float64)
+    lib.pt_tdb_minus_tt(day.size, day, sec, out)
+    return out
+
+
+def itrf_to_gcrs(tt_day, tt_sec, ut1_day, ut1_sec, xp, yp, itrf_xyz):
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(tt_day)
+    ttd = np.ascontiguousarray(tt_day, np.int64)
+    tts = np.ascontiguousarray(tt_sec, np.float64)
+    u1d = np.ascontiguousarray(ut1_day, np.int64)
+    u1s = np.ascontiguousarray(ut1_sec, np.float64)
+    xpa = np.ascontiguousarray(np.broadcast_to(xp, (n,)), np.float64)
+    ypa = np.ascontiguousarray(np.broadcast_to(yp, (n,)), np.float64)
+    itrf = np.ascontiguousarray(itrf_xyz, np.float64)
+    pos = np.empty((n, 3), np.float64)
+    vel = np.empty((n, 3), np.float64)
+    lib.pt_itrf_to_gcrs(n, ttd, tts, u1d, u1s, xpa, ypa, itrf, pos, vel)
+    return pos, vel
+
+
+def cheby_posvel(et, rec, ncoef, data_type):
+    lib = get_lib()
+    if lib is None:
+        return None
+    et = np.ascontiguousarray(et, np.float64)
+    rec = np.ascontiguousarray(rec, np.float64)
+    n, rsize = rec.shape
+    if ncoef > 32:
+        return None  # C kernel stack buffer bound; numpy path handles it
+    pos = np.empty((n, 3), np.float64)
+    vel = np.empty((n, 3), np.float64)
+    lib.pt_cheby_posvel(n, ncoef, data_type, rsize, et, rec, pos, vel)
+    return pos, vel

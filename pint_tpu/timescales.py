@@ -132,6 +132,11 @@ def tdb_minus_tt(tt: Epochs) -> np.ndarray:
     T2CMETHOD; reference: toa.py::TOAs.compute_TDBs grid).
     """
     assert tt.scale == "tt"
+    from .native import tdb_minus_tt as _native
+
+    nat = _native(tt.day, tt.sec)
+    if nat is not None:
+        return nat
     T = ((tt.day - 51544) - 0.5 + tt.sec / SECS_PER_DAY) / 36525.0
     out = np.zeros_like(T)
     for amp, rate, phase in _TDB_TERMS:

@@ -1,0 +1,127 @@
+"""Native C++ host-kernel equivalence tests.
+
+The C++ library (native/src/host_kernels.cpp) mirrors the numpy
+implementations term-for-term; these tests assert both paths agree to
+machine precision and that the loader degrades gracefully.
+"""
+
+import numpy as np
+import pytest
+
+import pint_tpu.native as native
+from pint_tpu.mjd import Epochs
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lb = native.get_lib()
+    if lb is None:
+        pytest.skip("native library unavailable (no g++?)")
+    return lb
+
+
+def _numpy_only(monkeypatch):
+    """Force the numpy paths regardless of the built library."""
+    monkeypatch.setattr(native, "_LIB", False)
+
+
+def test_tdb_minus_tt_equivalence(lib, monkeypatch):
+    rng = np.random.default_rng(0)
+    day = rng.integers(44000, 61000, 500).astype(np.int64)
+    sec = rng.uniform(0, 86400, 500)
+    tt = Epochs(day, sec, "tt")
+    got = native.tdb_minus_tt(tt.day, tt.sec)
+    from pint_tpu.timescales import tdb_minus_tt
+
+    _numpy_only(monkeypatch)
+    expected = tdb_minus_tt(tt)
+    # both are ~1.6 ms amplitude; require < 1 ps agreement
+    np.testing.assert_allclose(got, expected, rtol=0, atol=1e-12)
+    assert np.abs(got).max() > 1e-4  # sanity: series actually evaluated
+
+
+def test_itrf_to_gcrs_equivalence(lib, monkeypatch):
+    from pint_tpu.earth.erfa_lite import gcrs_posvel_from_itrf
+
+    rng = np.random.default_rng(1)
+    day = rng.integers(50000, 61000, 300).astype(np.int64)
+    sec = rng.uniform(0, 86400, 300)
+    utc = Epochs(day, sec, "utc")
+    itrf = np.array([882589.65, -4924872.32, 3943729.348])  # GBT
+    pos_n, vel_n = gcrs_posvel_from_itrf(itrf, utc)  # native path
+    _numpy_only(monkeypatch)
+    pos_p, vel_p = gcrs_posvel_from_itrf(itrf, utc)  # numpy path
+    # sub-micrometer agreement on Earth-radius vectors
+    np.testing.assert_allclose(pos_n, pos_p, rtol=0, atol=1e-6)
+    np.testing.assert_allclose(vel_n, vel_p, rtol=0, atol=1e-10)
+    r = np.linalg.norm(pos_n, axis=1)
+    assert np.all(np.abs(r - np.linalg.norm(itrf)) < 1e-3)  # rotation only
+
+
+def test_cheby_posvel_equivalence(lib):
+    """Native Chebyshev vs direct numpy recurrence on synthetic records."""
+    rng = np.random.default_rng(2)
+    n, ncoef = 200, 12
+    rsize = 2 + 3 * ncoef
+    rec = np.zeros((n, rsize))
+    rec[:, 0] = rng.uniform(0, 1e8, n)     # mid
+    rec[:, 1] = rng.uniform(1e4, 1e5, n)   # radius
+    rec[:, 2:] = rng.normal(0, 1e3, (n, 3 * ncoef))
+    et = rec[:, 0] + rng.uniform(-1, 1, n) * rec[:, 1]
+    pos, vel = native.cheby_posvel(et, rec, ncoef, 2)
+    s = (et - rec[:, 0]) / rec[:, 1]
+    T = np.zeros((ncoef, n))
+    dT = np.zeros((ncoef, n))
+    T[0], T[1] = 1.0, s
+    dT[1] = 1.0
+    for k in range(2, ncoef):
+        T[k] = 2 * s * T[k - 1] - T[k - 2]
+        dT[k] = 2 * T[k - 1] + 2 * s * dT[k - 1] - dT[k - 2]
+    for axis in range(3):
+        c = rec[:, 2 + axis * ncoef: 2 + (axis + 1) * ncoef]
+        np.testing.assert_allclose(pos[:, axis], np.einsum("nk,kn->n", c, T),
+                                   rtol=1e-13)
+        np.testing.assert_allclose(vel[:, axis],
+                                   np.einsum("nk,kn->n", c, dT) / rec[:, 1],
+                                   rtol=1e-12)
+
+
+def test_loader_disable_env(monkeypatch):
+    monkeypatch.setenv("PINT_TPU_NO_NATIVE", "1")
+    monkeypatch.setattr(native, "_LIB", None)
+    assert native.get_lib() is None
+    assert native.tdb_minus_tt(np.array([55000]), np.array([0.0])) is None
+    monkeypatch.setattr(native, "_LIB", None)  # reset for other tests
+
+
+def test_native_speedup(lib):
+    """The native earth-rotation kernel should beat numpy comfortably
+    on per-TOA batches (it is the host hot path for photon loads)."""
+    import time
+
+    from pint_tpu.earth import erfa_lite
+
+    n = 20000
+    rng = np.random.default_rng(3)
+    utc = Epochs(rng.integers(50000, 61000, n).astype(np.int64),
+                 rng.uniform(0, 86400, n), "utc")
+    itrf = np.array([882589.65, -4924872.32, 3943729.348])
+    def best_of(k, fn):
+        ts_ = []
+        for _ in range(k):
+            t0 = time.perf_counter()
+            fn()
+            ts_.append(time.perf_counter() - t0)
+        return min(ts_)
+
+    run = lambda: erfa_lite.gcrs_posvel_from_itrf(itrf, utc)  # noqa: E731
+    run()  # warm both paths' imports
+    t_native = best_of(3, run)
+    M = native._LIB
+    try:
+        native._LIB = False
+        t_numpy = best_of(3, run)
+    finally:
+        native._LIB = M
+    # generous bound: regression signal without timing-noise flakes
+    assert t_native < 2.0 * t_numpy, (t_native, t_numpy)
