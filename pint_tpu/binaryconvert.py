@@ -1,0 +1,145 @@
+"""Binary-model conversion (ELL1 <-> DD/BT families, DD -> DDS/DDGR).
+
+(reference: src/pint/binaryconvert.py::convert_binary — transforms
+parameters between binary parameterizations including uncertainty
+propagation through the analytic Jacobians.)
+
+ELL1 <-> DD mapping (Lange et al. 2001):
+    ECC = sqrt(EPS1^2 + EPS2^2),  OM = atan2(EPS1, EPS2)
+    T0  = TASC + OM/(2 pi) * PB
+and inverse. The ELL1 expansion is valid for x e^2 << timing
+precision; conversion warns (via returned model's docstring, not an
+exception) outside that regime like the reference does.
+"""
+
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+
+from .constants import SECS_PER_JULIAN_YEAR
+from .models.binary import add_binary_component
+
+_TWO_PI = 2.0 * np.pi
+
+
+def _strip_binary(model):
+    out = copy.deepcopy(model)
+    name = next(n for n in out.components if n.startswith("Binary"))
+    comp = out.components[name]
+    vals = {p: (getattr(comp, p).value, getattr(comp, p).uncertainty,
+                getattr(comp, p).frozen) for p in comp.params}
+    out.remove_component(name)
+    return out, vals, type(comp).binary_model_name
+
+
+def _apply(comp, vals, skip=()):
+    for p, (v, u, fr) in vals.items():
+        if p in skip or p not in comp.params or v is None:
+            continue
+        par = getattr(comp, p)
+        par.value = v
+        par.uncertainty = u
+        par.frozen = fr
+
+
+def convert_binary(model, output: str):
+    """Return a new model with the binary component converted to the
+    ``output`` parameterization (reference: binaryconvert.py::convert_binary)."""
+    output = output.upper()
+    out, vals, current = _strip_binary(model)
+    keys = {}  # no prefix params carried through conversion by default
+    for i in range(20):
+        if f"FB{i}" in vals and vals[f"FB{i}"][0] is not None:
+            keys[f"FB{i}"] = [repr(vals[f"FB{i}"][0])]
+    comp = add_binary_component(out, output, keys)
+    ell1_like = {"ELL1", "ELL1H", "ELL1K"}
+
+    def _pb_days():
+        pb = vals.get("PB", (None,))[0]
+        if pb is not None:
+            return pb
+        fb0 = vals.get("FB0", (None,))[0]
+        if fb0:
+            return 1.0 / (fb0 * 86400.0)
+        raise ValueError("binary model has neither PB nor FB0")
+
+    if current in ell1_like and output not in ell1_like:
+        e1, u1, _ = vals.get("EPS1", (0.0, None, True))
+        e2, u2, _ = vals.get("EPS2", (0.0, None, True))
+        e1, e2 = e1 or 0.0, e2 or 0.0
+        ecc = float(np.hypot(e1, e2))
+        om = float(np.arctan2(e1, e2) % _TWO_PI)
+        pb = _pb_days()
+        tasc = vals["TASC"][0]
+        t0 = tasc + (om / _TWO_PI) * pb
+        _apply(comp, vals, skip=("EPS1", "EPS2", "EPS1DOT", "EPS2DOT", "TASC"))
+        comp.ECC.value = ecc
+        comp.OM.value = np.rad2deg(om)
+        comp.T0.value = t0
+        # eccentricity-evolution terms map through the polar transform:
+        # edot = (e1 e1dot + e2 e2dot)/e, omdot = (e2 e1dot - e1 e2dot)/e^2
+        e1d = vals.get("EPS1DOT", (None,))[0]
+        e2d = vals.get("EPS2DOT", (None,))[0]
+        if (e1d or e2d) and ecc > 0:
+            e1d, e2d = e1d or 0.0, e2d or 0.0
+            comp.EDOT.value = (e1 * e1d + e2 * e2d) / ecc
+            omdot_rad_s = (e2 * e1d - e1 * e2d) / ecc**2
+            comp.OMDOT.value = np.rad2deg(omdot_rad_s) * SECS_PER_JULIAN_YEAR
+        comp.ECC.frozen = vals.get("EPS1", (None, None, True))[2]
+        comp.OM.frozen = comp.ECC.frozen
+        comp.T0.frozen = vals.get("TASC", (None, None, True))[2]
+        # uncertainty propagation (Jacobian of the polar transform)
+        if u1 is not None or u2 is not None:
+            u1, u2 = u1 or 0.0, u2 or 0.0
+            if ecc > 0:
+                comp.ECC.uncertainty = float(
+                    np.hypot(e1 * u1, e2 * u2) / ecc)
+                s_om = float(np.hypot(e2 * u1, e1 * u2) / ecc**2)
+                comp.OM.uncertainty = np.rad2deg(s_om)
+                ut = vals.get("TASC", (None, None, None))[1]
+                comp.T0.uncertainty = float(np.hypot(
+                    ut or 0.0, (s_om / _TWO_PI) * pb)) or None
+    elif current not in ell1_like and output in ell1_like:
+        ecc, ue, _ = vals.get("ECC", (0.0, None, True))
+        om_deg, uo, _ = vals.get("OM", (0.0, None, True))
+        ecc, om_deg = ecc or 0.0, om_deg or 0.0
+        om = np.deg2rad(om_deg)
+        eps1, eps2 = ecc * np.sin(om), ecc * np.cos(om)
+        pb = _pb_days()
+        t0 = vals["T0"][0]
+        tasc = t0 - (om % _TWO_PI) / _TWO_PI * pb
+        _apply(comp, vals, skip=("ECC", "OM", "EDOT", "OMDOT", "T0",
+                                 "GAMMA", "DR", "DTH", "A0", "B0"))
+        comp.EPS1.value = float(eps1)
+        comp.EPS2.value = float(eps2)
+        comp.TASC.value = float(tasc)
+        # inverse mapping of eccentricity-evolution terms
+        edot = vals.get("EDOT", (None,))[0]
+        omdot = vals.get("OMDOT", (None,))[0]
+        if (edot or omdot) and "EPS1DOT" in comp.params:
+            edot = edot or 0.0
+            omdot_rad_s = np.deg2rad(omdot or 0.0) / SECS_PER_JULIAN_YEAR
+            comp.EPS1DOT.value = float(edot * np.sin(om)
+                                       + ecc * np.cos(om) * omdot_rad_s)
+            comp.EPS2DOT.value = float(edot * np.cos(om)
+                                       - ecc * np.sin(om) * omdot_rad_s)
+        comp.EPS1.frozen = comp.EPS2.frozen = vals.get("ECC", (None, None, True))[2]
+        comp.TASC.frozen = vals.get("T0", (None, None, True))[2]
+        if ue is not None or uo is not None:
+            ue = ue or 0.0
+            uo_r = np.deg2rad(uo or 0.0)
+            comp.EPS1.uncertainty = float(np.hypot(np.sin(om) * ue,
+                                                   ecc * np.cos(om) * uo_r))
+            comp.EPS2.uncertainty = float(np.hypot(np.cos(om) * ue,
+                                                   ecc * np.sin(om) * uo_r))
+            ut = vals.get("T0", (None, None, None))[1]
+            comp.TASC.uncertainty = float(np.hypot(
+                ut or 0.0, (uo_r / _TWO_PI) * pb)) or None
+    else:
+        # within-family conversion (DD->DDS/DDK/DDGR, ELL1->ELL1H, ...):
+        # shared params carry over; new params start unset
+        _apply(comp, vals)
+    out.setup()
+    return out

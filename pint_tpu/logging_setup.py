@@ -1,0 +1,56 @@
+"""Logging setup with repeated-warning dedup.
+
+(reference: src/pint/logging.py — loguru sink with a LogFilter that
+suppresses repeats of known-noisy messages and a ``setup(level=...)``
+entry point. loguru is not in this environment; the stdlib logging
+module provides the same surface.)
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+LOG_NAME = "pint_tpu"
+
+
+class DedupFilter(logging.Filter):
+    """Emit each distinct (level, message) once; drop repeats
+    (reference: pint.logging.LogFilter)."""
+
+    def __init__(self, max_repeats: int = 1):
+        super().__init__()
+        self.max_repeats = max_repeats
+        self._seen: dict[tuple, int] = {}
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        if record.levelno < logging.WARNING:
+            return True
+        key = (record.levelno, record.getMessage())
+        n = self._seen.get(key, 0)
+        self._seen[key] = n + 1
+        return n < self.max_repeats
+
+
+def setup(level="INFO", stream=None, dedup=True) -> logging.Logger:
+    """Configure the package logger (reference: pint.logging.setup).
+
+    Returns the logger; repeat calls reconfigure idempotently.
+    """
+    logger = logging.getLogger(LOG_NAME)
+    logger.setLevel(getattr(logging, str(level).upper(), logging.INFO))
+    for h in list(logger.handlers):
+        logger.removeHandler(h)
+    handler = logging.StreamHandler(stream or sys.stderr)
+    handler.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname)-7s %(name)s: %(message)s", "%H:%M:%S"))
+    if dedup:
+        handler.addFilter(DedupFilter())
+    logger.addHandler(handler)
+    logger.propagate = False
+    return logger
+
+
+def get_logger(child: str | None = None) -> logging.Logger:
+    name = LOG_NAME if child is None else f"{LOG_NAME}.{child}"
+    return logging.getLogger(name)

@@ -116,6 +116,10 @@ def get_model(parfile, allow_name_mixing=False) -> TimingModel:
         from .binary import add_binary_component
 
         add_binary_component(model, keys["BINARY"][0], keys)
+    if "TZRMJD" in keys:
+        from .absolute_phase import AbsPhase
+
+        model.add_component(AbsPhase())
     if any(c in ("EFAC", "EQUAD", "ECORR", "DMEFAC", "DMEQUAD") for c, _ in repeats) or any(
             k.startswith(("RNAMP", "RNIDX", "TNRED")) for k in keys):
         from .noise import ScaleToaError, EcorrNoise, PLRedNoise

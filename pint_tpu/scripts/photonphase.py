@@ -73,7 +73,12 @@ def main(argv=None) -> int:
         out_cols = {k: np.asarray(v)[keep] for k, v in cols.items()}
         out_cols["PULSE_PHASE"] = phases
         if args.absphase:
-            out_cols["PULSE_NUMBER"] = np.asarray(ph_obj.int, np.float64)
+            # Phase.frac is in [-0.5, 0.5) but PULSE_PHASE is frac % 1,
+            # so borrow a cycle where frac went negative to keep
+            # NUMBER + PHASE == int_ + frac exactly
+            pn = (np.asarray(ph_obj.int_, np.float64)
+                  - (np.asarray(ph_obj.frac) < 0))
+            out_cols["PULSE_NUMBER"] = pn
         keep = {k: header[k] for k in ("MJDREFI", "MJDREFF", "MJDREF",
                                        "TIMESYS", "TELESCOP") if k in header}
         write_fits_table(args.outfile, out_cols, keep, extname="EVENTS")

@@ -131,3 +131,56 @@ def compute_hash(*chunks) -> str:
             c = c.encode()
         h.update(c)
     return h.hexdigest()
+
+
+def dmx_ranges(toas, binwidth_days=6.5):
+    """Propose DMX windows covering the TOAs (reference:
+    utils.py::dmx_ranges — greedy epoch binning; a window closes when
+    the next TOA is more than binwidth away)."""
+    if len(toas) == 0:
+        raise ValueError("cannot propose DMX ranges for empty TOAs")
+    mjds = np.sort(toas.get_mjds())
+    ranges = []
+    lo = hi = mjds[0]
+    for m in mjds[1:]:
+        if m - lo > binwidth_days:
+            ranges.append((lo - 0.01, hi + 0.01))
+            lo = hi = m
+        else:
+            hi = m
+    ranges.append((lo - 0.01, hi + 0.01))
+    return ranges
+
+
+def dmxparse(fitter):
+    """Collect fitted DMX values/uncertainties/epochs into arrays
+    (reference: utils.py::dmxparse; used for DM(t) plots and the
+    NANOGrav dmxparse.out convention).
+
+    Returns dict with keys dmxs, dmx_verrs, dmxeps, r1s, r2s, bins.
+    """
+    model = fitter.model
+    comp = model.components.get("DispersionDMX")
+    if comp is None:
+        raise ValueError("model has no DispersionDMX component")
+    idxs = comp.dmx_ids
+    dmxs, verrs, eps, r1s, r2s, bins = [], [], [], [], [], []
+    for i in idxs:
+        p = getattr(model, f"DMX_{i:04d}")
+        r1 = getattr(model, f"DMXR1_{i:04d}").value
+        r2 = getattr(model, f"DMXR2_{i:04d}").value
+        dmxs.append(p.value or 0.0)
+        verrs.append(p.uncertainty if p.uncertainty is not None else np.nan)
+        r1s.append(r1)
+        r2s.append(r2)
+        eps.append(0.5 * ((r1 or 0.0) + (r2 or 0.0)))
+        bins.append(f"DMX_{i:04d}")
+    return {
+        "dmxs": np.array(dmxs),
+        "dmx_verrs": np.array(verrs),
+        "dmxeps": np.array(eps),
+        "r1s": np.array(r1s, dtype=float),
+        "r2s": np.array(r2s, dtype=float),
+        "bins": bins,
+        "mean_dmx": float(np.mean(dmxs)) if dmxs else np.nan,
+    }

@@ -1,0 +1,156 @@
+"""Breadth-parity tests: AbsPhase/TZR, modelutils frame conversion,
+binaryconvert, dmxparse, plot_utils, logging, config.
+
+(reference patterns: tests/test_absphase.py, tests/test_modelutils.py,
+tests/test_binaryconvert.py, tests/test_dmxparse.py.)
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+warnings.simplefilter("ignore")
+
+from pint_tpu.models import get_model
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+BASE = """
+PSR TESTBR
+RAJ 06:30:49.4
+DECJ -28:34:42.7
+F0 301.5 1
+F1 -7e-16 1
+PEPOCH 55100
+DM 22.0 1
+"""
+
+
+def test_absphase_tzr():
+    """With TZR*, the TZR TOA itself must land at integer phase."""
+    par = BASE + "TZRMJD 55100.1234\nTZRSITE @\nTZRFRQ 1400\n"
+    m = get_model(par)
+    assert "AbsPhase" in m.components
+    tzr_toas = m.components["AbsPhase"].get_TZR_toa(m)
+    ph = m.phase(tzr_toas)
+    frac = float(np.asarray(ph.frac)[0])
+    assert abs(frac) < 1e-7, frac
+    assert abs(float(np.asarray(ph.int_)[0])) < 1  # counts from TZR
+
+
+def test_model_equatorial_to_ecliptic_roundtrip():
+    from pint_tpu.modelutils import (model_ecliptic_to_equatorial,
+                                     model_equatorial_to_ecliptic)
+
+    m = get_model(BASE)
+    m.RAJ.uncertainty = 1e-8
+    m.DECJ.uncertainty = 2e-8
+    m.PMRA.value, m.PMDEC.value = 3.2, -1.1
+    me = model_equatorial_to_ecliptic(m)
+    assert "AstrometryEcliptic" in me.components
+    # residuals identical: same sky position
+    mjds = np.linspace(55000, 55200, 30)
+    t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=1400.0,
+                                obs="gbt", add_noise=False)
+    r = np.asarray(Residuals(t, me, subtract_mean=False).calc_time_resids())
+    assert np.abs(r).max() < 2e-9
+    # round-trip restores angles and PM
+    mq = model_ecliptic_to_equatorial(me)
+    assert mq.RAJ.value == pytest.approx(m.RAJ.value, abs=1e-12)
+    assert mq.DECJ.value == pytest.approx(m.DECJ.value, abs=1e-12)
+    assert mq.PMRA.value == pytest.approx(3.2, abs=1e-6)
+    assert mq.PMDEC.value == pytest.approx(-1.1, abs=1e-6)
+    # diagonal-only propagation drops the RAJ/DECJ cross-covariance, so
+    # the round-tripped uncertainty inflates slightly (a few %)
+    assert mq.RAJ.uncertainty == pytest.approx(1e-8, rel=0.1)
+
+
+def test_convert_binary_ell1_dd_roundtrip():
+    from pint_tpu.binaryconvert import convert_binary
+
+    e, om_deg = 1e-5, 37.0
+    om = np.deg2rad(om_deg)
+    par = BASE + (f"BINARY ELL1\nPB 2.5 1\nA1 4.2 1\nTASC 55101.0 1\n"
+                  f"EPS1 {e*np.sin(om):.15e} 1\nEPS2 {e*np.cos(om):.15e} 1\n")
+    m = get_model(par)
+    m.EPS1.uncertainty = 1e-8
+    m.EPS2.uncertainty = 1e-8
+    md = convert_binary(m, "DD")
+    assert "BinaryDD" in md.components
+    assert md.ECC.value == pytest.approx(e, rel=1e-10)
+    assert md.OM.value == pytest.approx(om_deg, rel=1e-8)
+    assert md.ECC.uncertainty is not None
+    # T0 = TASC + OM/2pi*PB
+    assert md.T0.value == pytest.approx(55101.0 + om / (2 * np.pi) * 2.5,
+                                        abs=1e-9)
+    # residual agreement between parameterizations
+    mjds = np.linspace(55050, 55150, 40)
+    t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=1400.0,
+                                obs="gbt", add_noise=False)
+    r = np.asarray(Residuals(t, md, subtract_mean=True).calc_time_resids())
+    assert np.abs(r).max() < 5e-8  # O(x e^2) = 4e-10 s + expansion terms
+    # back to ELL1
+    me = convert_binary(md, "ELL1")
+    assert me.EPS1.value == pytest.approx(e * np.sin(om), rel=1e-8)
+    assert me.TASC.value == pytest.approx(55101.0, abs=1e-9)
+
+
+def test_dmxparse_and_ranges():
+    from pint_tpu.utils import dmx_ranges, dmxparse
+    from pint_tpu.fitter import WLSFitter
+
+    par = BASE + ("DMX 6.5\nDMX_0001 1e-4 1\nDMXR1_0001 55000\nDMXR2_0001 55100\n"
+                  "DMX_0002 -2e-4 1\nDMXR1_0002 55100\nDMXR2_0002 55200\n")
+    m = get_model(par)
+    mjds = np.linspace(55001, 55199, 60)
+    t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=1400.0,
+                                obs="gbt", add_noise=True, seed=2)
+    f = WLSFitter(t, m)
+    f.fit_toas()
+    out = dmxparse(f)
+    assert len(out["dmxs"]) == 2
+    assert np.isfinite(out["dmx_verrs"]).all()
+    assert out["dmxeps"][0] == pytest.approx(55050.0)
+    ranges = dmx_ranges(t, binwidth_days=10.0)
+    assert ranges[0][0] <= mjds[0] and ranges[-1][1] >= mjds[-1]
+
+
+def test_plot_utils(tmp_path):
+    from pint_tpu.plot_utils import phaseogram, phaseogram_binned
+
+    rng = np.random.default_rng(0)
+    mjds = rng.uniform(55000, 55010, 500)
+    ph = rng.vonmises(np.pi, 3.0, 500) / (2 * np.pi) % 1.0
+    f1 = tmp_path / "pg.png"
+    phaseogram(mjds, ph, plotfile=str(f1), title="t")
+    f2 = tmp_path / "pgb.png"
+    phaseogram_binned(mjds, ph, plotfile=str(f2))
+    assert f1.exists() and f2.exists()
+
+
+def test_logging_dedup(capsys):
+    import io
+
+    from pint_tpu.logging_setup import setup, get_logger
+
+    buf = io.StringIO()
+    setup(level="INFO", stream=buf)
+    log = get_logger("test")
+    for _ in range(5):
+        log.warning("repeated message")
+    log.info("info passes")
+    text = buf.getvalue()
+    assert text.count("repeated message") == 1
+    assert "info passes" in text
+
+
+def test_config_accessors():
+    from pint_tpu import config
+
+    par = config.examplefile("NGC6440E.par")
+    m = get_model(par)
+    assert m.F0.value is not None
+    assert config.runtimefile("observatories.json").endswith("observatories.json")
+    with pytest.raises(FileNotFoundError):
+        config.examplefile("nope.par")
