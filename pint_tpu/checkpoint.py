@@ -1,0 +1,142 @@
+"""Fit-state checkpointing for long batch fits.
+
+(reference analog: SURVEY.md section 5 — the reference's checkpoint is
+the par file itself (TimingModel.as_parfile round-trips full state)
+plus the TOA pickle cache. For TPU batch fits this module adds an
+orbax-backed snapshot of the numeric fit state between outer
+iterations, with a plain-npz fallback, so a preempted multi-hour PTA
+run resumes instead of restarting.)
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+class FitCheckpointer:
+    """Save/restore (param-vector, iteration, chi2) snapshots.
+
+    Uses orbax-checkpoint when importable (atomic, async-capable);
+    falls back to numpy .npz with atomic rename otherwise. Either way
+    the on-disk layout is a directory per tag.
+    """
+
+    def __init__(self, directory):
+        self.directory = str(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        try:
+            import orbax.checkpoint as ocp
+
+            self._ocp = ocp
+        except ImportError:
+            self._ocp = None
+
+    def _path(self, tag):
+        return os.path.join(self.directory, str(tag))
+
+    def save(self, tag, state: dict):
+        """state: dict of arrays/scalars (e.g. {"x": ..., "iter": i,
+        "chi2": ...}). String-valued entries (parameter names) go to a
+        JSON sidecar — orbax/tensorstore has no string dtype."""
+        import json
+
+        state = {k: np.asarray(v) for k, v in state.items()}
+        meta = {k: np.asarray(v).tolist() for k, v in state.items()
+                if np.asarray(v).dtype.kind in "US"}
+        numeric = {k: v for k, v in state.items()
+                   if np.asarray(v).dtype.kind not in "US"}
+        meta_path = self._path(tag) + ".meta.json"
+        tmp = meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, meta_path)
+        if self._ocp is not None:
+            import jax
+
+            path = os.path.abspath(self._path(tag))
+            ckptr = self._ocp.PyTreeCheckpointer()
+            ckptr.save(path, jax.tree_util.tree_map(np.asarray, numeric),
+                       force=True)
+            return path
+        path = self._path(tag) + ".npz"
+        tmp = path + ".tmp.npz"
+        np.savez(tmp, **numeric)
+        os.replace(tmp, path)
+        return path
+
+    def restore(self, tag) -> dict | None:
+        import json
+
+        out = None
+        if self._ocp is not None:
+            path = os.path.abspath(self._path(tag))
+            if os.path.exists(path):
+                ckptr = self._ocp.PyTreeCheckpointer()
+                try:
+                    out = dict(ckptr.restore(path))
+                except Exception:
+                    return None
+        else:
+            path = self._path(tag) + ".npz"
+            if os.path.exists(path):
+                try:
+                    with np.load(path) as z:
+                        out = {k: z[k] for k in z.files}
+                except OSError:
+                    return None
+        if out is None:
+            return None
+        meta_path = self._path(tag) + ".meta.json"
+        if os.path.exists(meta_path):
+            try:
+                with open(meta_path) as f:
+                    out.update({k: np.asarray(v)
+                                for k, v in json.load(f).items()})
+            except (OSError, json.JSONDecodeError):
+                pass
+        return out
+
+    def latest_iteration(self, tag) -> int:
+        state = self.restore(tag)
+        return int(state["iter"]) if state is not None and "iter" in state else -1
+
+
+def checkpointed_fit(fitter, directory, tag="fit", every=1, maxiter=20,
+                     **fit_kw):
+    """Run fitter.fit_toas with snapshots between outer iterations.
+
+    Resumes from the saved parameter vector when a snapshot exists
+    (per-pulsar failure isolation for batch runs lives in
+    parallel/pta.py; this wrapper covers the single-pulsar fitters).
+    Snapshots store parameter NAMES alongside values; on resume the
+    values are matched by name, and a snapshot whose free-parameter
+    set differs from the current model raises instead of silently
+    mis-assigning. "iter" counts completed fit iterations.
+    """
+    ckpt = FitCheckpointer(directory)
+    state = ckpt.restore(tag)
+    chi2 = None
+    if state is not None and "param_values" in state:
+        names = [str(n) for n in np.asarray(state["param_names"])]
+        current = list(fitter.model.free_params)
+        if set(names) != set(current):
+            raise ValueError(
+                f"checkpoint {tag!r} was taken with free params {names}, "
+                f"model has {current}; refusing positional restore")
+        vals = dict(zip(names, np.asarray(state["param_values"], float)))
+        for name in current:
+            getattr(fitter.model, name).value = float(vals[name])
+        chi2 = float(state["chi2"])
+    done = max(ckpt.latest_iteration(tag), 0)
+    while done < maxiter:
+        n = min(every, maxiter - done)
+        chi2 = fitter.fit_toas(maxiter=n, **fit_kw)
+        done += n
+        names = list(fitter.model.free_params)
+        vals = np.array([getattr(fitter.model, p).value for p in names])
+        ckpt.save(tag, {"param_values": vals,
+                        "param_names": np.array(names),
+                        "iter": done, "chi2": chi2})
+    return chi2

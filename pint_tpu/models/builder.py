@@ -121,8 +121,8 @@ def get_model(parfile, allow_name_mixing=False) -> TimingModel:
 
         model.add_component(AbsPhase())
     if any(c in ("EFAC", "EQUAD", "ECORR", "DMEFAC", "DMEQUAD") for c, _ in repeats) or any(
-            k.startswith(("RNAMP", "RNIDX", "TNRED")) for k in keys):
-        from .noise import ScaleToaError, EcorrNoise, PLRedNoise
+            k.startswith(("RNAMP", "RNIDX", "TNRED", "TNDM")) for k in keys):
+        from .noise import ScaleToaError, EcorrNoise, PLRedNoise, PLDMNoise
 
         if any(c in ("EFAC", "EQUAD", "DMEFAC", "DMEQUAD") for c, _ in repeats):
             model.add_component(ScaleToaError())
@@ -130,6 +130,23 @@ def get_model(parfile, allow_name_mixing=False) -> TimingModel:
             model.add_component(EcorrNoise())
         if any(k.startswith(("RNAMP", "RNIDX", "TNRED")) for k in keys):
             model.add_component(PLRedNoise())
+        if any(k.startswith("TNDM") for k in keys):
+            model.add_component(PLDMNoise())
+    if any(k.startswith("DMWXFREQ_") for k in keys):
+        from .wave import DMWaveX
+
+        model.add_component(DMWaveX())
+    if any(k.startswith("SWXDM_") for k in keys):
+        from .solar_wind import SolarWindDispersionX
+
+        # replaces the plain solar-wind component when both would match
+        if "SolarWindDispersion" in model.components:
+            model.remove_component("SolarWindDispersion")
+        model.add_component(SolarWindDispersionX())
+    if any(k.startswith("PWEP_") for k in keys):
+        from .piecewise import PiecewiseSpindown
+
+        model.add_component(PiecewiseSpindown())
 
     # dynamic prefix families before value assignment
     sd = model.components["Spindown"]
@@ -159,6 +176,26 @@ def get_model(parfile, allow_name_mixing=False) -> TimingModel:
         ids = sorted({int(k.split("_")[1]) for k in keys if k.startswith("WXFREQ_")})
         for idx in ids:
             wx.add_wavex(idx)
+    if "DMWaveX" in model.components:
+        dwx = model.components["DMWaveX"]
+        ids = sorted({int(k.split("_")[1]) for k in keys
+                      if k.startswith("DMWXFREQ_")})
+        for idx in ids:
+            dwx.add_dmwavex(idx)
+    if "SolarWindDispersionX" in model.components:
+        swx = model.components["SolarWindDispersionX"]
+        ids = sorted({int(k.split("_")[1]) for k in keys
+                      if k.startswith("SWXDM_")})
+        for idx in ids:
+            lo = float(keys.get(f"SWXR1_{idx:04d}", ["0"])[0])
+            hi = float(keys.get(f"SWXR2_{idx:04d}", ["0"])[0])
+            swx.add_swx_range(idx, lo, hi)
+    if "PiecewiseSpindown" in model.components:
+        pw = model.components["PiecewiseSpindown"]
+        ids = sorted({int(k.split("_")[1]) for k in keys
+                      if k.startswith("PWEP_")})
+        for idx in ids:
+            pw.add_segment(idx)
     if "FD" in model.components:
         fd = model.components["FD"]
         i = 1

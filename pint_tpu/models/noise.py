@@ -149,6 +149,33 @@ class EcorrNoise(NoiseComponent):
         return U, w
 
 
+def fourier_basis(toas, n_harm):
+    """(F (n_toa, 2*n_harm), freqs_Hz repeated sin/cos, tspan_s) —
+    the shared red/DM-noise Fourier machinery (one home so the basis
+    convention can't diverge between the chromatic and achromatic
+    components)."""
+    mjds = toas.get_mjds()
+    tspan_s = (mjds.max() - mjds.min() + 1.0) * SECS_PER_DAY
+    t_s = (mjds - mjds.min()) * SECS_PER_DAY
+    k = np.arange(1, n_harm + 1)
+    freqs = k / tspan_s
+    arg = 2 * np.pi * np.outer(t_s, freqs)
+    F = np.empty((len(toas), 2 * n_harm))
+    F[:, 0::2] = np.sin(arg)
+    F[:, 1::2] = np.cos(arg)
+    return F, np.repeat(freqs, 2), tspan_s
+
+
+def powerlaw_phi(A, gamma, f, tspan_s):
+    """Per-column prior variances [us^2] of the enterprise-convention
+    power law P(f) = A^2/(12 pi^2) (f/f_yr)^(-gamma) yr^3."""
+    import jax.numpy as jnp
+
+    fyr = 1.0 / (365.25 * SECS_PER_DAY)
+    psd = (A**2 / (12.0 * jnp.pi**2) * (f / fyr) ** (-gamma)) / fyr**3
+    return psd / tspan_s * 1e12  # s^2 -> us^2
+
+
 class PLRedNoise(NoiseComponent):
     """Power-law red noise Fourier basis (reference: noise_model.py::PLRedNoise).
 
@@ -183,18 +210,9 @@ class PLRedNoise(NoiseComponent):
     def pack(self, model, toas, prep, params0):
         import jax.numpy as jnp
 
-        mjds = toas.get_mjds()
-        tspan_s = (mjds.max() - mjds.min() + 1.0) * SECS_PER_DAY
-        t_s = (mjds - mjds.min()) * SECS_PER_DAY
-        nh = self.n_harmonics()
-        k = np.arange(1, nh + 1)
-        freqs = k / tspan_s  # Hz
-        arg = 2 * np.pi * np.outer(t_s, freqs)
-        F = np.empty((len(toas), 2 * nh))
-        F[:, 0::2] = np.sin(arg)
-        F[:, 1::2] = np.cos(arg)
+        F, freqs, tspan_s = fourier_basis(toas, self.n_harmonics())
         prep["rn_F"] = jnp.asarray(F)
-        prep["rn_freqs"] = jnp.asarray(np.repeat(freqs, 2))
+        prep["rn_freqs"] = jnp.asarray(freqs)
         prep["rn_tspan_s"] = tspan_s
         for pname in ("RNAMP", "RNIDX", "TNREDAMP", "TNREDGAM"):
             params0[pname] = getattr(self, pname).value or 0.0
@@ -221,7 +239,50 @@ class PLRedNoise(NoiseComponent):
             # kept equivalent: validated in tests/test_gls.py against direct PSD)
             A = params["RNAMP"] * (2.0 * jnp.pi * jnp.sqrt(3.0)) / (1e6 * 365.25 * 86400.0)
             gamma = -params["RNIDX"]
-        # PSD [s^2/Hz]; variance per bin = PSD * df, df = 1/Tspan
-        psd = (A**2 / (12.0 * jnp.pi**2) * (f / fyr) ** (-gamma)) / fyr**3
-        phi = psd / tspan * 1e12  # s^2 -> us^2
-        return prep["rn_F"], phi
+        return prep["rn_F"], powerlaw_phi(A, gamma, f, tspan)
+
+
+class PLDMNoise(NoiseComponent):
+    """Power-law DM (chromatic) noise (reference: noise_model.py::
+    PLDMNoise): same Fourier machinery as PLRedNoise, but the basis is
+    scaled per TOA by (f_ref/nu)^2, f_ref = 1400 MHz — achromatic in
+    DM units, chromatic in time delay. Params TNDMAMP (log10),
+    TNDMGAM, TNDMC.
+    """
+
+    category = "pl_dm_noise"
+    order = 93
+    F_REF_MHZ = 1400.0
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("TNDMAMP", units="log10",
+                                      description="log10 DM-noise amplitude"))
+        self.add_param(floatParameter("TNDMGAM", units="",
+                                      description="DM-noise spectral index"))
+        p = floatParameter("TNDMC", units="", description="Number of harmonics")
+        p.value = 30
+        self.add_param(p)
+
+    def device_slot(self, pname):
+        return pname, None
+
+    def pack(self, model, toas, prep, params0):
+        import jax.numpy as jnp
+
+        F, freqs, tspan_s = fourier_basis(toas, int(self.TNDMC.value or 30))
+        # chromatic scaling; infinite-frequency TOAs see no DM noise
+        with np.errstate(divide="ignore"):
+            chrom = np.where(np.isfinite(toas.freq_mhz),
+                             (self.F_REF_MHZ / toas.freq_mhz) ** 2, 0.0)
+        prep["dmrn_F"] = jnp.asarray(F * chrom[:, None])
+        prep["dmrn_freqs"] = jnp.asarray(freqs)
+        prep["dmrn_tspan_s"] = tspan_s
+        for pname in ("TNDMAMP", "TNDMGAM"):
+            params0[pname] = getattr(self, pname).value or 0.0
+
+    def basis_weight(self, params, prep):
+        A = 10.0 ** params["TNDMAMP"]
+        gamma = params["TNDMGAM"]
+        return prep["dmrn_F"], powerlaw_phi(
+            A, gamma, prep["dmrn_freqs"], prep["dmrn_tspan_s"])

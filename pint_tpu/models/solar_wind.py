@@ -17,6 +17,8 @@ device and differentiable in NE_SW and the pulsar position.
 
 from __future__ import annotations
 
+import numpy as np
+
 from ..constants import AU_LS, DMconst, ONE_AU_PC
 from .parameter import floatParameter
 from .timing_model import DelayComponent
@@ -72,3 +74,65 @@ class SolarWindDispersion(DelayComponent):
         dm = self.solar_wind_dm(params, batch, prep)
         f2 = jnp.square(batch.freq_mhz)
         return jnp.where(jnp.isfinite(f2), DMconst * dm / f2, 0.0)
+
+
+class SolarWindDispersionX(SolarWindDispersion):
+    """Piecewise solar wind (reference: solar_wind_dispersion.py::
+    SolarWindDispersionX *(version-dependent)*): per-window electron
+    densities SWXDM_#### active in [SWXR1_####, SWXR2_####] MJD,
+    replacing the single NE_SW over those spans. Windows use the same
+    spherical r^-2 geometry; outside all windows NE_SW applies.
+    """
+
+    category = "solar_windx"
+
+    def __init__(self):
+        super().__init__()
+        self.swx_ids: list[int] = []
+
+    def add_swx_range(self, index, mjd_lo, mjd_hi, ne=0.0):
+        from .parameter import MJDParameter, prefixParameter
+
+        p = prefixParameter(f"SWXDM_{index:04d}", "SWXDM_", index,
+                            units="cm^-3")
+        p.value = ne
+        self.add_param(p)
+        r1 = MJDParameter(f"SWXR1_{index:04d}", units="MJD")
+        r1.value = mjd_lo
+        self.add_param(r1)
+        r2 = MJDParameter(f"SWXR2_{index:04d}", units="MJD")
+        r2.value = mjd_hi
+        self.add_param(r2)
+        self.swx_ids.append(index)
+
+    def device_slot(self, pname):
+        if pname.startswith("SWXDM_"):
+            return "SWXDM", self.swx_ids.index(int(pname[6:]))
+        return super().device_slot(pname)
+
+    def pack(self, model, toas, prep, params0):
+        import jax.numpy as jnp
+
+        super().pack(model, toas, prep, params0)
+        params0["SWXDM"] = np.array(
+            [getattr(self, f"SWXDM_{i:04d}").value or 0.0
+             for i in self.swx_ids], dtype=np.float64)
+        mjd = toas.get_mjds()
+        masks = np.stack([
+            ((mjd >= getattr(self, f"SWXR1_{i:04d}").value)
+             & (mjd < getattr(self, f"SWXR2_{i:04d}").value)).astype(np.float64)
+            for i in self.swx_ids]) if self.swx_ids else np.zeros((0, len(toas)))
+        prep["swx_masks"] = jnp.asarray(masks)
+
+    def delay(self, params, batch, prep, delay_accum):
+        import jax.numpy as jnp
+
+        dm_geom = self.solar_wind_dm(
+            {**params, "NE_SW": 1.0}, batch, prep)  # geometry for unit density
+        masks = prep["swx_masks"]
+        in_any = jnp.clip(jnp.sum(masks, axis=0), 0.0, 1.0)
+        ne = (params["SWXDM"] @ masks if masks.shape[0]
+              else jnp.zeros_like(dm_geom))
+        ne = ne + params["NE_SW"] * (1.0 - in_any)
+        f2 = jnp.square(batch.freq_mhz)
+        return jnp.where(jnp.isfinite(f2), DMconst * ne * dm_geom / f2, 0.0)

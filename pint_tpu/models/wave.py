@@ -128,3 +128,66 @@ class WaveX(DelayComponent):
         arg = 2.0 * jnp.pi * params["WXFREQ"] * t[:, None]
         return jnp.sum(params["WXSIN"] * jnp.sin(arg)
                        + params["WXCOS"] * jnp.cos(arg), axis=-1)
+
+
+class DMWaveX(DelayComponent):
+    """WaveX in DM space (reference: dmwavex.py::DMWaveX): explicit
+    frequencies DMWXFREQ_#### with DMWXSIN/DMWXCOS amplitudes in
+    pc cm^-3; delay = DMconst * DM_wave / nu^2."""
+
+    category = "dmwavex"
+    order = 37
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(MJDParameter("DMWXEPOCH", units="MJD",
+                                    description="Reference epoch of DMWaveX terms"))
+        self.wx_ids: list[int] = []
+
+    def add_dmwavex(self, index=None, freq_per_day=None):
+        index = index if index is not None else len(self.wx_ids) + 1
+        f = prefixParameter(f"DMWXFREQ_{index:04d}", "DMWXFREQ_", index,
+                            units="1/d")
+        f.value = freq_per_day if freq_per_day is not None else 0.0
+        self.add_param(f)
+        for stem in ("DMWXSIN", "DMWXCOS"):
+            p = prefixParameter(f"{stem}_{index:04d}", f"{stem}_", index,
+                                units="pc/cm^3")
+            p.value = 0.0
+            self.add_param(p)
+        self.wx_ids.append(index)
+        return index
+
+    def device_slot(self, pname):
+        stem, idx = pname.rsplit("_", 1)
+        if stem in ("DMWXSIN", "DMWXCOS", "DMWXFREQ"):
+            return stem, self.wx_ids.index(int(idx))
+        raise KeyError(pname)
+
+    def pack(self, model, toas, prep, params0):
+        import jax.numpy as jnp
+
+        for stem in ("DMWXFREQ", "DMWXSIN", "DMWXCOS"):
+            params0[stem] = np.array(
+                [getattr(self, f"{stem}_{i:04d}").value or 0.0
+                 for i in self.wx_ids], dtype=np.float64)
+        we = self.DMWXEPOCH
+        if we is not None and we.day is not None:
+            day, sec = we.day, we.sec
+        else:
+            day, sec = prep["pepoch_day"], prep["pepoch_sec"]
+        dt_day = ((toas.tdb.day - day).astype(np.float64)
+                  + (toas.tdb.sec - sec) / SECS_PER_DAY)
+        prep["dmwavex_dt_day"] = jnp.asarray(dt_day)
+
+    def delay(self, params, batch, prep, delay_accum):
+        import jax.numpy as jnp
+
+        from ..constants import DMconst
+
+        t = prep["dmwavex_dt_day"]
+        arg = 2.0 * jnp.pi * params["DMWXFREQ"] * t[:, None]
+        dm = jnp.sum(params["DMWXSIN"] * jnp.sin(arg)
+                     + params["DMWXCOS"] * jnp.cos(arg), axis=-1)
+        f2 = jnp.square(batch.freq_mhz)
+        return jnp.where(jnp.isfinite(f2), DMconst * dm / f2, 0.0)

@@ -43,11 +43,17 @@ def _pm_jacobian(fwd, a, b, pma, pmb, eps):
     a2, b2 = fwd(a, b, eps)
     da_da, db_da = fwd(a + h / np.cos(b), b, eps)
     da_db, db_db = fwd(a, b + h, eps)
+
+    def wrap(d):
+        # difference of two angles that individually wrap at 2 pi: a
+        # perturbation across the seam would otherwise read as ~2 pi
+        return (d + np.pi) % (2 * np.pi) - np.pi
+
     # columns: unit steps along (a*cos b, b); rows: response in
     # (a2*cos b2, b2)
     J = np.array([
-        [(da_da - a2) * np.cos(b2) / h, (da_db - a2) * np.cos(b2) / h],
-        [(db_da - b2) / h, (db_db - b2) / h],
+        [wrap(da_da - a2) * np.cos(b2) / h, wrap(da_db - a2) * np.cos(b2) / h],
+        [wrap(db_da - b2) / h, wrap(db_db - b2) / h],
     ])
     pm = J @ np.array([pma, pmb])
     return pm[0], pm[1], J

@@ -174,11 +174,19 @@ class PTABatch:
     """
 
     def __init__(self, models, toas_list, mesh=None):
+        from ..models.timing_model import _cpu_staging, device_put_staged
+
         self.models = models
         self.toas_list = toas_list
-        self.preps = [m.prepare(t) for m, t in zip(models, toas_list)]
-        (self.params, self.prep, self.batch, self.static,
-         self.n_toas) = stack_prepared(self.preps)
+        # stage per-pulsar packing + stacking on the CPU backend, then
+        # one batched transfer of the stacked trees (behind a tunnel,
+        # per-array transfers dominate the pack otherwise)
+        with _cpu_staging():
+            self.preps = [m.prepare(t) for m, t in zip(models, toas_list)]
+            (self.params, self.prep, self.batch, self.static,
+             self.n_toas) = stack_prepared(self.preps)
+        self.params, self.prep, self.batch = device_put_staged(
+            (self.params, self.prep, self.batch))
         self.template = models[0]
         self.mesh = mesh
         if mesh is not None:

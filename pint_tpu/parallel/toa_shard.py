@@ -58,6 +58,19 @@ def sharded_residuals(template_model, static, mesh: Mesh, params, batch, prep,
 
     batch_specs = jax.tree_util.tree_map(data_spec, batch)
     prep_specs = jax.tree_util.tree_map(data_spec, prep)
+    # inputs may be committed to a single device by the staged batched
+    # transfer (PreparedTiming); re-place them onto the mesh sharding
+    # so shard_map accepts them
+    from jax.sharding import NamedSharding
+
+    def place(tree, specs):
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s))
+            if isinstance(x, jax.Array) else x, tree, specs)
+
+    params = place(params, jax.tree_util.tree_map(lambda _: P(), params))
+    batch = place(batch, batch_specs)
+    prep = place(prep, prep_specs)
     fn = jax.shard_map(
         local, mesh=mesh,
         in_specs=(jax.tree_util.tree_map(lambda _: P(), params),
@@ -68,8 +81,13 @@ def sharded_residuals(template_model, static, mesh: Mesh, params, batch, prep,
 
 def sharded_chi2(template_model, static, mesh, params, batch, prep, axis="toa"):
     """Whitened chi2 with TOA-sharded reduction (psum)."""
+    import numpy as np
+
     r = sharded_residuals(template_model, static, mesh, params, batch, prep, axis)
     from .pta import pure_sigma_fn
 
-    sig = pure_sigma_fn(template_model, static)(params, batch, prep) * 1e-6
-    return jnp.sum(jnp.square(r / sig))
+    # sigma is evaluated on the original (single-device) inputs; pull
+    # both to host for the scalar reduction rather than mixing array
+    # placements inside one jitted expression
+    sig = np.asarray(pure_sigma_fn(template_model, static)(params, batch, prep)) * 1e-6
+    return float(np.sum(np.square(np.asarray(r) / sig)))

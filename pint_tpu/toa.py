@@ -426,18 +426,117 @@ def read_tim_file(path: str, _depth=0) -> tuple[list[TOA], list[str]]:
     return toas, commands
 
 
+def _pickle_settings_key(ephem, planets, include_gps, include_bipm,
+                         bipm_version):
+    from . import __version__
+    from .utils import compute_hash
+
+    # package version in the key: format changes bust stale caches
+    return compute_hash(repr((ephem, planets, include_gps, include_bipm,
+                              bipm_version, __version__)))
+
+
+def _tim_content_hash(path) -> str:
+    """Hash a tim file AND every file it INCLUDEs (recursively), so
+    editing an included epoch file busts the cache too."""
+    from .utils import compute_hash
+
+    chunks = []
+
+    def visit(p, depth=0):
+        if depth > 10:
+            return
+        with open(p, "rb") as f:
+            data = f.read()
+        chunks.append(data)
+        for raw in data.decode("utf-8", errors="replace").splitlines():
+            parts = raw.split()
+            if parts and parts[0].upper() == "INCLUDE" and len(parts) > 1:
+                inc = parts[1]
+                if not os.path.isabs(inc):
+                    inc = os.path.join(os.path.dirname(str(p)), inc)
+                if os.path.exists(inc):
+                    visit(inc, depth + 1)
+
+    visit(str(path))
+    return compute_hash(*chunks)
+
+
+def save_pickle(toas: TOAs, picklefile=None):
+    """Cache fully-prepared TOAs (reference: toa.py::save_pickle —
+    keyed on tim-file contents + load settings for invalidation).
+
+    For TOAs without a source file an explicit ``picklefile`` is
+    required and the cache is stored unvalidated (content_hash None)."""
+    import pickle
+
+    if picklefile is None:
+        if toas.filename is None:
+            raise ValueError("no picklefile given and TOAs has no filename")
+        picklefile = str(toas.filename) + ".pickle.gz"
+    content_hash = (_tim_content_hash(toas.filename)
+                    if toas.filename is not None else None)
+    key = _pickle_settings_key(toas.ephem, toas.planets, toas.include_gps,
+                               toas.include_bipm, toas.bipm_version)
+    import gzip
+
+    with gzip.open(picklefile, "wb") as f:
+        pickle.dump({"content_hash": content_hash, "settings": key,
+                     "toas": toas}, f)
+    return picklefile
+
+
+def load_pickle(timfile, picklefile=None, ephem="de440s", planets=False,
+                include_gps=True, include_bipm=True,
+                bipm_version="BIPM2019") -> TOAs | None:
+    """Load cached TOAs if fresh, else None (reference: toa.py::load_pickle)."""
+    import gzip
+    import pickle
+
+    if picklefile is None:
+        if timfile is None:
+            raise ValueError("need timfile or picklefile")
+        picklefile = str(timfile) + ".pickle.gz"
+    if not os.path.exists(picklefile):
+        return None
+    try:
+        with gzip.open(picklefile, "rb") as f:
+            blob = pickle.load(f)
+        key = _pickle_settings_key(ephem, planets, include_gps, include_bipm,
+                                   bipm_version)
+        if blob["settings"] != key:
+            return None
+        if timfile is not None:
+            if blob["content_hash"] != _tim_content_hash(timfile):
+                return None  # stale: tim (or INCLUDEd) contents changed
+        elif blob["content_hash"] is not None:
+            return None
+        return blob["toas"]
+    except (OSError, pickle.UnpicklingError, KeyError, EOFError):
+        return None
+
+
 def get_TOAs(timfile, ephem="de440s", planets=False, model=None,
              include_gps=True, include_bipm=True, bipm_version="BIPM2019",
-             limits="warn") -> TOAs:
+             limits="warn", usepickle=False) -> TOAs:
     """Load + fully prepare TOAs (reference: toa.py::get_TOAs).
 
     When ``model`` is given, EPHEM/PLANET_SHAPIRO/CLOCK settings are
-    taken from it, mirroring get_model_and_toas behavior.
+    taken from it, mirroring get_model_and_toas behavior. With
+    ``usepickle=True`` a content-hash-validated cache next to the tim
+    file skips the clock/TDB/posvel pipeline on reload.
     """
     if model is not None:
         ephem = getattr(model, "EPHEM", None) and model.EPHEM.value or ephem
         if getattr(model, "PLANET_SHAPIRO", None) is not None and model.PLANET_SHAPIRO.value:
             planets = True
+    if usepickle:
+        cached = load_pickle(timfile, ephem=ephem, planets=planets,
+                             include_gps=include_gps,
+                             include_bipm=include_bipm,
+                             bipm_version=bipm_version)
+        if cached is not None:
+            return cached
     toalist, commands = read_tim_file(str(timfile))
     t = TOAs(toalist, ephem=ephem, planets=planets, include_gps=include_gps,
              include_bipm=include_bipm, bipm_version=bipm_version)
@@ -446,6 +545,8 @@ def get_TOAs(timfile, ephem="de440s", planets=False, model=None,
     t.apply_clock_corrections(limits=limits)
     t.compute_TDBs()
     t.compute_posvels()
+    if usepickle:
+        save_pickle(t)
     return t
 
 

@@ -154,3 +154,57 @@ def test_config_accessors():
     assert config.runtimefile("observatories.json").endswith("observatories.json")
     with pytest.raises(FileNotFoundError):
         config.examplefile("nope.par")
+
+
+def test_toa_pickle_cache(tmp_path):
+    from pint_tpu.scripts import zima
+    from pint_tpu.toa import get_TOAs, load_pickle
+
+    par = tmp_path / "pk.par"
+    par.write_text(BASE)
+    tim = str(tmp_path / "pk.tim")
+    zima.main([str(par), tim, "--ntoa", "15", "--startMJD", "55000",
+               "--duration", "100"])
+    t1 = get_TOAs(tim, usepickle=True)
+    import os
+    assert os.path.exists(tim + ".pickle.gz")
+    t2 = get_TOAs(tim, usepickle=True)  # served from cache
+    np.testing.assert_array_equal(t1.day, t2.day)
+    np.testing.assert_allclose(np.asarray(t1.ssb_obs.pos),
+                               np.asarray(t2.ssb_obs.pos))
+    # different settings -> cache miss
+    assert load_pickle(tim, planets=True) is None
+    # editing the tim busts the cache
+    with open(tim, "a") as f:
+        f.write("# touched\n")
+    assert load_pickle(tim) is None
+
+
+def test_fit_checkpointing(tmp_path):
+    import copy
+
+    from pint_tpu.checkpoint import FitCheckpointer, checkpointed_fit
+    from pint_tpu.fitter import WLSFitter
+
+    ck = FitCheckpointer(tmp_path / "ck")
+    ck.save("t", {"x": np.arange(3.0), "iter": 4, "chi2": 12.5})
+    state = ck.restore("t")
+    np.testing.assert_allclose(state["x"], [0, 1, 2])
+    assert ck.latest_iteration("t") == 4
+    assert ck.restore("missing") is None
+    # end-to-end resume: fit, checkpoint, perturb, restore-by-rerun
+    m = get_model(BASE)
+    t = make_fake_toas_fromMJDs(np.linspace(55000, 55400, 40), m,
+                                error_us=1.0, freq_mhz=1400.0, obs="gbt",
+                                add_noise=True, seed=4)
+    m2 = copy.deepcopy(m)
+    m2.F0.value += 1e-9
+    f = WLSFitter(t, m2)
+    chi2 = checkpointed_fit(f, tmp_path / "fit_ck", maxiter=3)
+    assert np.isfinite(chi2)
+    # a fresh fitter resumes from the snapshot
+    m3 = copy.deepcopy(m)
+    m3.F0.value += 5e-9
+    f2 = WLSFitter(t, m3)
+    chi2b = checkpointed_fit(f2, tmp_path / "fit_ck", maxiter=4)
+    assert abs(f2.model.F0.value - f.model.F0.value) < 1e-11
