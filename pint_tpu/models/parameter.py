@@ -342,3 +342,39 @@ class maskParameter(floatParameter):
         if self.uncertainty is not None:
             line += f" {self._format_unc()}"
         return line + "\n"
+
+
+class funcParameter(floatParameter):
+    """Read-only derived parameter computed from other parameters
+    (reference: parameter.py::funcParameter *(version-dependent)* —
+    e.g. total mass from PB/A1/SINI/M2). Not fittable; ``value``
+    evaluates the function on each access."""
+
+    kind = "func"
+
+    def __init__(self, name, func, params, units="", description=""):
+        super().__init__(name, units=units, description=description,
+                         frozen=True)
+        self._func = func
+        self._src_params = tuple(params)
+
+    @property
+    def value(self):
+        if self._component is None or self._component._parent is None:
+            return None
+        model = self._component._parent
+        args = []
+        for p in self._src_params:
+            par = getattr(model, p, None)
+            if par is None or par.value is None:
+                return None
+            args.append(par.value)
+        return self._func(*args)
+
+    @value.setter
+    def value(self, v):
+        if v is not None:
+            raise AttributeError(f"{self.name} is a derived parameter")
+
+    def as_parfile_line(self):
+        return ""  # derived values never round-trip into par files

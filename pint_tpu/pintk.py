@@ -1,0 +1,125 @@
+"""Headless interactive-fitting state — the logic layer of the
+reference's pintk GUI, without Tk.
+
+(reference: src/pint/pintk/pulsar.py::Pulsar — the GUI-independent
+wrapper that pintk's plk widget drives: fit/undo/reset, TOA
+selection, per-selection jump add/remove, random-model spread. The Tk
+widgets themselves are out of TPU scope (SURVEY.md section 2.3: GUI
+exempted -> CLI parity); this class IS the tested surface, drivable
+from scripts, notebooks, or any future frontend.)
+"""
+
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+
+from .fitter import auto_fitter
+from .residuals import Residuals
+from .simulation import calculate_random_models
+
+
+class InteractivePulsar:
+    """Mutable fit session over (model, TOAs) with undo history.
+
+    (reference: pintk/pulsar.py::Pulsar)
+    """
+
+    def __init__(self, model, toas, fitter_factory=auto_fitter):
+        self.toas = toas
+        self.fitter_factory = fitter_factory
+        self._history = [copy.deepcopy(model)]
+        self.selected = np.zeros(len(toas), dtype=bool)
+        self.fitted = False
+        self.last_fit = None
+
+    @property
+    def model(self):
+        return self._history[-1]
+
+    @property
+    def prefit_model(self):
+        return self._history[0]
+
+    # -- residuals --
+
+    def resids_us(self, model=None) -> np.ndarray:
+        r = Residuals(self.toas, model or self.model)
+        return np.asarray(r.calc_time_resids()) * 1e6
+
+    # -- selection (reference: plk click/drag selection) --
+
+    def select(self, mask):
+        self.selected = np.asarray(mask, dtype=bool).copy()
+
+    def select_mjd_range(self, lo, hi):
+        mjd = self.toas.get_mjds()
+        self.selected = (mjd >= lo) & (mjd <= hi)
+
+    def clear_selection(self):
+        self.selected[:] = False
+
+    # -- fitting with history (reference: Pulsar.fit / undo / reset) --
+
+    def fit(self, **kw):
+        model = copy.deepcopy(self.model)
+        fitter = self.fitter_factory(self.toas, model)
+        fitter.fit_toas(**kw)
+        self._history.append(fitter.model)
+        self.fitted = True
+        self.last_fit = fitter
+        return fitter
+
+    def undo(self):
+        if len(self._history) > 1:
+            self._history.pop()
+        self.fitted = len(self._history) > 1
+        return self.model
+
+    def reset(self):
+        del self._history[1:]
+        self.fitted = False
+        self.last_fit = None
+
+    # -- jumps on the current selection (reference: Pulsar.add_jump) --
+
+    def add_jump_to_selection(self):
+        """JUMP the selected TOAs via a per-TOA flag mask; returns the
+        new jump parameter name."""
+        if not self.selected.any():
+            raise ValueError("no TOAs selected")
+        model = self.model
+        if "PhaseJump" not in model.components:
+            from .models.jump import PhaseJump
+
+            model.add_component(PhaseJump())
+        comp = model.components["PhaseJump"]
+        idx = (max(comp.jump_ids) + 1) if comp.jump_ids else 1
+        flag_val = f"pintk_{idx}"
+        for i in np.flatnonzero(self.selected):
+            self.toas.flags[i]["jump"] = flag_val
+        par = comp.add_jump(key="-jump", key_value=[flag_val], index=idx)
+        return par.name
+
+    def remove_jump(self, name):
+        comp = self.model.components.get("PhaseJump")
+        if comp is None or name not in comp.params:
+            raise KeyError(name)
+        idx = int(name[4:])
+        par = getattr(comp, name)
+        if par.key == "-jump":
+            tag = par.key_value[0]
+            for f in self.toas.flags:
+                if f.get("jump") == tag:
+                    del f["jump"]
+        comp.remove_param(name)
+        comp.jump_ids.remove(idx)
+
+    # -- random-model spread (reference: Pulsar.random_models) --
+
+    def random_models(self, n_models=30, seed=0):
+        if self.last_fit is None:
+            raise RuntimeError("fit first")
+        return calculate_random_models(self.last_fit, self.toas,
+                                       n_models=n_models, seed=seed)

@@ -157,3 +157,31 @@ class WidebandTOAResiduals:
     @property
     def dof(self):
         return self.toa.dof + int(self.dm.valid.sum())
+
+
+class CombinedResiduals:
+    """Concatenation of independent residual objects
+    (reference: residuals.py::CombinedResiduals — used by the
+    composite MCMC fitters to sum chi2/dof over datasets)."""
+
+    def __init__(self, residual_list):
+        self.residual_list = list(residual_list)
+
+    @property
+    def chi2(self):
+        return float(sum(r.chi2 for r in self.residual_list))
+
+    @property
+    def dof(self):
+        return int(sum(r.dof for r in self.residual_list))
+
+    @property
+    def reduced_chi2(self):
+        d = self.dof
+        return self.chi2 / d if d else float("nan")
+
+    def calc_time_resids(self):
+        import numpy as np
+
+        return np.concatenate([np.asarray(r.calc_time_resids())
+                               for r in self.residual_list])

@@ -1,0 +1,101 @@
+"""Headless interactive-fitting tests (reference pattern: pintk logic
+tested without Tk via pintk/pulsar.py)."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+warnings.simplefilter("ignore")
+
+from pint_tpu.models import get_model
+from pint_tpu.pintk import InteractivePulsar
+from pint_tpu.residuals import CombinedResiduals, Residuals
+from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+PAR = """
+PSR TESTK
+RAJ 08:15:00.0
+DECJ 02:30:00.0
+F0 88.5 1
+F1 -2e-16 1
+PEPOCH 55200
+DM 11.0 1
+"""
+
+
+@pytest.fixture(scope="module")
+def session():
+    m = get_model(PAR)
+    t = make_fake_toas_fromMJDs(np.linspace(55000, 55400, 50), m,
+                                error_us=1.0, freq_mhz=1400.0, obs="gbt",
+                                add_noise=True, seed=8)
+    m2 = get_model(PAR)
+    m2.F0.value += 2e-9
+    return InteractivePulsar(m2, t)
+
+
+def test_fit_undo_reset(session):
+    r_pre = session.resids_us()
+    f = session.fit()
+    assert f.resids.reduced_chi2 < 2.0
+    assert session.fitted
+    r_post = session.resids_us()
+    assert np.abs(r_post).std() < np.abs(r_pre).std()
+    session.undo()
+    np.testing.assert_allclose(session.resids_us(), r_pre)
+    session.fit()
+    session.reset()
+    assert not session.fitted
+    np.testing.assert_allclose(session.resids_us(), r_pre)
+
+
+def test_selection_and_jump(session):
+    session.reset()
+    session.select_mjd_range(55200, 55400)
+    n_sel = int(session.selected.sum())
+    assert 0 < n_sel < 50
+    name = session.add_jump_to_selection()
+    assert name in session.model.params
+    # jump shifts only the selected TOAs
+    getattr(session.model, name).value = 1e-4
+    r = session.resids_us()
+    session.remove_jump(name)
+    r0 = session.resids_us()
+    moved = np.abs(r - r0) > 1.0
+    assert moved.sum() == n_sel or moved.sum() == 50 - n_sel  # mean-subtracted
+    assert name not in session.model.params
+    with pytest.raises(KeyError):
+        session.remove_jump("JUMP99")
+
+
+def test_random_models(session):
+    session.reset()
+    session.fit()
+    spread = session.random_models(n_models=10, seed=1)
+    assert spread.shape == (10, 50)
+    assert np.isfinite(spread).all()
+
+
+def test_combined_residuals(session):
+    r1 = Residuals(session.toas, session.model)
+    c = CombinedResiduals([r1, r1])
+    assert c.chi2 == pytest.approx(2 * r1.chi2)
+    assert c.dof == 2 * r1.dof
+    assert len(c.calc_time_resids()) == 100
+
+
+def test_func_parameter():
+    from pint_tpu.derived_quantities import mass_function
+    from pint_tpu.models.parameter import funcParameter
+
+    par = PAR + "BINARY ELL1\nPB 1.2 1\nA1 2.0 1\nTASC 55201.0 1\nEPS1 0\nEPS2 0\n"
+    m = get_model(par)
+    comp = m.components["BinaryELL1"]
+    fp = funcParameter("FMASS", lambda pb, a1: float(mass_function(pb, a1)),
+                       ("PB", "A1"), units="Msun")
+    comp.add_param(fp)
+    assert fp.value == pytest.approx(float(mass_function(1.2, 2.0)))
+    with pytest.raises(AttributeError):
+        fp.value = 3.0
+    assert fp.as_parfile_line() == ""

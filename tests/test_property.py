@@ -1,0 +1,61 @@
+"""Property-based round-trip tests (hypothesis).
+
+(reference pattern: SURVEY.md section 4 item 9 — upstream uses
+hypothesis for format/time-conversion round-trips.)
+"""
+
+import warnings
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+warnings.simplefilter("ignore")
+
+from pint_tpu.mjd import Epochs, format_mjd, parse_mjd_string
+from pint_tpu import timescales as ts
+
+
+@settings(max_examples=200, deadline=None)
+@given(day=st.integers(41000, 69000),
+       sec=st.floats(0.0, 86399.999, allow_nan=False))
+def test_mjd_string_roundtrip(day, sec):
+    s = format_mjd(day, sec, ndigits=16)
+    d2, s2 = parse_mjd_string(s)
+    err_s = abs((d2 - day) * 86400.0 + (s2 - sec))
+    assert err_s < 1e-9  # < 1 ns through the string form
+
+
+@settings(max_examples=100, deadline=None)
+@given(day=st.integers(50000, 62000),
+       sec=st.floats(0.0, 86399.0, allow_nan=False))
+def test_utc_tai_roundtrip(day, sec):
+    e = Epochs(np.array([day]), np.array([sec]), "utc")
+    back = ts.tai_to_utc(ts.utc_to_tai(e))
+    err = abs((back.day[0] - day) * 86400.0 + (back.sec[0] - sec))
+    assert err < 1e-12
+
+
+@settings(max_examples=100, deadline=None)
+@given(day=st.integers(50000, 62000),
+       sec=st.floats(0.0, 86399.0, allow_nan=False))
+def test_tt_tdb_roundtrip(day, sec):
+    e = Epochs(np.array([day]), np.array([sec]), "tt")
+    back = ts.tdb_to_tt(ts.tt_to_tdb(e))
+    err = abs((back.day[0] - day) * 86400.0 + (back.sec[0] - sec))
+    assert err < 1e-10
+
+
+@settings(max_examples=50, deadline=None)
+@given(f0=st.floats(0.1, 1000.0, allow_nan=False),
+       dm=st.floats(0.0, 500.0, allow_nan=False),
+       f1=st.floats(-1e-12, 0.0, allow_nan=False))
+def test_parfile_roundtrip_values(f0, dm, f1):
+    from pint_tpu.models import get_model
+
+    par = (f"PSR PROP\nRAJ 06:00:00.0\nDECJ 10:00:00.0\nF0 {f0!r} 1\n"
+           f"F1 {f1!r} 1\nPEPOCH 55000\nDM {dm!r} 1\n")
+    m = get_model(par)
+    m2 = get_model(m.as_parfile())
+    assert m2.F0.value == m.F0.value
+    assert m2.F1.value == m.F1.value
+    assert m2.DM.value == m.DM.value
