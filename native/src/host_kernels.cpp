@@ -138,7 +138,9 @@ Mat3 nutation_matrix(double T, double dpsi, double deps) {
 inline double era(std::int64_t ut1_day, double ut1_sec) {
   const double du =
       (static_cast<double>(ut1_day - 51544) - 0.5) + ut1_sec / SECS_PER_DAY;
-  const double frac = ut1_sec / SECS_PER_DAY;
+  // Tu mod 1 carrier: MJD-split epoch has JD fraction 0.5 + sec/day; the
+  // +0.5 is required or ERA comes out wrong by exactly pi.
+  const double frac = ut1_sec / SECS_PER_DAY + 0.5;
   const double theta =
       TWO_PI * (0.7790572732640 + 0.00273781191135448 * du + frac);
   return std::fmod(theta, TWO_PI);

@@ -22,7 +22,7 @@ _jax.config.update("jax_enable_x64", True)
 
 from .constants import DMconst, C_M_S, AU_LS, SECS_PER_DAY, TSUN_S  # noqa: E402,F401
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 
 def _lazy(name):

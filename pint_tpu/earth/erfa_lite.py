@@ -75,7 +75,10 @@ def era(ut1: Epochs) -> np.ndarray:
     """Earth Rotation Angle [rad] (reference: erfa era00)."""
     # Tu = JD(UT1) - 2451545.0 ; MJD 51544.5 == J2000.0
     du = (ut1.day - 51544).astype(np.float64) - 0.5 + ut1.sec / SECS_PER_DAY
-    frac = ut1.sec / SECS_PER_DAY  # day fraction carrier for precision
+    # Fractional-cycle carrier: Tu mod 1. Tu = (int days) - 0.5 + sec/day,
+    # so the +0.5 is required (erfa era00 uses fmod(jd1,1)+fmod(jd2,1) = 0.5
+    # + sec/day for MJD-split epochs); omitting it puts ERA off by exactly pi.
+    frac = ut1.sec / SECS_PER_DAY + 0.5
     theta = TWO_PI * (0.7790572732640 + 0.00273781191135448 * du + frac)
     return np.mod(theta, TWO_PI)
 

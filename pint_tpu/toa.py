@@ -431,9 +431,15 @@ def _pickle_settings_key(ephem, planets, include_gps, include_bipm,
     from . import __version__
     from .utils import compute_hash
 
-    # package version in the key: format changes bust stale caches
+    # package version + physics revision in the key: cached pickles carry
+    # computed posvels, so any change to the earth-rotation/ephemeris chain
+    # must bust stale caches (e.g. the 0.2.0 ERA half-day fix).
     return compute_hash(repr((ephem, planets, include_gps, include_bipm,
-                              bipm_version, __version__)))
+                              bipm_version, __version__, _PHYSICS_REV)))
+
+
+# Bump whenever the posvel/clock/TDB pipeline changes numerically.
+_PHYSICS_REV = 2
 
 
 def _tim_content_hash(path) -> str:

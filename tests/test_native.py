@@ -86,6 +86,31 @@ def test_cheby_posvel_equivalence(lib):
                                    rtol=1e-12)
 
 
+def test_era_gast_absolute_anchors():
+    """Pin ERA/GMST to published absolute values (catches the classic
+    missing-half-day JD-fraction error, which shifts both by exactly pi).
+
+    Anchors: ERA at J2000.0 (UT1 JD 2451545.0) = 2*pi*0.7790572732640
+    (IERS Conventions); GMST at 2000-01-01 00:00 UT1 = 6h 39m 52.2626s
+    (Astronomical Almanac 2000).
+    """
+    from pint_tpu.earth.erfa_lite import era, gast
+
+    # J2000.0 noon: MJD 51544.5 -> day 51544, sec 43200
+    ut1 = Epochs(np.array([51544]), np.array([43200.0]), "ut1")
+    got = float(era(ut1)[0])
+    expected = 2 * np.pi * 0.7790572732640
+    assert abs(got - expected) < 1e-9, (got, expected)
+
+    # 2000-01-01 00:00 UT1: GMST = 6.664520 h = 99.9678 deg
+    ut1b = Epochs(np.array([51544]), np.array([0.0]), "ut1")
+    T = ((51544 - 51544) - 0.5 + 0.0 / 86400.0) / 36525.0
+    theta = float(gast(ut1b, np.array([T]))[0])
+    gmst_deg = np.rad2deg(theta)
+    # gast includes the equation of the equinoxes (~ -0.004 deg in 2000)
+    assert abs(gmst_deg - 99.9678) < 0.02, gmst_deg
+
+
 def test_loader_disable_env(monkeypatch):
     monkeypatch.setenv("PINT_TPU_NO_NATIVE", "1")
     monkeypatch.setattr(native, "_LIB", None)

@@ -57,13 +57,25 @@ def test_selection_and_jump(session):
     assert 0 < n_sel < 50
     name = session.add_jump_to_selection()
     assert name in session.model.params
-    # jump shifts only the selected TOAs
-    getattr(session.model, name).value = 1e-4
+    # A JUMP is a uniform time offset on the selected set. Weighted-mean
+    # subtraction redistributes it across ALL residuals (selected shift by
+    # JUMP*(1-w), unselected by -JUMP*w with w the selected weight
+    # fraction), so "only the selected move" is not the invariant; the
+    # *relative* shift between the two sets is exactly the JUMP value.
+    jump_s = 1e-4
+    getattr(session.model, name).value = jump_s
     r = session.resids_us()
     session.remove_jump(name)
     r0 = session.resids_us()
-    moved = np.abs(r - r0) > 1.0
-    assert moved.sum() == n_sel or moved.sum() == 50 - n_sel  # mean-subtracted
+    delta = r - r0
+    sel = session.selected
+    shift_sel = delta[sel].mean()
+    shift_unsel = delta[~sel].mean()
+    # uniform within each group...
+    assert np.abs(delta[sel] - shift_sel).max() < 1e-3   # us
+    assert np.abs(delta[~sel] - shift_unsel).max() < 1e-3
+    # ...and separated by exactly the jump (sign per convention)
+    assert abs(abs(shift_sel - shift_unsel) - jump_s * 1e6) < 1e-2
     assert name not in session.model.params
     with pytest.raises(KeyError):
         session.remove_jump("JUMP99")
