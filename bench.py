@@ -1,17 +1,21 @@
-"""Benchmark: PTA-batch WLS refit throughput on the available chip.
+"""Benchmark: PTA-batch GLS (headline) + WLS refit throughput.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-Workload: 68 synthetic pulsars x N TOAs (default 1000; override with
-PINT_TPU_BENCH_TOAS), one vmapped 3-iteration WLS refit as a single
-jitted program — the BASELINE.json config-5 shape (NANOGrav-15yr-like
-refit; 68 pulsars, ~670k TOAs at full scale).
+Headline workload: 68 synthetic pulsars x N TOAs (default 1000;
+override with PINT_TPU_BENCH_TOAS) with EFAC/EQUAD/ECORR white noise
+and power-law red noise, one vmapped 2-iteration **GLS** refit as a
+single jitted program — the BASELINE.json north-star shape (NANOGrav
+15yr GLS refit; 68 pulsars, ~670k TOAs at full scale). A WLS refit of
+the same batch is also timed and reported in detail.
 
 vs_baseline: the reference publishes no benchmarks (BASELINE.md); the
-driver-set north star is "68 pulsars / 670k TOAs full refit < 60 s".
-We report vs_baseline = 60 s / projected-670k-refit-seconds (>1 beats
-the target), with the projection linear in TOA count.
+driver-set north star is "68 pulsars / 670k TOAs full GLS refit < 60 s".
+We report vs_baseline = 60 s / projected-670k-GLS-refit-seconds (>1
+beats the target), with the projection linear in TOA count. Compile
+time is reported separately (it amortizes: one compiled program serves
+any same-shape PTA batch; a cold end-to-end run is compile_s + refit).
 """
 
 import json
@@ -24,26 +28,56 @@ warnings.simplefilter("ignore")
 import numpy as np
 
 
-def build_batch(n_psr, n_toa, seed=0):
+def build_batch(n_psr, n_toa, noise=True, seed=0):
     from pint_tpu.models import get_model
     from pint_tpu.simulation import make_fake_toas_fromMJDs
 
     rng = np.random.default_rng(seed)
     models, toas_list = [], []
+    per_epoch = 4  # clustered TOAs so ECORR quantization has real epochs
+    n_epochs = max(1, n_toa // per_epoch)
     for i in range(n_psr):
         par = (f"PSR BEN{i}\nRAJ {i % 24}:{(7 * i) % 60:02d}:00.0\n"
                f"DECJ {(i * 3) % 60 - 30}:30:00.0\n"
                f"F0 {150 + 5 * (i % 40)}.318 1\nF1 -{2 + i % 7}e-16 1\n"
                f"PEPOCH 55500\nDM {8 + i}.21 1\n")
+        if noise:
+            par += ("EFAC -f L-wide 1.1\nEQUAD -f L-wide 0.4\n"
+                    "ECORR -f L-wide 0.8\n"
+                    "RNAMP 1e-14\nRNIDX -3.1\nTNREDC 30\n")
         m = get_model(par)
-        mjds = np.sort(rng.uniform(54000, 57000, n_toa))
-        freqs = np.where(np.arange(n_toa) % 2, 1400.0, 800.0)
+        if noise:
+            epoch_days = np.sort(rng.uniform(54000, 57000, n_epochs))
+            mjds = np.concatenate(
+                [d + np.arange(per_epoch) * 0.5 / 86400.0
+                 for d in epoch_days])[:n_toa]
+        else:
+            mjds = np.sort(rng.uniform(54000, 57000, n_toa))
+        freqs = np.where(np.arange(len(mjds)) % 2, 1400.0, 800.0)
         # iterations=0: throughput benchmark doesn't need zero residuals
         t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=freqs,
                                     obs="gbt", add_noise=False, iterations=0)
+        if noise:
+            for f in t.flags:
+                f["f"] = "L-wide"
         models.append(m)
         toas_list.append(t)
     return models, toas_list
+
+
+def _timed_refit(fit, arg):
+    import jax
+
+    t0 = time.time()
+    x, chi2, cov = fit(maxiter=arg)
+    jax.block_until_ready(chi2)
+    compile_s = time.time() - t0
+    runs = 3
+    t0 = time.time()
+    for _ in range(runs):
+        x, chi2, cov = fit(maxiter=arg)
+        jax.block_until_ready(chi2)
+    return compile_s, (time.time() - t0) / runs
 
 
 def main():
@@ -53,11 +87,12 @@ def main():
 
     n_psr = int(os.environ.get("PINT_TPU_BENCH_PULSARS", "68"))
     n_toa = int(os.environ.get("PINT_TPU_BENCH_TOAS", "1000"))
-    maxiter = 3
 
     t0 = time.time()
     models, toas_list = build_batch(n_psr, n_toa)
     host_prep_s = time.time() - t0
+    # actual counts (epoch clustering floors n_toa to a multiple of 4)
+    n_toa = len(toas_list[0])
 
     n_dev = len(jax.devices())
     mesh = make_mesh(min(n_dev, n_psr))
@@ -65,35 +100,30 @@ def main():
     pta = PTABatch(models, toas_list, mesh=mesh)
     pack_s = time.time() - t0
 
-    # compile + first run
-    t0 = time.time()
-    x, chi2, cov = pta.wls_fit(maxiter=maxiter)
-    jax.block_until_ready(chi2)
-    compile_s = time.time() - t0
-
-    # steady-state refit
-    runs = 3
-    t0 = time.time()
-    for _ in range(runs):
-        x, chi2, cov = pta.wls_fit(maxiter=maxiter)
-        jax.block_until_ready(chi2)
-    refit_s = (time.time() - t0) / runs
+    gls_compile_s, gls_refit_s = _timed_refit(pta.gls_fit, 2)
+    wls_compile_s, wls_refit_s = _timed_refit(pta.wls_fit, 3)
 
     total_toas = n_psr * n_toa
-    rate = total_toas / refit_s  # TOAs fit per second (3-iter refit)
-    projected_670k = refit_s * (670_000 / total_toas)
+    rate = total_toas / gls_refit_s  # TOAs GLS-refit per second
+    projected_670k = gls_refit_s * (670_000 / total_toas)
     vs_baseline = 60.0 / projected_670k
 
     meta = {
         "n_pulsars": n_psr, "n_toas_per_pulsar": n_toa,
-        "devices": n_dev, "maxiter": maxiter,
+        "devices": n_dev,
+        "noise": "EFAC+EQUAD+ECORR+PLRedNoise(30 harm)",
         "host_prep_s": round(host_prep_s, 2), "pack_s": round(pack_s, 2),
-        "compile_s": round(compile_s, 2), "refit_wall_s": round(refit_s, 4),
-        "projected_670k_refit_s": round(projected_670k, 2),
+        "gls_compile_s": round(gls_compile_s, 2),
+        "gls_refit_wall_s": round(gls_refit_s, 4),
+        "gls_cold_e2e_s": round(host_prep_s + pack_s + gls_compile_s, 2),
+        "projected_670k_gls_refit_s": round(projected_670k, 2),
+        "wls_compile_s": round(wls_compile_s, 2),
+        "wls_refit_wall_s": round(wls_refit_s, 4),
+        "wls_toas_per_sec": round(total_toas / wls_refit_s, 1),
         "platform": jax.devices()[0].platform,
     }
     print(json.dumps({
-        "metric": "pta_wls_refit_toas_per_sec",
+        "metric": "pta_gls_refit_toas_per_sec",
         "value": round(rate, 1),
         "unit": "TOA/s",
         "vs_baseline": round(vs_baseline, 3),

@@ -139,7 +139,97 @@ def convert_binary(model, output: str):
                 ut or 0.0, (uo_r / _TWO_PI) * pb)) or None
     else:
         # within-family conversion (DD->DDS/DDK/DDGR, ELL1->ELL1H, ...):
-        # shared params carry over; new params start unset
-        _apply(comp, vals)
+        # shared params carry over, and reparameterized Shapiro terms are
+        # DERIVED, not dropped (reference: binaryconvert.py computes
+        # SHAPMAX / orthometric H3-H4-STIGMA in-family):
+        #   DDS:   SHAPMAX = -ln(1 - SINI)
+        #   ELL1H: STIGMA = SINI/(1 + cos i), H3 = Tsun*M2*STIGMA^3
+        # and the inverses when leaving those parameterizations.
+        skip = ()
+        if output == "DDS":
+            skip = ("SINI",)
+        elif output == "ELL1H":
+            skip = ("M2", "SINI")
+        _apply(comp, vals, skip=skip)
+    # Shapiro reparameterizations apply across ALL branches (e.g.
+    # ELL1H -> DD derives M2/SINI; DD -> ELL1H derives H3/STIGMA)
+    _derive_shapiro_reparam(comp, vals, current, output)
     out.setup()
     return out
+
+
+_TSUN_S = 4.925490947e-6  # GM_sun/c^3 [s]
+
+
+def _shapiro_m2_sini(vals, current):
+    """(m2, sini, u_m2, u_sini) in the source model's own terms, or None."""
+    if current == "DDS":
+        sm, us, _ = vals.get("SHAPMAX", (None, None, True))
+        if sm is None:
+            return None
+        sini = 1.0 - np.exp(-sm)
+        u_sini = (np.exp(-sm) * us) if us else None
+        m2, um, _ = vals.get("M2", (None, None, True))
+        return m2, sini, um, u_sini
+    if current == "ELL1H":
+        h3, uh3, _ = vals.get("H3", (None, None, True))
+        if not h3:
+            return None
+        st, ust, _ = vals.get("STIGMA", (None, None, True))
+        if not st:  # unset OR placeholder 0.0: try the H4/H3 route
+            h4, uh4, _ = vals.get("H4", (None, None, True))
+            if not h4:
+                return None
+            st = h4 / h3
+            if not st:
+                return None
+            ust = (np.hypot(uh4 or 0.0, st * (uh3 or 0.0)) / h3
+                   if (uh4 or uh3) else None)
+        sini = 2 * st / (1 + st**2)
+        u_sini = (2 * (1 - st**2) / (1 + st**2) ** 2 * ust) if ust else None
+        m2 = h3 / (_TSUN_S * st**3)
+        um = (m2 * np.hypot((uh3 or 0.0) / h3, 3 * (ust or 0.0) / st)
+              if (uh3 or ust) else None)
+        return m2, sini, um, u_sini
+    m2, um, _ = vals.get("M2", (None, None, True))
+    sini, us, _ = vals.get("SINI", (None, None, True))
+    if sini is None:
+        return None
+    return m2, sini, um, us
+
+
+def _derive_shapiro_reparam(comp, vals, current, output):
+    ms = _shapiro_m2_sini(vals, current)
+    if ms is None:
+        return
+    m2, sini, um, usini = ms
+    shap_frozen = vals.get("SINI", vals.get("SHAPMAX",
+                           vals.get("H3", (None, None, True))))[2]
+    if output == "DDS":
+        if sini is not None and sini < 1.0:
+            comp.SHAPMAX.value = float(-np.log(1.0 - sini))
+            comp.SHAPMAX.uncertainty = (
+                float(usini / (1.0 - sini)) if usini else None)
+            comp.SHAPMAX.frozen = shap_frozen
+    elif output == "ELL1H":
+        if sini is not None and m2 is not None and 0 < sini < 1.0:
+            cosi = np.sqrt(1.0 - sini**2)
+            st = sini / (1.0 + cosi)
+            comp.STIGMA.value = float(st)
+            comp.H3.value = float(_TSUN_S * m2 * st**3)
+            comp.H3.frozen = comp.STIGMA.frozen = shap_frozen
+            dst_dsini = 1.0 / (cosi * (1.0 + cosi)) if cosi > 0 else 0.0
+            ust = (usini * dst_dsini) if usini else None
+            comp.STIGMA.uncertainty = float(ust) if ust else None
+            if um or ust:
+                comp.H3.uncertainty = float(_TSUN_S * st**3 * np.hypot(
+                    um or 0.0, 3 * m2 / st * (ust or 0.0)))
+    elif current in ("DDS", "ELL1H"):
+        # leaving a reparameterized model: write plain M2/SINI if present
+        if "SINI" in comp.params and sini is not None:
+            comp.SINI.value = float(sini)
+            comp.SINI.uncertainty = float(usini) if usini else None
+            comp.SINI.frozen = shap_frozen
+        if "M2" in comp.params and m2 is not None:
+            comp.M2.value = float(m2)
+            comp.M2.uncertainty = float(um) if um else None

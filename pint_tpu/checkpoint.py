@@ -67,18 +67,22 @@ class FitCheckpointer:
         return path
 
     def restore(self, tag) -> dict | None:
+        """Load a snapshot regardless of which backend WROTE it: save()
+        picked the format at write time, so an .npz written where orbax
+        was absent must still restore once orbax becomes importable
+        (and vice versa) instead of silently restarting the fit."""
         import json
 
         out = None
         if self._ocp is not None:
             path = os.path.abspath(self._path(tag))
-            if os.path.exists(path):
+            if os.path.isdir(path):
                 ckptr = self._ocp.PyTreeCheckpointer()
                 try:
                     out = dict(ckptr.restore(path))
                 except Exception:
-                    return None
-        else:
+                    out = None
+        if out is None:
             path = self._path(tag) + ".npz"
             if os.path.exists(path):
                 try:

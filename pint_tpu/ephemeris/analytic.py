@@ -5,21 +5,26 @@ The reference always evaluates a JPL DE kernel
 this build environment has no network and no bundled kernel, so this
 module provides a clearly-flagged analytic fallback:
 
-- planets + EMB: Keplerian osculating elements with secular rates
+- Earth: truncated VSOP87D series (ephemeris/vsop87.py) — the
+  precision-critical body gets the best offline-computable series;
+- planets: Keplerian osculating elements with secular rates
   (Standish "Approximate Positions of the Planets", valid 1800-2050,
-  heliocentric ecliptic-of-J2000);
-- Earth from EMB: truncated lunar theory (Meeus ch.47 main terms);
+  heliocentric ecliptic-of-J2000) — only consumed by planet-Shapiro
+  geometry, which tolerates arcminutes;
+- Moon/EMB: derived from the VSOP87 Earth + truncated lunar theory
+  (Meeus ch.47 main terms);
 - Sun wrt SSB: mass-weighted recoil from all planets.
 
-Documented accuracy: Earth SSB position good to a few hundred km
-(dominated by truncated planetary/lunar series) -> Roemer delays good
-to ~1 ms absolute... NO: a few hundred km is ~1 ms; in practice the
-dominant residual terms are periodic at the ~50-300 km level, i.e.
-~0.2-1 ms. This fallback is for *self-consistent* operation
-(simulate -> fit round-trips are exact) and smoke-scale absolute
-accuracy; for ns-level absolute work supply a real DE kernel
-(io/spk.py reads .bsp files directly). The active provider is recorded
-on every TOABatch so results are traceable.
+Measured accuracy (tests/test_precision_budget.py): Earth from the
+VSOP87 truncation is ~1 arcsec-in-longitude class, i.e. a few hundred
+km / ~1 ms Roemer worst-case. (The previous Keplerian-elements Earth
+measured 5-16 thousand km = 17-54 ms against VSOP87 over 2000-2026 —
+the docstring claim of 0.2-1 ms for it was wrong.) This fallback is
+for *self-consistent* operation (simulate -> fit round-trips are
+exact) plus sub-ms-scale absolute accuracy; for ns-level absolute work
+supply a real DE kernel (io/spk.py reads .bsp files directly). The
+active provider is recorded on every TOABatch so results are
+traceable.
 """
 
 from __future__ import annotations
@@ -125,7 +130,17 @@ def _ecl_to_icrs(v):
 
 
 def _all_positions_icrs(T):
-    """dict of ICRS positions [m] wrt SSB for sun/planets/earth/moon."""
+    """dict of ICRS positions [m] wrt SSB for sun/planets/earth/moon.
+
+    Earth comes from the truncated VSOP87D series (ephemeris/vsop87.py,
+    ~1 arcsec / few-hundred-km class), NOT the Keplerian elements: the
+    Standish EMB elements measure 5-16 thousand km (17-54 ms Roemer)
+    against VSOP87 over 2000-2026 — fine for planet Shapiro geometry,
+    fatal for the Earth Roemer term. EMB/Moon are derived from the
+    VSOP87 Earth + truncated lunar theory so the trio stays consistent.
+    """
+    from .vsop87 import earth_heliocentric_icrs_m
+
     helio = {b: _helio_ecliptic(b, T) * AU_M for b in _ELEMENTS}
     inv_mtot = 1.0 + sum(1.0 / im for im in _INV_MASS.values())
     sun_ssb = -sum(helio[b] / _INV_MASS[b] for b in _ELEMENTS) / inv_mtot
@@ -133,9 +148,10 @@ def _all_positions_icrs(T):
     for b in _ELEMENTS:
         out[b if b != "emb" else "emb"] = _ecl_to_icrs(sun_ssb + helio[b])
     moon_geo = _ecl_to_icrs(_moon_geocentric_ecliptic(T))
-    earth = out["emb"] - moon_geo / (1.0 + _EARTH_MOON_MASS_RATIO)
+    earth = out["sun"] + earth_heliocentric_icrs_m(T)
     out["earth"] = earth
     out["moon"] = earth + moon_geo
+    out["emb"] = earth + moon_geo / (1.0 + _EARTH_MOON_MASS_RATIO)
     # barycenter aliases used by Shapiro code
     out["jupiter_bary"] = out["jupiter"]
     out["saturn_bary"] = out["saturn"]

@@ -50,10 +50,27 @@ class Fitter:
             getattr(self.model, pname).set_fitted_value(float(val))
 
     def _set_uncertainties(self, prepared, cov):
+        from .pint_matrix import CovarianceMatrix
+
         sig = np.sqrt(np.diag(np.asarray(cov)))
+        names = []
         for (pname, _, _), s in zip(prepared.free_param_map(), sig):
             getattr(self.model, pname).uncertainty = float(s)
+            names.append(pname)
         self.parameter_covariance_matrix = np.asarray(cov)
+        units = [getattr(self.model, p).units or "" for p in names]
+        self.covariance_matrix = CovarianceMatrix(
+            self.parameter_covariance_matrix, names, units)
+        self.correlation_matrix = self.covariance_matrix.to_correlation()
+
+    def get_designmatrix(self):
+        """Labeled time-residual design matrix [s/param-unit]
+        (reference: pint_matrix.py::DesignMatrix from
+        TimingModel.designmatrix)."""
+        from .pint_matrix import DesignMatrix
+
+        return DesignMatrix.from_prepared(
+            self.model.prepare(self.toas), self.model)
 
     def print_summary(self):
         print(self.get_summary())
@@ -336,14 +353,41 @@ class DownhillGLSFitter(GLSFitter):
 class WidebandTOAFitter(GLSFitter):
     """Joint time+DM fit (reference: fitter.py::WidebandTOAFitter).
 
-    Residual vector [time_resids; dm_resids]; design matrix stacks the
-    phase derivatives with d(DM_model)/d(param) rows
-    (reference: pint_matrix.py::combine_design_matrices_by_quantity).
+    Residual vector [time_resids; dm_resids]; the design matrix is
+    assembled from labeled per-quantity DesignMatrix blocks via
+    combine_design_matrices_by_quantity
+    (reference: pint_matrix.py::combine_design_matrices_by_quantity),
+    so the time and DM blocks carry their own units and the column
+    union is explicit rather than hand-padded.
     """
 
-    def fit_toas(self, maxiter=2, threshold=1e-12):
+    def _dm_designmatrix(self, prepared, valid):
+        """Labeled d(DM_resid)/d(param) block [pc cm^-3 / param-unit]."""
         import jax
         import jax.numpy as jnp
+
+        from .pint_matrix import DesignMatrix
+
+        def dm_model(x):
+            p = prepared.params_with_vector(x)
+            comp = self.model.components["DispersionDM"]
+            dm = comp.dm_value(p, prepared.prep)
+            if "DMX" in p:
+                dm = dm + p["DMX"] @ prepared.prep["dmx_masks"]
+            return dm[jnp.asarray(np.flatnonzero(valid))]
+
+        x0 = prepared.vector_from_params()
+        M_dm = -jax.jacfwd(dm_model)(x0)  # resid = measured - model
+        names = [n for n, _, _ in prepared.free_param_map()]
+        units = [f"pc cm^-3/({getattr(self.model, n).units or '1'})"
+                 for n in names]
+        return DesignMatrix(M_dm, "dm", "pc cm^-3", names, units)
+
+    def fit_toas(self, maxiter=2, threshold=1e-12):
+        import jax.numpy as jnp
+
+        from .pint_matrix import (DesignMatrix,
+                                  combine_design_matrices_by_quantity)
 
         for _ in range(maxiter):
             prepared = self.model.prepare(self.toas)
@@ -353,30 +397,19 @@ class WidebandTOAFitter(GLSFitter):
             r_dm = jnp.asarray(wb.dm.calc_dm_resids()[valid])
             sigma_t = prepared.scaled_sigma_us() * 1e-6
             sigma_dm = jnp.asarray(wb.dm.dm_error[valid])
-            M_t, labels = prepared.designmatrix()
-            noff = _n_offset(labels)
-            f0 = prepared.params0["F"][0]
-            M_t = M_t / f0
 
-            # DM-part design matrix via jacfwd of the model DM prediction
-            def dm_model(x):
-                p = prepared.params_with_vector(x)
-                comp = self.model.components["DispersionDM"]
-                dm = comp.dm_value(p, prepared.prep)
-                if "DMX" in p:
-                    dm = dm + p["DMX"] @ prepared.prep["dmx_masks"]
-                return dm[jnp.asarray(np.flatnonzero(valid))]
-
-            x0 = prepared.vector_from_params()
-            M_dm = jax.jacfwd(dm_model)(x0)
-            M_dm = -jnp.concatenate(
-                [jnp.zeros((M_dm.shape[0], noff)), M_dm], axis=1)
-            M = jnp.concatenate([M_t, M_dm], axis=0)
+            dm_time = DesignMatrix.from_prepared(prepared, self.model)
+            dm_dm = self._dm_designmatrix(prepared, valid)
+            combined = combine_design_matrices_by_quantity([dm_time, dm_dm])
+            self.design_matrix = combined
+            noff = _n_offset(combined.param_names)
+            M = combined.matrix
             r = jnp.concatenate([r_t, r_dm])
             sigma = jnp.concatenate([sigma_t, sigma_dm])
             Mw = M / sigma[:, None]
             rw = r / sigma
             dx_all, covn, norm = wls_step(Mw, rw, threshold)
+            x0 = prepared.vector_from_params()
             self._sync_model_from_vector(prepared, x0 - dx_all[noff:])
             cov_all = cov_from_normalized(covn, norm)
             self._set_uncertainties(prepared, cov_all[noff:, noff:])

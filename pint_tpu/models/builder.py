@@ -189,7 +189,8 @@ def get_model(parfile, allow_name_mixing=False) -> TimingModel:
         for idx in ids:
             lo = float(keys.get(f"SWXR1_{idx:04d}", ["0"])[0])
             hi = float(keys.get(f"SWXR2_{idx:04d}", ["0"])[0])
-            swx.add_swx_range(idx, lo, hi)
+            p = float(keys.get(f"SWXP_{idx:04d}", ["2"])[0])
+            swx.add_swx_range(idx, lo, hi, p=p)
     if "PiecewiseSpindown" in model.components:
         pw = model.components["PiecewiseSpindown"]
         ids = sorted({int(k.split("_")[1]) for k in keys

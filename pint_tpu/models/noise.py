@@ -141,12 +141,20 @@ class EcorrNoise(NoiseComponent):
         prep["ecorr_owner"] = jnp.asarray(np.array(owner, dtype=np.int64))
 
     def basis_weight(self, params, prep):
-        """(U, w): covariance contribution U diag(w) U^T, w in us^2."""
+        """(U, w): covariance contribution U diag(w) U^T, w in us^2.
+
+        owner < 0 marks batch-padding columns (parallel/pta.py pads
+        ragged epoch counts with owner=-1): those get w=0 so the padded
+        zero column is exactly degenerate and dropped by the solver's
+        threshold instead of carrying pulsar-0's ECORR prior."""
         import jax.numpy as jnp
 
         U = prep["ecorr_U"]
-        w = jnp.square(params["ECORR"])[prep["ecorr_owner"]] if U.shape[1] else jnp.zeros(0)
-        return U, w
+        if not U.shape[1]:
+            return U, jnp.zeros(0)
+        owner = prep["ecorr_owner"]
+        w = jnp.square(params["ECORR"])[jnp.clip(owner, 0, None)]
+        return U, jnp.where(owner >= 0, w, 0.0)
 
 
 def fourier_basis(toas, n_harm):

@@ -38,16 +38,27 @@ class PiecewiseSpindown(PhaseComponent):
         if stop_mjd is not None:
             r2.value = stop_mjd
         self.add_param(r2)
-        for stem, unit in (("PWPH", ""), ("PWF0", "1/s"), ("PWF1", "1/s^2")):
+        for stem, unit in (("PWPH", ""), ("PWF0", "1/s"), ("PWF1", "1/s^2"),
+                           ("PWF2", "1/s^3")):
             p = prefixParameter(f"{stem}_{index:04d}", f"{stem}_", index,
                                 units=unit)
             p.value = 0.0
             self.add_param(p)
         self.pw_ids.append(index)
 
+    def validate(self):
+        from .timing_model import MissingParameter
+
+        for i in self.pw_ids:
+            for stem in ("PWSTART", "PWSTOP"):
+                if getattr(self, f"{stem}_{i:04d}").value is None:
+                    raise MissingParameter(
+                        "PiecewiseSpindown", f"{stem}_{i:04d}",
+                        "(segment window bounds are required)")
+
     def device_slot(self, pname):
         stem = pname.split("_")[0]
-        if stem in ("PWPH", "PWF0", "PWF1"):
+        if stem in ("PWPH", "PWF0", "PWF1", "PWF2"):
             return stem, self.pw_ids.index(int(pname.split("_")[1]))
         raise KeyError(pname)
 
@@ -55,7 +66,7 @@ class PiecewiseSpindown(PhaseComponent):
         import jax.numpy as jnp
 
         n_seg = len(self.pw_ids)
-        for stem in ("PWPH", "PWF0", "PWF1"):
+        for stem in ("PWPH", "PWF0", "PWF1", "PWF2"):
             params0[stem] = np.array(
                 [getattr(self, f"{stem}_{i:04d}").value or 0.0
                  for i in self.pw_ids], dtype=np.float64)
@@ -78,5 +89,6 @@ class PiecewiseSpindown(PhaseComponent):
         dt = prep["pw_dts"] - delay_total[None, :]
         ph = (params["PWPH"][:, None]
               + params["PWF0"][:, None] * dt
-              + 0.5 * params["PWF1"][:, None] * dt**2)
+              + 0.5 * params["PWF1"][:, None] * dt**2
+              + params["PWF2"][:, None] * dt**3 / 6.0)
         return jnp.sum(ph * prep["pw_masks"], axis=0)

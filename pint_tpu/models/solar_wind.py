@@ -76,12 +76,63 @@ class SolarWindDispersion(DelayComponent):
         return jnp.where(jnp.isfinite(f2), DMconst * dm / f2, 0.0)
 
 
+# Fixed Gauss-Legendre rule on [0, 1] for the general-p line-of-sight
+# integral: static nodes keep the quadrature jit-safe and differentiable.
+_GL_U, _GL_W = np.polynomial.legendre.leggauss(48)
+_GL_U = 0.5 * (_GL_U + 1.0)
+_GL_W = 0.5 * _GL_W
+
+
+def _cospow_integral(phi_hi, p):
+    """F(phi_hi; p) = integral_0^phi_hi cos^(p-2)(phi) dphi, vectorized
+    over phi_hi (any shape) with scalar-or-matching p. Exact for p=2
+    (reduces to phi_hi); analytic integrand -> 48-node Gauss-Legendre
+    is ~machine precision for the p in solar-wind use (1 < p <~ 6)."""
+    import jax.numpy as jnp
+
+    u = jnp.asarray(_GL_U)
+    w = jnp.asarray(_GL_W)
+    phi = phi_hi[..., None] * u
+    vals = jnp.cos(phi) ** (jnp.asarray(p)[..., None] - 2.0)
+    return phi_hi * jnp.sum(w * vals, axis=-1)
+
+
+def solar_wind_geometry_p(sun_ls, n_hat, p):
+    """DM per unit electron density at 1 AU [pc cm^-3 per cm^-3] for an
+    n ~ r^-p wind, along the observatory->pulsar line of sight.
+
+    I = AU^p * integral_0^inf d(s)^-p ds with d^2 = b^2 + (s - z0)^2,
+    b = r sin(theta) the impact parameter, z0 = r cos(theta);
+    substituting u = tan(phi): I = AU^p/b^(p-1) * [F(pi/2;p) + F(atan(z0/b);p)]
+    with F the cos-power integral above. p=2 reduces exactly to the
+    classic (pi - theta)/(r sin theta) factor
+    (reference: solar_wind_dispersion.py::_dm_p_int / _solar_wind_geometry).
+    """
+    import jax.numpy as jnp
+
+    r_ls = jnp.linalg.norm(sun_ls, axis=-1)
+    cos_t = jnp.clip(jnp.sum(sun_ls * n_hat, axis=-1) / r_ls, -1.0, 1.0)
+    theta = jnp.arccos(cos_t)
+    sin_t = jnp.clip(jnp.sin(theta), 1e-6, None)
+    b_ls = r_ls * sin_t
+    z0_ls = r_ls * cos_t
+    p = jnp.broadcast_to(jnp.asarray(p), jnp.shape(b_ls))
+    F_inf = _cospow_integral(jnp.full_like(b_ls, 0.5 * jnp.pi), p)
+    F_z = _cospow_integral(jnp.arctan(z0_ls / b_ls), p)
+    I_ls = AU_LS**p / b_ls ** (p - 1.0) * (F_inf + F_z)
+    return I_ls * (ONE_AU_PC / AU_LS)  # ls -> pc
+
+
 class SolarWindDispersionX(SolarWindDispersion):
     """Piecewise solar wind (reference: solar_wind_dispersion.py::
-    SolarWindDispersionX *(version-dependent)*): per-window electron
-    densities SWXDM_#### active in [SWXR1_####, SWXR2_####] MJD,
-    replacing the single NE_SW over those spans. Windows use the same
-    spherical r^-2 geometry; outside all windows NE_SW applies.
+    SolarWindDispersionX *(version-dependent)*).
+
+    Upstream convention (matching tempo2/PINT par files): SWXDM_#### is
+    the window's MAXIMUM solar-wind DM [pc cm^-3] over
+    [SWXR1_####, SWXR2_####); the per-TOA contribution is
+    SWXDM * g_p(t) / max_window(g_p) with g_p the r^-p geometry factor
+    and p = SWXP_#### (default 2). Outside all windows the base NE_SW
+    density applies.
     """
 
     category = "solar_windx"
@@ -90,13 +141,16 @@ class SolarWindDispersionX(SolarWindDispersion):
         super().__init__()
         self.swx_ids: list[int] = []
 
-    def add_swx_range(self, index, mjd_lo, mjd_hi, ne=0.0):
+    def add_swx_range(self, index, mjd_lo, mjd_hi, dm=0.0, p=2.0):
         from .parameter import MJDParameter, prefixParameter
 
-        p = prefixParameter(f"SWXDM_{index:04d}", "SWXDM_", index,
-                            units="cm^-3")
-        p.value = ne
-        self.add_param(p)
+        pdm = prefixParameter(f"SWXDM_{index:04d}", "SWXDM_", index,
+                              units="pc cm^-3")
+        pdm.value = dm
+        self.add_param(pdm)
+        pp = prefixParameter(f"SWXP_{index:04d}", "SWXP_", index, units="")
+        pp.value = p
+        self.add_param(pp)
         r1 = MJDParameter(f"SWXR1_{index:04d}", units="MJD")
         r1.value = mjd_lo
         self.add_param(r1)
@@ -104,6 +158,15 @@ class SolarWindDispersionX(SolarWindDispersion):
         r2.value = mjd_hi
         self.add_param(r2)
         self.swx_ids.append(index)
+
+    def validate(self):
+        super().validate()
+        for i in self.swx_ids:
+            pp = getattr(self, f"SWXP_{i:04d}")
+            if not pp.frozen:
+                raise ValueError(
+                    f"SWXP_{i:04d}: fitting the solar-wind power index is "
+                    "not supported (static per-window quadrature)")
 
     def device_slot(self, pname):
         if pname.startswith("SWXDM_"):
@@ -123,16 +186,31 @@ class SolarWindDispersionX(SolarWindDispersion):
              & (mjd < getattr(self, f"SWXR2_{i:04d}").value)).astype(np.float64)
             for i in self.swx_ids]) if self.swx_ids else np.zeros((0, len(toas)))
         prep["swx_masks"] = jnp.asarray(masks)
+        prep["swx_p"] = jnp.asarray(np.array(
+            [getattr(self, f"SWXP_{i:04d}").value or 2.0
+             for i in self.swx_ids], dtype=np.float64))
 
     def delay(self, params, batch, prep, delay_accum):
         import jax.numpy as jnp
 
-        dm_geom = self.solar_wind_dm(
-            {**params, "NE_SW": 1.0}, batch, prep)  # geometry for unit density
+        astrom = next((c for c in self._parent.delay_components()
+                       if c.category == "astrometry"), None)
         masks = prep["swx_masks"]
-        in_any = jnp.clip(jnp.sum(masks, axis=0), 0.0, 1.0)
-        ne = (params["SWXDM"] @ masks if masks.shape[0]
-              else jnp.zeros_like(dm_geom))
-        ne = ne + params["NE_SW"] * (1.0 - in_any)
         f2 = jnp.square(batch.freq_mhz)
-        return jnp.where(jnp.isfinite(f2), DMconst * ne * dm_geom / f2, 0.0)
+        base_dm = self.solar_wind_dm(params, batch, prep)
+        if masks.shape[0] == 0 or astrom is None:
+            return jnp.where(jnp.isfinite(f2), DMconst * base_dm / f2, 0.0)
+        n_hat = astrom.ssb_to_psb_xyz(params, prep)
+        # per-window geometry (k, n): window j uses its own power index
+        G = solar_wind_geometry_p(batch.obs_sun_ls[None, :, :],
+                                  n_hat[None, :, :] if n_hat.ndim == 2
+                                  else n_hat[None, :],
+                                  prep["swx_p"][:, None])
+        # normalize each window by its in-window maximum (upstream's
+        # "SWXDM is the max DM over the window" convention)
+        gmax = jnp.max(G * masks, axis=1)
+        gmax = jnp.where(gmax > 0, gmax, 1.0)
+        dm_x = jnp.sum((params["SWXDM"] / gmax)[:, None] * G * masks, axis=0)
+        in_any = jnp.clip(jnp.sum(masks, axis=0), 0.0, 1.0)
+        dm = dm_x + base_dm * (1.0 - in_any)
+        return jnp.where(jnp.isfinite(f2), DMconst * dm / f2, 0.0)

@@ -96,7 +96,15 @@ def stack_prepared(preps: list[PreparedTiming]):
         arrs = [np.asarray(_toa_dim_pad(v, p.batch.n_toas, n_max))
                 for v, p in zip(vals, preps)]
         shape = tuple(max(a.shape[i] for a in arrs) for i in range(arrs[0].ndim))
-        prep_stack[k] = jnp.asarray(np.stack([_pad_to(a, shape) for a in arrs]))
+        # ecorr_owner indexes ECORR params; pad with -1 so padded basis
+        # columns get zero weight (see EcorrNoise.basis_weight), not
+        # pulsar-0's ECORR prior
+        fill = -1 if k == "ecorr_owner" else 0
+        prep_stack[k] = jnp.asarray(np.stack(
+            [_pad_to(a, shape) if fill == 0 else
+             np.concatenate([a, np.full(shape[0] - a.shape[0], fill,
+                                        dtype=a.dtype)])
+             for a in arrs]))
 
     # --- batch: pad TOA axis; sentinel sigma on padded rows
     from ..toa import TOABatch
@@ -248,10 +256,40 @@ class PTABatch:
 
         return jax.vmap(pull_one)(self.params)
 
+    def _isolate_diverged(self, x0, x, chi2):
+        """Per-pulsar fault isolation (SURVEY section 5 "failure
+        detection"): a diverged lane (non-finite chi2 or params) must
+        not poison the batch result. vmap lanes are independent, so
+        divergence cannot corrupt *other* pulsars mid-fit; here we
+        restore the diverged pulsars' starting vectors, record which
+        they were, and continue — the reference analog is the Downhill
+        fitters keeping the best-so-far ModelState on a failed step.
+
+        Returns (x_clean, chi2); the diverged pulsar indices are
+        reported via self.diverged.
+        """
+        import warnings
+
+        x = np.array(x, np.float64)  # copy: jax buffers are read-only
+        chi2 = np.asarray(chi2, np.float64)
+        bad = ~np.isfinite(chi2) | ~np.isfinite(x).all(axis=1)
+        self.diverged = np.flatnonzero(bad)
+        if bad.any():
+            names = [getattr(m, "PSR", None) and m.PSR.value or f"#{i}"
+                     for i, m in enumerate(self.models)]
+            warnings.warn(
+                f"PTA batch: {bad.sum()}/{len(bad)} pulsars diverged "
+                f"({[names[i] for i in self.diverged]}); their parameter "
+                "vectors were restored to the pre-fit values")
+            x[bad] = np.asarray(x0, np.float64)[bad]
+        return x, chi2
+
     def wls_fit(self, maxiter=3, threshold=1e-12):
         """Vmapped, mesh-sharded multi-pulsar WLS fit.
 
         Returns (x_fit (n_psr, n_free), chi2 (n_psr,), cov (n_psr, k, k)).
+        Diverged pulsars (non-finite results) are reported via
+        self.diverged and returned with their starting vectors.
         """
         import jax
         import jax.numpy as jnp
@@ -294,14 +332,157 @@ class PTABatch:
         key = ("wls", maxiter, threshold)
         if key not in self._fns:
             self._fns[key] = jax.jit(jax.vmap(fit_one))
-        x, chi2, (covn, norm) = self._fns[key](self._x0(), self.params,
+        x0 = self._x0()
+        x, chi2, (covn, norm) = self._fns[key](x0, self.params,
                                                self.batch, self.prep)
         # physical-unit covariance on host in IEEE f64: variances like
         # var(F1)~1e-38 leave the TPU emulated-f64 exponent range
         covn = np.asarray(covn, np.float64)
         norm = np.asarray(norm, np.float64)
         cov = covn / (norm[:, :, None] * norm[:, None, :])
+        x, chi2 = self._isolate_diverged(x0, x, chi2)
         return x, chi2, cov
+
+    def _noise_bw_fn(self):
+        """Pure (params, prep) -> (B, w_us2) stacking every noise
+        component's basis/weight pair; None if the batch has no
+        correlated-noise components. Padded basis columns are zero with
+        zero weight (red-noise raggedness) or zero with a real prior
+        (ECORR raggedness) — both give exactly zero amplitude in the
+        augmented solve below.
+        """
+        comps = [c for c in self.template.components.values()
+                 if getattr(c, "basis_weight", None) is not None]
+        if not comps:
+            return None
+        static = self.static
+
+        def noise_bw(params, prep):
+            import jax.numpy as jnp
+
+            full = {**prep, **static}
+            Bs, ws = [], []
+            for c in comps:
+                B, w = c.basis_weight(params, full)
+                if B.shape[1]:
+                    Bs.append(B)
+                    ws.append(w)
+            if not Bs:
+                return None
+            return jnp.concatenate(Bs, axis=1), jnp.concatenate(ws)
+
+        return noise_bw
+
+    def gls_fit(self, maxiter=2, threshold=1e-12):
+        """Vmapped, mesh-sharded multi-pulsar GLS fit — the
+        BASELINE.json north-star path (NANOGrav-15yr-style refit with
+        EFAC/EQUAD/ECORR/red-noise) as ONE jitted program.
+
+        Noise bases (ECORR quantization U, red-noise Fourier F) are
+        appended to the design matrix with prior weights, and the
+        Woodbury-marginalized normal equations A = Mn^T Mn + Phi^-1 are
+        solved by a batched eigh + eigenvalue threshold — the same math
+        as fitter.py::GLSFitter, vmapped. (An augmented-row batched SVD
+        formulation was tried first and compiles pathologically slowly
+        on the TPU backend — tall (n_toa+k, k) SVDs; the (k, k) eigh is
+        the MXU-friendly shape.) Zero-padded basis columns from ragged
+        per-pulsar epoch/harmonic counts carry zero weight and a zero
+        column (see basis_weight owner=-1 convention), so they appear
+        as exactly-zero eigenvalues and are dropped by the threshold.
+
+        Returns (x_fit, chi2_whitened, cov) like wls_fit; diverged
+        pulsars reported via self.diverged.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from ..fitter import column_norms
+
+        resid_fn = self._resid_fn()
+        phase_fn = self._phase_fn()
+        noise_bw = self._noise_bw_fn()
+
+        def one_step(x, params, batch, prep):
+            p = self._overlay(params, x)
+            r, sig = resid_fn(p, batch, prep)
+            sigma_s = sig * 1e-6
+
+            def phase_of(xv):
+                return phase_fn(self._overlay(params, xv), batch, prep)
+
+            M = jax.jacfwd(phase_of)(x) / p["F"][0]
+            M = jnp.concatenate([jnp.ones((M.shape[0], 1)), M], axis=1)
+            nparam = M.shape[1]
+            bw = noise_bw(p, prep) if noise_bw is not None else None
+            if bw is not None:
+                B, w_us2 = bw
+                Mfull = jnp.concatenate([M, B], axis=1)
+                # us^2 -> s^2 prior variance; zero-weight (padded)
+                # columns get phi_inv = 0 AND a zero basis column ->
+                # exactly-zero eigenvalue -> dropped by the threshold
+                phi_inv = jnp.concatenate([
+                    jnp.zeros(nparam),
+                    jnp.where(w_us2 > 0, 1.0 / (w_us2 * 1e-12), 0.0),
+                ])
+            else:
+                Mfull = M
+                phi_inv = jnp.zeros(nparam)
+            Mw = Mfull / sigma_s[:, None]
+            rw = r / sigma_s
+            norm = column_norms(Mw)
+            Mn = Mw / norm
+            A = Mn.T @ Mn + jnp.diag(phi_inv / norm / norm)
+            b = Mn.T @ rw
+            evals, evecs = jnp.linalg.eigh(A)
+            cut = max(threshold**2, 3e-14)
+            good = evals > cut * jnp.max(evals)
+            einv = jnp.where(good, 1.0 / jnp.where(good, evals, 1.0), 0.0)
+            dxn = evecs @ (einv * (evecs.T @ b))
+            dx_all = dxn / norm
+            covn = evecs @ (einv[:, None] * evecs.T)
+            # whitened marginalized chi2: r^T C^-1 r = |rw|^2 - b.dxn
+            chi2 = jnp.sum(jnp.square(rw)) - b @ dxn
+            return (x - dx_all[1:nparam], chi2,
+                    (covn[1:nparam, 1:nparam], norm[1:nparam]))
+
+        def fit_one(x0, params, batch, prep):
+            x = x0
+            for _ in range(maxiter):
+                x, chi2, cov = one_step(x, params, batch, prep)
+            return x, chi2, cov
+
+        key = ("gls", maxiter, threshold)
+        if key not in self._fns:
+            self._fns[key] = jax.jit(jax.vmap(fit_one))
+        x0 = self._x0()
+        x, chi2, (covn, norm) = self._fns[key](x0, self.params,
+                                               self.batch, self.prep)
+        covn = np.asarray(covn, np.float64)
+        norm = np.asarray(norm, np.float64)
+        cov = covn / (norm[:, :, None] * norm[:, None, :])
+        x, chi2 = self._isolate_diverged(x0, x, chi2)
+        return x, chi2, cov
+
+    @staticmethod
+    def structure_key(model):
+        """Hashable model-structure signature: component set, free
+        parameters, AND the par values that become static (Python
+        scalar) prep config — those must be uniform within a batch
+        (stack_prepared asserts it), so they are part of the bucket
+        key. Pulsars sharing a key can be stacked into one vmapped
+        batch."""
+        comps = tuple(sorted(model.components))
+        free = tuple(sorted(model.free_params))
+        static_cfg = []
+        for pname in ("PLANET_SHAPIRO", "ECL", "CORRECT_TROPOSPHERE",
+                      "SIFUNC"):
+            if pname in model.params:
+                static_cfg.append((pname, getattr(model, pname).value))
+        # FB-mode vs PB-mode orbits produce different static orb_mode_fb
+        if "FB0" in model.params:
+            static_cfg.append(("FB0?", getattr(model, "FB0").value
+                               is not None))
+        return (comps, free, tuple(static_cfg))
 
     def time_residuals(self):
         """(n_psr, n_toa_max) residual seconds + validity mask."""
@@ -317,3 +498,63 @@ class PTABatch:
         r = jax.jit(jax.vmap(one))(self.params, self.batch, self.prep)
         mask = np.arange(r.shape[1])[None, :] < self.n_toas[:, None]
         return r, mask
+
+
+class PTAFleet:
+    """Mixed-structure PTA fitting: bucket pulsars by model structure,
+    one PTABatch per bucket, fit buckets sequentially (each bucket is
+    one vmapped mesh-sharded program).
+
+    Real PTA datasets mix isolated pulsars, different binary models and
+    noise configurations; PTABatch requires uniform structure
+    (SURVEY.md section 7.3 item 4 — "bucketing TOA counts / component
+    sets to limit recompiles"). The reference fits pulsars one at a
+    time in Python (no counterpart); this keeps the per-bucket batching
+    win while accepting arbitrary mixtures.
+    """
+
+    def __init__(self, models, toas_list, mesh=None):
+        self.buckets = {}
+        self.order = []  # (bucket_key, index_within_bucket) per pulsar
+        groups = {}
+        for i, (m, t) in enumerate(zip(models, toas_list)):
+            key = PTABatch.structure_key(m)
+            groups.setdefault(key, []).append(i)
+        self.group_indices = groups
+        self.batches = {}
+        for key, idxs in groups.items():
+            self.batches[key] = PTABatch([models[i] for i in idxs],
+                                         [toas_list[i] for i in idxs],
+                                         mesh=mesh)
+        self.n = len(models)
+
+    def fit(self, method="auto", maxiter=3, **kw):
+        """Fit every bucket; returns per-pulsar lists (x, chi2, cov)
+        in the original pulsar order. method: "wls", "gls", or "auto"
+        (gls when the bucket has correlated-noise components)."""
+        xs = [None] * self.n
+        chi2s = np.zeros(self.n)
+        covs = [None] * self.n
+        self.diverged = []
+        for key, idxs in self.group_indices.items():
+            batch = self.batches[key]
+            use_gls = (method == "gls"
+                       or (method == "auto"
+                           and batch._noise_bw_fn() is not None))
+            fit = batch.gls_fit if use_gls else batch.wls_fit
+            x, chi2, cov = fit(maxiter=maxiter, **kw)
+            for j, i in enumerate(idxs):
+                xs[i] = np.asarray(x)[j]
+                chi2s[i] = np.asarray(chi2)[j]
+                covs[i] = np.asarray(cov)[j]
+            self.diverged.extend(idxs[j] for j in batch.diverged)
+        return xs, chi2s, covs
+
+    def free_maps(self):
+        """Per-pulsar free-parameter maps in original order."""
+        out = [None] * self.n
+        for key, idxs in self.group_indices.items():
+            fmap = self.batches[key].free_map()
+            for i in idxs:
+                out[i] = fmap
+        return out

@@ -124,12 +124,12 @@ _TDB_TERMS = np.array([
 _TDB_T_TERM = (0.0000102, 628.3075850, 4.2490)  # amplitude*T mixed term
 
 
-def tdb_minus_tt(tt: Epochs) -> np.ndarray:
-    """TDB-TT [s] at TT epochs, truncated FB1990 series (~10 us absolute).
+def tdb_minus_tt_series(tt: Epochs) -> np.ndarray:
+    """TDB-TT [s], truncated FB1990 harmonic series (~5-10 us absolute).
 
-    Geocentric TDB (topocentric ~2 us diurnal term omitted, as the
-    reference also evaluates TDB at the geocenter for its default
-    T2CMETHOD; reference: toa.py::TOAs.compute_TDBs grid).
+    Kept as (a) the convention anchor for the integrated table below,
+    (b) the out-of-table-range fallback, and (c) the C++-mirrored path
+    (native/src/host_kernels.cpp::pt_tdb_minus_tt).
     """
     assert tt.scale == "tt"
     from .native import tdb_minus_tt as _native
@@ -144,6 +144,80 @@ def tdb_minus_tt(tt: Epochs) -> np.ndarray:
     amp, rate, phase = _TDB_T_TERM
     out += amp * T * np.sin(rate * T + phase)
     return out
+
+
+# Integrated TDB-TT table: d(TDB-TT)/dTT = (v_E^2/2 + sum_b GM_b/r_bE)/c^2
+# - const, cumulatively integrated on a dense grid from the package's own
+# ephemeris (VSOP87-class Earth), then calibrated (constant + slope only)
+# to the FB1990 series so the IAU TDB convention is preserved. This
+# carries every periodic term the ephemeris knows about — hundreds of
+# terms the 10-term series truncates — without hand-entering the 787
+# FB/ERFA coefficients; accuracy is then set by the ephemeris
+# (fractional velocity error ~1e-5 -> sub-us), not by series truncation.
+# (reference equivalent: astropy Time.tdb uses the full ERFA dtdb series.)
+_TDB_GRID_LO, _TDB_GRID_HI, _TDB_GRID_STEP = 40000, 64000, 0.25  # MJD, days
+_TDB_TABLE = None
+
+
+def _build_tdb_table():
+    from .constants import C_M_S, GMSUN_M3_S2
+    from .ephemeris import analytic
+
+    mjd = np.arange(_TDB_GRID_LO, _TDB_GRID_HI + _TDB_GRID_STEP,
+                    _TDB_GRID_STEP)
+    T = (mjd - 51544.5) / 36525.0
+    pos = analytic._all_positions_icrs(T)
+    earth = pos["earth"]
+    dt_s = _TDB_GRID_STEP * SECS_PER_DAY
+    vel = np.gradient(earth, dt_s, axis=0)
+    # external potential at the geocenter: Sun + planets + Moon
+    bodies = [("sun", 1.0), ("moon", 1.0 / (analytic._INV_MASS["emb"]
+                                            * (1.0 + analytic._EARTH_MOON_MASS_RATIO)))]
+    bodies += [(b, 1.0 / analytic._INV_MASS[b])
+               for b in analytic._INV_MASS if b != "emb"]
+    U = np.zeros(len(mjd))
+    for name, mass_frac in bodies:
+        r = np.linalg.norm(pos[name] - earth, axis=1)
+        U += GMSUN_M3_S2 * mass_frac / r
+    rate = (0.5 * np.sum(vel**2, axis=1) + U) / C_M_S**2
+    rate -= rate.mean()
+    tdb_tt = np.concatenate([[0.0], np.cumsum(
+        0.5 * (rate[1:] + rate[:-1]) * dt_s)])
+    # calibrate constant + slope against the FB series (IAU convention)
+    fb = tdb_minus_tt_series(Epochs(
+        mjd.astype(np.int64), (mjd % 1.0) * SECS_PER_DAY, "tt"))
+    x = (mjd - mjd.mean()) / (mjd.max() - mjd.min())
+    A = np.stack([np.ones_like(x), x], axis=1)
+    coef, *_ = np.linalg.lstsq(A, fb - tdb_tt, rcond=None)
+    tdb_tt = tdb_tt + A @ coef
+    try:
+        from scipy.interpolate import CubicSpline
+
+        return CubicSpline(mjd, tdb_tt)
+    except ImportError:
+        return lambda m: np.interp(m, mjd, tdb_tt)
+
+
+def tdb_minus_tt(tt: Epochs) -> np.ndarray:
+    """TDB-TT [s] at TT epochs (geocentric; the topocentric ~2 us
+    diurnal term is omitted, matching the reference's default-method
+    geocentric TDB grid; reference: toa.py::TOAs.compute_TDBs).
+
+    Integrated-table path (sub-us class, see _build_tdb_table) inside
+    MJD [40000, 64000]; FB1990 truncated series (~5-10 us) outside.
+    Set PINT_TPU_TDB_SERIES=1 to force the series path.
+    """
+    assert tt.scale == "tt"
+    global _TDB_TABLE
+
+    if os.environ.get("PINT_TPU_TDB_SERIES"):
+        return tdb_minus_tt_series(tt)
+    mjd = np.atleast_1d(tt.day + tt.sec / SECS_PER_DAY)
+    if mjd.min() < _TDB_GRID_LO or mjd.max() > _TDB_GRID_HI:
+        return tdb_minus_tt_series(tt)
+    if _TDB_TABLE is None:
+        _TDB_TABLE = _build_tdb_table()
+    return np.asarray(_TDB_TABLE(mjd), dtype=np.float64)
 
 
 def tt_to_tdb(t: Epochs) -> Epochs:

@@ -439,7 +439,8 @@ def _pickle_settings_key(ephem, planets, include_gps, include_bipm,
 
 
 # Bump whenever the posvel/clock/TDB pipeline changes numerically.
-_PHYSICS_REV = 2
+# 2: ERA half-day fix; 3: VSOP87 Earth + integrated TDB-TT table.
+_PHYSICS_REV = 3
 
 
 def _tim_content_hash(path) -> str:

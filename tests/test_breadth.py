@@ -96,6 +96,60 @@ def test_convert_binary_ell1_dd_roundtrip():
     assert me.TASC.value == pytest.approx(55101.0, abs=1e-9)
 
 
+def test_convert_binary_dds_derives_shapmax():
+    """DD -> DDS must DERIVE SHAPMAX = -ln(1-SINI) (not silently drop
+    the Shapiro delay), and back-convert SINI = 1 - exp(-SHAPMAX)."""
+    from pint_tpu.binaryconvert import convert_binary
+
+    sini = 0.95
+    par = BASE + ("BINARY DD\nPB 3.1 1\nA1 6.0 1\nT0 55100.0 1\n"
+                  "ECC 1e-4 1\nOM 45.0 1\nM2 0.3\nSINI 0.95\n")
+    m = get_model(par)
+    m.SINI.uncertainty = 0.01
+    mdds = convert_binary(m, "DDS")
+    assert "BinaryDDS" in mdds.components
+    assert mdds.SHAPMAX.value == pytest.approx(-np.log(1 - sini), rel=1e-12)
+    assert mdds.SHAPMAX.uncertainty == pytest.approx(0.01 / (1 - sini), rel=1e-9)
+    # residual equivalence: the Shapiro delay survives the conversion
+    mjds = np.linspace(55050, 55150, 60)
+    t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=1400.0,
+                                obs="gbt", add_noise=False)
+    r = np.asarray(Residuals(t, mdds, subtract_mean=False).calc_time_resids())
+    assert np.abs(r).max() < 1e-10
+    # and back
+    mdd = convert_binary(mdds, "DD")
+    assert mdd.SINI.value == pytest.approx(sini, rel=1e-12)
+
+
+def test_convert_binary_ell1h_orthometric():
+    """ELL1 -> ELL1H derives (H3, STIGMA) from (M2, SINI); inverse
+    recovers them (Freire & Wex 2010)."""
+    from pint_tpu.binaryconvert import convert_binary
+
+    m2, sini = 0.25, 0.9
+    par = BASE + ("BINARY ELL1\nPB 1.8 1\nA1 3.0 1\nTASC 55101.0 1\n"
+                  "EPS1 1e-6 1\nEPS2 -2e-6 1\nM2 0.25\nSINI 0.9\n")
+    m = get_model(par)
+    mh = convert_binary(m, "ELL1H")
+    assert "BinaryELL1H" in mh.components
+    cosi = np.sqrt(1 - sini**2)
+    st = sini / (1 + cosi)
+    tsun = 4.925490947e-6
+    assert mh.STIGMA.value == pytest.approx(st, rel=1e-12)
+    assert mh.H3.value == pytest.approx(tsun * m2 * st**3, rel=1e-12)
+    # residual equivalence through the orthometric expansion
+    mjds = np.linspace(55050, 55150, 60)
+    t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=1400.0,
+                                obs="gbt", add_noise=False)
+    r = np.asarray(Residuals(t, mh, subtract_mean=True).calc_time_resids())
+    # exact-harmonics vs m2/sini ln-form difference only
+    assert np.abs(r).max() < 5e-8
+    # inverse: ELL1H -> ELL1 recovers M2/SINI
+    me = convert_binary(mh, "ELL1")
+    assert me.SINI.value == pytest.approx(sini, rel=1e-10)
+    assert me.M2.value == pytest.approx(m2, rel=1e-10)
+
+
 def test_dmxparse_and_ranges():
     from pint_tpu.utils import dmx_ranges, dmxparse
     from pint_tpu.fitter import WLSFitter
@@ -208,3 +262,19 @@ def test_fit_checkpointing(tmp_path):
     f2 = WLSFitter(t, m3)
     chi2b = checkpointed_fit(f2, tmp_path / "fit_ck", maxiter=4)
     assert abs(f2.model.F0.value - f.model.F0.value) < 1e-11
+
+
+def test_checkpoint_cross_format_restore(tmp_path):
+    """A snapshot written by the npz backend (orbax unavailable at save
+    time) must restore once orbax IS importable — save() chose the
+    format at write time."""
+    from pint_tpu.checkpoint import FitCheckpointer
+
+    ck_npz = FitCheckpointer(tmp_path / "x")
+    ck_npz._ocp = None  # simulate "orbax absent" at save time
+    ck_npz.save("t", {"x": np.arange(4.0), "iter": 2, "chi2": 3.5})
+    ck_orbax = FitCheckpointer(tmp_path / "x")  # orbax importable now
+    state = ck_orbax.restore("t")
+    assert state is not None
+    np.testing.assert_allclose(state["x"], np.arange(4.0))
+    assert ck_orbax.latest_iteration("t") == 2

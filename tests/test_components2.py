@@ -80,8 +80,11 @@ def test_dmwavex_chromatic_delay():
 
 
 def test_swx_windows():
+    """Upstream SWX convention: SWXDM is the window's MAXIMUM
+    solar-wind DM [pc cm^-3], contribution SWXDM * g(t)/max_window(g)."""
+    swxdm = 2.5e-4  # pc cm^-3 (max DM over the window)
     par_plain = BASE + "NE_SW 7.9\n"
-    par_swx = BASE + ("NE_SW 7.9\nSWXDM_0001 12.5 1\n"
+    par_swx = BASE + (f"NE_SW 7.9\nSWXDM_0001 {swxdm} 1\n"
                       "SWXR1_0001 54990\nSWXR2_0001 55300\n")
     m_plain = get_model(par_plain)
     m_swx = get_model(par_swx)
@@ -95,11 +98,49 @@ def test_swx_windows():
     base = np.asarray(get_model(BASE).delay(t))
     sw_plain = d_plain - base
     sw_swx = d_swx - base
-    # rtol reflects subtractive cancellation: the ~1 us solar-wind term
-    # is recovered from ~100 s total delays
+    # outside windows: base NE_SW applies unchanged
     np.testing.assert_allclose(sw_swx[~inside], sw_plain[~inside], rtol=1e-5)
-    np.testing.assert_allclose(sw_swx[inside], sw_plain[inside] * 12.5 / 7.9,
-                               rtol=1e-5)
+    # inside: per-TOA geometry g recovered from the plain model
+    # (sw_plain = DMconst * 7.9 * g / f^2), normalized by its window max
+    from pint_tpu.constants import DMconst
+
+    f2 = np.asarray(t.freq_mhz) ** 2
+    g = sw_plain * f2 / (DMconst * 7.9)
+    expect = DMconst * swxdm * (g / g[inside].max()) / f2
+    np.testing.assert_allclose(sw_swx[inside], expect[inside], rtol=1e-4)
+    # the window's peak DM equals SWXDM by construction
+    dm_inside = sw_swx[inside] * f2[inside] / DMconst
+    assert abs(dm_inside.max() - swxdm) < 1e-3 * swxdm
+
+
+def test_swx_power_index_quadrature():
+    """The general-p quadrature geometry: exact reduction at p=2 and
+    agreement with direct numerical integration at p=2.5."""
+    import jax.numpy as jnp
+
+    from pint_tpu.constants import AU_LS, ONE_AU_PC
+    from pint_tpu.models.solar_wind import solar_wind_geometry_p
+
+    rng = np.random.default_rng(3)
+    n = 40
+    sun = rng.normal(0, AU_LS, (n, 3)) + np.array([AU_LS, 0, 0])
+    nh = rng.normal(0, 1, (n, 3))
+    nh /= np.linalg.norm(nh, axis=1, keepdims=True)
+    # p = 2: closed form (pi - theta)/(r sin theta)
+    g2 = np.asarray(solar_wind_geometry_p(jnp.asarray(sun), jnp.asarray(nh), 2.0))
+    r = np.linalg.norm(sun, axis=1)
+    cos_t = np.clip(np.sum(sun * nh, axis=1) / r, -1, 1)
+    theta = np.arccos(cos_t)
+    expect2 = ONE_AU_PC * (np.pi - theta) / ((r / AU_LS) * np.sin(theta))
+    np.testing.assert_allclose(g2, expect2, rtol=1e-12)
+    # p = 2.5: brute-force line-of-sight integral
+    p = 2.5
+    gp = np.asarray(solar_wind_geometry_p(jnp.asarray(sun), jnp.asarray(nh), p))
+    s = np.linspace(0, 2000 * AU_LS, 400001)
+    for i in range(0, n, 13):
+        d = np.sqrt(r[i] ** 2 + s**2 - 2 * r[i] * s * cos_t[i])
+        integ = np.trapezoid((AU_LS / d) ** p, s) * (ONE_AU_PC / AU_LS)
+        np.testing.assert_allclose(gp[i], integ, rtol=1e-3)
 
 
 def test_piecewise_spindown():
@@ -127,3 +168,37 @@ def test_piecewise_spindown():
     f = DownhillWLSFitter(t, m1)
     f.fit_toas()
     assert f.model.PWF0_0001.value == pytest.approx(1e-8, rel=1e-3)
+
+
+def test_piecewise_pwf2_and_validation():
+    import copy
+
+    # PWF2 quadratic frequency term contributes dt^3/6 cycles in-window
+    par = BASE + ("PWEP_0001 55100\nPWSTART_0001 55000\nPWSTOP_0001 55200\n"
+                  "PWPH_0001 0.0\nPWF0_0001 0\nPWF1_0001 0\n"
+                  "PWF2_0001 1e-21\n")
+    m = get_model(par)
+    assert m.PWF2_0001.value == pytest.approx(1e-21)
+    t = _toas(m)
+    m0 = copy.deepcopy(m)
+    m0.PWF2_0001.value = 0.0
+    r = np.asarray(Residuals(t, m, subtract_mean=False).calc_time_resids())
+    r0 = np.asarray(Residuals(t, m0, subtract_mean=False).calc_time_resids())
+    # same clock as the component's window masks (TDB, not UTC):
+    # a boundary TOA must not flip between the two
+    mjd = t.tdb.day + t.tdb.sec / 86400.0
+    inside = (mjd >= 55000) & (mjd < 55200)
+    f0 = m.F0.value
+    dt = (mjd - 55100) * 86400.0
+    expect = 1e-21 * dt**3 / 6.0 / f0  # <=0.11 cycles: no phase wrap
+    got = r - r0
+    # rtol: the component evaluates dt at the delay-corrected emission
+    # time (TDB - delays), the hand formula at the raw UTC grid
+    np.testing.assert_allclose(got[inside], expect[inside],
+                               rtol=2e-3, atol=1e-10)
+    assert np.abs(got[~inside]).max() < 1e-12
+    # missing window bounds -> typed MissingParameter at validate time
+    from pint_tpu.models.timing_model import MissingParameter
+
+    with pytest.raises(MissingParameter):
+        get_model(BASE + "PWEP_0001 55100\nPWF0_0001 1e-8\n")

@@ -85,6 +85,95 @@ def test_residuals_padding_inert():
     assert np.isfinite(r[mask]).all()
 
 
+def _noise_batch(n_psr=3, n_epochs=18, per_epoch=3, harmonics=None, seed=7):
+    """Pulsars with EFAC/EQUAD/ECORR (+optionally ragged red noise):
+    clustered epochs so ECORR quantization produces real columns, with
+    per-pulsar epoch counts ragged to exercise basis padding."""
+    rng = np.random.default_rng(seed)
+    models, toas_list = [], []
+    for i in range(n_psr):
+        par = (f"PSR NZ{i}\nRAJ 0{(2 * i) % 10}:30:00.0\nDECJ {8 + i}:00:00.0\n"
+               f"F0 {310 + 4 * i}.25 1\nF1 -{2 + i}e-16 1\nPEPOCH 55500\n"
+               f"DM {12 + i}.3 1\n"
+               "EFAC -f L-wide 1.2\nEQUAD -f L-wide 0.5\n"
+               "ECORR -f L-wide 0.9\n")
+        if harmonics:
+            par += f"RNAMP 1e-14\nRNIDX -3.2\nTNREDC {harmonics[i]}\n"
+        m = get_model(par)
+        ne = n_epochs + 2 * i  # ragged epoch (and thus basis) counts
+        epoch_days = np.linspace(55000, 56000, ne)
+        mjds = np.concatenate(
+            [d + np.arange(per_epoch) * 0.5 / 86400.0 for d in epoch_days])
+        freqs = np.full(len(mjds), 1400.0)
+        t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=freqs,
+                                    obs="gbt", add_noise=True, seed=100 + i)
+        for f in t.flags:
+            f["f"] = "L-wide"
+        models.append(m)
+        toas_list.append(t)
+    return models, toas_list
+
+
+def test_pta_gls_matches_single_pulsar_gls():
+    """Batched GLS (augmented-prior SVD) must agree with the
+    single-pulsar GLSFitter (eigh-based Woodbury) per pulsar."""
+    from pint_tpu.fitter import GLSFitter
+
+    models, toas_list = _noise_batch(3)
+    pta = PTABatch([copy.deepcopy(m) for m in models], toas_list)
+    x, chi2, cov = pta.gls_fit(maxiter=2)
+    x = np.asarray(x)
+    assert len(pta.diverged) == 0
+    for i in range(3):
+        f = GLSFitter(toas_list[i], copy.deepcopy(models[i]))
+        f.fit_toas(maxiter=2)
+        fmap = pta.free_map()
+        for j, (pname, _, _) in enumerate(fmap):
+            par = getattr(f.model, pname)
+            assert abs(x[i, j] - par.value) <= \
+                max(1e-2 * (par.uncertainty or 1e-12), 1e-15), \
+                (i, pname, x[i, j], par.value)
+
+
+def test_pta_gls_ragged_rednoise_bases():
+    """Per-pulsar harmonic counts differ -> zero-padded basis columns
+    must be inert (finite result, chi2 comparable to WLS-with-noise)."""
+    models, toas_list = _noise_batch(3, harmonics=[10, 14, 12])
+    pta = PTABatch(models, toas_list)
+    x, chi2, cov = pta.gls_fit(maxiter=2)
+    assert np.isfinite(np.asarray(chi2)).all()
+    assert len(pta.diverged) == 0
+    assert np.isfinite(np.asarray(x)).all()
+
+
+def test_pta_fault_isolation_poisoned_pulsar():
+    """One poisoned pulsar (zero TOA errors -> NaN whitening) must not
+    corrupt the other lanes; it is reported and restored to x0."""
+    import warnings as w
+
+    models, toas_list, _ = _batch(8)
+    # clean reference run
+    pta_ref = PTABatch([copy.deepcopy(m) for m in models],
+                       toas_list)
+    x_ref, chi2_ref, _ = pta_ref.wls_fit(maxiter=3)
+    # poison pulsar 3
+    bad = copy.deepcopy(toas_list)
+    bad[3].error_us = np.zeros_like(bad[3].error_us)
+    pta = PTABatch([copy.deepcopy(m) for m in models], bad)
+    with w.catch_warnings(record=True) as rec:
+        w.simplefilter("always")
+        x, chi2, cov = pta.wls_fit(maxiter=3)
+    assert list(pta.diverged) == [3]
+    assert any("diverged" in str(r.message) for r in rec)
+    x, x_ref = np.asarray(x), np.asarray(x_ref)
+    # other lanes bitwise-unaffected by lane 3's NaNs
+    for i in [0, 1, 2, 4, 5, 6, 7]:
+        np.testing.assert_allclose(x[i], x_ref[i], rtol=1e-12)
+    # poisoned lane restored to its starting vector
+    np.testing.assert_allclose(x[3], np.asarray(pta._x0())[3], rtol=0,
+                               atol=0)
+
+
 def test_toa_axis_shard_map():
     from pint_tpu.parallel.toa_shard import sharded_chi2
     from jax.sharding import Mesh
