@@ -1,0 +1,171 @@
+"""BASELINE.md configs 1-4 measurement harness.
+
+Runs the four single-pulsar benchmark configurations from
+BASELINE.json (the driver-set targets; the reference publishes no
+numbers of its own) and prints one JSON line per config with compile
+time and steady-state wall time reported separately:
+
+  1. WLSFitter on the NGC6440E example (~62 TOAs)
+  2. GLSFitter, J1909-3744-like MSP with EFAC/EQUAD/ECORR
+  3. WidebandTOAFitter (time + DM residuals)
+  4. DownhillGLSFitter + PLRedNoise at 10k TOAs (J1713-scale)
+
+Usage: python -m benchmarks.baseline_configs  (any backend; the driver
+chip gives the TPU numbers, CPU runs give a floor).
+"""
+
+import json
+import os
+import time
+import warnings
+
+warnings.simplefilter("ignore")
+
+import numpy as np
+
+MSP_PAR = """
+PSR J1909-BENCH
+RAJ 19:09:47.43
+DECJ -37:44:14.5
+F0 339.31568729 1
+F1 -1.615e-15 1
+PEPOCH 55500
+POSEPOCH 55500
+DM 10.39 1
+BINARY ELL1
+PB 1.533449 1
+A1 1.89799 1
+TASC 55001.0 1
+EPS1 2e-8 1
+EPS2 -8e-8 1
+M2 0.21
+SINI 0.998
+EFAC -f L-wide 1.1
+EQUAD -f L-wide 0.3
+ECORR -f L-wide 0.7
+"""
+
+J1713_PAR = """
+PSR J1713-BENCH
+RAJ 17:13:49.53
+DECJ 07:47:37.5
+F0 218.81184 1
+F1 -4.08e-16 1
+PEPOCH 55000
+DM 15.99 1
+RNAMP 3e-14
+RNIDX -3.8
+TNREDC 30
+EFAC -f L-wide 1.05
+EQUAD -f L-wide 0.2
+"""
+
+
+def _timed(fit_call):
+    import jax
+
+    t0 = time.time()
+    chi2 = fit_call()
+    jax.block_until_ready(chi2) if hasattr(chi2, "block_until_ready") else None
+    compile_s = time.time() - t0
+    runs = 3
+    t0 = time.time()
+    for _ in range(runs):
+        chi2 = fit_call()
+    steady_s = (time.time() - t0) / runs
+    return compile_s, steady_s, float(chi2)
+
+
+def _emit(name, n_toas, compile_s, steady_s, chi2, extra=None):
+    import jax
+
+    out = {"config": name, "n_toas": n_toas,
+           "compile_s": round(compile_s, 2),
+           "steady_fit_s": round(steady_s, 4),
+           "toas_per_sec": round(n_toas / steady_s, 1),
+           "chi2": round(chi2, 2),
+           "platform": jax.devices()[0].platform}
+    if extra:
+        out.update(extra)
+    print(json.dumps(out), flush=True)
+    return out
+
+
+def _clustered(model, n_toa, span=(53000, 57000), per_epoch=4, seed=0,
+               flag=True):
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    rng = np.random.default_rng(seed)
+    n_epochs = max(1, n_toa // per_epoch)
+    days = np.sort(rng.uniform(*span, n_epochs))
+    mjds = np.concatenate(
+        [d + np.arange(per_epoch) * 0.5 / 86400.0 for d in days])[:n_toa]
+    freqs = np.where(np.arange(len(mjds)) % 2, 1400.0, 800.0)
+    t = make_fake_toas_fromMJDs(mjds, model, error_us=1.0, freq_mhz=freqs,
+                                obs="gbt", add_noise=True, seed=seed,
+                                iterations=1)
+    if flag:
+        for f in t.flags:
+            f["f"] = "L-wide"
+    return t
+
+
+def config1_ngc6440e():
+    from pint_tpu import config
+    from pint_tpu.fitter import WLSFitter
+    from pint_tpu.models import get_model_and_toas
+
+    m, t = get_model_and_toas(config.examplefile("NGC6440E.par"),
+                              config.examplefile("NGC6440E.tim"),
+                              usepickle=False)
+    c, s, chi2 = _timed(lambda: WLSFitter(t, m).fit_toas(maxiter=2))
+    return _emit("1_NGC6440E_WLS", len(t), c, s, chi2)
+
+
+def config2_gls_msp():
+    from pint_tpu.fitter import GLSFitter
+    from pint_tpu.models import get_model
+
+    m = get_model(MSP_PAR)
+    t = _clustered(m, 2000, seed=2)
+    c, s, chi2 = _timed(lambda: GLSFitter(t, m).fit_toas(maxiter=2))
+    return _emit("2_J1909_GLS_ecorr", len(t), c, s, chi2)
+
+
+def config3_wideband():
+    from pint_tpu.fitter import WidebandTOAFitter
+    from pint_tpu.models import get_model
+
+    m = get_model(MSP_PAR.replace("ECORR -f L-wide 0.7\n", ""))
+    t = _clustered(m, 1000, seed=3)
+    rng = np.random.default_rng(3)
+    for f in t.flags:
+        f["pp_dm"] = f"{10.39 + rng.standard_normal() * 1e-4:.8f}"
+        f["pp_dme"] = "1e-4"
+    c, s, chi2 = _timed(lambda: WidebandTOAFitter(t, m).fit_toas(maxiter=2))
+    return _emit("3_wideband_time+DM", len(t), c, s, chi2)
+
+
+def config4_downhill_gls_10k():
+    from pint_tpu.fitter import DownhillGLSFitter
+    from pint_tpu.models import get_model
+
+    m = get_model(J1713_PAR)
+    t = _clustered(m, 10000, seed=4)
+    c, s, chi2 = _timed(
+        lambda: DownhillGLSFitter(t, m).fit_toas(maxiter=4))
+    return _emit("4_J1713_downhillGLS_rednoise_10k", len(t), c, s, chi2)
+
+
+def main():
+    results = []
+    for fn in (config1_ngc6440e, config2_gls_msp, config3_wideband,
+               config4_downhill_gls_10k):
+        results.append(fn())
+    out = os.path.join(os.path.dirname(__file__), "baseline_results.json")
+    with open(out, "w") as f:
+        json.dump(results, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -203,8 +203,15 @@ def _derive_shapiro_reparam(comp, vals, current, output):
     if ms is None:
         return
     m2, sini, um, usini = ms
-    shap_frozen = vals.get("SINI", vals.get("SHAPMAX",
-                           vals.get("H3", (None, None, True))))[2]
+    # frozen state follows whichever Shapiro parameter was actually SET
+    # in the source model (DDS/ELL1H inherit an unset SINI whose default
+    # frozen=True would otherwise always win)
+    shap_frozen = True
+    for cand in ("SINI", "SHAPMAX", "H3"):
+        v = vals.get(cand)
+        if v is not None and v[0] is not None:
+            shap_frozen = v[2]
+            break
     if output == "DDS":
         if sini is not None and sini < 1.0:
             comp.SHAPMAX.value = float(-np.log(1.0 - sini))

@@ -20,6 +20,41 @@ class ConvergenceFailure(RuntimeError):
     pass
 
 
+class MaxiterReached(ConvergenceFailure):
+    """Downhill loop hit maxiter before the tolerance was met
+    (reference: fitter.py::MaxiterReached). Carries the best state so
+    callers can keep it."""
+
+    def __init__(self, iterations, chi2):
+        super().__init__(
+            f"no convergence after {iterations} iterations (chi2={chi2:.6g})")
+        self.iterations = iterations
+        self.chi2 = chi2
+
+
+class StepProblem(ConvergenceFailure):
+    """A fit step failed to improve chi2 even after step halving
+    (reference: fitter.py::StepProblem)."""
+
+
+class CorrelatedErrors(ValueError):
+    """A fitter that assumes uncorrelated errors was given a model with
+    correlated-noise components (reference: fitter.py::CorrelatedErrors
+    — raised by WLS-family fitters when ECORR/red-noise is present)."""
+
+    def __init__(self, components):
+        names = [type(c).__name__ for c in components]
+        super().__init__(
+            f"model has correlated-noise components {names}; use a GLS "
+            "fitter (GLSFitter/DownhillGLSFitter) instead")
+        self.noise_components = names
+
+
+def _correlated_noise_components(model):
+    return [c for c in model.components.values()
+            if getattr(c, "basis_weight", None) is not None]
+
+
 class Fitter:
     """(reference: fitter.py::Fitter base)."""
 
@@ -129,6 +164,34 @@ def cov_from_normalized(covn, norm) -> np.ndarray:
     return covn / np.outer(norm, norm)
 
 
+# eigh backward-error floor for the GLS eigenvalue threshold: a
+# symmetric eigensolver perturbs eigenvalues by O(||A|| * n * eps)
+# (Golub & Van Loan sec. 8.1); with n <= ~500 columns n*eps ~ 1e-13,
+# and 3e-14 sits at the small-n end of that bound. Relative cuts below
+# it would "keep" pure-noise eigenvalues of exactly-degenerate
+# directions and inject O(1/noise) garbage into dx. Anchored by
+# tests/test_gls_threshold.py. Single home for both the single-pulsar
+# GLSFitter and the batched parallel/pta.py GLS path.
+GLS_EIG_FLOOR = 3e-14
+
+
+def gls_eigh_solve(A, b, threshold=1e-12):
+    """Thresholded eigendecomposition solve of normal equations
+    A dxn = b: returns (dxn, covn) with degenerate directions (relative
+    eigenvalue below max(threshold^2, GLS_EIG_FLOOR)) given zero update
+    — the eigenvalues of A are squared singular values, so threshold^2
+    matches wls_step's s > threshold*smax cut."""
+    import jax.numpy as jnp
+
+    evals, evecs = jnp.linalg.eigh(A)
+    cut = max(threshold**2, GLS_EIG_FLOOR)
+    good = evals > cut * jnp.max(evals)
+    einv = jnp.where(good, 1.0 / jnp.where(good, evals, 1.0), 0.0)
+    dxn = evecs @ (einv * (evecs.T @ b))
+    covn = evecs @ (einv[:, None] * evecs.T)
+    return dxn, covn
+
+
 def wls_step(Mw, rw, threshold=1e-12):
     """Column-normalized whitened SVD solve: returns
     (dx, cov_normalized, norm).
@@ -162,6 +225,9 @@ class WLSFitter(Fitter):
     """
 
     def fit_toas(self, maxiter=2, threshold=1e-12):
+        corr = _correlated_noise_components(self.model)
+        if corr:
+            raise CorrelatedErrors(corr)
         prepared = self.model.prepare(self.toas)
         resid_fn = prepared.residual_vector_fn(track_mode=self._track_mode())
         dm_fn, labels = prepared.designmatrix_fn()
@@ -189,9 +255,13 @@ class WLSFitter(Fitter):
 class DownhillWLSFitter(WLSFitter):
     """Step-halving line search on chi2 (reference: fitter.py::DownhillWLSFitter)."""
 
-    def fit_toas(self, maxiter=20, threshold=1e-12, min_lambda=1e-3, tol=1e-10):
+    def fit_toas(self, maxiter=20, threshold=1e-12, min_lambda=1e-3, tol=1e-10,
+                 raise_maxiter=False):
         import jax.numpy as jnp
 
+        corr = _correlated_noise_components(self.model)
+        if corr:
+            raise CorrelatedErrors(corr)
         prepared = self.model.prepare(self.toas)
         resid_fn = prepared.residual_vector_fn(track_mode=self._track_mode())
         dm_fn, labels = prepared.designmatrix_fn()
@@ -226,6 +296,13 @@ class DownhillWLSFitter(WLSFitter):
                 lam *= 0.5
             if lam < min_lambda or not improved:
                 break
+        else:
+            # every iteration still improved: maxiter exhausted without
+            # reaching tol (reference: fitter.py::MaxiterReached). Best
+            # state is kept on the model either way.
+            if raise_maxiter:
+                self._sync_model_from_vector(prepared, x)
+                raise MaxiterReached(maxiter, best_chi2)
         self._sync_model_from_vector(prepared, x)
         if covn is not None:
             cov_all = cov_from_normalized(covn, norm)
@@ -309,9 +386,17 @@ class GLSFitter(Fitter):
             # (reference: fitter.py::GLSFitter cholesky-with-SVD-fallback)
             evals, evecs = jnp.linalg.eigh(A)
             # eigenvalues of the normal matrix are squared singular values,
-            # so threshold**2 matches wls_step's s > threshold*smax cut —
-            # clamped at the f64 eigh noise floor so exactly-degenerate
-            # directions (noise eigenvalues ~eps*max) are still dropped
+            # so threshold**2 matches wls_step's s > threshold*smax cut.
+            # The floor is the eigh backward-error bound: a symmetric
+            # eigensolver perturbs eigenvalues by O(||A|| * n * eps)
+            # (Golub & Van Loan sec. 8.1), so an exactly-degenerate
+            # direction surfaces as a noise eigenvalue up to ~n*eps*max.
+            # With n <= ~500 columns, n*eps ~ 1e-13; 3e-14 sits at the
+            # small-n end of that bound — relative cuts below it would
+            # "keep" pure-noise directions and inject O(1/noise) garbage
+            # into dx. Verified empirically in
+            # tests/test_gls_threshold.py (degenerate dropped at 3e-14,
+            # real eigenvalues down to ~1e-9 retained).
             cut = max(threshold**2, 3e-14)
             good = evals > cut * jnp.max(evals)
             einv = jnp.where(good, 1.0 / jnp.where(good, evals, 1.0), 0.0)
@@ -383,38 +468,219 @@ class WidebandTOAFitter(GLSFitter):
                  for n in names]
         return DesignMatrix(M_dm, "dm", "pc cm^-3", names, units)
 
-    def fit_toas(self, maxiter=2, threshold=1e-12):
+    def _wideband_system(self):
+        """(prepared, combined DesignMatrix, r, sigma, noff, x0) for the
+        current model state."""
         import jax.numpy as jnp
 
         from .pint_matrix import (DesignMatrix,
                                   combine_design_matrices_by_quantity)
 
-        for _ in range(maxiter):
-            prepared = self.model.prepare(self.toas)
-            wb = WidebandTOAResiduals(self.toas, self.model, prepared=prepared)
-            valid = wb.dm.valid
-            r_t = wb.toa.calc_time_resids()
-            r_dm = jnp.asarray(wb.dm.calc_dm_resids()[valid])
-            sigma_t = prepared.scaled_sigma_us() * 1e-6
-            sigma_dm = jnp.asarray(wb.dm.dm_error[valid])
+        # the wideband solve is plain whitened WLS on [time; DM] rows:
+        # correlated-noise bases are not (yet) stacked into it, so
+        # refuse rather than silently ignore ECORR/red noise
+        corr = _correlated_noise_components(self.model)
+        if corr:
+            raise CorrelatedErrors(corr)
+        prepared = self.model.prepare(self.toas)
+        wb = WidebandTOAResiduals(self.toas, self.model, prepared=prepared)
+        valid = wb.dm.valid
+        r_t = wb.toa.calc_time_resids()
+        r_dm = jnp.asarray(wb.dm.calc_dm_resids()[valid])
+        sigma_t = prepared.scaled_sigma_us() * 1e-6
+        sigma_dm = jnp.asarray(wb.dm.dm_error[valid])
+        dm_time = DesignMatrix.from_prepared(prepared, self.model)
+        dm_dm = self._dm_designmatrix(prepared, valid)
+        combined = combine_design_matrices_by_quantity([dm_time, dm_dm])
+        self.design_matrix = combined
+        r = jnp.concatenate([r_t, r_dm])
+        sigma = jnp.concatenate([sigma_t, sigma_dm])
+        noff = _n_offset(combined.param_names)
+        return (prepared, combined, r, sigma, noff,
+                prepared.vector_from_params())
 
-            dm_time = DesignMatrix.from_prepared(prepared, self.model)
-            dm_dm = self._dm_designmatrix(prepared, valid)
-            combined = combine_design_matrices_by_quantity([dm_time, dm_dm])
-            self.design_matrix = combined
-            noff = _n_offset(combined.param_names)
-            M = combined.matrix
-            r = jnp.concatenate([r_t, r_dm])
-            sigma = jnp.concatenate([sigma_t, sigma_dm])
-            Mw = M / sigma[:, None]
+    def _wideband_chi2(self):
+        wb = WidebandTOAResiduals(self.toas, self.model)
+        return float(wb.chi2)
+
+    def fit_toas(self, maxiter=2, threshold=1e-12):
+        for _ in range(maxiter):
+            prepared, combined, r, sigma, noff, x0 = self._wideband_system()
+            Mw = combined.matrix / sigma[:, None]
             rw = r / sigma
             dx_all, covn, norm = wls_step(Mw, rw, threshold)
-            x0 = prepared.vector_from_params()
             self._sync_model_from_vector(prepared, x0 - dx_all[noff:])
             cov_all = cov_from_normalized(covn, norm)
             self._set_uncertainties(prepared, cov_all[noff:, noff:])
         self.resids = WidebandTOAResiduals(self.toas, self.model)
         self.converged = True
+        return self.resids.chi2
+
+
+class WidebandDownhillFitter(WidebandTOAFitter):
+    """Step-halving wideband fit
+    (reference: fitter.py::WidebandDownhillFitter)."""
+
+    def _wideband_chi2_fn(self, prepared):
+        """Jit-backed chi2(x) over [time; DM] rows for line searches —
+        no host re-prepare per probe (the probes reuse the prepared
+        residual and DM-model functions)."""
+        import jax
+        import jax.numpy as jnp
+
+        wb = WidebandTOAResiduals(self.toas, self.model, prepared=prepared)
+        valid = wb.dm.valid
+        idx = jnp.asarray(np.flatnonzero(valid))
+        dm_meas = jnp.asarray(np.asarray(wb.dm.dm_observed)[valid])
+        sigma_dm = jnp.asarray(np.asarray(wb.dm.dm_error)[valid])
+        resid_fn = prepared.residual_vector_fn(track_mode=self._track_mode())
+
+        def dm_model(x):
+            p = prepared.params_with_vector(x)
+            comp = self.model.components["DispersionDM"]
+            dm = comp.dm_value(p, prepared.prep)
+            if "DMX" in p:
+                dm = dm + p["DMX"] @ prepared.prep["dmx_masks"]
+            return dm[idx]
+
+        @jax.jit
+        def chi2_of(x):
+            r_t = resid_fn(x)
+            sig_t = prepared.scaled_sigma_us(
+                prepared.params_with_vector(x)) * 1e-6
+            c_t = jnp.sum(jnp.square(r_t / sig_t))
+            c_dm = jnp.sum(jnp.square((dm_meas - dm_model(x)) / sigma_dm))
+            return c_t + c_dm
+
+        return chi2_of
+
+    def fit_toas(self, maxiter=15, threshold=1e-12, min_lambda=1e-3,
+                 tol=1e-9, raise_maxiter=False):
+        best_chi2 = None
+        for it in range(maxiter):
+            prepared, combined, r, sigma, noff, x0 = self._wideband_system()
+            chi2_of = self._wideband_chi2_fn(prepared)
+            if best_chi2 is None:
+                best_chi2 = float(chi2_of(x0))
+            Mw = combined.matrix / sigma[:, None]
+            rw = r / sigma
+            dx_all, covn, norm = wls_step(Mw, rw, threshold)
+            dx = dx_all[noff:]
+            lam = 1.0
+            improved = False
+            x_new = x0
+            while lam >= min_lambda:
+                chi2 = float(chi2_of(x0 - lam * dx))
+                if chi2 <= best_chi2 + 1e-12:
+                    improved = chi2 < best_chi2 - tol * max(1.0, best_chi2)
+                    best_chi2 = min(best_chi2, chi2)
+                    x_new = x0 - lam * dx
+                    break
+                lam *= 0.5
+            self._sync_model_from_vector(prepared, x_new)
+            cov_all = cov_from_normalized(covn, norm)
+            self._set_uncertainties(prepared, cov_all[noff:, noff:])
+            if lam < min_lambda or not improved:
+                break
+        else:
+            if raise_maxiter:
+                raise MaxiterReached(maxiter, best_chi2)
+        self.resids = WidebandTOAResiduals(self.toas, self.model)
+        self.converged = True
+        return self.resids.chi2
+
+
+class WidebandLMFitter(WidebandTOAFitter):
+    """Levenberg-Marquardt wideband fit
+    (reference: fitter.py::WidebandLMFitter): the normalized normal
+    matrix is damped by lm_lambda * diag, with the damping adapted on
+    chi2 acceptance/rejection."""
+
+    def fit_toas(self, maxiter=20, threshold=1e-12, lm_lambda0=1e-3,
+                 tol=1e-9):
+        import jax.numpy as jnp
+
+        lm = lm_lambda0
+        best_chi2 = self._wideband_chi2()
+        for _ in range(maxiter):
+            prepared, combined, r, sigma, noff, x0 = self._wideband_system()
+            Mw = combined.matrix / sigma[:, None]
+            rw = r / sigma
+            norm = column_norms(Mw)
+            Mn = Mw / norm
+            A = Mn.T @ Mn
+            b = Mn.T @ rw
+            A_damped = A + lm * jnp.diag(jnp.diag(A))
+            dxn = jnp.linalg.solve(A_damped, b)
+            dx = (dxn / norm)[noff:]
+            self._sync_model_from_vector(prepared, x0 - dx)
+            chi2 = self._wideband_chi2()
+            if chi2 <= best_chi2 + 1e-12:
+                accepted = chi2 < best_chi2 - tol * max(1.0, best_chi2)
+                best_chi2 = min(best_chi2, chi2)
+                lm = max(lm / 9.0, 1e-12)
+                self._lm_cov = (A, norm)
+                if not accepted:
+                    break
+            else:
+                self._sync_model_from_vector(prepared, x0)
+                lm *= 11.0
+                if lm > 1e6:
+                    break
+        # covariance from the undamped normal matrix at the solution
+        if getattr(self, "_lm_cov", None) is not None:
+            A, norm = self._lm_cov
+            covn = np.linalg.pinv(np.asarray(A))
+            cov_all = cov_from_normalized(covn, np.asarray(norm))
+            prepared = self.model.prepare(self.toas)
+            noff = len(cov_all) - len(prepared.free_param_map())
+            self._set_uncertainties(prepared, cov_all[noff:, noff:])
+        self.resids = WidebandTOAResiduals(self.toas, self.model)
+        self.converged = True
+        return self.resids.chi2
+
+
+class PowellFitter(Fitter):
+    """Derivative-free Powell minimization of chi2
+    (reference: fitter.py::PowellFitter, scipy.optimize backend).
+
+    Useful for pathological likelihoods where the linearized step
+    fails; the objective is the jitted whitened chi2 with scipy's
+    Powell driving it from the host.
+    """
+
+    def fit_toas(self, maxiter=2000, xtol=1e-8):
+        import jax.numpy as jnp
+        from scipy.optimize import minimize
+
+        prepared = self.model.prepare(self.toas)
+        resid_fn = prepared.residual_vector_fn(track_mode=self._track_mode())
+        dm_fn, labels = prepared.designmatrix_fn()
+        noff = _n_offset(labels)
+        x0 = np.asarray(prepared.vector_from_params())
+        # scale each direction by its rough 1-sigma from the whitened
+        # design matrix, so unit steps in z-space move chi2 by O(1)
+        # (magnitude scaling leaves Powell's line searches orders of
+        # magnitude away from the chi2 valley for spin parameters)
+        sigma_s0 = np.asarray(
+            prepared.scaled_sigma_us(prepared.params_with_vector(
+                jnp.asarray(x0)))) * 1e-6
+        M = np.asarray(dm_fn(jnp.asarray(x0)))[:, noff:]
+        f0 = float(prepared.params0["F"][0])
+        colnorm = np.linalg.norm((M / f0) / sigma_s0[:, None], axis=0)
+        scale = 1.0 / np.where(colnorm > 0, colnorm, 1.0)
+
+        def chi2_of(z):
+            x = jnp.asarray(x0 + z * scale)
+            r = resid_fn(x)
+            sig = prepared.scaled_sigma_us(prepared.params_with_vector(x)) * 1e-6
+            return float(jnp.sum(jnp.square(r / sig)))
+
+        res = minimize(chi2_of, np.zeros_like(x0), method="Powell",
+                       options={"maxiter": maxiter, "xtol": xtol})
+        self._sync_model_from_vector(prepared, x0 + res.x * scale)
+        self.resids = Residuals(self.toas, self.model)
+        self.converged = bool(res.success)
         return self.resids.chi2
 
 
@@ -425,7 +691,7 @@ def auto_fitter(toas, model):
     wideband = (toas._flags is not None
                 and any("pp_dm" in f for f in toas._flags))
     if wideband:
-        return WidebandTOAFitter(toas, model)
+        return WidebandDownhillFitter(toas, model)
     if has_noise:
         return DownhillGLSFitter(toas, model)
     return DownhillWLSFitter(toas, model)

@@ -75,7 +75,7 @@ def get_model(parfile, allow_name_mixing=False) -> TimingModel:
         model.add_component(AstrometryEcliptic())
     if "DM" in keys or "DM1" in keys:
         model.add_component(DispersionDM())
-    if any(k.startswith("DMX_") for k in keys):
+    if "DMX" in keys or any(k.startswith("DMX_") for k in keys):
         model.add_component(DispersionDMX())
     model.add_component(SolarSystemShapiro())
     if "NE_SW" in keys or "SWM" in keys:

@@ -99,7 +99,27 @@ class DispersionDMX(DelayComponent):
 
     def __init__(self):
         super().__init__()
+        from .parameter import floatParameter
+
+        # bare "DMX <days>" par line: legacy tempo DMX epoch-bin width;
+        # carried for round-trip fidelity, not used in the delay
+        # (reference: dispersion_model.py DMX parameter)
+        self.add_param(floatParameter(
+            "DMX", units="d",
+            description="legacy DMX bin width marker (unused in delay)"))
         self.dmx_ids: list[int] = []
+
+    def validate(self):
+        super().validate()
+        # DMX (the bare bin-width marker) has no device slot; a fit
+        # flag on it would crash prepare() with a KeyError — freeze it
+        # loudly instead
+        if not self.DMX.frozen:
+            import warnings
+
+            warnings.warn("bare DMX is a legacy bin-width marker, not a "
+                          "fittable parameter; freezing it")
+            self.DMX.frozen = True
 
     def add_dmx_range(self, index, mjd_start, mjd_end, value=0.0, frozen=True):
         from .parameter import floatParameter

@@ -116,9 +116,14 @@ def solar_wind_geometry_p(sun_ls, n_hat, p):
     sin_t = jnp.clip(jnp.sin(theta), 1e-6, None)
     b_ls = r_ls * sin_t
     z0_ls = r_ls * cos_t
-    p = jnp.broadcast_to(jnp.asarray(p), jnp.shape(b_ls))
-    F_inf = _cospow_integral(jnp.full_like(b_ls, 0.5 * jnp.pi), p)
-    F_z = _cospow_integral(jnp.arctan(z0_ls / b_ls), p)
+    # p and b_ls broadcast naturally (e.g. per-window p (k,1) against
+    # per-TOA b_ls (1,n) -> (k,n)); do NOT force p to b_ls's shape —
+    # that is an invalid broadcast for k >= 2 windows
+    p = jnp.asarray(p)
+    ones = jnp.ones(jnp.broadcast_shapes(jnp.shape(p), jnp.shape(b_ls)))
+    F_inf = _cospow_integral(ones * (0.5 * jnp.pi), p * ones)
+    F_z = _cospow_integral(jnp.arctan(z0_ls / b_ls) * jnp.ones_like(ones),
+                           p * ones)
     I_ls = AU_LS**p / b_ls ** (p - 1.0) * (F_inf + F_z)
     return I_ls * (ONE_AU_PC / AU_LS)  # ls -> pc
 

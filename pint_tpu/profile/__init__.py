@@ -6,3 +6,40 @@ JAX implementation replaces the three backends.)
 """
 
 from .fftfit import fftfit_basic, fftfit_full, FFTFITResult  # noqa: F401
+
+
+def fftfit_full_aarchiba(template, profile, **kw):
+    """Compat shim matching the reference's aarchiba backend surface
+    (reference: profile/fftfit_aarchiba.py::fftfit_full)."""
+    return fftfit_full(template, profile, **kw)
+
+
+def fftfit_basic_aarchiba(template, profile, **kw):
+    return fftfit_basic(template, profile, **kw)
+
+
+def fftfit_full_nustar(template, profile, **kw):
+    """nustar-backend shim: upstream returns (shift, eshift, snr, esnr);
+    kept callable with the same positional meaning."""
+    r = fftfit_full(template, profile, **kw)
+    return r.shift, r.uncertainty, r.snr, 0.0
+
+
+def fftfit_full_presto(template, profile, **kw):
+    """presto-backend shim: upstream returns shift in BINS; convert."""
+    import numpy as _np
+
+    r = fftfit_full(template, profile, **kw)
+    n = len(_np.asarray(profile))
+    return r.shift * n, r.uncertainty * n
+
+
+def fftfit_cprof(profile):
+    """presto cprof equivalent: (c, amp, phase) harmonic decomposition
+    of a profile (reference: profile/__init__.py::fftfit_cprof)."""
+    import numpy as _np
+
+    p = _np.asarray(profile, float)
+    spec = _np.fft.rfft(p)
+    return p.sum(), _np.abs(spec[1:]), _np.angle(spec[1:])
+

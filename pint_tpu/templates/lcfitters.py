@@ -75,12 +75,37 @@ class LCFitter:
             v = v.at[:n_norm].set(norms)
             i = n_norm
             for pr in self.template.primitives:
-                v = v.at[i].set(jnp.maximum(v[i], 1e-4))  # width param
+                # every width-like param (all but the trailing loc) must
+                # stay positive — e.g. LCSkewGaussian carries two widths
+                for kk in range(pr.n_params - 1):
+                    v = v.at[i + kk].set(jnp.maximum(v[i + kk], 1e-4))
                 v = v.at[i + pr.n_params - 1].set(v[i + pr.n_params - 1] % 1.0)
                 i += pr.n_params
         self.template.set_parameters(np.asarray(v))
         self.ll = -float(val(v))
         return self.ll
+
+    def param_uncertainties(self):
+        """1-sigma uncertainties of the fitted template parameters from
+        the inverse Hessian of the log-likelihood (reference:
+        lcfitters.py hess_errors)."""
+        import jax
+        import jax.numpy as jnp
+
+        from . import photon_loglike
+
+        fn, vec0 = self.template.gradient_ready()
+        ph = jnp.asarray(self.phases)
+        w = None if self.weights is None else jnp.asarray(self.weights)
+
+        def negll(v):
+            return -photon_loglike(fn(v, ph), w)
+
+        H = np.asarray(jax.hessian(negll)(jnp.asarray(vec0)))
+        # pseudo-inverse: parameters at projection bounds can be flat
+        cov = np.linalg.pinv(H)
+        var = np.clip(np.diag(cov), 0.0, None)
+        return np.sqrt(var)
 
     def phase_shift_uncertainty(self):
         """Cramer-Rao sigma of an overall phase shift, from the Fisher

@@ -59,6 +59,45 @@ class LCGaussian(LCPrimitive):
             sigma * math.sqrt(2 * math.pi))
 
 
+class LCLorentzian(LCPrimitive):
+    """Wrapped Lorentzian (reference: lcprimitives.py::LCLorentzian):
+    p = [gamma (HWHM), loc]. The infinite wrap sum has the closed form
+    sum_k gamma/pi/((x+k)^2+gamma^2) = sinh(2 pi gamma) /
+    (cosh(2 pi gamma) - cos(2 pi x))  (normalized on [0,1))."""
+
+    def __call__(self, phases, p=None):
+        import jax.numpy as jnp
+
+        p = self.p if p is None else p
+        gamma, loc = p[0], p[1]
+        x = 2 * jnp.pi * (jnp.asarray(phases) - loc)
+        g = 2 * jnp.pi * gamma
+        return jnp.sinh(g) / (jnp.cosh(g) - jnp.cos(x))
+
+
+class LCSkewGaussian(LCPrimitive):
+    """Two-sided (skew) wrapped Gaussian
+    (reference: lcprimitives.py::LCGaussian2): p = [sigma1, sigma2,
+    loc] — width sigma1 leading (phi < loc), sigma2 trailing;
+    normalized density with continuous peak."""
+
+    n_params = 3
+
+    def __call__(self, phases, p=None):
+        import jax.numpy as jnp
+
+        p = self.p if p is None else p
+        s1, s2, loc = p[0], p[1], p[2]
+        ph = jnp.asarray(phases)
+        k = jnp.arange(-2, 3, dtype=jnp.float64)
+        d = ph[..., None] - loc + k
+        sig = jnp.where(d < 0, s1, s2)
+        dens = jnp.exp(-0.5 * (d / sig) ** 2)
+        # normalization: integral = sqrt(pi/2)(s1+s2)
+        return jnp.sum(dens, axis=-1) / (
+            math.sqrt(math.pi / 2.0) * (s1 + s2))
+
+
 class LCVonMises(LCPrimitive):
     """von Mises peak (reference: lcprimitives.py::LCVonMises):
     p = [kappa_inv, loc]; density ~ exp(kappa cos(2pi(phi-loc)))."""

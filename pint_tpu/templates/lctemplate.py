@@ -53,8 +53,12 @@ class LCTemplate:
         norms = vec[:n]
         out = (1.0 - jnp.sum(norms)) * jnp.ones_like(ph)
         i = n
-        for pr in self.primitives:
-            out = out + norms[i - n] * pr(ph, p=vec[i:i + pr.n_params])
+        # index norms by primitive number, NOT by offset into vec:
+        # norms[i - n] walked past the end for the 2nd+ primitive, and
+        # jax's clipped out-of-bounds gather silently DROPPED that
+        # norm's gradient (multi-peak fits collapsed their later peaks)
+        for j, pr in enumerate(self.primitives):
+            out = out + norms[j] * pr(ph, p=vec[i:i + pr.n_params])
             i += pr.n_params
         return out
 

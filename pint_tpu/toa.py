@@ -345,6 +345,22 @@ def _parse_princeton_line(line):
     return TOA(day, sec, err, freq, obs_code.lower(), {})
 
 
+def _parse_parkes_line(line):
+    """Parkes/Jodrell fixed-column format (reference: toa.py parkes
+    branch of _parse_TOA_line): col 0 blank, freq cols 25-34,
+    MJD cols 34-55, phase offset cols 55-63, error cols 63-71,
+    observatory code col 79."""
+    freq = float(line[25:34])
+    day, sec = parse_mjd_string(line[34:55].strip())
+    phase_off = line[55:63].strip()
+    err = float(line[63:71])
+    obs_code = line[79] if len(line) > 79 else line.rstrip()[-1]
+    flags = {}
+    if phase_off and float(phase_off) != 0.0:
+        flags["padd"] = phase_off  # phase offset in periods (tempo PADD)
+    return TOA(day, sec, err, freq, obs_code.lower(), flags)
+
+
 def read_tim_file(path: str, _depth=0) -> tuple[list[TOA], list[str]]:
     """Parse a tim file into TOA records + commands seen.
 
@@ -361,6 +377,8 @@ def read_tim_file(path: str, _depth=0) -> tuple[list[TOA], list[str]]:
     time_offset = 0.0
     efac = 1.0
     equad_us = 0.0
+    emin_us = 0.0
+    emax_us = np.inf
     jump_level = 0
     phase_offset = 0
     with open(path) as f:
@@ -388,6 +406,14 @@ def read_tim_file(path: str, _depth=0) -> tuple[list[TOA], list[str]]:
                     efac = float(parts[1])
                 elif head == "EQUAD":
                     equad_us = float(parts[1])
+                elif head == "EMIN":
+                    emin_us = float(parts[1])
+                elif head == "EMAX":
+                    emax_us = float(parts[1]) if float(parts[1]) > 0 else np.inf
+                elif head == "MODE":
+                    # MODE 1 = weighted fit (the default here); MODE 0
+                    # (unweighted) is recorded for callers via commands
+                    pass
                 elif head == "SKIP":
                     skipping = True
                 elif head == "NOSKIP":
@@ -404,6 +430,9 @@ def read_tim_file(path: str, _depth=0) -> tuple[list[TOA], list[str]]:
             try:
                 if fmt == "tempo2":
                     toa = _parse_tempo2_line(parts)
+                elif line[:1] == " " and len(line.rstrip()) >= 70:
+                    # parkes format: leading blank, obs code col 79
+                    toa = _parse_parkes_line(line)
                 else:
                     toa = _parse_princeton_line(line)
             except (ValueError, IndexError) as e:
@@ -418,6 +447,10 @@ def read_tim_file(path: str, _depth=0) -> tuple[list[TOA], list[str]]:
                 toa.error_us *= efac
             if equad_us:
                 toa.error_us = float(np.hypot(toa.error_us, equad_us))
+            # EMIN/EMAX: drop TOAs outside the (scaled) error window
+            # (reference: toa.py EMIN/EMAX command handling)
+            if toa.error_us < emin_us or toa.error_us > emax_us:
+                continue
             if jump_level:
                 toa.flags["tim_jump"] = "1"
             if phase_offset:

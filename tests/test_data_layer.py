@@ -126,3 +126,100 @@ def test_toas_summary_and_select(tmp_path):
     sub = t.mask(t.freq_mhz > 1000)
     assert len(sub) == 2
     assert all(o == "gbt" for o in sub.obs)
+
+
+def test_parkes_tim_format():
+    """Parkes/Jodrell fixed-column tim format round-trips through the
+    parser (reference: toa.py parkes branch)."""
+    import os
+    import tempfile
+
+    from pint_tpu.toa import read_tim_file
+
+    def parkes_line(freq, mjd_str, err, obs_code, phase_off="0.0"):
+        line = " NAME" + " " * 20
+        line = line[:25] + f"{freq:9.4f}" + f" {mjd_str:<20}"
+        line = line + f"{phase_off:>8}" + f"{err:8.3f}" + " " * 8 + obs_code
+        return line
+
+    with tempfile.NamedTemporaryFile("w", suffix=".tim", delete=False) as f:
+        f.write(parkes_line(1400.0, "55000.123456789012", 2.5, "7") + "\n")
+        f.write(parkes_line(3100.0, "55010.987654321098", 1.25, "7") + "\n")
+        path = f.name
+    try:
+        toas, cmds = read_tim_file(path)
+    finally:
+        os.unlink(path)
+    assert len(toas) == 2
+    assert toas[0].obs == "7"
+    assert toas[0].freq_mhz == 1400.0
+    assert toas[0].error_us == 2.5
+    assert toas[0].day == 55000
+    assert toas[0].sec == pytest.approx(0.123456789012 * 86400.0, abs=1e-6)
+    assert toas[1].error_us == 1.25
+
+
+def test_emin_emax_commands():
+    """EMIN/EMAX drop TOAs outside the error window
+    (reference: toa.py EMIN/EMAX handling)."""
+    import os
+    import tempfile
+
+    from pint_tpu.toa import read_tim_file
+
+    body = (
+        "FORMAT 1\n"
+        "a 1400.0 55000.1 0.5 gbt\n"
+        "b 1400.0 55001.1 2.0 gbt\n"
+        "EMIN 1.0\n"
+        "c 1400.0 55002.1 0.5 gbt\n"   # dropped: err < 1.0
+        "d 1400.0 55003.1 2.0 gbt\n"
+        "EMAX 3.0\n"
+        "e 1400.0 55004.1 5.0 gbt\n"   # dropped: err > 3.0
+        "f 1400.0 55005.1 2.5 gbt\n"
+        "EMIN 0\nEMAX 0\n"
+        "g 1400.0 55006.1 9.0 gbt\n"   # window reset
+    )
+    with tempfile.NamedTemporaryFile("w", suffix=".tim", delete=False) as f:
+        f.write(body)
+        path = f.name
+    try:
+        toas, _ = read_tim_file(path)
+    finally:
+        os.unlink(path)
+    names = [t.flags["name"] for t in toas]
+    assert names == ["a", "b", "d", "f", "g"]
+
+
+def test_bare_dmx_line_recognized():
+    """A bare 'DMX <value>' par line (legacy bin-width marker) must not
+    produce an unrecognized-line warning."""
+    import warnings as w
+
+    from pint_tpu.models import get_model
+
+    par = ("PSR TDX\nRAJ 10:00:00.0\nDECJ 10:00:00.0\nF0 100.0 1\n"
+           "PEPOCH 55000\nDM 20.0 1\nDMX 6.5\n"
+           "DMX_0001 1e-4 1\nDMXR1_0001 55000\nDMXR2_0001 55100\n")
+    with w.catch_warnings(record=True) as rec:
+        w.simplefilter("always")
+        m = get_model(par)
+    assert not any("unrecognized" in str(r.message) for r in rec)
+    assert m.DMX.value == pytest.approx(6.5)
+    assert m.unrecognized == {}
+
+
+def test_expanded_observatory_registry():
+    """~40 ground sites with tempo site-code aliases resolve."""
+    from pint_tpu.observatory import get_observatory, list_observatories
+
+    names = list_observatories()
+    assert len(names) >= 40
+    for alias, expect in [("mwa", "mwa"), ("most", "most"),
+                          ("jbmk2", "jodrell_mk2"), ("h1", "lho"),
+                          ("tm65", "tianma65"), ("o8", "onsala"),
+                          ("tr", "torun"), ("pks", "parkes"),
+                          ("aro", "algonquin"), ("mc", "medicina")]:
+        o = get_observatory(alias)
+        assert o.name == expect, (alias, o.name)
+        assert np.linalg.norm(o.itrf_xyz) > 6.3e6  # on the Earth

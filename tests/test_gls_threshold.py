@@ -1,0 +1,91 @@
+"""GLS eigenvalue-threshold defense (VERDICT round-1 weak item 7).
+
+The GLS normal-equation solver drops eigenvalue directions below
+cut = max(threshold^2, 3e-14) relative to the largest. These tests pin
+both sides of that floor: (a) an exactly-degenerate direction (duplicate
+design column) must be dropped — its eigenvalue appears at the eigh
+noise floor ~n*eps; (b) a genuinely small but real direction several
+decades above the floor must be retained and fitted.
+"""
+
+import copy
+import warnings
+
+import numpy as np
+import pytest
+
+warnings.simplefilter("ignore")
+
+from pint_tpu.models import get_model
+from pint_tpu.fitter import GLSFitter, WLSFitter
+from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+PAR = """
+PSR TESTTH
+RAJ 04:37:00.0
+DECJ -47:15:00.0
+F0 173.7 1
+F1 -1.7e-15 1
+PEPOCH 55500
+DM 2.64 1
+"""
+
+
+def _toas(m, n=60, seed=6):
+    mjds = np.linspace(55000, 56000, n)
+    freqs = np.where(np.arange(n) % 2, 1400.0, 800.0)
+    return make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=freqs,
+                                   obs="gbt", add_noise=True, seed=seed)
+
+
+def test_exact_degeneracy_dropped():
+    """Two JUMPs covering the SAME TOA subset are exactly degenerate
+    (duplicate design columns): the fit must return finite parameters
+    with zero update along the degenerate difference direction instead
+    of exploding on a noise eigenvalue."""
+    par = PAR + ("JUMP -f L-wide 0.0 1\nJUMP -f L-wide 0.0 1\n")
+    m = get_model(par)
+    t = _toas(m)
+    for i, f in enumerate(t.flags):
+        f["f"] = "L-wide" if i % 2 else "S-wide"
+    f = GLSFitter(t, copy.deepcopy(m))
+    chi2 = f.fit_toas(maxiter=2)
+    assert np.isfinite(chi2)
+    j1 = f.model.JUMP1.value
+    j2 = f.model.JUMP2.value
+    assert np.isfinite(j1) and np.isfinite(j2)
+    # the degenerate direction (j1 - j2) received no update; the
+    # physical sum stays bounded by the per-TOA error scale
+    assert abs(j1 - j2) < 1e-9
+    assert abs(j1 + j2) < 5e-5
+
+
+def test_small_but_real_direction_retained():
+    """F1's normalized eigenvalue sits decades below the leading ones
+    but far above the 3e-14 floor: it must be fitted, not dropped."""
+    m = get_model(PAR)
+    t = _toas(m, n=80)
+    mp = copy.deepcopy(m)
+    mp.F1.value = m.F1.value - 3e-18  # small injected F1 offset
+    f = GLSFitter(t, mp)
+    f.fit_toas(maxiter=2)
+    # recovered back to truth within uncertainty (if the F1 direction
+    # were dropped, the offset would persist exactly)
+    assert abs(f.model.F1.value - m.F1.value) < max(
+        3 * (f.model.F1.uncertainty or 0), 1e-18)
+
+
+def test_gls_matches_wls_without_noise():
+    """With no correlated noise, GLS and WLS must agree (the threshold
+    machinery must not perturb a well-conditioned fit)."""
+    m = get_model(PAR)
+    t = _toas(m, n=70, seed=8)
+    fg = GLSFitter(t, copy.deepcopy(m))
+    fg.fit_toas(maxiter=2)
+    fw = WLSFitter(t, copy.deepcopy(m))
+    fw.fit_toas(maxiter=2)
+    for p in m.free_params:
+        a = getattr(fg.model, p)
+        b = getattr(fw.model, p)
+        assert abs(a.value - b.value) <= 1e-3 * max(
+            b.uncertainty or 1e-12, 1e-15), p

@@ -57,3 +57,79 @@ def test_wideband_fit_constrains_dm():
     # fitted DM pulled to the measured value despite time-domain degeneracy
     assert abs(f.model.DM.value - 15.9905) < 1e-4
     assert f.model.DM.uncertainty < 1e-4
+
+
+def test_wideband_downhill_and_lm_fitters():
+    """Downhill and LM wideband variants converge to (at least) the
+    single-step fitter's chi2 from a perturbed start."""
+    from pint_tpu.fitter import WidebandDownhillFitter, WidebandLMFitter
+
+    m = get_model(PAR)
+    t = _wb_toas(m, dm_true=15.9905)
+    ref = WidebandTOAFitter(t, copy.deepcopy(m))
+    chi2_ref = ref.fit_toas(maxiter=3)
+
+    m_d = copy.deepcopy(m)
+    m_d.F0.value += 2e-9
+    m_d.DM.value += 5e-3
+    fd = WidebandDownhillFitter(t, m_d)
+    chi2_d = fd.fit_toas()
+    assert chi2_d <= chi2_ref * 1.01
+    assert abs(fd.model.DM.value - 15.9905) < 1e-4
+
+    m_l = copy.deepcopy(m)
+    m_l.F0.value += 2e-9
+    m_l.DM.value += 5e-3
+    fl = WidebandLMFitter(t, m_l)
+    chi2_l = fl.fit_toas()
+    assert chi2_l <= chi2_ref * 1.01
+    assert abs(fl.model.DM.value - 15.9905) < 1e-4
+    assert fl.model.DM.uncertainty is not None
+
+
+def test_typed_fit_exceptions():
+    """CorrelatedErrors from WLS on a correlated-noise model;
+    MaxiterReached from an exhausted downhill loop."""
+    import pytest
+
+    from pint_tpu.fitter import (CorrelatedErrors, DownhillWLSFitter,
+                                 MaxiterReached, WLSFitter)
+
+    m = get_model(PAR + "ECORR -f L-wide 0.8\n")
+    t = _wb_toas(m)
+    for f in t.flags:
+        f["f"] = "L-wide"
+    with pytest.raises(CorrelatedErrors) as ei:
+        WLSFitter(t, copy.deepcopy(m)).fit_toas()
+    assert "EcorrNoise" in str(ei.value)
+
+    m2 = get_model(PAR)
+    t2 = _wb_toas(m2)
+    m2p = copy.deepcopy(m2)
+    m2p.F0.value += 5e-10  # recoverable (no phase wrap) but needs >1 iter
+    fd = DownhillWLSFitter(t2, m2p)
+    with pytest.raises(MaxiterReached):
+        fd.fit_toas(maxiter=1, raise_maxiter=True)
+    # the one improving step was still written back (fitter's own copy)
+    assert abs(fd.model.F0.value - 218.8) < 1e-10
+
+
+def test_powell_fitter():
+    from pint_tpu.fitter import PowellFitter, WLSFitter
+
+    m = get_model(PAR)
+    # two frequencies: single-frequency data leaves DM degenerate with
+    # the mean (Powell would walk DM to absurd values instead of F0)
+    mjds = np.linspace(55000, 56000, 50)
+    freqs = np.where(np.arange(50) % 2, 1400.0, 800.0)
+    t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=freqs,
+                                obs="gbt", add_noise=True, seed=3)
+    mp = copy.deepcopy(m)
+    mp.F0.value += 3e-10
+    f = PowellFitter(t, mp)
+    chi2 = f.fit_toas()
+    ref = WLSFitter(t, copy.deepcopy(m))
+    chi2_ref = ref.fit_toas()
+    assert chi2 <= chi2_ref * 1.05
+    assert abs(f.model.F0.value - ref.model.F0.value) < 3 * (
+        ref.model.F0.uncertainty or 1e-9)
