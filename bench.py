@@ -20,12 +20,21 @@ any same-shape PTA batch; a cold end-to-end run is compile_s + refit).
 
 import json
 import os
+import sys
 import time
 import warnings
 
 warnings.simplefilter("ignore")
 
 import numpy as np
+
+_T0 = time.time()
+
+
+def _stage(msg):
+    # progress to stderr; stdout stays the single JSON line
+    print(f"[bench +{time.time() - _T0:7.1f}s] {msg}", file=sys.stderr,
+          flush=True)
 
 
 def build_batch(n_psr, n_toa, noise=True, seed=0):
@@ -88,20 +97,27 @@ def main():
     n_psr = int(os.environ.get("PINT_TPU_BENCH_PULSARS", "68"))
     n_toa = int(os.environ.get("PINT_TPU_BENCH_TOAS", "1000"))
 
+    _stage(f"building {n_psr}x{n_toa} synthetic PTA batch on host")
     t0 = time.time()
     models, toas_list = build_batch(n_psr, n_toa)
     host_prep_s = time.time() - t0
     # actual counts (epoch clustering floors n_toa to a multiple of 4)
     n_toa = len(toas_list[0])
 
+    _stage(f"host prep done ({host_prep_s:.1f}s); acquiring devices")
     n_dev = len(jax.devices())
     mesh = make_mesh(min(n_dev, n_psr))
     t0 = time.time()
     pta = PTABatch(models, toas_list, mesh=mesh)
     pack_s = time.time() - t0
 
+    _stage(f"packed ({pack_s:.1f}s) on {n_dev} {jax.devices()[0].platform} "
+           "device(s); compiling+running GLS refit")
     gls_compile_s, gls_refit_s = _timed_refit(pta.gls_fit, 2)
+    _stage(f"GLS done (compile {gls_compile_s:.1f}s, refit {gls_refit_s:.3f}s"
+           "); compiling+running WLS refit")
     wls_compile_s, wls_refit_s = _timed_refit(pta.wls_fit, 3)
+    _stage(f"WLS done (compile {wls_compile_s:.1f}s, refit {wls_refit_s:.3f}s)")
 
     total_toas = n_psr * n_toa
     rate = total_toas / gls_refit_s  # TOAs GLS-refit per second

@@ -257,3 +257,263 @@ void pt_cheby_posvel(std::int64_t n, std::int64_t ncoef,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// FORMAT-1 (tempo2) tim-file parser — the native data loader.
+//
+// The reference's tim parsing is a pure-Python per-line loop flagged as
+// a hot spot for large files (reference: src/pint/toa.py::read_toa_file;
+// PINT mitigates it with a pickle cache). Here the fast path lives in
+// C++: one pass over the raw buffer producing column arrays plus a
+// packed flags blob, mirroring pint_tpu/toa.py::_parse_tempo2_line and
+// pint_tpu/mjd.py::parse_mjd_string semantics exactly. Any construct
+// that needs stateful Python handling (INCLUDE recursion, TIME/EFAC/
+// EQUAD/EMIN/EMAX/SKIP/JUMP/PHASE, princeton/parkes lines before a
+// FORMAT 1) returns -1 so the caller falls back to the Python parser.
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+namespace {
+struct TimTok {
+  const char* p;
+  int len;
+};
+
+inline bool tim_space(char c) { return c == ' ' || c == '\t' || c == '\r'; }
+
+inline bool tok_is_ci(const TimTok& t, const char* kw) {
+  int i = 0;
+  for (; kw[i]; ++i) {
+    if (i >= t.len) return false;
+    char c = t.p[i];
+    if (c >= 'a' && c <= 'z') c -= 32;
+    if (c != kw[i]) return false;
+  }
+  return i == t.len;
+}
+
+// full-token float parse (mirrors toa.py::_is_number / float())
+inline bool tok_float(const TimTok& t, double* out) {
+  char tmp[64];
+  if (t.len <= 0 || t.len >= 64) return false;
+  for (int i = 0; i < t.len; ++i) tmp[i] = t.p[i];
+  tmp[t.len] = 0;
+  char* end = nullptr;
+  double v = strtod(tmp, &end);
+  if (end != tmp + t.len) return false;
+  *out = v;
+  return true;
+}
+
+// exact decimal MJD -> (int day, f64 seconds-of-day); mirrors
+// mjd.py::parse_mjd_string (long double = x86 80-bit, same as numpy
+// longdouble, so results are bit-identical for <= 19 frac digits).
+inline bool tok_mjd(const TimTok& t, std::int64_t* day, double* sec) {
+  int i = 0;
+  bool neg = false;
+  if (i < t.len && (t.p[i] == '+' || t.p[i] == '-')) {
+    neg = t.p[i] == '-';
+    ++i;
+  }
+  if (i >= t.len || t.p[i] < '0' || t.p[i] > '9') return false;
+  std::int64_t ipart = 0;
+  for (; i < t.len && t.p[i] >= '0' && t.p[i] <= '9'; ++i)
+    ipart = ipart * 10 + (t.p[i] - '0');
+  long double fsec = 0.0L;
+  if (i < t.len && t.p[i] == '.') {
+    ++i;
+    if (i >= t.len) return false;  // regex requires >=1 frac digit
+    long double fi = 0.0L;
+    int nd = 0;
+    for (; i < t.len && t.p[i] >= '0' && t.p[i] <= '9'; ++i) {
+      fi = fi * 10.0L + (t.p[i] - '0');
+      ++nd;
+    }
+    long double p10 = 1.0L;
+    for (int k = 0; k < nd; ++k) p10 *= 10.0L;
+    fsec = fi * 86400.0L / p10;
+  }
+  if (i != t.len) return false;
+  std::int64_t d = neg ? -ipart : ipart;
+  double s = static_cast<double>(fsec);
+  if (neg && s > 0.0) {  // "-1.5" -> (-2, 43200): frac counts away from 0
+    d -= 1;
+    s = 86400.0 - s;
+  }
+  *day = d;
+  *sec = s;
+  return true;
+}
+}  // namespace
+
+// Returns n_toas (>=0) on success; -1 = caller must use the Python
+// parser (stateful command / non-FORMAT-1 line); -2 = a capacity was
+// exceeded (caller falls back).  flags blob layout per TOA:
+// "key\x1Fvalue\x1Ekey\x1Fvalue..." with flag_off[i]..flag_off[i+1]
+// delimiting TOA i (flag_off has n+1 entries).
+std::int64_t pt_parse_tim_t2(
+    const char* buf, std::int64_t nbytes, std::int64_t* day, double* sec,
+    double* freq, double* err, std::int32_t* obs_id, char* obs_tab,
+    std::int64_t obs_cap, std::int64_t* obs_tab_len, char* flags,
+    std::int64_t flags_cap, std::int64_t* flag_off, std::int64_t* n_bad) {
+  constexpr int MAXTOK = 96;
+  TimTok tok[MAXTOK];
+  // small obs string table (unique sites in one tim file are few)
+  constexpr int MAXOBS = 128;
+  int obs_start[MAXOBS], obs_len[MAXOBS];
+  int n_obs = 0;
+  std::int64_t obs_used = 0;
+  std::int64_t n = 0, bad = 0, fpos = 0;
+  bool format1 = false;
+  const char* end = buf + nbytes;
+  const char* line = buf;
+  while (line < end) {
+    const char* eol = line;
+    while (eol < end && *eol != '\n') ++eol;
+    // tokenize
+    int ntok = 0;
+    const char* p = line;
+    while (p < eol && ntok < MAXTOK) {
+      while (p < eol && tim_space(*p)) ++p;
+      if (p >= eol) break;
+      const char* q = p;
+      while (q < eol && !tim_space(*q)) ++q;
+      tok[ntok].p = p;
+      tok[ntok].len = static_cast<int>(q - p);
+      ++ntok;
+      p = q;
+    }
+    if (p < eol && ntok >= MAXTOK) return -1;  // pathological line: python owns it
+    line = eol + 1;
+    if (ntok == 0) continue;
+    // comments: '#', or 'C '/'c ' (needs a second token to mirror
+    // python's startswith("C ") on the stripped line)
+    if (tok[0].p[0] == '#') continue;
+    if (tok[0].len == 1 && (tok[0].p[0] == 'C' || tok[0].p[0] == 'c') &&
+        ntok > 1)
+      continue;
+    // command dispatch (python: head in _COMMANDS)
+    if (tok_is_ci(tok[0], "FORMAT")) {
+      if (ntok > 1 && tok[1].len == 1 && tok[1].p[0] == '1') format1 = true;
+      continue;
+    }
+    if (tok_is_ci(tok[0], "MODE") || tok_is_ci(tok[0], "INFO") ||
+        tok_is_ci(tok[0], "TRACK"))
+      continue;
+    if (tok_is_ci(tok[0], "END")) break;
+    if (tok_is_ci(tok[0], "INCLUDE") || tok_is_ci(tok[0], "TIME") ||
+        tok_is_ci(tok[0], "EFAC") || tok_is_ci(tok[0], "EQUAD") ||
+        tok_is_ci(tok[0], "EMIN") || tok_is_ci(tok[0], "EMAX") ||
+        tok_is_ci(tok[0], "SKIP") || tok_is_ci(tok[0], "NOSKIP") ||
+        tok_is_ci(tok[0], "JUMP") || tok_is_ci(tok[0], "PHASE"))
+      return -1;  // stateful: python parser owns these
+    // TOA line
+    if (!format1) return -1;  // princeton/parkes territory
+    if (ntok < 5) {
+      ++bad;
+      continue;
+    }
+    double f, e;
+    std::int64_t d;
+    double s;
+    if (!tok_float(tok[1], &f) || !tok_mjd(tok[2], &d, &s) ||
+        !tok_float(tok[3], &e)) {
+      ++bad;
+      continue;
+    }
+    day[n] = d;
+    sec[n] = s;
+    freq[n] = f;
+    err[n] = e;
+    // observatory: lowercase, uniquified into obs_tab
+    char site[64];
+    if (tok[4].len > 63) return -1;  // absurd site name: python owns it
+    int slen = tok[4].len;
+    for (int i = 0; i < slen; ++i) {
+      char c = tok[4].p[i];
+      if (c >= 'A' && c <= 'Z') c += 32;
+      site[i] = c;
+    }
+    int oid = -1;
+    for (int i = 0; i < n_obs; ++i) {
+      if (obs_len[i] == slen) {
+        bool eq = true;
+        for (int k = 0; k < slen; ++k)
+          if (obs_tab[obs_start[i] + k] != site[k]) {
+            eq = false;
+            break;
+          }
+        if (eq) {
+          oid = i;
+          break;
+        }
+      }
+    }
+    if (oid < 0) {
+      if (n_obs >= MAXOBS || obs_used + slen + 1 > obs_cap) return -2;
+      obs_start[n_obs] = static_cast<int>(obs_used);
+      obs_len[n_obs] = slen;
+      for (int k = 0; k < slen; ++k) obs_tab[obs_used + k] = site[k];
+      obs_tab[obs_used + slen] = '\n';
+      obs_used += slen + 1;
+      oid = n_obs++;
+    }
+    obs_id[n] = oid;
+    // flags (python: _parse_tempo2_line flag loop + setdefault("name"))
+    flag_off[n] = fpos;
+    bool have_name = false, first_pair = true;
+    int i = 5;
+    while (i < ntok) {
+      double dummy;
+      bool is_flag = tok[i].len > 1 && tok[i].p[0] == '-' &&
+                     !tok_float(tok[i], &dummy);
+      if (tok[i].len == 1 && tok[i].p[0] == '-') is_flag = true;
+      if (!is_flag) {
+        ++i;
+        continue;
+      }
+      const char* key = tok[i].p + 1;
+      int klen = tok[i].len - 1;
+      const char* val = nullptr;
+      int vlen = 0;
+      if (i + 1 < ntok) {
+        bool next_is_flag = tok[i + 1].len >= 1 && tok[i + 1].p[0] == '-' &&
+                            !tok_float(tok[i + 1], &dummy);
+        if (!next_is_flag) {
+          val = tok[i + 1].p;
+          vlen = tok[i + 1].len;
+          i += 2;
+        } else {
+          ++i;
+        }
+      } else {
+        ++i;
+      }
+      if (klen == 4 && key[0] == 'n' && key[1] == 'a' && key[2] == 'm' &&
+          key[3] == 'e')
+        have_name = true;
+      if (fpos + klen + vlen + 2 > flags_cap) return -2;
+      if (!first_pair) flags[fpos++] = '\x1e';
+      first_pair = false;
+      for (int k = 0; k < klen; ++k) flags[fpos++] = key[k];
+      flags[fpos++] = '\x1f';
+      for (int k = 0; k < vlen; ++k) flags[fpos++] = val[k];
+    }
+    if (!have_name) {  // python: flags.setdefault("name", parts[0])
+      if (fpos + tok[0].len + 7 > flags_cap) return -2;
+      if (!first_pair) flags[fpos++] = '\x1e';
+      const char nm[] = "name";
+      for (int k = 0; k < 4; ++k) flags[fpos++] = nm[k];
+      flags[fpos++] = '\x1f';
+      for (int k = 0; k < tok[0].len; ++k) flags[fpos++] = tok[0].p[k];
+    }
+    ++n;
+  }
+  flag_off[n] = fpos;
+  *obs_tab_len = obs_used;
+  *n_bad = bad;
+  return n;
+}
+
+}  // extern "C"

@@ -131,7 +131,7 @@ def get_event_weights(toas: TOAs) -> np.ndarray | None:
     per-TOA '-weight' flags for tim-file round-trips), or None."""
     if toas.weights is not None:
         return np.asarray(toas.weights, float)
-    if toas._flags is None:
+    if not toas.has_flags():
         return None  # lazy flags: don't materialize 1e7 empty dicts
     w = [f.get("weight") for f in toas.flags]
     if any(x is None for x in w):

@@ -688,8 +688,8 @@ def auto_fitter(toas, model):
     """Pick a fitter like the reference's Fitter.auto()."""
     has_noise = any(c.kind == "noise" and c.category != "scale_toa_error"
                     for c in model.components.values())
-    wideband = (toas._flags is not None
-                and any("pp_dm" in f for f in toas._flags))
+    wideband = (toas.has_flags()
+                and any("pp_dm" in f for f in toas.flags))
     if wideband:
         return WidebandDownhillFitter(toas, model)
     if has_noise:

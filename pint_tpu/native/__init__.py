@@ -79,6 +79,13 @@ def get_lib() -> ctypes.CDLL | None:
                                     ctypes.c_int64, ctypes.c_int64,
                                     _f64p, _f64p, _f64p, _f64p]
     lib.pt_cheby_posvel.restype = None
+    _u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    _i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    lib.pt_parse_tim_t2.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, _i64p, _f64p, _f64p, _f64p,
+        _i32p, _u8p, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+        _u8p, ctypes.c_int64, _i64p, ctypes.POINTER(ctypes.c_int64)]
+    lib.pt_parse_tim_t2.restype = ctypes.c_int64
     _LIB = lib
     return lib
 
@@ -127,3 +134,46 @@ def cheby_posvel(et, rec, ncoef, data_type):
     vel = np.empty((n, 3), np.float64)
     lib.pt_cheby_posvel(n, ncoef, data_type, rsize, et, rec, pos, vel)
     return pos, vel
+
+
+def parse_tim_t2(data: bytes):
+    """Fast-path parse of a FORMAT-1 tim buffer (native data loader;
+    reference: src/pint/toa.py::read_toa_file hot loop).
+
+    Returns ``(day, sec, freq, err, obs, flags_blob, flag_off, n_bad)``
+    or ``None`` when unavailable or when the buffer needs the stateful
+    Python parser (INCLUDE/TIME/EFAC/... commands, non-tempo2 lines).
+    ``flags_blob``/``flag_off`` pack per-TOA flag dicts for lazy decode
+    by ``pint_tpu.toa._decode_flags``.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    nbytes = len(data)
+    cap = data.count(b"\n") + 2
+    day = np.empty(cap, np.int64)
+    sec = np.empty(cap, np.float64)
+    freq = np.empty(cap, np.float64)
+    err = np.empty(cap, np.float64)
+    obs_id = np.empty(cap, np.int32)
+    obs_tab = np.empty(4096, np.uint8)
+    flags_blob = np.empty(nbytes + 16 * cap + 64, np.uint8)
+    flag_off = np.empty(cap + 1, np.int64)
+    obs_tab_len = ctypes.c_int64(0)
+    n_bad = ctypes.c_int64(0)
+    n = lib.pt_parse_tim_t2(
+        data, nbytes, day, sec, freq, err, obs_id, obs_tab,
+        obs_tab.size, ctypes.byref(obs_tab_len), flags_blob,
+        flags_blob.size, flag_off, ctypes.byref(n_bad))
+    if n < 0:
+        return None
+    names = obs_tab[:obs_tab_len.value].tobytes().decode().split("\n")[:-1]
+    obs = np.array(names, dtype=object)[obs_id[:n]] if n else \
+        np.empty(0, dtype=object)
+    # blob stays bytes: the offsets are byte positions, and non-ASCII
+    # flag values must not shift later slices (_decode_flags decodes
+    # each key/value individually)
+    blob = flags_blob[:flag_off[n]].tobytes()
+    return (day[:n].copy(), sec[:n].copy(), freq[:n].copy(),
+            err[:n].copy(), obs, blob, flag_off[:n + 1].copy(),
+            int(n_bad.value))

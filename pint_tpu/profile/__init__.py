@@ -5,7 +5,8 @@ fftfit_nustar.py / fftfit_presto.py compat shims — here a single
 JAX implementation replaces the three backends.)
 """
 
-from .fftfit import fftfit_basic, fftfit_full, FFTFITResult  # noqa: F401
+from .fftfit import (fftfit_basic, fftfit_cc, fftfit_full,  # noqa: F401
+                     FFTFITResult)
 
 
 def fftfit_full_aarchiba(template, profile, **kw):

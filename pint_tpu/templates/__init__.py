@@ -20,5 +20,9 @@ def photon_loglike(f, weights=None):
 from .lcprimitives import (LCGaussian, LCLorentzian, LCSkewGaussian,  # noqa: E402,F401
                            LCVonMises)
 from .lcnorm import NormAngles, angles_from_norms, norms_from_angles  # noqa: E402,F401
-from .lctemplate import LCTemplate  # noqa: E402,F401
+from .lctemplate import (LCTemplate, LCEmpiricalFourier,  # noqa: E402,F401
+                         gauss_template_from_file, write_gauss_template)
+from .lcprimitives import LCHarmonic, LCTopHat  # noqa: E402,F401
+from .lceprimitives import (LCEGaussian, LCELorentzian,  # noqa: E402,F401
+                            LCEVonMises)
 from .lcfitters import LCFitter  # noqa: E402,F401

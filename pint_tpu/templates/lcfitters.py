@@ -17,10 +17,14 @@ import numpy as np
 
 
 class LCFitter:
-    def __init__(self, template, phases, weights=None):
+    def __init__(self, template, phases, weights=None, log10_ens=None):
         self.template = template
         self.phases = np.asarray(phases, float) % 1.0
         self.weights = None if weights is None else np.asarray(weights, float)
+        # per-photon log10(E/MeV) for energy-dependent templates
+        # (reference: lcfitters.py LCFitter(..., log10_ens))
+        self.log10_ens = (None if log10_ens is None
+                          else np.asarray(log10_ens, float))
 
     def loglikelihood(self, vec=None):
         import jax.numpy as jnp
@@ -29,7 +33,7 @@ class LCFitter:
 
         fn, vec0 = self.template.gradient_ready()
         v = jnp.asarray(vec0 if vec is None else vec)
-        f = fn(v, jnp.asarray(self.phases))
+        f = fn(v, jnp.asarray(self.phases), log10_ens=self.log10_ens)
         w = None if self.weights is None else jnp.asarray(self.weights)
         return photon_loglike(f, w)
 
@@ -46,12 +50,13 @@ class LCFitter:
         fn, vec0 = self.template.gradient_ready()
         ph = jnp.asarray(self.phases)
         w = None if self.weights is None else jnp.asarray(self.weights)
+        ens = None if self.log10_ens is None else jnp.asarray(self.log10_ens)
         n_norm = len(self.template.primitives)
 
         from . import photon_loglike
 
         def negll(v):
-            return -photon_loglike(fn(v, ph), w)
+            return -photon_loglike(fn(v, ph, log10_ens=ens), w)
 
         grad = jax.jit(jax.grad(negll))
         val = jax.jit(negll)
@@ -75,11 +80,10 @@ class LCFitter:
             v = v.at[:n_norm].set(norms)
             i = n_norm
             for pr in self.template.primitives:
-                # every width-like param (all but the trailing loc) must
-                # stay positive — e.g. LCSkewGaussian carries two widths
-                for kk in range(pr.n_params - 1):
-                    v = v.at[i + kk].set(jnp.maximum(v[i + kk], 1e-4))
-                v = v.at[i + pr.n_params - 1].set(v[i + pr.n_params - 1] % 1.0)
+                # each primitive owns its constraint set (widths > 0,
+                # wrapped locs, frozen structural params, free slopes)
+                v = v.at[i:i + pr.n_params].set(
+                    pr.project_params(v[i:i + pr.n_params]))
                 i += pr.n_params
         self.template.set_parameters(np.asarray(v))
         self.ll = -float(val(v))
@@ -97,9 +101,10 @@ class LCFitter:
         fn, vec0 = self.template.gradient_ready()
         ph = jnp.asarray(self.phases)
         w = None if self.weights is None else jnp.asarray(self.weights)
+        ens = None if self.log10_ens is None else jnp.asarray(self.log10_ens)
 
         def negll(v):
-            return -photon_loglike(fn(v, ph), w)
+            return -photon_loglike(fn(v, ph, log10_ens=ens), w)
 
         H = np.asarray(jax.hessian(negll)(jnp.asarray(vec0)))
         # pseudo-inverse: parameters at projection bounds can be flat
@@ -119,7 +124,9 @@ class LCFitter:
         from . import photon_loglike
 
         def ll_of_shift(dphi):
-            f = fn(jnp.asarray(vec0), (ph + dphi) % 1.0)
+            f = fn(jnp.asarray(vec0), (ph + dphi) % 1.0,
+                   log10_ens=None if self.log10_ens is None
+                   else jnp.asarray(self.log10_ens))
             return photon_loglike(f, None if self.weights is None
                                   else jnp.asarray(self.weights))
 

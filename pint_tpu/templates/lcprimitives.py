@@ -21,6 +21,7 @@ class LCPrimitive:
     """Base: density f(phi) normalized over the unit circle."""
 
     n_params = 2
+    energy_dependent = False
 
     def __init__(self, p):
         self.p = np.asarray(p, float)
@@ -41,6 +42,15 @@ class LCPrimitive:
         y = self(x)
         return jnp.trapezoid(y, x)
 
+    def project_params(self, q):
+        """Constrain one optimizer step's slice of this primitive's
+        params (LCFitter calls this after each update): widths stay
+        positive, the trailing location wraps to [0, 1)."""
+        import jax.numpy as jnp
+
+        q = q.at[:-1].set(jnp.maximum(q[:-1], 1e-4))
+        return q.at[-1].set(q[-1] % 1.0)
+
 
 class LCGaussian(LCPrimitive):
     """Wrapped Gaussian (reference: lcprimitives.py::LCGaussian):
@@ -52,9 +62,10 @@ class LCGaussian(LCPrimitive):
         p = self.p if p is None else p
         sigma, loc = p[0], p[1]
         ph = jnp.asarray(phases)
-        # sum over wraps k = -2..2 (sigma << 1 in practice)
+        # sum over wraps k = -2..2 (sigma << 1 in practice); the
+        # (ph - loc) form broadcasts per-photon params (lceprimitives)
         k = jnp.arange(-2, 3, dtype=jnp.float64)
-        z = (ph[..., None] - loc + k) / sigma
+        z = ((ph - loc)[..., None] + k) / jnp.asarray(sigma)[..., None]
         return jnp.sum(jnp.exp(-0.5 * z**2), axis=-1) / (
             sigma * math.sqrt(2 * math.pi))
 
@@ -90,8 +101,9 @@ class LCSkewGaussian(LCPrimitive):
         s1, s2, loc = p[0], p[1], p[2]
         ph = jnp.asarray(phases)
         k = jnp.arange(-2, 3, dtype=jnp.float64)
-        d = ph[..., None] - loc + k
-        sig = jnp.where(d < 0, s1, s2)
+        d = (ph - loc)[..., None] + k
+        sig = jnp.where(d < 0, jnp.asarray(s1)[..., None],
+                        jnp.asarray(s2)[..., None])
         dens = jnp.exp(-0.5 * (d / sig) ** 2)
         # normalization: integral = sqrt(pi/2)(s1+s2)
         return jnp.sum(dens, axis=-1) / (
@@ -113,3 +125,53 @@ class LCVonMises(LCPrimitive):
         # density on [0,1): exp(k cos)/I0(k); i0e(k) = exp(-k) I0(k)
         # keeps the ratio finite for large kappa
         return jnp.exp(kappa * (jnp.cos(2 * jnp.pi * (ph - loc)) - 1.0)) / i0e(kappa)
+
+
+class LCTopHat(LCPrimitive):
+    """Top-hat (boxcar) component (reference: lcprimitives.py::LCTopHat):
+    p = [width, loc]; uniform density 1/width on the wrapped interval
+    centered at loc. A steep-but-smooth logistic edge (scale width/50)
+    keeps it differentiable for the gradient fitters."""
+
+    def __call__(self, phases, p=None):
+        import jax.numpy as jnp
+
+        p = self.p if p is None else p
+        width, loc = p[0], p[1]
+        ph = jnp.asarray(phases)
+        # wrapped distance from center in [-0.5, 0.5)
+        d = (ph - loc + 0.5) % 1.0 - 0.5
+        edge = jnp.asarray(width) / 50.0
+        inside = (jax_sigmoid((width / 2.0 - d) / edge)
+                  * jax_sigmoid((width / 2.0 + d) / edge))
+        return inside / width
+
+
+def jax_sigmoid(x):
+    import jax.nn
+
+    return jax.nn.sigmoid(x)
+
+
+class LCHarmonic(LCPrimitive):
+    """Single-harmonic density (reference: lcprimitives.py::LCHarmonic):
+    p = [order, loc]; density 1 + cos(2 pi m (phi - loc)) — the lowest
+    nonnegative density containing only harmonic m. ``order`` is a
+    structural (integer, non-fitted) parameter."""
+
+    def __init__(self, p):
+        super().__init__(p)
+        self.order = int(round(float(self.p[0])))
+
+    def __call__(self, phases, p=None):
+        import jax.numpy as jnp
+
+        p = self.p if p is None else p
+        loc = p[1]
+        ph = jnp.asarray(phases)
+        return 1.0 + jnp.cos(2 * jnp.pi * self.order * (ph - loc))
+
+    def project_params(self, q):
+        # the harmonic order is structural, not a fit parameter
+        q = q.at[0].set(float(self.order))
+        return q.at[1].set(q[1] % 1.0)

