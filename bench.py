@@ -92,6 +92,18 @@ def _timed_refit(fit, arg):
 def main():
     import jax
 
+    # persistent compilation cache: the driver's end-of-round bench run
+    # reuses programs compiled during the build session (same chip, same
+    # jaxlib), turning the ~100s+ cold compiles into cache hits; on any
+    # fingerprint mismatch jax silently recompiles, so this is pure upside
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except Exception:
+        pass  # older jax without the knobs: just compile
+
     from pint_tpu.parallel import PTABatch, make_mesh
 
     n_psr = int(os.environ.get("PINT_TPU_BENCH_PULSARS", "68"))

@@ -1,0 +1,54 @@
+"""Pallas kernel vs jnp-path timings on the current backend.
+
+Usage: python -m benchmarks.kernel_bench  (prints one JSON line per
+kernel with both paths' steady-state times).
+"""
+
+import json
+import time
+import warnings
+
+warnings.simplefilter("ignore")
+
+import numpy as np
+
+
+def _best(fn, k=5):
+    import jax
+
+    fn()  # compile
+    ts = []
+    for _ in range(k):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def main():
+    import jax
+
+    from pint_tpu.kernels import harmonic_sums_jnp, harmonic_sums_pallas
+
+    platform = jax.devices()[0].platform
+    rng = np.random.default_rng(0)
+    n, m = 4_000_000, 20
+    ph = rng.random(n)
+    w = rng.random(n)
+
+    t_jnp = _best(lambda: harmonic_sums_jnp(ph, m, w)[0])
+    if platform == "tpu":
+        t_pl = _best(lambda: harmonic_sums_pallas(ph, m, weights=w)[0])
+    else:
+        t_pl = None  # interpreter timing is meaningless
+    print(json.dumps({
+        "kernel": "harmonic_sums", "n_photons": n, "m": m,
+        "platform": platform,
+        "jnp_s": round(t_jnp, 4),
+        "pallas_s": None if t_pl is None else round(t_pl, 4),
+        "speedup": None if t_pl is None else round(t_jnp / t_pl, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -26,14 +26,16 @@ def z2m(phases, m=2):
     """Z^2_m test statistic for each harmonic count 1..m.
 
     Returns array [Z^2_1, ..., Z^2_m]
-    (reference: eventstats.py::z2m).
+    (reference: eventstats.py::z2m). The harmonic sums go through the
+    pallas streaming kernel on TPU at photon scale
+    (pint_tpu/kernels/harmonics.py); small or CPU batches use the
+    identical-math jnp path.
     """
     jnp = _jnp()
-    ph = jnp.asarray(phases) * (2.0 * jnp.pi)
-    n = ph.shape[-1]
-    k = jnp.arange(1, m + 1)[:, None]
-    c = jnp.sum(jnp.cos(k * ph[None, :]), axis=-1)
-    s = jnp.sum(jnp.sin(k * ph[None, :]), axis=-1)
+    from .kernels import harmonic_sums
+
+    n = jnp.asarray(phases).shape[-1]
+    c, s = harmonic_sums(phases, m)
     terms = (2.0 / n) * (c**2 + s**2)
     return jnp.cumsum(terms)
 
@@ -41,11 +43,10 @@ def z2m(phases, m=2):
 def z2mw(phases, weights, m=2):
     """Weighted Z^2_m (reference: eventstats.py::z2mw)."""
     jnp = _jnp()
-    ph = jnp.asarray(phases) * (2.0 * jnp.pi)
+    from .kernels import harmonic_sums
+
     w = jnp.asarray(weights)
-    k = jnp.arange(1, m + 1)[:, None]
-    c = jnp.sum(w[None, :] * jnp.cos(k * ph[None, :]), axis=-1)
-    s = jnp.sum(w[None, :] * jnp.sin(k * ph[None, :]), axis=-1)
+    c, s = harmonic_sums(phases, m, weights=w)
     norm = jnp.sum(w**2) / 2.0
     return jnp.cumsum((c**2 + s**2) / norm)
 

@@ -1,0 +1,9 @@
+"""Pallas TPU kernels for the hot ops, each with a jnp mirror.
+
+(SURVEY.md 7.1 "Pallas: only if profiling shows need" — the photon
+harmonic-sum reduction is the one op where streaming beats XLA's
+materialize-then-reduce; everything else fuses fine.)
+"""
+
+from .harmonics import (harmonic_sums, harmonic_sums_jnp,  # noqa: F401
+                        harmonic_sums_pallas)

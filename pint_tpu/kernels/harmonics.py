@@ -1,0 +1,157 @@
+"""Pallas TPU kernel: streaming harmonic sums over photon phases.
+
+The H-test / Z^2_m hot loop (reference: src/pint/eventstats.py::hm/
+z2m over 1e5-1e7 photon phases, SURVEY.md 3.5) needs, for harmonics
+k = 1..m:
+
+    C_k = sum_i w_i cos(2 pi k phi_i)      S_k = sum_i w_i sin(...)
+
+The naive jnp expression materializes an (m, n) intermediate in HBM
+(20x the photon array) before reducing; this kernel streams photon
+blocks HBM -> VMEM once and accumulates all 2m sums on-chip, using the
+Chebyshev recurrence cos(k t) = 2 cos t cos((k-1)t) - cos((k-2)t) so
+each block pays two transcendentals instead of 2m.
+
+Test statistics tolerate f32 phase precision (a phase error of 1e-6
+turns perturbs H by ~1e-4); the final cross-lane reduction happens in
+f64 on the host side of the call. Non-TPU backends and small batches
+use the plain jnp path (identical math, f64) — the kernel is a
+performance mirror, verified against it by tests/test_kernels.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+_BLOCK_ROWS = 64  # photons per grid step = _BLOCK_ROWS * 128
+
+
+def harmonic_sums_jnp(phases, m, weights=None):
+    """Reference jnp path: (C[1..m], S[1..m]) in f64."""
+    import jax.numpy as jnp
+
+    ph = jnp.asarray(phases, jnp.float64) * (2.0 * jnp.pi)
+    k = jnp.arange(1, m + 1, dtype=jnp.float64)[:, None]
+    w = None if weights is None else jnp.asarray(weights, jnp.float64)
+    ck = jnp.cos(k * ph[None, :])
+    sk = jnp.sin(k * ph[None, :])
+    if w is not None:
+        ck = ck * w[None, :]
+        sk = sk * w[None, :]
+    return jnp.sum(ck, axis=-1), jnp.sum(sk, axis=-1)
+
+
+def _kernel(m, ph_ref, w_ref, c_out, s_out, cacc, sacc):
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _init():
+        cacc[:] = jnp.zeros_like(cacc)
+        sacc[:] = jnp.zeros_like(sacc)
+
+    theta = ph_ref[:] * np.float32(2.0 * np.pi)
+    w = w_ref[:]
+    c1 = jnp.cos(theta)
+    s1 = jnp.sin(theta)
+    # Chebyshev three-term recurrence over harmonics; k loop unrolled
+    # (m is a static python int), all VPU elementwise work
+    ckm2 = jnp.ones_like(c1)   # cos(0 t)
+    skm2 = jnp.zeros_like(s1)  # sin(0 t)
+    ck, sk = c1, s1
+    two_c1 = 2.0 * c1
+    for k in range(1, m + 1):
+        cacc[k - 1, :] += jnp.sum(w * ck, axis=0)
+        sacc[k - 1, :] += jnp.sum(w * sk, axis=0)
+        ck_next = two_c1 * ck - ckm2
+        sk_next = two_c1 * sk - skm2
+        ckm2, skm2 = ck, sk
+        ck, sk = ck_next, sk_next
+
+    @pl.when(step == pl.num_programs(0) - 1)
+    def _emit():
+        c_out[:] = cacc[:]
+        s_out[:] = sacc[:]
+
+
+def harmonic_sums_pallas(phases, m, weights=None, interpret=False):
+    """Pallas path; returns (C[1..m], S[1..m]) as f64 jnp arrays."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    block = _BLOCK_ROWS * 128
+    ph = jnp.asarray(phases, jnp.float32).ravel()
+    n = ph.shape[0]
+    nblocks = max(1, -(-n // block))
+    npad = nblocks * block - n
+    # padded photons carry weight 0, so they vanish from every sum
+    w = (jnp.ones(n, jnp.float32) if weights is None
+         else jnp.asarray(weights, jnp.float32))
+    ph = jnp.pad(ph, (0, npad))
+    w = jnp.pad(w, (0, npad))
+    ph2 = ph.reshape(nblocks * _BLOCK_ROWS, 128)
+    w2 = w.reshape(nblocks * _BLOCK_ROWS, 128)
+
+    m_pad = -(-m // 8) * 8  # sublane-aligned scratch/output
+
+    c_part, s_part = pl.pallas_call(
+        functools.partial(_kernel, m),
+        grid=(nblocks,),
+        in_specs=[
+            pl.BlockSpec((_BLOCK_ROWS, 128), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_BLOCK_ROWS, 128), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((m_pad, 128), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((m_pad, 128), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m_pad, 128), jnp.float32),
+            jax.ShapeDtypeStruct((m_pad, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((m_pad, 128), jnp.float32),
+            pltpu.VMEM((m_pad, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(ph2, w2)
+    # cross-lane reduction in f64 (cheap: m x 128)
+    c = jnp.sum(c_part[:m].astype(jnp.float64), axis=-1)
+    s = jnp.sum(s_part[:m].astype(jnp.float64), axis=-1)
+    return c, s
+
+
+def _tpu_backend():
+    import jax
+
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def harmonic_sums(phases, m, weights=None):
+    """Dispatch: pallas kernel on TPU for large photon batches, jnp
+    elsewhere. Both return (C[1..m], S[1..m]) in f64."""
+    import jax.numpy as jnp
+
+    ph = jnp.asarray(phases)
+    n = ph.size
+    # 1-D only: the kernel ravels, so batched inputs must keep the
+    # jnp path's per-axis semantics rather than silently co-adding
+    if ph.ndim == 1 and n >= (1 << 16) and _tpu_backend():
+        try:
+            return harmonic_sums_pallas(phases, m, weights=weights)
+        except Exception:  # mosaic/version quirks: fall back silently
+            pass
+    return harmonic_sums_jnp(phases, m, weights=weights)

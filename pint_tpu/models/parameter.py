@@ -220,11 +220,17 @@ class AngleParameter(Parameter):
         scale = 15.0 if self.angle_unit == "hourangle" else 1.0
         total = rad / _D2R / scale
         sign = "-" if total < 0 else ""
-        total = abs(total)
-        d = int(total)
-        m = int((total - d) * 60)
-        s = (total - d - m / 60.0) * 3600.0
-        return f"{sign}{d:02d}:{m:02d}:{s:013.10f}"
+        # integer tick arithmetic at the printed resolution so seconds
+        # can never print as 60.0 ("1:0:0" used to format as 00:59:60
+        # through float truncation)
+        ndec = 10
+        unit = 10**ndec
+        ticks = round(abs(total) * 3600 * unit)
+        d, rem = divmod(ticks, 3600 * unit)
+        m, s_ticks = divmod(rem, 60 * unit)
+        s_int, s_frac = divmod(s_ticks, unit)
+        return (f"{sign}{int(d):02d}:{int(m):02d}:"
+                f"{int(s_int):02d}.{int(s_frac):0{ndec}d}")
 
 
 class prefixParameter(floatParameter):

@@ -224,13 +224,15 @@ class TimingModel:
 
     @free_params.setter
     def free_params(self, names):
+        # validate BEFORE touching any frozen flag: a typo must not
+        # leave the model with a half-rewritten free-parameter set
+        missing = set(names) - set(self.params)
+        if missing:
+            raise KeyError(f"unknown params {missing}")
         for p in self.params:
             if p in self.top_params:
                 continue
             getattr(self, p).frozen = p not in names
-        missing = set(names) - set(self.params)
-        if missing:
-            raise KeyError(f"unknown params {missing}")
 
     def get_params_dict(self):
         return {p: getattr(self, p).value for p in self.params}
@@ -258,6 +260,12 @@ class TimingModel:
         for p in self.top_params:
             lines.append(self._top[p].as_parfile_line())
         for comp in list(self.delay_components()) + list(self.phase_components()):
+            name = getattr(comp, "binary_model_name", None)
+            if name is not None:
+                # the BINARY line is the model selector, not a
+                # parameter — without it the par file can't rebuild
+                # the model (par-file-as-checkpoint invariant)
+                lines.append(f"{'BINARY':<15} {name}\n")
             for pname in comp.params:
                 lines.append(getattr(comp, pname).as_parfile_line())
         return "".join(l for l in lines if l)

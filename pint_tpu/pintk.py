@@ -30,9 +30,13 @@ class InteractivePulsar:
         self.toas = toas
         self.fitter_factory = fitter_factory
         self._history = [copy.deepcopy(model)]
+        # parallel to _history: whether each entry came from a fit
+        # (par edits also grow history, so len(history)>1 != fitted)
+        self._from_fit = [False]
         self.selected = np.zeros(len(toas), dtype=bool)
         self.fitted = False
         self.last_fit = None
+        self._all_toas = None  # pre-deletion snapshot (restore_all_toas)
 
     @property
     def model(self):
@@ -67,6 +71,7 @@ class InteractivePulsar:
         fitter = self.fitter_factory(self.toas, model)
         fitter.fit_toas(**kw)
         self._history.append(fitter.model)
+        self._from_fit.append(True)
         self.fitted = True
         self.last_fit = fitter
         return fitter
@@ -74,11 +79,15 @@ class InteractivePulsar:
     def undo(self):
         if len(self._history) > 1:
             self._history.pop()
-        self.fitted = len(self._history) > 1
+            self._from_fit.pop()
+        self.fitted = self._from_fit[-1]
+        if not self.fitted:
+            self.last_fit = None
         return self.model
 
     def reset(self):
         del self._history[1:]
+        del self._from_fit[1:]
         self.fitted = False
         self.last_fit = None
 
@@ -115,6 +124,119 @@ class InteractivePulsar:
                     del f["jump"]
         comp.remove_param(name)
         comp.jump_ids.remove(idx)
+
+    # -- TOA deletion (reference: plk delete/restore on selection) --
+
+    def delete_selected(self):
+        """Drop the selected TOAs from the working set (the full set is
+        kept for restore, mirroring pintk's all_toas/selected_toas
+        split: reference pintk/pulsar.py::Pulsar.delete_TOAs)."""
+        if not self.selected.any():
+            raise ValueError("no TOAs selected")
+        if self._all_toas is None:
+            self._all_toas = self.toas
+        keep = ~self.selected
+        self.toas = self.toas.mask(keep)
+        self.selected = np.zeros(len(self.toas), dtype=bool)
+
+    def restore_all_toas(self):
+        """Undo every deletion (reference: Pulsar.reset_TOAs side)."""
+        if self._all_toas is not None:
+            self.toas = self._all_toas
+            self._all_toas = None
+        self.selected = np.zeros(len(self.toas), dtype=bool)
+
+    # -- pulse numbers / phase wraps (reference: Pulsar.add_phase_wrap) --
+
+    def compute_pulse_numbers(self):
+        """Stamp model-predicted pulse numbers into the pn flags so
+        residual tracking survives wraps/deletions (reference:
+        TOAs.compute_pulse_numbers + pintk track mode)."""
+        r = Residuals(self.toas, self.model, track_mode="nearest")
+        frac, pulse_int = r.prepared.phase_frac_and_int(None)
+        pn = np.asarray(pulse_int) + np.round(np.asarray(frac))
+        for i, f in enumerate(self.toas.flags):
+            f["pn"] = repr(int(pn[i]))
+        return pn
+
+    def add_phase_wrap(self, n_wraps: int):
+        """Add +-N integer turns to the selected TOAs' pulse numbers
+        (reference: pintk/pulsar.py::Pulsar.add_phase_wrap). Computes
+        pulse numbers first unless every SELECTED TOA already carries
+        one (delete/restore cycles can leave partial stamping)."""
+        if not self.selected.any():
+            raise ValueError("no TOAs selected")
+        sel_idx = np.flatnonzero(self.selected)
+        if not all("pn" in self.toas.flags[i] for i in sel_idx):
+            self.compute_pulse_numbers()
+        for i in sel_idx:
+            f = self.toas.flags[i]
+            f["pn"] = repr(int(float(f["pn"])) + int(n_wraps))
+
+    # -- color modes (reference: pintk/colormodes.py, headless form) --
+
+    COLOR_MODES = ("default", "obs", "freq", "error", "jump", "selected")
+
+    def color_categories(self, mode="default"):
+        """Per-TOA category labels for plotting frontends: the logic
+        layer of pintk's colormodes (DefaultMode/ObservatoryMode/
+        FreqMode/ErrorMode/JumpMode) without Tk or colors."""
+        n = len(self.toas)
+        if mode == "default":
+            return np.array(["prefit" if not self.fitted else "postfit"] * n,
+                            dtype=object)
+        if mode == "obs":
+            return self.toas.obs.astype(object)
+        if mode == "freq":
+            f = self.toas.freq_mhz
+            bands = [(0.0, "<400"), (400.0, "400-700"), (700.0, "700-1000"),
+                     (1000.0, "1000-1800"), (1800.0, "1800-3000"),
+                     (3000.0, ">3000")]
+            out = np.empty(n, dtype=object)
+            for lo, name in bands:
+                out[f >= lo] = name
+            out[~np.isfinite(f)] = "inf"
+            return out
+        if mode == "error":
+            med = np.median(self.toas.error_us)
+            return np.where(self.toas.error_us > med, "above-median",
+                            "below-median").astype(object)
+        if mode == "jump":
+            tags = self.toas.get_flag_value("jump", fill="")
+            return np.array([t if t else "unjumped" for t in tags],
+                            dtype=object)
+        if mode == "selected":
+            return np.where(self.selected, "selected",
+                            "unselected").astype(object)
+        raise ValueError(f"unknown color mode {mode!r}; "
+                         f"choose from {self.COLOR_MODES}")
+
+    # -- fit-parameter checkboxes (reference: plk fitbox) --
+
+    def set_fit_params(self, names):
+        """Free exactly these parameters (the plk fitbox behavior)."""
+        self.model.free_params = list(names)
+
+    # -- par/tim editing (reference: pintk/paredit.py + timedit.py) --
+
+    def apply_parfile(self, par_text: str):
+        """Replace the working model with an edited par file, keeping
+        history (paredit's 'apply changes'). The previous fit no
+        longer describes the working model, so last_fit is dropped
+        (random_models must not spread around a stale covariance)."""
+        from .models import get_model
+
+        self._history.append(get_model(par_text))
+        self._from_fit.append(False)
+        self.fitted = False
+        self.last_fit = None
+
+    def write_par(self, path):
+        with open(path, "w") as f:
+            f.write(self.model.as_parfile())
+
+    def write_tim(self, path):
+        self.toas.write_TOA_file(path)
 
     # -- random-model spread (reference: Pulsar.random_models) --
 

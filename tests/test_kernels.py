@@ -1,0 +1,52 @@
+"""Pallas kernel mirrors vs their jnp reference implementations.
+
+The TPU kernels run in interpreter mode here (conftest forces the CPU
+backend), which executes the same kernel logic; the real-chip speedup
+is measured by benchmarks/kernel_bench.py.
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu.kernels import harmonic_sums_jnp, harmonic_sums_pallas
+
+
+@pytest.mark.parametrize("n,m", [(1000, 5), (200_000, 20), (8192, 1)])
+def test_harmonic_sums_matches_jnp(n, m):
+    rng = np.random.default_rng(42)
+    ph = rng.random(n)
+    c0, s0 = harmonic_sums_jnp(ph, m)
+    c1, s1 = harmonic_sums_pallas(ph, m, interpret=True)
+    # f32 block accumulation: absolute error ~n * 2^-24-class
+    tol = max(4e-8 * n, 1e-4)
+    assert np.abs(np.asarray(c0) - np.asarray(c1)).max() < tol
+    assert np.abs(np.asarray(s0) - np.asarray(s1)).max() < tol
+
+
+def test_harmonic_sums_weighted_and_padding():
+    """Weights flow through, and the block padding contributes zero
+    (n deliberately NOT a multiple of the 8192-photon block)."""
+    rng = np.random.default_rng(1)
+    n = 8192 * 3 + 517
+    ph = rng.random(n)
+    w = rng.random(n)
+    c0, s0 = harmonic_sums_jnp(ph, 8, w)
+    c1, s1 = harmonic_sums_pallas(ph, 8, weights=w, interpret=True)
+    tol = 4e-8 * n
+    assert np.abs(np.asarray(c0) - np.asarray(c1)).max() < tol
+    assert np.abs(np.asarray(s0) - np.asarray(s1)).max() < tol
+
+
+def test_z2m_h_test_through_kernel_path():
+    """End statistic: H-test of a pulsed signal is unchanged (to stat
+    noise) whichever path computes the harmonic sums."""
+    from pint_tpu.eventstats import hm, z2m
+
+    rng = np.random.default_rng(7)
+    n = 100_000
+    ph = np.concatenate([(rng.normal(0.3, 0.05, n // 4)) % 1.0,
+                         rng.random(3 * n // 4)])
+    h = float(hm(ph, m=20))
+    z = np.asarray(z2m(ph, m=4))
+    assert h > 1000  # strongly pulsed
+    assert z.shape == (4,) and np.all(np.diff(z) >= 0)

@@ -111,3 +111,202 @@ def test_func_parameter():
     with pytest.raises(AttributeError):
         fp.value = 3.0
     assert fp.as_parfile_line() == ""
+
+
+def test_delete_restore_toas():
+    m = get_model(PAR)
+    t = make_fake_toas_fromMJDs(np.linspace(55000, 55400, 40), m,
+                                error_us=1.0, freq_mhz=1400.0, obs="gbt",
+                                add_noise=True, seed=3)
+    s = InteractivePulsar(get_model(PAR), t)
+    # lo a hair under 55000: the zero-residual iteration leaves the
+    # first UTC MJD ~4e-8 d below its nominal grid point
+    s.select_mjd_range(54999.9, 55100)
+    n_sel = int(s.selected.sum())
+    assert n_sel > 0
+    s.delete_selected()
+    assert len(s.toas) == 40 - n_sel
+    assert (s.toas.get_mjds() > 55100).all()
+    # fit still works on the reduced set
+    s.fit()
+    s.restore_all_toas()
+    assert len(s.toas) == 40
+    with pytest.raises(ValueError):
+        s.delete_selected()  # nothing selected after restore
+
+
+def test_phase_wraps_shift_residuals():
+    """Adding a phase wrap to a block of TOAs moves their tracked
+    residuals by exactly one turn (reference: Pulsar.add_phase_wrap)."""
+    m = get_model(PAR)
+    t = make_fake_toas_fromMJDs(np.linspace(55000, 55400, 30), m,
+                                error_us=1.0, freq_mhz=1400.0, obs="gbt",
+                                add_noise=False, seed=4)
+    s = InteractivePulsar(get_model(PAR), t)
+    pn = s.compute_pulse_numbers()
+    assert np.all(np.diff(pn) > 0)
+    s.select_mjd_range(55300, 55500)
+    sel = s.selected.copy()
+    r0 = Residuals(s.toas, s.model, track_mode="use_pulse_numbers",
+                   subtract_mean=False)
+    ph0 = np.asarray(r0.calc_phase_resids())
+    s.add_phase_wrap(-1)
+    r1 = Residuals(s.toas, s.model, track_mode="use_pulse_numbers",
+                   subtract_mean=False)
+    ph1 = np.asarray(r1.calc_phase_resids())
+    assert np.allclose((ph1 - ph0)[sel], 1.0, atol=1e-9)
+    assert np.allclose((ph1 - ph0)[~sel], 0.0, atol=1e-9)
+
+
+def test_color_modes():
+    m = get_model(PAR)
+    t = make_fake_toas_fromMJDs(np.linspace(55000, 55400, 20), m,
+                                error_us=1.0,
+                                freq_mhz=np.where(np.arange(20) % 2, 1440.0,
+                                                  820.0),
+                                obs="gbt", add_noise=True, seed=5)
+    s = InteractivePulsar(get_model(PAR), t)
+    assert set(s.color_categories("freq")) == {"700-1000", "1000-1800"}
+    assert set(s.color_categories("obs")) == {"gbt"}
+    assert set(s.color_categories("error")) <= {"above-median", "below-median"}
+    s.select(np.arange(20) < 5)
+    cats = s.color_categories("selected")
+    assert (cats[:5] == "selected").all() and (cats[5:] == "unselected").all()
+    s.add_jump_to_selection()
+    jc = s.color_categories("jump")
+    assert (jc[:5] == "pintk_1").all() and (jc[5:] == "unjumped").all()
+    with pytest.raises(ValueError):
+        s.color_categories("nope")
+
+
+def test_fitbox_and_paredit(tmp_path):
+    m = get_model(PAR)
+    t = make_fake_toas_fromMJDs(np.linspace(55000, 55400, 25), m,
+                                error_us=1.0, freq_mhz=1400.0, obs="gbt",
+                                add_noise=True, seed=6)
+    s = InteractivePulsar(get_model(PAR), t)
+    s.set_fit_params(["F0"])
+    assert s.model.free_params == ["F0"]
+    # paredit: apply an edited par with a different DM, history grows
+    edited = PAR.replace("DM 11.0 1", "DM 12.5 1")
+    s.apply_parfile(edited)
+    assert s.model.DM.value == pytest.approx(12.5)
+    s.undo()
+    assert s.model.DM.value == pytest.approx(11.0)
+    # write out par + tim and reload
+    s.write_par(tmp_path / "out.par")
+    s.write_tim(tmp_path / "out.tim")
+    from pint_tpu.models import get_model_and_toas
+
+    m2, t2 = get_model_and_toas(str(tmp_path / "out.par"),
+                                str(tmp_path / "out.tim"))
+    assert len(t2) == 25
+    assert m2.F0.value == pytest.approx(s.model.F0.value)
+
+
+@pytest.mark.parametrize("bin_name,extra", [
+    ("ELL1", "PB 1.2 1\nA1 2.0\nTASC 55000\nEPS1 1e-7\nEPS2 0\n"),
+    ("DD", "PB 10 1\nA1 5.0\nT0 55000\nECC 0.3\nOM 90\nM2 0.3\nSINI 0.9\n"),
+    ("DDK", "PB 10 1\nA1 5.0\nT0 55000\nECC 0.3\nOM 90\nM2 0.3\nKIN 70\n"
+            "KOM 30\nPX 1.2\nPMRA 5\nPMDEC -3\n"),
+    ("ELL1H", "PB 1.2 1\nA1 2.0\nTASC 55000\nEPS1 1e-7\nEPS2 0\nH3 1e-7\n"
+              "H4 4e-8\n"),
+])
+def test_binary_parfile_roundtrip(bin_name, extra):
+    """as_parfile must emit the BINARY selector line: the par file IS
+    the checkpoint (reference: TimingModel.as_parfile; SURVEY.md 5
+    checkpoint/resume)."""
+    par = (f"PSR T\nRAJ 1:0:0\nDECJ 2:0:0\nF0 100 1\nPEPOCH 55000\n"
+           f"DM 10\nBINARY {bin_name}\n{extra}")
+    m = get_model(par)
+    m2 = get_model(m.as_parfile())
+    assert ([c for c in m2.components if c.startswith("Binary")]
+            == [c for c in m.components if c.startswith("Binary")])
+    assert m2.PB.value == pytest.approx(m.PB.value)
+
+
+def test_angle_formatting_carry():
+    """1:0:0 must print as 01:00:00..., never 00:59:60... (integer
+    tick formatting), and round-trip exactly."""
+    m = get_model("PSR T\nRAJ 1:0:0\nDECJ -0:0:30\nF0 100\nPEPOCH 55000\n"
+                  "DM 10\n")
+    txt = m.as_parfile()
+    raj = next(l for l in txt.splitlines() if l.startswith("RAJ"))
+    assert "01:00:00" in raj and ":60" not in raj
+    m2 = get_model(txt)
+    assert m2.RAJ.value == pytest.approx(m.RAJ.value, abs=1e-15)
+    assert m2.DECJ.value == pytest.approx(m.DECJ.value, abs=1e-15)
+
+
+def test_paredit_clears_fit_state():
+    """apply_parfile drops last_fit and fitted; undo restores them
+    consistently (review finding: stale last_fit fed random_models)."""
+    m = get_model(PAR)
+    t = make_fake_toas_fromMJDs(np.linspace(55000, 55400, 20), m,
+                                error_us=1.0, freq_mhz=1400.0, obs="gbt",
+                                add_noise=True, seed=9)
+    s = InteractivePulsar(get_model(PAR), t)
+    s.fit()
+    assert s.fitted and s.last_fit is not None
+    s.apply_parfile(PAR.replace("DM 11.0 1", "DM 11.3 1"))
+    assert not s.fitted and s.last_fit is None
+    with pytest.raises(RuntimeError):
+        s.random_models()
+    s.undo()  # back to the post-fit model
+    assert s.fitted
+    s.undo()  # back to the initial model
+    assert not s.fitted and s.last_fit is None
+
+
+def test_phase_wrap_after_delete_restore():
+    """Partial pn stamping from a delete/compute/restore cycle must
+    trigger a recompute, not a KeyError (review finding)."""
+    m = get_model(PAR)
+    t = make_fake_toas_fromMJDs(np.linspace(55000, 55400, 20), m,
+                                error_us=1.0, freq_mhz=1400.0, obs="gbt",
+                                add_noise=False, seed=10)
+    s = InteractivePulsar(get_model(PAR), t)
+    s.select(np.arange(20) < 5)
+    s.delete_selected()
+    s.select(np.ones(15, dtype=bool))
+    s.add_phase_wrap(0)  # stamps pn on the 15 survivors only
+    s.restore_all_toas()
+    s.select(np.arange(20) < 5)  # restored TOAs: no pn yet
+    s.add_phase_wrap(2)
+    for i in range(5):
+        assert "pn" in s.toas.flags[i]
+
+
+def test_free_params_setter_validates_first():
+    m = get_model(PAR)
+    before = m.free_params
+    with pytest.raises(KeyError):
+        m.free_params = ["F0", "NOT_A_PARAM"]
+    assert m.free_params == before  # untouched on failure
+
+
+def test_harmonic_sums_batched_input_uses_jnp_path():
+    """2-D phases must never silently co-add through the raveling
+    pallas kernel (review finding): the dispatcher is 1-D-only."""
+    from pint_tpu.kernels import harmonics
+
+    called = {"pallas": False}
+    orig = harmonics.harmonic_sums_pallas
+
+    def spy(*a, **k):
+        called["pallas"] = True
+        return orig(*a, **k)
+
+    harmonics.harmonic_sums_pallas = spy
+    old_backend = harmonics._tpu_backend
+    harmonics._tpu_backend = lambda: True  # pretend we're on TPU
+    try:
+        ph2d = np.random.default_rng(0).random((4, 70000))
+        with pytest.raises(Exception):
+            # jnp path's broadcasting rejects 2-D input loudly on every
+            # backend -- exactly the parity the dispatcher must keep
+            np.asarray(harmonics.harmonic_sums(ph2d, 3)[0])
+        assert not called["pallas"]
+    finally:
+        harmonics.harmonic_sums_pallas = orig
+        harmonics._tpu_backend = old_backend

@@ -157,3 +157,39 @@ def test_event_optimize_smoke(tmp_path, capsys):
     assert "max posterior" in cap
     import os
     assert os.path.exists(out_par)
+
+
+def test_t2binary2pint(tmp_path):
+    from pint_tpu.scripts.t2binary2pint import choose_model, main
+
+    t2_par = """PSR J1012+5307
+RAJ 10:12:33.43
+DECJ 53:07:02.5
+F0 190.2678376 1
+F1 -6.2e-16
+PEPOCH 55000
+DM 9.02
+BINARY T2
+PB 0.60467 1
+A1 0.58181 1
+TASC 50700.08 1
+EPS1 1.3e-7 1
+EPS2 -4.0e-7 1
+"""
+    src = tmp_path / "t2.par"
+    out = tmp_path / "pint.par"
+    src.write_text(t2_par)
+    assert main([str(src), str(out)]) == 0
+    text = out.read_text()
+    assert "ELL1" in text and "T2" not in text.split()
+    from pint_tpu.models import get_model
+
+    m = get_model(str(out))
+    assert "BinaryELL1" in m.components
+    assert m.PB.value == pytest.approx(0.60467)
+
+    # model choice heuristics (reference: t2binary2pint mapping)
+    assert choose_model({"KIN", "ECC"}) == "DDK"
+    assert choose_model({"EPS1", "H3"}) == "ELL1H"
+    assert choose_model({"ECC", "OM", "M2", "SINI"}) == "DD"
+    assert choose_model({"ECC", "OM"}) == "BT"
