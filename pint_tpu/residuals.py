@@ -99,6 +99,55 @@ class Residuals:
     def reduced_chi2(self):
         return self.chi2 / self.dof
 
+    def ecorr_average(self, use_noise_model=True):
+        """Epoch-averaged residuals (reference:
+        residuals.py::Residuals.ecorr_average — NANOGrav-style averaged
+        residual plots).
+
+        TOAs are grouped by the EcorrNoise quantization epochs; TOAs
+        outside every epoch (singletons, or no ECORR component) are
+        their own groups. Within a group the residual is the
+        1/sigma^2-weighted mean; the group error is
+        sqrt(1/sum(1/sigma^2) + ECORR^2). With use_noise_model=False,
+        raw TOA uncertainties replace the EFAC/EQUAD-scaled ones and
+        the ECORR term is dropped (matching the reference's toggle).
+
+        Returns a dict with 'mjds', 'freqs', 'time_resids' [s],
+        'errors' [us], 'indices' (list of member-index arrays).
+        """
+        n = len(self.toas)
+        sigma_us = (np.asarray(self.prepared.scaled_sigma_us())
+                    if use_noise_model else np.asarray(self.toas.error_us))
+        r = np.asarray(self.time_resids)
+        mjd = self.toas.get_mjds()
+        freq = self.toas.freq_mhz
+        prep = self.prepared.prep
+        U = np.asarray(prep.get("ecorr_U", np.zeros((n, 0))))
+        groups = [np.flatnonzero(U[:, j]) for j in range(U.shape[1])]
+        w_us2 = np.zeros(U.shape[1])
+        if U.shape[1] and use_noise_model:
+            comp = self.model.components.get("EcorrNoise")
+            if comp is not None:
+                _, w = comp.basis_weight(self.prepared.params0, prep)
+                w_us2 = np.asarray(w)
+        in_epoch = U.sum(axis=1) > 0
+        groups += [np.array([i]) for i in np.flatnonzero(~in_epoch)]
+        w_us2 = np.concatenate([w_us2, np.zeros(n - int(in_epoch.sum()))])
+        order = np.argsort([mjd[g].mean() for g in groups])
+        out = {"mjds": [], "freqs": [], "time_resids": [], "errors": [],
+               "indices": []}
+        for k in order:
+            g = groups[k]
+            w = 1.0 / sigma_us[g] ** 2
+            out["mjds"].append(mjd[g].mean())
+            out["freqs"].append(freq[g].mean())
+            out["time_resids"].append(np.sum(r[g] * w) / np.sum(w))
+            out["errors"].append(np.sqrt(1.0 / np.sum(w) + w_us2[k]))
+            out["indices"].append(g)
+        for key in ("mjds", "freqs", "time_resids", "errors"):
+            out[key] = np.asarray(out[key])
+        return out
+
 
 class WidebandDMResiduals:
     """DM residuals from wideband TOA flags (reference: residuals.py::WidebandDMResiduals).

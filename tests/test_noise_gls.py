@@ -138,3 +138,46 @@ def test_gls_red_noise_whitening():
     # and the noise amplitudes are actually nonzero
     assert f.noise_ampls is not None
     assert np.abs(f.noise_ampls).max() > 0
+
+
+def test_ecorr_average():
+    """Epoch-averaged residuals: grouping follows the ECORR
+    quantization, weighted means are exact, errors shrink ~1/sqrt(n)
+    and include the ECORR term (reference: Residuals.ecorr_average)."""
+    import numpy as np
+
+    from pint_tpu.models import get_model
+    from pint_tpu.residuals import Residuals
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    par = ("PSR TECA\nRAJ 05:00:00\nDECJ 10:00:00\nF0 300.0 1\nPEPOCH 55100\n"
+           "DM 12.0\nEFAC -f X 2.0\nECORR -f X 0.5\n")
+    m = get_model(par)
+    rng = np.random.default_rng(0)
+    epochs = np.sort(rng.uniform(55000, 55200, 25))
+    mjds = np.concatenate([e + np.arange(4) * 0.5 / 86400 for e in epochs])
+    t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=1400.0,
+                                obs="gbt", add_noise=True, seed=1)
+    for f in t.flags:
+        f["f"] = "X"
+    r = Residuals(t, m)
+    avg = r.ecorr_average()
+    assert len(avg["mjds"]) == 25  # one group per 4-TOA epoch
+    assert np.all(np.diff(avg["mjds"]) > 0)
+    # exact weighted mean for the first group
+    g = avg["indices"][0]
+    sig = np.asarray(r.prepared.scaled_sigma_us())[g]
+    w = 1 / sig**2
+    expect = np.sum(np.asarray(r.time_resids)[g] * w) / w.sum()
+    assert avg["time_resids"][0] == pytest.approx(expect, rel=1e-12)
+    # error: EFAC=2 scales sigma to 2us -> 2/sqrt(4)=1, plus ECORR=0.5
+    assert avg["errors"][0] == pytest.approx(np.sqrt(1.0 + 0.25), rel=1e-6)
+    # without the noise model: RAW errors (no EFAC), no ECORR term
+    avg0 = r.ecorr_average(use_noise_model=False)
+    assert avg0["errors"][0] == pytest.approx(0.5, rel=1e-6)
+    # singleton handling: no-ECORR model -> every TOA its own group
+    m2 = get_model("PSR T2\nRAJ 05:00:00\nDECJ 10:00:00\nF0 300.0 1\n"
+                   "PEPOCH 55100\nDM 12.0\n")
+    r2 = Residuals(t, m2)
+    avg2 = r2.ecorr_average()
+    assert len(avg2["mjds"]) == len(t)
