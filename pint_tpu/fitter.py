@@ -132,6 +132,61 @@ class Fitter:
 
         return ftest(other_chi2, other_dof, self.resids.chi2, self.resids.dof)
 
+    def get_derived_params(self) -> dict:
+        """Post-fit derived quantities with first-order propagated
+        uncertainties (reference: fitter.py::Fitter.get_derived_params).
+
+        Always: P0/P1 (from F0/F1), and when F1 < 0 the spin-down
+        quantities AGE [yr], BSURF [G], EDOT [erg/s]. With proper
+        motion: PMTOT [mas/yr]. With a binary: MASSFN [Msun], minimum
+        and median companion masses (sin i = 1, 0.866), and the pulsar
+        mass when M2 and SINI are both fit.
+        Values are (value, uncertainty-or-None) pairs.
+        """
+        from . import derived_quantities as dq
+
+        out = {}
+        f0 = self.model.F0.value
+        f0e = self.model.F0.uncertainty or 0.0
+        f1 = getattr(self.model, "F1", None)
+        f1v = f1.value if f1 is not None and f1.value is not None else 0.0
+        f1e = (f1.uncertainty or 0.0) if f1 is not None else 0.0
+        p0 = 1.0 / f0
+        p0e = f0e / f0**2
+        p1 = -f1v / f0**2
+        p1e = np.sqrt((f1e / f0**2) ** 2 + (2 * f1v * f0e / f0**3) ** 2)
+        out["P0"] = (p0, p0e or None)
+        out["P1"] = (p1, p1e or None)
+        if f1v < 0:
+            out["AGE_yr"] = (float(dq.pulsar_age(f0, f1v)), None)
+            out["BSURF_G"] = (float(dq.pulsar_B(f0, f1v)), None)
+            out["EDOT_erg_s"] = (float(dq.pulsar_edot(f0, f1v)), None)
+        pm_names = (("PMRA", "PMDEC") if "PMRA" in self.model.params
+                    else ("PMELONG", "PMELAT"))
+        if all(n in self.model.params for n in pm_names):
+            a = getattr(self.model, pm_names[0]).value
+            b = getattr(self.model, pm_names[1]).value
+            if a is not None and b is not None:
+                out["PMTOT_masyr"] = (float(dq.pmtot(a, b)), None)
+        pb = (self.model.PB.value if "PB" in self.model.params else None)
+        if pb is None and "FB0" in self.model.params \
+                and self.model.FB0.value:
+            pb = 1.0 / self.model.FB0.value / 86400.0  # FB0 [Hz] -> PB [d]
+        a1 = (self.model.A1.value if "A1" in self.model.params else None)
+        if pb is not None and a1 is not None:
+            fm = float(dq.mass_function(pb, a1))
+            out["MASSFN_Msun"] = (fm, None)
+            out["MC_MIN_Msun"] = (float(dq.companion_mass(pb, a1, 1.0)), None)
+            out["MC_MED_Msun"] = (float(dq.companion_mass(pb, a1, 0.866)),
+                                  None)
+            m2 = getattr(self.model, "M2", None)
+            sini = getattr(self.model, "SINI", None)
+            if (m2 is not None and m2.value and sini is not None
+                    and sini.value):
+                out["MP_Msun"] = (float(dq.pulsar_mass(pb, a1, m2.value,
+                                                       sini.value)), None)
+        return out
+
 
 def _n_offset(labels):
     """Count of leading non-parameter columns (the implicit 'Offset');
@@ -216,6 +271,21 @@ def wls_step(Mw, rw, threshold=1e-12):
     return dx, covn, norm
 
 
+def _reject_free_dmjump(model):
+    """Narrowband fitters must refuse free DMJUMPs: their time-domain
+    design column is identically zero, so the 'fit' would report the
+    input value with uncertainty 0 (reference behavior: DMJUMP has no
+    delay derivative and only wideband fitters handle it)."""
+    comp = model.components.get("DispersionJump")
+    if comp is None:
+        return
+    free = [p for p in comp.params if not getattr(comp, p).frozen]
+    if free:
+        raise ValueError(
+            f"free DMJUMP parameters {free} affect only wideband DM "
+            "measurements; use a wideband fitter or freeze them")
+
+
 class WLSFitter(Fitter):
     """Weighted least squares via SVD (reference: fitter.py::WLSFitter).
 
@@ -228,6 +298,7 @@ class WLSFitter(Fitter):
         corr = _correlated_noise_components(self.model)
         if corr:
             raise CorrelatedErrors(corr)
+        _reject_free_dmjump(self.model)
         prepared = self.model.prepare(self.toas)
         resid_fn = prepared.residual_vector_fn(track_mode=self._track_mode())
         dm_fn, labels = prepared.designmatrix_fn()
@@ -262,6 +333,7 @@ class DownhillWLSFitter(WLSFitter):
         corr = _correlated_noise_components(self.model)
         if corr:
             raise CorrelatedErrors(corr)
+        _reject_free_dmjump(self.model)
         prepared = self.model.prepare(self.toas)
         resid_fn = prepared.residual_vector_fn(track_mode=self._track_mode())
         dm_fn, labels = prepared.designmatrix_fn()
@@ -344,6 +416,7 @@ class GLSFitter(Fitter):
     def fit_toas(self, maxiter=2, threshold=1e-12, tol=0.0):
         import jax.numpy as jnp
 
+        _reject_free_dmjump(self.model)
         chi2 = None
         prepared = self.model.prepare(self.toas)
         resid_fn = prepared.residual_vector_fn(track_mode=self._track_mode())
@@ -454,11 +527,10 @@ class WidebandTOAFitter(GLSFitter):
         from .pint_matrix import DesignMatrix
 
         def dm_model(x):
+            from .residuals import wideband_dm_model
+
             p = prepared.params_with_vector(x)
-            comp = self.model.components["DispersionDM"]
-            dm = comp.dm_value(p, prepared.prep)
-            if "DMX" in p:
-                dm = dm + p["DMX"] @ prepared.prep["dmx_masks"]
+            dm = wideband_dm_model(self.model, p, prepared.prep)
             return dm[jnp.asarray(np.flatnonzero(valid))]
 
         x0 = prepared.vector_from_params()
@@ -653,6 +725,7 @@ class PowellFitter(Fitter):
         import jax.numpy as jnp
         from scipy.optimize import minimize
 
+        _reject_free_dmjump(self.model)
         prepared = self.model.prepare(self.toas)
         resid_fn = prepared.residual_vector_fn(track_mode=self._track_mode())
         dm_fn, labels = prepared.designmatrix_fn()

@@ -57,8 +57,11 @@ class MCMCFitter(Fitter):
         self.converged = self.sampler.accept_frac > 0.05
         return self.maxpost
 
-    def get_derived_params(self, burn=0):
-        """Posterior samples dict, for corner plots / summaries."""
+    def get_posterior_samples(self, burn=0):
+        """Posterior samples dict, for corner plots / summaries.
+
+        (Renamed from get_derived_params so the base Fitter's derived-
+        quantity API stays uniform across all fitters.)"""
         flat = self.sampler.chain[burn:].reshape(-1, self.ndim)
         return {p: flat[:, i] for i, p in enumerate(self.bt.param_labels)}
 

@@ -59,7 +59,8 @@ def get_model(parfile, allow_name_mixing=False) -> TimingModel:
     repeats = []
     for k, fields in entries:
         canon = ALIASES.get(k, k)
-        if canon in ("JUMP", "EFAC", "EQUAD", "ECORR", "DMEFAC", "DMEQUAD"):
+        if canon in ("JUMP", "EFAC", "EQUAD", "ECORR", "DMEFAC", "DMEQUAD",
+                     "DMJUMP"):
             repeats.append((canon, fields))
         else:
             keys[canon] = fields
@@ -112,6 +113,10 @@ def get_model(parfile, allow_name_mixing=False) -> TimingModel:
         model.add_component(PhaseOffset())
     if any(c == "JUMP" for c, _ in repeats):
         model.add_component(PhaseJump())
+    if any(c == "DMJUMP" for c, _ in repeats):
+        from .dispersion import DispersionJump
+
+        model.add_component(DispersionJump())
     if "BINARY" in keys:
         from .binary import add_binary_component
 
@@ -255,11 +260,15 @@ def get_model(parfile, allow_name_mixing=False) -> TimingModel:
 
     # --- repeated mask parameters ---
     jump_comp = model.components.get("PhaseJump")
+    dmjump_comp = model.components.get("DispersionJump")
     noise_comp = model.components.get("ScaleToaError")
     ecorr_comp = model.components.get("EcorrNoise")
     for canon, fields in repeats:
         if canon == "JUMP" and jump_comp is not None:
             p = jump_comp.add_jump()
+            p.from_parfile_fields(fields)
+        elif canon == "DMJUMP" and dmjump_comp is not None:
+            p = dmjump_comp.add_dmjump()
             p.from_parfile_fields(fields)
         elif canon in ("EFAC", "EQUAD", "DMEFAC", "DMEQUAD") and noise_comp is not None:
             noise_comp.add_mask_param(canon, fields)

@@ -161,3 +161,61 @@ class DispersionDMX(DelayComponent):
         dm_per_toa = params["DMX"] @ prep["dmx_masks"]
         f2 = jnp.square(batch.freq_mhz)
         return jnp.where(jnp.isfinite(f2), DMconst * dm_per_toa / f2, 0.0)
+
+
+class DispersionJump(DelayComponent):
+    """DMJUMP: per-subset DM offsets applied to wideband DM
+    measurements ONLY — no TOA delay contribution (reference:
+    src/pint/models/dispersion_model.py::DispersionJump, the wideband
+    analog of JUMP: receiver-dependent offsets in the measured DMs).
+
+    Sign matches the reference's jump_dm: the jump enters the model DM
+    negated (dm_model - DMJUMP over each mask), so fitted DMJUMP values
+    interchange with reference par files (see
+    residuals.py::wideband_dm_model). A FREE DMJUMP is meaningful only
+    to wideband fitters; narrowband fitters reject it loudly rather
+    than reporting a zero-uncertainty no-op fit.
+    """
+
+    category = "dispersion_jump"
+    order = 31
+
+    def __init__(self):
+        super().__init__()
+        self.dmjump_ids: list[int] = []
+
+    def add_dmjump(self, key="", key_value=(), value=0.0, frozen=False,
+                   index=None):
+        from .parameter import maskParameter
+
+        index = index if index is not None else len(self.dmjump_ids) + 1
+        p = maskParameter(f"DMJUMP{index}", "DMJUMP", index,
+                          units="pc cm^-3", frozen=frozen)
+        p.key = key
+        p.key_value = list(key_value)
+        p.value = value
+        self.add_param(p)
+        self.dmjump_ids.append(index)
+        return p
+
+    def device_slot(self, pname):
+        return "DMJUMP", self.dmjump_ids.index(int(pname[6:]))
+
+    def pack(self, model, toas, prep, params0):
+        import jax.numpy as jnp
+
+        if not self.dmjump_ids:
+            params0["DMJUMP"] = np.zeros(0)
+            prep["dmjump_masks"] = jnp.zeros((0, len(toas)))
+            return
+        vals = np.array([getattr(self, f"DMJUMP{i}").value or 0.0
+                         for i in self.dmjump_ids])
+        params0["DMJUMP"] = vals
+        masks = np.stack([getattr(self, f"DMJUMP{i}").resolve_mask(toas)
+                          for i in self.dmjump_ids]).astype(np.float64)
+        prep["dmjump_masks"] = jnp.asarray(masks)
+
+    def delay(self, params, batch, prep, delay_accum):
+        import jax.numpy as jnp
+
+        return jnp.zeros_like(batch.tdb_sec)

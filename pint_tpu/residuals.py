@@ -149,11 +149,31 @@ class Residuals:
         return out
 
 
+def wideband_dm_model(model, params, prep):
+    """Effective per-TOA model DM for the wideband comparison:
+    DM(t) Taylor series + DMX windows + DMJUMP mask offsets. The one
+    assembly point shared by WidebandDMResiduals and the wideband
+    fitter's DM design block, so derivatives and residuals can't
+    disagree (reference: dispersion components' contribution to
+    WidebandDMResiduals)."""
+    comp = model.components.get("DispersionDM")
+    dm = comp.dm_value(params, prep)
+    if "DispersionDMX" in model.components:
+        dm = dm + params["DMX"] @ prep["dmx_masks"]
+    if "DispersionJump" in model.components and len(params.get("DMJUMP", ())):
+        # upstream sign convention (dispersion_model.py::DispersionJump
+        # jump_dm): the jump enters the MODEL DM with a minus sign, so
+        # d(DM_resid)/d(DMJUMP) = +1 and par files interchange with the
+        # reference without negating
+        dm = dm - params["DMJUMP"] @ prep["dmjump_masks"]
+    return dm
+
+
 class WidebandDMResiduals:
     """DM residuals from wideband TOA flags (reference: residuals.py::WidebandDMResiduals).
 
     Observed DM per TOA comes from -pp_dm/-pp_dme flags; model DM is
-    the DispersionDM/DMX prediction.
+    the DispersionDM/DMX (+DMJUMP) prediction.
     """
 
     def __init__(self, toas, model, prepared=None):
@@ -170,13 +190,7 @@ class WidebandDMResiduals:
 
     def calc_dm_resids(self, params=None):
         p = self.prepared.params0 if params is None else params
-        comp = self.model.components.get("DispersionDM")
-        dm_model = comp.dm_value(p, self.prepared.prep)
-        if "DispersionDMX" in self.model.components:
-            import jax.numpy as jnp
-
-            dmx = p["DMX"] @ self.prepared.prep["dmx_masks"]
-            dm_model = dm_model + dmx
+        dm_model = wideband_dm_model(self.model, p, self.prepared.prep)
         return self.dm_observed - np.asarray(dm_model)
 
     @property

@@ -39,19 +39,53 @@ def _iterate_zero_residuals(toas: TOAs, model, iterations=4):
     return toas
 
 
+def _apply_noise(toas: TOAs, model, rng, white=True, correlated=False):
+    """Add measurement-noise draws to TOA times in place and refresh
+    the derived columns. Correlated draws realize each noise
+    component's (basis, weights) pair — ECORR per-epoch offsets and
+    power-law red-noise Fourier amplitudes — exactly as the GLS fit
+    models them (reference: simulation.py add_correlated_noise)."""
+    if white:
+        toas.sec = toas.sec + rng.standard_normal(len(toas)) * toas.error_us * 1e-6
+    if correlated:
+        prepared = model.prepare(toas)
+        for comp in model.components.values():
+            bw = getattr(comp, "basis_weight", None)
+            if bw is None:
+                continue
+            B, w_us2 = bw(prepared.params0, prepared.prep)
+            B = np.asarray(B)
+            w = np.asarray(w_us2)
+            if B.size == 0:
+                continue
+            amps_us = rng.standard_normal(B.shape[1]) * np.sqrt(w)
+            toas.sec = toas.sec + (B @ amps_us) * 1e-6
+    norm = Epochs(toas.day, toas.sec, "utc").normalized()
+    toas.day, toas.sec = norm.day, norm.sec
+    toas.tdb = None
+    toas.ssb_obs = None
+    toas._clock_applied = False
+    toas.apply_clock_corrections()
+    toas.compute_TDBs()
+    toas.compute_posvels()
+
+
 def make_fake_toas_uniform(startMJD, endMJD, ntoas, model, error_us=1.0,
                            freq_mhz=1400.0, obs="gbt", add_noise=False,
+                           add_correlated_noise=False,
                            seed=None, iterations=4) -> TOAs:
     """(reference: simulation.py::make_fake_toas_uniform)"""
     mjds = np.linspace(startMJD, endMJD, ntoas)
     return make_fake_toas_fromMJDs(mjds, model, error_us=error_us,
                                    freq_mhz=freq_mhz, obs=obs,
-                                   add_noise=add_noise, seed=seed,
-                                   iterations=iterations)
+                                   add_noise=add_noise,
+                                   add_correlated_noise=add_correlated_noise,
+                                   seed=seed, iterations=iterations)
 
 
 def make_fake_toas_fromMJDs(mjds, model, error_us=1.0, freq_mhz=1400.0,
-                            obs="gbt", add_noise=False, seed=None,
+                            obs="gbt", add_noise=False,
+                            add_correlated_noise=False, seed=None,
                             iterations=4) -> TOAs:
     """(reference: simulation.py::make_fake_toas_fromMJDs)"""
     mjds = np.asarray(mjds, dtype=np.float64)
@@ -68,21 +102,14 @@ def make_fake_toas_fromMJDs(mjds, model, error_us=1.0, freq_mhz=1400.0,
     planets = bool(model.PLANET_SHAPIRO.value) if "PLANET_SHAPIRO" in model.params else False
     toas = TOAs(toalist, ephem=ephem, planets=planets)
     _iterate_zero_residuals(toas, model, iterations=iterations)
-    if add_noise:
-        rng = np.random.default_rng(seed)
-        toas.sec = toas.sec + rng.standard_normal(len(toas)) * err * 1e-6
-        norm = Epochs(toas.day, toas.sec, "utc").normalized()
-        toas.day, toas.sec = norm.day, norm.sec
-        toas.tdb = None
-        toas.ssb_obs = None
-        toas._clock_applied = False
-        toas.apply_clock_corrections()
-        toas.compute_TDBs()
-        toas.compute_posvels()
+    if add_noise or add_correlated_noise:
+        _apply_noise(toas, model, np.random.default_rng(seed),
+                     white=add_noise, correlated=add_correlated_noise)
     return toas
 
 
-def make_fake_toas_fromtim(timfile, model, add_noise=False, seed=None) -> TOAs:
+def make_fake_toas_fromtim(timfile, model, add_noise=False,
+                           add_correlated_noise=False, seed=None) -> TOAs:
     """(reference: simulation.py::make_fake_toas_fromtim)"""
     from .toa import read_tim_file
 
@@ -92,15 +119,9 @@ def make_fake_toas_fromtim(timfile, model, add_noise=False, seed=None) -> TOAs:
         ephem = model.EPHEM.value.lower()
     toas = TOAs(toalist, ephem=ephem)
     _iterate_zero_residuals(toas, model)
-    if add_noise:
-        rng = np.random.default_rng(seed)
-        toas.sec = toas.sec + rng.standard_normal(len(toas)) * toas.error_us * 1e-6
-        toas.tdb = None
-        toas.ssb_obs = None
-        toas._clock_applied = False
-        toas.apply_clock_corrections()
-        toas.compute_TDBs()
-        toas.compute_posvels()
+    if add_noise or add_correlated_noise:
+        _apply_noise(toas, model, np.random.default_rng(seed),
+                     white=add_noise, correlated=add_correlated_noise)
     return toas
 
 

@@ -186,3 +186,62 @@ def test_grid_chisq_derived(grid_fitter):
                               ["P"], [ps])
     assert chi2.shape == (3,)
     assert np.isfinite(chi2).all()
+
+
+def test_correlated_noise_simulation():
+    """add_correlated_noise realizes the modeled covariance: ECORR
+    epoch blocks move together, red noise is time-correlated; fitted
+    residual scatter grows beyond the white level (reference:
+    simulation.py add_correlated_noise)."""
+    import numpy as np
+
+    from pint_tpu.models import get_model
+    from pint_tpu.residuals import Residuals
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    par = ("PSR TCN\nRAJ 03:00:00\nDECJ 20:00:00\nF0 250.0 1\nPEPOCH 55500\n"
+           "DM 10.0\nECORR 50.0\n")  # bare mask: every TOA
+    m = get_model(par)
+    rng = np.random.default_rng(0)
+    days = np.sort(rng.uniform(55000, 56000, 30))
+    mjds = np.concatenate([days + k * 0.5 / 86400 for k in range(4)])
+    mjds = np.sort(mjds)
+    t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=1400.0,
+                                obs="gbt", add_noise=True,
+                                add_correlated_noise=True, seed=3)
+    r = np.asarray(Residuals(t, m, subtract_mean=False,
+                             track_mode="nearest").calc_time_resids()) * 1e6
+    # per-epoch means dominated by the 50us ECORR draws, not the 1us white
+    means = [r[4 * k:4 * k + 4].mean() for k in range(30)]
+    assert np.std(means) > 10.0  # ECORR-scale epoch offsets present
+    # within-epoch scatter stays white-noise sized
+    within = np.concatenate([r[4 * k:4 * k + 4] - means[k] for k in range(30)])
+    assert np.std(within) < 5.0
+
+
+def test_get_derived_params():
+    from pint_tpu.fitter import WLSFitter
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    par = ("PSR TDQ\nRAJ 04:00:00\nDECJ 30:00:00\nF0 200.0 1\nF1 -1e-15 1\n"
+           "PEPOCH 55500\nDM 10.0\nPMRA 3.0\nPMDEC -4.0\n"
+           "BINARY ELL1\nPB 2.0 1\nA1 3.0\nTASC 55500\nEPS1 0\nEPS2 0\n"
+           "M2 0.3\nSINI 0.9\n")
+    m = get_model(par)
+    t = make_fake_toas_fromMJDs(np.linspace(55000, 56000, 40), m,
+                                error_us=1.0, freq_mhz=1400.0, obs="gbt",
+                                add_noise=True, seed=1)
+    f = WLSFitter(t, m)
+    f.fit_toas(maxiter=2)
+    d = f.get_derived_params()
+    assert d["P0"][0] == pytest.approx(1 / f.model.F0.value, rel=1e-12)
+    assert d["P0"][1] is not None and d["P0"][1] > 0
+    assert d["P1"][0] == pytest.approx(1e-15 / 200.0**2, rel=1e-2)
+    assert d["PMTOT_masyr"][0] == pytest.approx(5.0, rel=1e-6)
+    assert d["AGE_yr"][0] > 0 and d["BSURF_G"][0] > 0
+    from pint_tpu.derived_quantities import mass_function
+
+    assert d["MASSFN_Msun"][0] == pytest.approx(float(mass_function(2.0, 3.0)))
+    assert d["MC_MIN_Msun"][0] < d["MC_MED_Msun"][0]
+    assert 0.5 < d["MP_Msun"][0] < 3.0

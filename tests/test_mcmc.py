@@ -92,7 +92,7 @@ def test_mcmc_fitter_recovers(fitted):
         wls = getattr(fitted.model, p)
         got = getattr(mf.model, p).value
         assert abs(got - wls.value) < 5 * wls.uncertainty
-    samples = mf.get_derived_params(burn=75)
+    samples = mf.get_posterior_samples(burn=75)
     assert set(samples) == set(mf.bt.param_labels)
     # posterior std same order as WLS uncertainty
     s = samples["F0"].std()

@@ -133,3 +133,57 @@ def test_powell_fitter():
     assert chi2 <= chi2_ref * 1.05
     assert abs(f.model.F0.value - ref.model.F0.value) < 3 * (
         ref.model.F0.uncertainty or 1e-9)
+
+
+def test_dmjump_recovers_receiver_offset():
+    """DMJUMP: a receiver-dependent offset in the measured DMs is
+    absorbed by the masked DMJUMP parameter, not by global DM
+    (reference: dispersion_model.py::DispersionJump; convention
+    resid = dm_obs - (dm_model + DMJUMP), i.e. DMJUMP subtracts from
+    the measurement)."""
+    rng = np.random.default_rng(5)
+    par = PAR + "DMJUMP -fe Rcvr_800 0.0 1\n"
+    m = get_model(par)
+    assert "DispersionJump" in m.components
+    mjds = np.linspace(55000, 56000, 60)
+    t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=1400.0,
+                                obs="gbt", add_noise=True, seed=5)
+    offset = 3e-3
+    for i, f in enumerate(t.flags):
+        f["fe"] = "Rcvr_800" if i % 2 else "Rcvr1_2"
+        dm = 15.99 + (offset if i % 2 else 0.0)
+        f["pp_dm"] = f"{dm + rng.standard_normal() * 1e-4:.8f}"
+        f["pp_dme"] = "1e-4"
+    fit = WidebandTOAFitter(t, copy.deepcopy(m))
+    fit.fit_toas(maxiter=3)
+    # upstream sign: the jump enters the model DM negated, so a +offset
+    # measurement bias fits as DMJUMP = -offset
+    assert abs(fit.model.DMJUMP1.value - (-offset)) < 5e-5
+    assert abs(fit.model.DM.value - 15.99) < 1e-4
+    # par round trip keeps the jump (mask spec + fitted value)
+    m2 = get_model(fit.model.as_parfile())
+    assert abs(m2.DMJUMP1.value - fit.model.DMJUMP1.value) < 1e-12
+    assert m2.DMJUMP1.key == "-fe" and m2.DMJUMP1.key_value == ["Rcvr_800"]
+
+
+def test_free_dmjump_rejected_by_narrowband_fitters():
+    """A free DMJUMP has a zero time-domain design column; WLS/GLS must
+    refuse rather than report a zero-uncertainty no-op (review finding)."""
+    import pytest
+
+    from pint_tpu.fitter import DownhillWLSFitter, WLSFitter
+
+    par = PAR + "DMJUMP -fe Rcvr_800 1e-3 1\n"
+    m = get_model(par)
+    t = make_fake_toas_fromMJDs(np.linspace(55000, 55500, 20), m,
+                                error_us=1.0, freq_mhz=1400.0, obs="gbt",
+                                add_noise=True, seed=1)
+    for f in t.flags:
+        f["fe"] = "Rcvr_800"
+    with pytest.raises(ValueError, match="DMJUMP"):
+        WLSFitter(t, m).fit_toas()
+    with pytest.raises(ValueError, match="DMJUMP"):
+        DownhillWLSFitter(t, m).fit_toas()
+    # frozen DMJUMP is fine narrowband
+    m.DMJUMP1.frozen = True
+    WLSFitter(t, m).fit_toas(maxiter=1)
