@@ -129,7 +129,24 @@ def main():
     _stage(f"GLS done (compile {gls_compile_s:.1f}s, refit {gls_refit_s:.3f}s"
            "); compiling+running WLS refit")
     wls_compile_s, wls_refit_s = _timed_refit(pta.wls_fit, 3)
-    _stage(f"WLS done (compile {wls_compile_s:.1f}s, refit {wls_refit_s:.3f}s)")
+    _stage(f"WLS done (compile {wls_compile_s:.1f}s, refit {wls_refit_s:.3f}s"
+           "); photon H-test throughput")
+
+    # photon-domain side metric: H-test over 4M photon phases (the
+    # pallas streaming kernel on TPU; SURVEY.md 3.5 photon workload)
+    from pint_tpu.eventstats import hm
+
+    rng = np.random.default_rng(0)
+    n_ph = 4_000_000
+    phot = np.concatenate([(rng.normal(0.3, 0.04, n_ph // 4)) % 1.0,
+                           rng.uniform(0, 1, 3 * n_ph // 4)])
+    h = float(hm(phot, m=20))  # compile + warm
+    t0 = time.time()
+    runs = 3
+    for _ in range(runs):
+        h = float(hm(phot, m=20))
+    htest_s = (time.time() - t0) / runs
+    _stage(f"H-test 4M photons: {htest_s:.3f}s (H={h:.0f})")
 
     total_toas = n_psr * n_toa
     rate = total_toas / gls_refit_s  # TOAs GLS-refit per second
@@ -148,6 +165,8 @@ def main():
         "wls_compile_s": round(wls_compile_s, 2),
         "wls_refit_wall_s": round(wls_refit_s, 4),
         "wls_toas_per_sec": round(total_toas / wls_refit_s, 1),
+        "htest_4M_photons_s": round(htest_s, 4),
+        "htest_photons_per_sec": round(n_ph / htest_s, 0),
         "platform": jax.devices()[0].platform,
     }
     print(json.dumps({

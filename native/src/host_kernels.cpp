@@ -280,7 +280,13 @@ struct TimTok {
   int len;
 };
 
-inline bool tim_space(char c) { return c == ' ' || c == '\t' || c == '\r'; }
+// python str.split() whitespace, full ASCII set: space, \t, \v, \f,
+// \x1c-\x1f (file/group/record/unit separators are isspace() in
+// python). \r and \n are line terminators, never intra-line here.
+inline bool tim_space(char c) {
+  return c == ' ' || c == '\t' || c == '\v' || c == '\f' ||
+         (c >= '\x1c' && c <= '\x1f');
+}
 
 inline bool tok_is_ci(const TimTok& t, const char* kw) {
   int i = 0;
@@ -293,15 +299,30 @@ inline bool tok_is_ci(const TimTok& t, const char* kw) {
   return i == t.len;
 }
 
-// full-token float parse (mirrors toa.py::_is_number / float())
+// full-token float parse mirroring python float() (toa.py::_is_number):
+// underscores allowed only between digits (stripped), hex floats
+// rejected; otherwise strtod semantics match python's float grammar.
 inline bool tok_float(const TimTok& t, double* out) {
   char tmp[64];
+  int m = 0;
   if (t.len <= 0 || t.len >= 64) return false;
-  for (int i = 0; i < t.len; ++i) tmp[i] = t.p[i];
-  tmp[t.len] = 0;
+  for (int i = 0; i < t.len; ++i) {
+    char c = t.p[i];
+    if (c == '_') {
+      if (i == 0 || i == t.len - 1) return false;
+      char a = t.p[i - 1], b = t.p[i + 1];
+      if (a < '0' || a > '9' || b < '0' || b > '9') return false;
+      continue;  // python float() strips digit-adjacent underscores
+    }
+    tmp[m++] = c;
+  }
+  tmp[m] = 0;
+  int s = (m > 0 && (tmp[0] == '+' || tmp[0] == '-')) ? 1 : 0;
+  if (s + 1 < m && tmp[s] == '0' && (tmp[s + 1] == 'x' || tmp[s + 1] == 'X'))
+    return false;  // python float() has no hex literals
   char* end = nullptr;
   double v = strtod(tmp, &end);
-  if (end != tmp + t.len) return false;
+  if (end != tmp + m) return false;
   *out = v;
   return true;
 }
@@ -369,8 +390,22 @@ std::int64_t pt_parse_tim_t2(
   const char* end = buf + nbytes;
   const char* line = buf;
   while (line < end) {
+    // universal-newline line split, matching python text mode:
+    // \n, \r\n, and bare \r all terminate a line
     const char* eol = line;
-    while (eol < end && *eol != '\n') ++eol;
+    bool high_byte = false;
+    while (eol < end && *eol != '\n' && *eol != '\r') {
+      if (static_cast<unsigned char>(*eol) >= 0x80) high_byte = true;
+      ++eol;
+    }
+    const char* next_line = eol + 1;
+    if (eol < end && *eol == '\r' && eol + 1 < end && eol[1] == '\n')
+      next_line = eol + 2;
+    // any non-ASCII byte: python owns the line — str.split() honors
+    // unicode whitespace and float() honors unicode digits, neither
+    // of which this parser mirrors (single pass, folded into the
+    // newline scan above)
+    if (high_byte) return -1;
     // tokenize
     int ntok = 0;
     const char* p = line;
@@ -385,7 +420,7 @@ std::int64_t pt_parse_tim_t2(
       p = q;
     }
     if (p < eol && ntok >= MAXTOK) return -1;  // pathological line: python owns it
-    line = eol + 1;
+    line = next_line;
     if (ntok == 0) continue;
     // comments: '#', or 'C '/'c ' (needs a second token to mirror
     // python's startswith("C ") on the stripped line)

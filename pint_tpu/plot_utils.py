@@ -43,8 +43,10 @@ def phaseogram(mjds, phases, weights=None, bins=64, rotate=0.0, size=5,
     ph2 = np.concatenate([ph, ph + 1.0])
     t2 = np.concatenate([mjds, mjds])
     w2 = None if weights is None else np.concatenate([weights, weights])
-    ax1.scatter(ph2, t2, s=size, alpha=alpha,
-                c=None if w2 is None else w2, cmap="viridis")
+    if w2 is None:
+        ax1.scatter(ph2, t2, s=size, alpha=alpha)
+    else:
+        ax1.scatter(ph2, t2, s=size, alpha=alpha, c=w2, cmap="viridis")
     ax1.set_xlim(0, 2)
     ax1.set_xlabel("Pulse Phase")
     ax1.set_ylabel("MJD")

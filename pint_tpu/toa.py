@@ -508,10 +508,12 @@ _TIM_CMD_RE = re.compile(
 
 def _read_tim_native(path: str, **toas_kw) -> "TOAs | None":
     """Build TOAs straight from the C++ tim parser when the file is a
-    plain FORMAT-1 tim (the dominant case at PTA scale). Returns None
-    when the native library is absent or the file needs the stateful
-    Python parser (INCLUDE, TIME/EFAC/..., princeton/parkes lines) —
-    ``read_tim_file`` then handles it. ~20x faster than the Python
+    plain ASCII FORMAT-1 tim (the dominant case at PTA scale). Returns
+    None when the native library is absent or the file needs the
+    Python parser's semantics (INCLUDE, TIME/EFAC/... state,
+    princeton/parkes lines, any non-ASCII byte — unicode whitespace
+    and digits follow str.split()/float() rules only Python knows) —
+    ``read_tim_file`` then handles it. ~12x faster than the Python
     loop on 100k-line files (reference: toa.py::read_toa_file is the
     reference's corresponding hot loop, mitigated there by a pickle
     cache)."""

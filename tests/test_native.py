@@ -252,12 +252,13 @@ def test_parse_tim_native_falls_back_on_stateful(lib, tmp_path):
 
 
 def test_parse_tim_native_non_ascii_and_crlf(lib, tmp_path):
-    """Byte offsets must survive non-ASCII flag values, and CRLF files
-    must yield the same commands/flags as the Python parser."""
+    """CRLF and bare-CR files parse identically to Python's universal
+    newlines; any non-ASCII content (unicode whitespace/digits change
+    str.split()/float() semantics) hands off to the Python parser."""
     from pint_tpu.toa import TOAs, _read_tim_native, read_tim_file
 
     text = ("FORMAT 1\r\n"
-            "psr1 1400.0 54321.5 1.0 gbt -tel Effelsbergé -be X\r\n"
+            "psr1 1400.0 54321.5 1.0 gbt -be X\r\n"
             "MODE 1\r\n"
             "psr2 800.0 54400.5 2.0 ao -fe L-wide\r\n")
     p = tmp_path / "crlf.tim"
@@ -265,10 +266,27 @@ def test_parse_tim_native_non_ascii_and_crlf(lib, tmp_path):
     tn = _read_tim_native(str(p))
     toalist, commands = read_tim_file(str(p))
     tp = TOAs(toalist)
-    assert tn.flags == tp.flags  # é must not shift later slices
-    assert tn.flags[0]["tel"] == "Effelsbergé"
+    assert tn is not None and tn.flags == tp.flags
     assert tn.flags[1] == {"fe": "L-wide", "name": "psr2"}
     assert tn.commands == commands == ["FORMAT 1", "MODE 1"]
+
+    # bare-\r (old-Mac) endings: same TOA set as python, not 0 TOAs
+    p2 = tmp_path / "cr.tim"
+    p2.write_bytes(b"FORMAT 1\rpsr1 1400.0 55000.5 1.0 gbt\r")
+    tn2 = _read_tim_native(str(p2))
+    toalist2, _ = read_tim_file(str(p2))
+    assert tn2 is not None and len(tn2) == len(toalist2) == 1
+    assert tn2.sec[0] == 43200.0
+
+    # non-ASCII flag value: python parser owns it, results identical
+    p3 = tmp_path / "uni.tim"
+    p3.write_bytes("FORMAT 1\npsr1 1400.0 54321.5 1.0 gbt -tel "
+                   "Effelsbergé\n".encode())
+    assert _read_tim_native(str(p3)) is None
+    from pint_tpu.toa import get_TOAs
+
+    t3 = get_TOAs(str(p3))
+    assert t3.flags[0]["tel"] == "Effelsbergé"
 
 
 def test_has_flags_consumers_see_native_flags(lib, tmp_path):
