@@ -314,6 +314,7 @@ inline bool tok_float(const TimTok& t, double* out) {
       if (a < '0' || a > '9' || b < '0' || b > '9') return false;
       continue;  // python float() strips digit-adjacent underscores
     }
+    if (c == '(') return false;  // strtod's nan(seq); python rejects
     tmp[m++] = c;
   }
   tmp[m] = 0;
@@ -401,11 +402,6 @@ std::int64_t pt_parse_tim_t2(
     const char* next_line = eol + 1;
     if (eol < end && *eol == '\r' && eol + 1 < end && eol[1] == '\n')
       next_line = eol + 2;
-    // any non-ASCII byte: python owns the line — str.split() honors
-    // unicode whitespace and float() honors unicode digits, neither
-    // of which this parser mirrors (single pass, folded into the
-    // newline scan above)
-    if (high_byte) return -1;
     // tokenize
     int ntok = 0;
     const char* p = line;
@@ -423,11 +419,18 @@ std::int64_t pt_parse_tim_t2(
     line = next_line;
     if (ntok == 0) continue;
     // comments: '#', or 'C '/'c ' (needs a second token to mirror
-    // python's startswith("C ") on the stripped line)
+    // python's startswith("C ") on the stripped line). Checked BEFORE
+    // the non-ASCII bailout so a unicode comment doesn't forfeit the
+    // fast path for the whole file.
     if (tok[0].p[0] == '#') continue;
     if (tok[0].len == 1 && (tok[0].p[0] == 'C' || tok[0].p[0] == 'c') &&
         ntok > 1)
       continue;
+    // non-ASCII on a DATA line: python owns the file — str.split()
+    // honors unicode whitespace and float() honors unicode digits,
+    // neither of which this parser mirrors (detected during the
+    // newline scan above, no extra pass)
+    if (high_byte) return -1;
     // command dispatch (python: head in _COMMANDS)
     if (tok_is_ci(tok[0], "FORMAT")) {
       if (ntok > 1 && tok[1].len == 1 && tok[1].p[0] == '1') format1 = true;

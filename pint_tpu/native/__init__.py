@@ -150,7 +150,10 @@ def parse_tim_t2(data: bytes):
     if lib is None:
         return None
     nbytes = len(data)
-    cap = data.count(b"\n") + 2
+    # the C++ parser splits on \n, \r\n, AND bare \r (python universal
+    # newlines): capacity must count both terminators or bare-CR files
+    # overrun the output arrays
+    cap = data.count(b"\n") + data.count(b"\r") + 2
     day = np.empty(cap, np.int64)
     sec = np.empty(cap, np.float64)
     freq = np.empty(cap, np.float64)

@@ -503,7 +503,22 @@ def _decode_flags(blob: bytes, off) -> list[dict]:
 
 
 _TIM_CMD_RE = re.compile(
-    rb"(?mi)^[ \t]*(FORMAT|MODE|INFO|TRACK|END)(?:[ \t][^\n]*)?\r?$")
+    rb"^[ \t]*(FORMAT|MODE|INFO|TRACK|END)(?:[ \t]|$)", re.I)
+_TIM_EOL_RE = re.compile(rb"\r\n|\r|\n")  # python universal newlines
+
+
+def _collect_tim_commands(data: bytes) -> list[str]:
+    """Benign command lines in file order, split exactly like python
+    text mode (\\n, \\r\\n, bare \\r), stopping at END inclusive —
+    mirrors read_tim_file's commands list for the native fast path."""
+    cmds = []
+    for ln in _TIM_EOL_RE.split(data):
+        if _TIM_CMD_RE.match(ln):
+            line = ln.strip().decode(errors="replace")
+            cmds.append(line)
+            if line.split()[0].upper() == "END":
+                break
+    return cmds
 
 
 def _read_tim_native(path: str, **toas_kw) -> "TOAs | None":
@@ -533,13 +548,7 @@ def _read_tim_native(path: str, **toas_kw) -> "TOAs | None":
     t = TOAs.from_arrays(day, sec, error_us=err, freq_mhz=freq, obs=obs,
                          flags=None, **toas_kw)
     t._flags_raw = (blob, flag_off)
-    commands = []
-    for m in _TIM_CMD_RE.finditer(data):
-        line = m.group(0).strip().decode(errors="replace")
-        commands.append(line)
-        if line.split()[0].upper() == "END":
-            break
-    t.commands = commands
+    t.commands = _collect_tim_commands(data)
     t.filename = str(path)
     return t
 

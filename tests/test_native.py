@@ -308,3 +308,44 @@ def test_has_flags_consumers_see_native_flags(lib, tmp_path):
     t2 = _read_tim_native(str(p))
     assert t2.has_flags()
     assert any("pp_dm" in f for f in t2.flags)
+
+
+def test_parse_tim_native_bare_cr_many_toas(lib, tmp_path):
+    """Bare-CR files with MANY TOAs: output buffers must be sized for
+    CR-terminated lines too (regression: 50-TOA bare-CR file overran
+    the arrays and corrupted the heap), and the commands list must
+    match the Python parser's universal-newline splitting."""
+    from pint_tpu.toa import TOAs, _read_tim_native, read_tim_file
+
+    lines = ["FORMAT 1"]
+    for i in range(50):
+        lines.append(f"p{i} 1400.0 {55000 + i}.5 1.0 gbt -fe L-wide")
+    lines.append("MODE 1")
+    p = tmp_path / "crmany.tim"
+    p.write_bytes("\r".join(lines).encode() + b"\r")
+    tn = _read_tim_native(str(p))
+    toalist, commands = read_tim_file(str(p))
+    tp = TOAs(toalist)
+    assert tn is not None and len(tn) == len(tp) == 50
+    assert np.array_equal(tn.day, tp.day)
+    assert np.array_equal(tn.sec, tp.sec)
+    assert tn.flags == tp.flags
+    assert tn.commands == commands == ["FORMAT 1", "MODE 1"]
+
+
+def test_parse_tim_native_nan_paren_and_unicode_comment(lib, tmp_path):
+    """strtod's nan(seq) form is not a python float (flag-key parity),
+    and a non-ASCII comment must NOT forfeit the native fast path."""
+    from pint_tpu.toa import TOAs, _read_tim_native, read_tim_file
+
+    text = ("FORMAT 1\n"
+            "# commentaire réduit — unicode stays commentary\n"
+            "p1 1400.0 55000.5 1.0 gbt -x -nan(q) -y 2\n")
+    p = tmp_path / "nanq.tim"
+    p.write_bytes(text.encode())
+    tn = _read_tim_native(str(p))
+    toalist, _ = read_tim_file(str(p))
+    tp = TOAs(toalist)
+    assert tn is not None  # unicode comment did not force fallback
+    assert tn.flags == tp.flags
+    assert tn.flags[0]["x"] == "" and "nan(q)" in tn.flags[0]
