@@ -13,7 +13,8 @@ import copy
 
 import numpy as np
 
-from .residuals import Residuals, WidebandTOAResiduals
+from .residuals import (Residuals, WidebandDMResiduals,
+                        WidebandTOAResiduals)
 
 
 class ConvergenceFailure(RuntimeError):
@@ -65,6 +66,7 @@ class Fitter:
         self.resids_init = residuals or Residuals(toas, self.model)
         self.resids = self.resids_init
         self.converged = False
+        self.noise_ampls = None  # set by GLS-family fits with bases
 
     def _track_mode(self):
         tm = getattr(self.model, "TRACK", None)
@@ -245,6 +247,74 @@ def gls_eigh_solve(A, b, threshold=1e-12):
     dxn = evecs @ (einv * (evecs.T @ b))
     covn = evecs @ (einv[:, None] * evecs.T)
     return dxn, covn
+
+
+def gls_normal(Mfull, r, sigma, sqrt_phi_inv):
+    """(A, b, norm): whitened, prior-folded, column-normalized normal
+    equations — jit-safe core shared by GLSFitter, the wideband
+    fitters, and the batched PTA path (single home for the
+    normalization convention).
+
+    The prior enters through its SQUARE ROOT (1/sqrt(prior variance)):
+    sqrt values stay <= ~1e22 where phi_inv itself reaches ~1e42,
+    which overflows the TPU-emulated f64's f32-like exponent range
+    (see column_norms). Folding the prior into the normalization
+    (norm_j^2 = ||col_j||^2 + phi_inv_j via hypot) makes diag(A) = 1
+    exactly, so gls_eigh_solve's RELATIVE eigenvalue cut always
+    measures parameter degeneracy — without it, one negligible-
+    variance noise harmonic inflates max(evals) and the cut silently
+    zeroes every parameter update.
+    """
+    import jax.numpy as jnp
+
+    Mw = Mfull / sigma[:, None]
+    norm = jnp.hypot(column_norms(Mw), sqrt_phi_inv)
+    Mn = Mw / norm
+    q = sqrt_phi_inv / norm  # <= 1 by construction
+    A = Mn.T @ Mn + jnp.diag(q * q)
+    b = Mn.T @ (r / sigma)
+    return A, b, norm
+
+
+def gls_solve(Mfull, r, sigma, sqrt_phi_inv, threshold=1e-12):
+    """Whitened, column-normalized, prior-weighted normal-equation
+    solve — the one GLS step shared by GLSFitter and the wideband
+    fitters (reference: fitter.py::GLSFitter cholesky/Woodbury solve).
+
+    ``Mfull`` may carry noise-basis columns after the parameter
+    columns; ``sqrt_phi_inv`` holds 0 for parameters (infinite prior
+    variance) and 1/sqrt(prior variance) for basis amplitudes.
+    Returns (dx_all, (covn, norm), whitened_chi2) where whitened_chi2
+    is r^T C^-1 r via the Woodbury identity (rw2 - b.dxn).
+    """
+    import jax.numpy as jnp
+
+    A, b, norm = gls_normal(Mfull, r, sigma, sqrt_phi_inv)
+    dxn, covn = gls_eigh_solve(A, b, threshold)
+    dx = dxn / norm
+    rw2 = jnp.sum(jnp.square(r / sigma))
+    chi2 = float(rw2 - b @ dxn)
+    return dx, (covn, norm), chi2
+
+
+def stack_noise_bases(M, bases):
+    """(Mfull, sqrt_phi_inv, nparam): append noise-basis columns with
+    their prior sqrt-inverse-variances (us^2 weights -> 1/s prior
+    sqrts; zero-weight padded columns get 0 = dropped as degenerate).
+    Single home for the us^2 -> s^2 prior convention."""
+    import jax.numpy as jnp
+
+    B, w_us2 = bases
+    nparam = M.shape[1]
+    if B is None:
+        return M, jnp.zeros(nparam), nparam
+    Mfull = jnp.concatenate([M, B], axis=1)
+    sqrt_phi_inv = jnp.concatenate([
+        jnp.zeros(nparam),
+        jnp.where(w_us2 > 0, 1.0 / (jnp.sqrt(jnp.where(w_us2 > 0, w_us2, 1.0))
+                                    * 1e-6), 0.0),
+    ])
+    return Mfull, sqrt_phi_inv, nparam
 
 
 def wls_step(Mw, rw, threshold=1e-12):
@@ -432,57 +502,16 @@ class GLSFitter(Fitter):
             M = dm_fn(x)
             f0 = prepared.params0["F"][0]
             M = M / f0
-            nparam = M.shape[1]
-            B, w_us2 = self._noise_bases(prepared, p)
-            if B is not None:
-                Mfull = jnp.concatenate([M, B], axis=1)
-                phi_inv = jnp.concatenate([
-                    jnp.zeros(nparam),  # infinite prior variance on params
-                    1.0 / (w_us2 * 1e-12),  # us^2 -> s^2
-                ])
-            else:
-                Mfull = M
-                phi_inv = jnp.zeros(nparam)
-            # whiten, then normalize columns of the whitened matrix so the
-            # eigenvalue threshold measures true degeneracy, not units
-            Ninv = 1.0 / jnp.square(sigma_s)
-            Mw = Mfull / sigma_s[:, None]
-            norm = column_norms(Mw)
-            Mn = Mw / norm
-            # prior on original amplitudes a = dxn/norm ->
-            # diag(phi_inv/norm^2) in normalized space; divide twice —
-            # norm**2 for the F1 column leaves the TPU f64 exponent range
-            A = Mn.T @ Mn + jnp.diag(phi_inv / norm / norm)
-            b = Mn.T @ (r / sigma_s)
-            # eigh + threshold: degenerate directions get zero update,
-            # matching the reference's SVD small-singular-value drop
-            # (reference: fitter.py::GLSFitter cholesky-with-SVD-fallback)
-            evals, evecs = jnp.linalg.eigh(A)
-            # eigenvalues of the normal matrix are squared singular values,
-            # so threshold**2 matches wls_step's s > threshold*smax cut.
-            # The floor is the eigh backward-error bound: a symmetric
-            # eigensolver perturbs eigenvalues by O(||A|| * n * eps)
-            # (Golub & Van Loan sec. 8.1), so an exactly-degenerate
-            # direction surfaces as a noise eigenvalue up to ~n*eps*max.
-            # With n <= ~500 columns, n*eps ~ 1e-13; 3e-14 sits at the
-            # small-n end of that bound — relative cuts below it would
-            # "keep" pure-noise directions and inject O(1/noise) garbage
-            # into dx. Verified empirically in
-            # tests/test_gls_threshold.py (degenerate dropped at 3e-14,
-            # real eigenvalues down to ~1e-9 retained).
-            cut = max(threshold**2, 3e-14)
-            good = evals > cut * jnp.max(evals)
-            einv = jnp.where(good, 1.0 / jnp.where(good, evals, 1.0), 0.0)
-            dxn = evecs @ (einv * (evecs.T @ b))
-            dx = dxn / norm
-            cov = (evecs @ jnp.diag(einv) @ evecs.T, norm)
+            bases = self._noise_bases(prepared, p)
+            Mfull, sqrt_phi_inv, nparam = stack_noise_bases(M, bases)
+            # shared whitened/normalized/prior-weighted eigh solve (see
+            # gls_solve; threshold semantics anchored by
+            # tests/test_gls_threshold.py)
+            dx, cov, chi2 = gls_solve(Mfull, r, sigma_s, sqrt_phi_inv,
+                                      threshold)
             x = x - dx[noff:nparam]
-            # whitened chi2: r^T C^-1 r via the Woodbury identity
-            # (with no noise bases this reduces to the plain whitened chi2
-            # minus the fitted-parameter improvement, same formula)
-            rw2 = jnp.sum(r**2 * Ninv)
-            chi2 = float(rw2 - b @ dxn)
-            self.noise_ampls = np.asarray(dx[nparam:]) if B is not None else None
+            self.noise_ampls = (np.asarray(dx[nparam:])
+                                if bases[0] is not None else None)
             if (tol and last_chi2 is not None
                     and abs(last_chi2 - chi2) < tol * max(1.0, abs(last_chi2))):
                 break
@@ -541,19 +570,17 @@ class WidebandTOAFitter(GLSFitter):
         return DesignMatrix(M_dm, "dm", "pc cm^-3", names, units)
 
     def _wideband_system(self):
-        """(prepared, combined DesignMatrix, r, sigma, noff, x0) for the
-        current model state."""
+        """(prepared, combined DesignMatrix, r, sigma, noff, x0,
+        (B, w_us2)) for the current model state. B holds the TOA-noise
+        basis columns (ECORR/red noise) zero-padded over the DM rows —
+        DM measurements are uncorrelated with the TOA noise processes
+        (reference: wideband GLS stacks noise bases exactly like the
+        narrowband fitter, on the time block only)."""
         import jax.numpy as jnp
 
         from .pint_matrix import (DesignMatrix,
                                   combine_design_matrices_by_quantity)
 
-        # the wideband solve is plain whitened WLS on [time; DM] rows:
-        # correlated-noise bases are not (yet) stacked into it, so
-        # refuse rather than silently ignore ECORR/red noise
-        corr = _correlated_noise_components(self.model)
-        if corr:
-            raise CorrelatedErrors(corr)
         prepared = self.model.prepare(self.toas)
         wb = WidebandTOAResiduals(self.toas, self.model, prepared=prepared)
         valid = wb.dm.valid
@@ -568,37 +595,29 @@ class WidebandTOAFitter(GLSFitter):
         r = jnp.concatenate([r_t, r_dm])
         sigma = jnp.concatenate([sigma_t, sigma_dm])
         noff = _n_offset(combined.param_names)
+        bases = self._noise_bases_padded(prepared, int(valid.sum()))
         return (prepared, combined, r, sigma, noff,
-                prepared.vector_from_params())
+                prepared.vector_from_params(), bases)
 
-    def _wideband_chi2(self):
-        wb = WidebandTOAResiduals(self.toas, self.model)
-        return float(wb.chi2)
+    def _noise_bases_padded(self, prepared, n_dm_rows):
+        """TOA-noise bases zero-padded over the DM rows."""
+        import jax.numpy as jnp
 
-    def fit_toas(self, maxiter=2, threshold=1e-12):
-        for _ in range(maxiter):
-            prepared, combined, r, sigma, noff, x0 = self._wideband_system()
-            Mw = combined.matrix / sigma[:, None]
-            rw = r / sigma
-            dx_all, covn, norm = wls_step(Mw, rw, threshold)
-            self._sync_model_from_vector(prepared, x0 - dx_all[noff:])
-            cov_all = cov_from_normalized(covn, norm)
-            self._set_uncertainties(prepared, cov_all[noff:, noff:])
-        self.resids = WidebandTOAResiduals(self.toas, self.model)
-        self.converged = True
-        return self.resids.chi2
+        B, w_us2 = self._noise_bases(prepared)
+        if B is not None:
+            B = jnp.concatenate(
+                [B, jnp.zeros((n_dm_rows, B.shape[1]))], axis=0)
+        return (B, w_us2)
 
-
-class WidebandDownhillFitter(WidebandTOAFitter):
-    """Step-halving wideband fit
-    (reference: fitter.py::WidebandDownhillFitter)."""
-
-    def _wideband_chi2_fn(self, prepared):
-        """Jit-backed chi2(x) over [time; DM] rows for line searches —
-        no host re-prepare per probe (the probes reuse the prepared
-        residual and DM-model functions)."""
+    def _wideband_chi2_fn(self, prepared, bases=(None, None)):
+        """Jit-backed GLS objective chi2(x) over [time; DM] rows: the
+        whitened chi2 with any noise-basis amplitudes marginalized at
+        fixed x (Woodbury: |rw|^2 - b.dxn). One compiled function per
+        outer iteration; line-search probes pay no host re-prepare."""
         import jax
         import jax.numpy as jnp
+
+        from .residuals import wideband_dm_model
 
         wb = WidebandTOAResiduals(self.toas, self.model, prepared=prepared)
         valid = wb.dm.valid
@@ -606,43 +625,91 @@ class WidebandDownhillFitter(WidebandTOAFitter):
         dm_meas = jnp.asarray(np.asarray(wb.dm.dm_observed)[valid])
         sigma_dm = jnp.asarray(np.asarray(wb.dm.dm_error)[valid])
         resid_fn = prepared.residual_vector_fn(track_mode=self._track_mode())
-
-        def dm_model(x):
-            p = prepared.params_with_vector(x)
-            comp = self.model.components["DispersionDM"]
-            dm = comp.dm_value(p, prepared.prep)
-            if "DMX" in p:
-                dm = dm + p["DMX"] @ prepared.prep["dmx_masks"]
-            return dm[idx]
+        B, w_us2 = bases
+        if B is not None:
+            sqrt_phi_inv = jnp.where(
+                w_us2 > 0,
+                1.0 / (jnp.sqrt(jnp.where(w_us2 > 0, w_us2, 1.0)) * 1e-6),
+                0.0)
 
         @jax.jit
         def chi2_of(x):
+            p = prepared.params_with_vector(x)
             r_t = resid_fn(x)
-            sig_t = prepared.scaled_sigma_us(
-                prepared.params_with_vector(x)) * 1e-6
-            c_t = jnp.sum(jnp.square(r_t / sig_t))
-            c_dm = jnp.sum(jnp.square((dm_meas - dm_model(x)) / sigma_dm))
-            return c_t + c_dm
+            sig_t = prepared.scaled_sigma_us(p) * 1e-6
+            dm = wideband_dm_model(self.model, p, prepared.prep)[idx]
+            r = jnp.concatenate([r_t, dm_meas - dm])
+            sigma = jnp.concatenate([sig_t, sigma_dm])
+            rw2 = jnp.sum(jnp.square(r / sigma))
+            if B is None:
+                return rw2
+            A, b, _ = gls_normal(B, r, sigma, sqrt_phi_inv)
+            dxn, _ = gls_eigh_solve(A, b)
+            return rw2 - b @ dxn
 
         return chi2_of
+
+    def _wideband_chi2(self):
+        """GLS objective at the CURRENT model state."""
+        prepared = self.model.prepare(self.toas)
+        wb_valid = WidebandDMResiduals(self.toas, self.model,
+                                       prepared=prepared).valid
+        bases = self._noise_bases_padded(prepared, int(wb_valid.sum()))
+        fn = self._wideband_chi2_fn(prepared, bases)
+        return float(fn(prepared.vector_from_params()))
+
+    def fit_toas(self, maxiter=2, threshold=1e-12):
+        chi2 = None
+        for _ in range(maxiter):
+            prepared, combined, r, sigma, noff, x0, bases = \
+                self._wideband_system()
+            Mfull, sqrt_phi_inv, nparam = stack_noise_bases(
+                combined.matrix, bases)
+            dx_all, cov, chi2 = gls_solve(Mfull, r, sigma, sqrt_phi_inv,
+                                          threshold)
+            self._sync_model_from_vector(prepared, x0 - dx_all[noff:nparam])
+            self.noise_ampls = (np.asarray(dx_all[nparam:])
+                                if bases[0] is not None else None)
+            cov_all = cov_from_normalized(*cov)
+            self._set_uncertainties(prepared, cov_all[noff:nparam,
+                                                      noff:nparam])
+        self.resids = WidebandTOAResiduals(self.toas, self.model)
+        self.converged = True
+        self.chi2_whitened = chi2
+        # the whitened/marginalized value, like GLSFitter — the raw
+        # resids.chi2 would be noise-realization-inflated under
+        # correlated models
+        return chi2
+
+
+class WidebandDownhillFitter(WidebandTOAFitter):
+    """Step-halving wideband fit
+    (reference: fitter.py::WidebandDownhillFitter)."""
 
     def fit_toas(self, maxiter=15, threshold=1e-12, min_lambda=1e-3,
                  tol=1e-9, raise_maxiter=False):
         best_chi2 = None
         for it in range(maxiter):
-            prepared, combined, r, sigma, noff, x0 = self._wideband_system()
-            chi2_of = self._wideband_chi2_fn(prepared)
+            prepared, combined, r, sigma, noff, x0, bases = \
+                self._wideband_system()
+            # one jitted GLS objective per outer iteration; line-search
+            # probes marginalize the (fixed) bases on device
+            chi2_fn = self._wideband_chi2_fn(prepared, bases)
+            chi2_of = lambda x: float(chi2_fn(x))  # noqa: E731
             if best_chi2 is None:
-                best_chi2 = float(chi2_of(x0))
-            Mw = combined.matrix / sigma[:, None]
-            rw = r / sigma
-            dx_all, covn, norm = wls_step(Mw, rw, threshold)
-            dx = dx_all[noff:]
+                best_chi2 = chi2_of(x0)
+            Mfull, sqrt_phi_inv, nparam = stack_noise_bases(
+                combined.matrix, bases)
+            dx_all, cov, _ = gls_solve(Mfull, r, sigma, sqrt_phi_inv,
+                                       threshold)
+            self.noise_ampls = (np.asarray(dx_all[nparam:])
+                                if bases[0] is not None else None)
+            dx = dx_all[noff:nparam]
             lam = 1.0
             improved = False
             x_new = x0
             while lam >= min_lambda:
-                chi2 = float(chi2_of(x0 - lam * dx))
+                chi2 = chi2_of(x0 - lam * dx)
                 if chi2 <= best_chi2 + 1e-12:
                     improved = chi2 < best_chi2 - tol * max(1.0, best_chi2)
                     best_chi2 = min(best_chi2, chi2)
@@ -650,8 +717,9 @@ class WidebandDownhillFitter(WidebandTOAFitter):
                     break
                 lam *= 0.5
             self._sync_model_from_vector(prepared, x_new)
-            cov_all = cov_from_normalized(covn, norm)
-            self._set_uncertainties(prepared, cov_all[noff:, noff:])
+            cov_all = cov_from_normalized(*cov)
+            self._set_uncertainties(prepared, cov_all[noff:nparam,
+                                                      noff:nparam])
             if lam < min_lambda or not improved:
                 break
         else:
@@ -659,7 +727,8 @@ class WidebandDownhillFitter(WidebandTOAFitter):
                 raise MaxiterReached(maxiter, best_chi2)
         self.resids = WidebandTOAResiduals(self.toas, self.model)
         self.converged = True
-        return self.resids.chi2
+        self.chi2_whitened = best_chi2
+        return best_chi2
 
 
 class WidebandLMFitter(WidebandTOAFitter):
@@ -675,23 +744,21 @@ class WidebandLMFitter(WidebandTOAFitter):
         lm = lm_lambda0
         best_chi2 = self._wideband_chi2()
         for _ in range(maxiter):
-            prepared, combined, r, sigma, noff, x0 = self._wideband_system()
-            Mw = combined.matrix / sigma[:, None]
-            rw = r / sigma
-            norm = column_norms(Mw)
-            Mn = Mw / norm
-            A = Mn.T @ Mn
-            b = Mn.T @ rw
+            prepared, combined, r, sigma, noff, x0, bases = \
+                self._wideband_system()
+            Mfull, sqrt_phi_inv, nparam = stack_noise_bases(
+                combined.matrix, bases)
+            A, b, norm = gls_normal(Mfull, r, sigma, sqrt_phi_inv)
             A_damped = A + lm * jnp.diag(jnp.diag(A))
             dxn = jnp.linalg.solve(A_damped, b)
-            dx = (dxn / norm)[noff:]
+            dx = (dxn / norm)[noff:nparam]
             self._sync_model_from_vector(prepared, x0 - dx)
             chi2 = self._wideband_chi2()
             if chi2 <= best_chi2 + 1e-12:
                 accepted = chi2 < best_chi2 - tol * max(1.0, best_chi2)
                 best_chi2 = min(best_chi2, chi2)
                 lm = max(lm / 9.0, 1e-12)
-                self._lm_cov = (A, norm)
+                self._lm_cov = (A, norm, noff, nparam)
                 if not accepted:
                     break
             else:
@@ -699,17 +766,25 @@ class WidebandLMFitter(WidebandTOAFitter):
                 lm *= 11.0
                 if lm > 1e6:
                     break
-        # covariance from the undamped normal matrix at the solution
+        # covariance + basis amplitudes from one undamped solve at the
+        # accepted solution
         if getattr(self, "_lm_cov", None) is not None:
-            A, norm = self._lm_cov
+            A, norm, noff, nparam = self._lm_cov
             covn = np.linalg.pinv(np.asarray(A))
             cov_all = cov_from_normalized(covn, np.asarray(norm))
-            prepared = self.model.prepare(self.toas)
-            noff = len(cov_all) - len(prepared.free_param_map())
-            self._set_uncertainties(prepared, cov_all[noff:, noff:])
+            prepared, combined, r, sigma, _, _, bases = \
+                self._wideband_system()
+            Mfull, sqrt_phi_inv, nparam2 = stack_noise_bases(
+                combined.matrix, bases)
+            dx_all, _, _ = gls_solve(Mfull, r, sigma, sqrt_phi_inv)
+            self.noise_ampls = (np.asarray(dx_all[nparam2:])
+                                if bases[0] is not None else None)
+            self._set_uncertainties(prepared, cov_all[noff:nparam,
+                                                      noff:nparam])
         self.resids = WidebandTOAResiduals(self.toas, self.model)
         self.converged = True
-        return self.resids.chi2
+        self.chi2_whitened = best_chi2
+        return best_chi2
 
 
 class PowellFitter(Fitter):

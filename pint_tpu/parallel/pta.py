@@ -396,7 +396,7 @@ class PTABatch:
         import jax
         import jax.numpy as jnp
 
-        from ..fitter import column_norms
+        from ..fitter import gls_eigh_solve, gls_normal, stack_noise_bases
 
         resid_fn = self._resid_fn()
         phase_fn = self._phase_fn()
@@ -412,36 +412,19 @@ class PTABatch:
 
             M = jax.jacfwd(phase_of)(x) / p["F"][0]
             M = jnp.concatenate([jnp.ones((M.shape[0], 1)), M], axis=1)
-            nparam = M.shape[1]
-            bw = noise_bw(p, prep) if noise_bw is not None else None
-            if bw is not None:
-                B, w_us2 = bw
-                Mfull = jnp.concatenate([M, B], axis=1)
-                # us^2 -> s^2 prior variance; zero-weight (padded)
-                # columns get phi_inv = 0 AND a zero basis column ->
-                # exactly-zero eigenvalue -> dropped by the threshold
-                phi_inv = jnp.concatenate([
-                    jnp.zeros(nparam),
-                    jnp.where(w_us2 > 0, 1.0 / (w_us2 * 1e-12), 0.0),
-                ])
-            else:
-                Mfull = M
-                phi_inv = jnp.zeros(nparam)
-            Mw = Mfull / sigma_s[:, None]
-            rw = r / sigma_s
-            norm = column_norms(Mw)
-            Mn = Mw / norm
-            A = Mn.T @ Mn + jnp.diag(phi_inv / norm / norm)
-            b = Mn.T @ rw
-            evals, evecs = jnp.linalg.eigh(A)
-            cut = max(threshold**2, 3e-14)
-            good = evals > cut * jnp.max(evals)
-            einv = jnp.where(good, 1.0 / jnp.where(good, evals, 1.0), 0.0)
-            dxn = evecs @ (einv * (evecs.T @ b))
+            # shared GLS machinery (fitter.stack_noise_bases /
+            # gls_normal / gls_eigh_solve): prior-folded normalization
+            # keeps the relative eigenvalue cut meaningful, sqrt-form
+            # priors stay inside the TPU f64 exponent range, and the
+            # zero-weight padded columns (zero basis + zero prior)
+            # surface as exactly-zero eigenvalues -> dropped
+            bw = noise_bw(p, prep) if noise_bw is not None else (None, None)
+            Mfull, sqrt_phi_inv, nparam = stack_noise_bases(M, bw)
+            A, b, norm = gls_normal(Mfull, r, sigma_s, sqrt_phi_inv)
+            dxn, covn = gls_eigh_solve(A, b, threshold)
             dx_all = dxn / norm
-            covn = evecs @ (einv[:, None] * evecs.T)
             # whitened marginalized chi2: r^T C^-1 r = |rw|^2 - b.dxn
-            chi2 = jnp.sum(jnp.square(rw)) - b @ dxn
+            chi2 = jnp.sum(jnp.square(r / sigma_s)) - b @ dxn
             return (x - dx_all[1:nparam], chi2,
                     (covn[1:nparam, 1:nparam], norm[1:nparam]))
 

@@ -221,6 +221,18 @@ class WidebandTOAResiduals:
     def dof(self):
         return self.toa.dof + int(self.dm.valid.sum())
 
+    @property
+    def reduced_chi2(self):
+        return self.chi2 / self.dof
+
+    def rms_weighted(self):
+        """Weighted RMS of the TIME residuals [s] (the quantity
+        summaries quote; DM residuals carry different units)."""
+        return self.toa.rms_weighted()
+
+    def calc_time_resids(self, params=None):
+        return self.toa.calc_time_resids(params)
+
 
 class CombinedResiduals:
     """Concatenation of independent residual objects

@@ -89,3 +89,37 @@ def test_gls_matches_wls_without_noise():
         b = getattr(fw.model, p)
         assert abs(a.value - b.value) <= 1e-3 * max(
             b.uncertainty or 1e-12, 1e-15), p
+
+
+def test_extreme_prior_spread_does_not_zero_params():
+    """Regression: a steep red-noise spectrum gives phi_inv spanning
+    ~30 decades; before the prior-folded normalization the relative
+    eigenvalue cut zeroed EVERY parameter update (dx ~ 1e-47), so the
+    fit silently returned the input model."""
+    import copy
+
+    import numpy as np
+
+    from pint_tpu.fitter import GLSFitter
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    par = ("PSR TPRI\nRAJ 01:00:00\nDECJ 05:00:00\nF0 300.0 1\nF1 -1e-15 1\n"
+           "PEPOCH 55500\nDM 12.0 1\n"
+           # RNIDX -6 over 30 harmonics: weight ratio ~ 30^6 ~ 7e8, and
+           # the tiny absolute RNAMP pushes 1/w to ~1e40 s^-2
+           "RNAMP 1e-16\nRNIDX -6.0\nTNREDC 30\nECORR 0.5\n")
+    m = get_model(par)
+    rng = np.random.default_rng(4)
+    days = np.sort(rng.uniform(55000, 56000, 30))
+    mjds = np.sort(np.concatenate([days, days + 30.0 / 86400]))
+    t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=1400.0,
+                                obs="gbt", add_noise=True, seed=4)
+    m2 = copy.deepcopy(m)
+    df0 = 4e-10
+    m2.F0.value += df0
+    f = GLSFitter(t, m2)
+    f.fit_toas(maxiter=3)
+    # the fitter must actually MOVE F0 back (not silently no-op)
+    assert abs(f.model.F0.value - m.F0.value) < 0.2 * df0
+    assert f.model.F0.uncertainty is not None and f.model.F0.uncertainty > 0
