@@ -259,7 +259,12 @@ class TimingModel:
         lines = []
         for p in self.top_params:
             lines.append(self._top[p].as_parfile_line())
-        for comp in list(self.delay_components()) + list(self.phase_components()):
+        ordered = list(self.delay_components()) + list(self.phase_components())
+        # noise components are neither delay nor phase but their
+        # EFAC/EQUAD/ECORR/red-noise params are model state too — a par
+        # file that silently drops them is not a checkpoint
+        ordered += [c for c in self.components.values() if c not in ordered]
+        for comp in ordered:
             name = getattr(comp, "binary_model_name", None)
             if name is not None:
                 # the BINARY line is the model selector, not a

@@ -142,3 +142,104 @@ def test_phase_connection_across_span(model):
                                obs="gbt", add_noise=False)
     r = Residuals(t, model)
     assert r.rms_weighted() < 1e-9
+
+
+def test_kitchen_sink_parfile_roundtrip():
+    """Every component's parameters must survive par -> model ->
+    as_parfile -> model (the par file is the checkpoint; SURVEY.md
+    section 5). One model carrying nearly every component class."""
+    import warnings
+
+    import numpy as np
+
+    from pint_tpu.models import get_model
+
+    par = """
+PSR SINK
+RAJ 04:37:15.8 1
+DECJ -47:15:09.1 1
+PMRA 121.4 1
+PMDEC -71.5 1
+PX 6.4 1
+POSEPOCH 55000
+F0 173.687946 1
+F1 -1.728e-15 1
+F2 1e-26
+PEPOCH 55000
+DM 2.64 1
+DM1 1e-4
+DMEPOCH 55000
+DMX_0001 1e-3 1
+DMXR1_0001 54900
+DMXR2_0001 55100
+NE_SW 7.9 1
+CORRECT_TROPOSPHERE Y
+PLANET_SHAPIRO Y
+BINARY ELL1
+PB 5.741 1
+A1 3.3667 1
+TASC 54501.4671 1
+EPS1 1.9e-5 1
+EPS2 -1.4e-5 1
+M2 0.224 1
+SINI 0.674 1
+GLEP_1 55300
+GLPH_1 0.2
+GLF0_1 1e-8
+GLF1_1 -1e-16
+GLF0D_1 2e-8
+GLTD_1 100
+WAVEEPOCH 55000
+WAVE_OM 0.005
+WAVE1 0.01 -0.02
+FD1 1e-5 1
+FD2 -2e-6
+SIFUNC 2
+IFUNC1 54950 1e-6
+IFUNC2 55400 -2e-6
+PHOFF 0.1 1
+TZRMJD 55000.123
+TZRSITE gbt
+TZRFRQ 1400
+JUMP -fe L-wide 1e-5 1
+DMJUMP -fe L-wide 1e-3 1
+EFAC -fe L-wide 1.1
+EQUAD -fe L-wide 0.3
+ECORR -fe L-wide 0.7
+RNAMP 1e-14
+RNIDX -3.2
+TNREDC 20
+"""
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # no unrecognized-line warnings
+        m = get_model(par)
+    # uncertainties are model state (post-fit par files carry them)
+    m.F0.uncertainty = 3.2e-13
+    m.DM.uncertainty = 1.5e-5
+    m.PB.uncertainty = 4e-9
+    txt = m.as_parfile()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        m2 = get_model(txt)
+    assert set(m2.components) == set(m.components), (
+        set(m.components) ^ set(m2.components))
+    for p in m.params:
+        a, b = getattr(m, p), getattr(m2, p, None)
+        assert b is not None, f"param {p} lost in round trip"
+        if a.value is None or a.kind in ("str", "bool", "func"):
+            continue
+        if a.kind == "pair":  # WAVEn sin/cos pairs: element-wise
+            assert np.allclose(np.asarray(a.value, float),
+                               np.asarray(b.value, float),
+                               rtol=0, atol=1e-12), (p, a.value, b.value)
+            continue
+        try:
+            av, bv = float(a.value), float(b.value)
+        except (TypeError, ValueError):
+            continue
+        assert np.isclose(av, bv, rtol=0, atol=max(1e-12, 1e-10 * abs(av))), \
+            (p, av, bv)
+        assert a.frozen == b.frozen, f"fit flag of {p} flipped"
+        if a.uncertainty is not None:  # uncertainties are state too
+            assert b.uncertainty is not None, f"uncertainty of {p} dropped"
+            assert np.isclose(a.uncertainty, b.uncertainty, rtol=1e-4), p
