@@ -93,3 +93,30 @@ class DelayJump(DelayComponent):
 
     def delay(self, params, batch, prep, delay_accum):
         return params["DJUMP"] @ prep["djump_masks"]
+
+
+def jump_flags_to_params(toas, model) -> list[str]:
+    """Create one free JUMP parameter per distinct tim-file JUMP block
+    (reference: jump.py::PhaseJump tim-jump handling — tim JUMP
+    commands mark TOAs with -tim_jump N flags; this turns each group
+    into a fittable JUMP maskParameter). Returns the new param names;
+    groups that already have a matching JUMP are skipped.
+    """
+    values = sorted({f["tim_jump"] for f in toas.flags if "tim_jump" in f},
+                    key=lambda v: (len(v), v))
+    if not values:
+        return []
+    if "PhaseJump" not in model.components:
+        model.add_component(PhaseJump())
+    comp = model.components["PhaseJump"]
+    existing = {tuple(getattr(comp, p).key_value)
+                for p in comp.params
+                if getattr(comp, p).key == "-tim_jump"}
+    created = []
+    for v in values:
+        if (v,) in existing:
+            continue
+        p = comp.add_jump(key="-tim_jump", key_value=[v], value=0.0,
+                          frozen=False)
+        created.append(p.name)
+    return created

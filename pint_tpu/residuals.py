@@ -233,6 +233,10 @@ class WidebandTOAResiduals:
     def calc_time_resids(self, params=None):
         return self.toa.calc_time_resids(params)
 
+    @property
+    def time_resids(self):
+        return self.toa.time_resids
+
 
 class CombinedResiduals:
     """Concatenation of independent residual objects

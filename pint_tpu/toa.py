@@ -264,6 +264,18 @@ class TOAs:
                 pn[i] = float(f["pn"])
         return pn
 
+    def get_errors(self) -> np.ndarray:
+        """TOA uncertainties [us] (reference: TOAs.get_errors)."""
+        return self.error_us
+
+    def get_freqs(self) -> np.ndarray:
+        """Observing frequencies [MHz] (reference: TOAs.get_freqs)."""
+        return self.freq_mhz
+
+    def get_obss(self) -> np.ndarray:
+        """Observatory names (reference: TOAs.get_obss)."""
+        return self.obs.astype(str)
+
     def get_mjds(self) -> np.ndarray:
         return Epochs(self.day, self.sec, "utc").mjd_float()
 
@@ -385,26 +397,27 @@ def _parse_parkes_line(line):
     return TOA(day, sec, err, freq, obs_code.lower(), flags)
 
 
-def read_tim_file(path: str, _depth=0) -> tuple[list[TOA], list[str]]:
+def read_tim_file(path: str, _depth=0,
+                  _state: dict | None = None) -> tuple[list[TOA], list[str]]:
     """Parse a tim file into TOA records + commands seen.
 
     Handles FORMAT 1 (tempo2), princeton fallback, INCLUDE recursion,
     TIME/EFAC/EQUAD/SKIP/JUMP/PHASE inline commands
-    (reference: toa.py::read_toa_file).
+    (reference: toa.py::read_toa_file). Command state is SHARED with
+    INCLUDEd files (one dict threaded through the recursion), matching
+    the reference's inline-execution semantics: a TIME offset or open
+    JUMP block in the parent applies inside the include, and jump
+    indices stay globally distinct.
     """
     if _depth > 10:
         raise RuntimeError("INCLUDE recursion too deep")
     toas: list[TOA] = []
     commands: list[str] = []
-    fmt = "princeton"
-    skipping = False
-    time_offset = 0.0
-    efac = 1.0
-    equad_us = 0.0
-    emin_us = 0.0
-    emax_us = np.inf
-    jump_level = 0
-    phase_offset = 0
+    st = _state if _state is not None else {
+        "fmt": "princeton", "skipping": False, "time_offset": 0.0,
+        "efac": 1.0, "equad_us": 0.0, "emin_us": 0.0, "emax_us": np.inf,
+        "jump_level": 0, "jump_index": 0, "phase_offset": 0,
+    }
     with open(path) as f:
         for raw in f:
             line = raw.rstrip("\n")
@@ -416,43 +429,45 @@ def read_tim_file(path: str, _depth=0) -> tuple[list[TOA], list[str]]:
             if head in _COMMANDS:
                 commands.append(ls)
                 if head == "FORMAT" and len(parts) > 1 and parts[1] == "1":
-                    fmt = "tempo2"
+                    st["fmt"] = "tempo2"
                 elif head == "INCLUDE":
                     inc = parts[1]
                     if not os.path.isabs(inc):
                         inc = os.path.join(os.path.dirname(path), inc)
-                    sub, subcmd = read_tim_file(inc, _depth + 1)
+                    sub, subcmd = read_tim_file(inc, _depth + 1, _state=st)
                     toas.extend(sub)
                     commands.extend(subcmd)
                 elif head == "TIME":
-                    time_offset += float(parts[1])
+                    st["time_offset"] += float(parts[1])
                 elif head == "EFAC":
-                    efac = float(parts[1])
+                    st["efac"] = float(parts[1])
                 elif head == "EQUAD":
-                    equad_us = float(parts[1])
+                    st["equad_us"] = float(parts[1])
                 elif head == "EMIN":
-                    emin_us = float(parts[1])
+                    st["emin_us"] = float(parts[1])
                 elif head == "EMAX":
-                    emax_us = float(parts[1]) if float(parts[1]) > 0 else np.inf
+                    st["emax_us"] = float(parts[1]) if float(parts[1]) > 0 else np.inf
                 elif head == "MODE":
                     # MODE 1 = weighted fit (the default here); MODE 0
                     # (unweighted) is recorded for callers via commands
                     pass
                 elif head == "SKIP":
-                    skipping = True
+                    st["skipping"] = True
                 elif head == "NOSKIP":
-                    skipping = False
+                    st["skipping"] = False
                 elif head == "JUMP":
-                    jump_level = 1 - jump_level
+                    st["jump_level"] = 1 - st["jump_level"]
+                    if st["jump_level"]:
+                        st["jump_index"] += 1
                 elif head == "PHASE":
-                    phase_offset += int(float(parts[1]))
+                    st["phase_offset"] += int(float(parts[1]))
                 elif head == "END":
                     break
                 continue
-            if skipping:
+            if st["skipping"]:
                 continue
             try:
-                if fmt == "tempo2":
+                if st["fmt"] == "tempo2":
                     toa = _parse_tempo2_line(parts)
                 elif line[:1] == " " and len(line.rstrip()) >= 70:
                     # parkes format: leading blank, obs code col 79
@@ -462,23 +477,25 @@ def read_tim_file(path: str, _depth=0) -> tuple[list[TOA], list[str]]:
             except (ValueError, IndexError) as e:
                 warnings.warn(f"{path}: unparseable TOA line {ls[:60]!r}: {e}")
                 continue
-            if time_offset:
-                toa.sec += time_offset
+            if st["time_offset"]:
+                toa.sec += st["time_offset"]
                 carry = int(np.floor(toa.sec / SECS_PER_DAY))
                 toa.day += carry
                 toa.sec -= carry * SECS_PER_DAY
-            if efac != 1.0:
-                toa.error_us *= efac
-            if equad_us:
-                toa.error_us = float(np.hypot(toa.error_us, equad_us))
+            if st["efac"] != 1.0:
+                toa.error_us *= st["efac"]
+            if st["equad_us"]:
+                toa.error_us = float(np.hypot(toa.error_us, st["equad_us"]))
             # EMIN/EMAX: drop TOAs outside the (scaled) error window
             # (reference: toa.py EMIN/EMAX command handling)
-            if toa.error_us < emin_us or toa.error_us > emax_us:
+            if toa.error_us < st["emin_us"] or toa.error_us > st["emax_us"]:
                 continue
-            if jump_level:
-                toa.flags["tim_jump"] = "1"
-            if phase_offset:
-                toa.flags["phase_offset"] = str(phase_offset)
+            if st["jump_level"]:
+                # distinct value per block so jump_flags_to_params can
+                # make one JUMP parameter per tim JUMP group
+                toa.flags["tim_jump"] = str(st["jump_index"])
+            if st["phase_offset"]:
+                toa.flags["phase_offset"] = str(st["phase_offset"])
             toas.append(toa)
     return toas, commands
 
@@ -664,6 +681,28 @@ def get_TOAs(timfile, ephem="de440s", planets=False, model=None,
         ephem = getattr(model, "EPHEM", None) and model.EPHEM.value or ephem
         if getattr(model, "PLANET_SHAPIRO", None) is not None and model.PLANET_SHAPIRO.value:
             planets = True
+        clock = getattr(model, "CLOCK", None)
+        if clock is not None and clock.value:
+            # "TT(BIPM2019)" -> BIPM chain + version; "TT(TAI)"/"UTC(NIST)"
+            # -> no BIPM refinement (reference: get_TOAs honors the par
+            # CLOCK directive)
+            cv = str(clock.value).upper().replace(" ", "")
+            m_bipm = re.match(r"TT\(BIPM(\d{4})?\)", cv)
+            if m_bipm:
+                include_bipm = True
+                if m_bipm.group(1):
+                    bipm_version = f"BIPM{m_bipm.group(1)}"
+            elif cv in ("TT(TAI)", "UTC(NIST)", "UTC"):
+                include_bipm = False
+            elif cv == "UNCORR":
+                # tempo2: no clock corrections at all
+                include_bipm = False
+                include_gps = False
+            else:
+                warnings.warn(
+                    f"unrecognized CLOCK realization {clock.value!r}; "
+                    f"proceeding with the default chain (include_bipm="
+                    f"{include_bipm}, {bipm_version})")
     if usepickle:
         cached = load_pickle(timfile, ephem=ephem, planets=planets,
                              include_gps=include_gps,

@@ -223,3 +223,102 @@ def test_expanded_observatory_registry():
         o = get_observatory(alias)
         assert o.name == expect, (alias, o.name)
         assert np.linalg.norm(o.itrf_xyz) > 6.3e6  # on the Earth
+
+
+def test_get_toas_honors_model_clock_directive():
+    """The par CLOCK line picks the BIPM realization
+    (reference: get_TOAs model plumbing)."""
+    from pint_tpu.models import get_model
+    from pint_tpu.toa import get_TOAs
+
+    base = ("PSR TCLK\nRAJ 01:00:00\nDECJ 01:00:00\nF0 100\nPEPOCH 55000\n"
+            "DM 1\n")
+    tim = "FORMAT 1\na 1400.0 55000.5 1.0 gbt\n"
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as d:
+        timf = os.path.join(d, "t.tim")
+        open(timf, "w").write(tim)
+        m1 = get_model(base + "CLOCK TT(BIPM2015)\n")
+        t1 = get_TOAs(timf, model=m1)
+        assert t1.include_bipm and t1.bipm_version == "BIPM2015"
+        m2 = get_model(base + "CLOCK TT(TAI)\n")
+        t2 = get_TOAs(timf, model=m2)
+        assert not t2.include_bipm
+        m3 = get_model(base)  # no CLOCK line: defaults hold
+        t3 = get_TOAs(timf, model=m3)
+        assert t3.include_bipm and t3.bipm_version == "BIPM2019"
+        m4 = get_model(base + "CLOCK UNCORR\n")
+        t4 = get_TOAs(timf, model=m4)
+        assert not t4.include_bipm and not t4.include_gps
+        import pytest, warnings as w
+        m5 = get_model(base + "CLOCK TT(PTB)\n")
+        with pytest.warns(UserWarning, match="unrecognized CLOCK"):
+            get_TOAs(timf, model=m5)
+
+
+def test_tim_jump_blocks_become_params(tmp_path):
+    """Each tim JUMP...JUMP block gets a distinct flag and converts to
+    its own fittable JUMP parameter (reference: tim JUMP command ->
+    -tim_jump flags -> PhaseJump params)."""
+    from pint_tpu.models import get_model
+    from pint_tpu.models.jump import jump_flags_to_params
+    from pint_tpu.toa import get_TOAs
+
+    tim = ("FORMAT 1\n"
+           "t1 1400.0 55000.5 1.0 gbt\n"
+           "JUMP\n"
+           "t2 1400.0 55001.5 1.0 gbt\n"
+           "t3 1400.0 55002.5 1.0 gbt\n"
+           "JUMP\n"
+           "t4 1400.0 55003.5 1.0 gbt\n"
+           "JUMP\n"
+           "t5 1400.0 55004.5 1.0 gbt\n"
+           "JUMP\n")
+    p = tmp_path / "j.tim"
+    p.write_text(tim)
+    t = get_TOAs(str(p))
+    tags = [f.get("tim_jump") for f in t.flags]
+    assert tags == [None, "1", "1", None, "2"]
+    m = get_model("PSR TJ\nRAJ 01:00:00\nDECJ 01:00:00\nF0 100 1\n"
+                  "PEPOCH 55002\nDM 1\n")
+    created = jump_flags_to_params(t, m)
+    assert created == ["JUMP1", "JUMP2"]
+    comp = m.components["PhaseJump"]
+    assert getattr(m, "JUMP1").key == "-tim_jump"
+    # masks select exactly the flagged groups
+    m1 = getattr(m, "JUMP1").resolve_mask(t)
+    m2 = getattr(m, "JUMP2").resolve_mask(t)
+    assert list(m1) == [False, True, True, False, False]
+    assert list(m2) == [False, False, False, False, True]
+    # idempotent
+    assert jump_flags_to_params(t, m) == []
+
+
+def test_tim_command_state_shared_with_includes(tmp_path):
+    """INCLUDE executes inline: TIME offsets and open JUMP blocks in
+    the parent apply inside the include, and jump indices stay
+    globally distinct (reference: read_toa_file shared command state)."""
+    from pint_tpu.toa import read_tim_file
+
+    (tmp_path / "child.tim").write_text(
+        "t3 1400.0 55010.5 1.0 gbt\n"
+        "JUMP\n"
+        "t4 1400.0 55011.5 1.0 gbt\n"
+        "JUMP\n")
+    (tmp_path / "parent.tim").write_text(
+        "FORMAT 1\n"
+        "TIME 0.25\n"
+        "JUMP\n"
+        "t1 1400.0 55000.5 1.0 gbt\n"
+        "JUMP\n"
+        "INCLUDE child.tim\n"
+        "t5 1400.0 55020.5 1.0 gbt\n")
+    toas, cmds = read_tim_file(str(tmp_path / "parent.tim"))
+    assert [t.flags["name"] for t in toas] == ["t1", "t3", "t4", "t5"]
+    # TIME applies everywhere, including the included file
+    assert all(abs(t.sec - 43200.25) < 1e-9 for t in toas)
+    tags = [t.flags.get("tim_jump") for t in toas]
+    # parent block -> "1"; child's own block -> "2"; others unjumped
+    assert tags == ["1", None, "2", None]
+    # FORMAT 1 carries into the child (it parsed as tempo2)
+    assert toas[2].flags["name"] == "t4"
