@@ -89,7 +89,28 @@ def _timed_refit(fit, arg):
     return compile_s, (time.time() - t0) / runs
 
 
+def _guard_wedged_device():
+    """Probe the default jax backend in a subprocess; if no device
+    materializes within 150 s (the axon relay can wedge for an hour
+    after an interrupted claim), force the CPU backend so the driver
+    records a real measurement instead of a timeout."""
+    import subprocess
+    import sys
+
+    try:
+        subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.numpy.ones(4).sum().block_until_ready()"],
+            timeout=150, check=True, capture_output=True)
+    except (subprocess.SubprocessError, OSError):
+        _stage("device probe hung/failed (wedged relay?) -> CPU backend")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
 def main():
+    _guard_wedged_device()
     import jax
 
     # persistent compilation cache: the driver's end-of-round bench run

@@ -79,6 +79,7 @@ class TOAs:
         self.include_gps = include_gps
         self.include_bipm = include_bipm
         self.bipm_version = bipm_version
+        self.include_site_clock = True  # False only for CLOCK UNCORR
         self.commands: list[str] = []
         self.filename = None
         n = len(toalist)
@@ -159,6 +160,10 @@ class TOAs:
         from .observatory import get_observatory
 
         if self._clock_applied:
+            return
+        if not self.include_site_clock:
+            # CLOCK UNCORR: raw TOAs, no site/GPS/BIPM chain at all
+            self._clock_applied = True
             return
         utc = Epochs(self.day, self.sec, "utc")
         for obs_name in np.unique(self.obs.astype(str)):
@@ -571,7 +576,7 @@ def _read_tim_native(path: str, **toas_kw) -> "TOAs | None":
 
 
 def _pickle_settings_key(ephem, planets, include_gps, include_bipm,
-                         bipm_version):
+                         bipm_version, include_site_clock=True):
     from . import __version__
     from .utils import compute_hash
 
@@ -579,12 +584,15 @@ def _pickle_settings_key(ephem, planets, include_gps, include_bipm,
     # computed posvels, so any change to the earth-rotation/ephemeris chain
     # must bust stale caches (e.g. the 0.2.0 ERA half-day fix).
     return compute_hash(repr((ephem, planets, include_gps, include_bipm,
-                              bipm_version, __version__, _PHYSICS_REV)))
+                              bipm_version, include_site_clock,
+                              __version__, _PHYSICS_REV)))
 
 
-# Bump whenever the posvel/clock/TDB pipeline changes numerically.
-# 2: ERA half-day fix; 3: VSOP87 Earth + integrated TDB-TT table.
-_PHYSICS_REV = 3
+# Bump whenever the posvel/clock/TDB pipeline OR the tim parser's
+# semantics change. 2: ERA half-day fix; 3: VSOP87 Earth + integrated
+# TDB-TT table; 4: INCLUDE shares command state + per-block tim_jump
+# indices + CLOCK-directive plumbing (cached parses differ).
+_PHYSICS_REV = 4
 
 
 def _tim_content_hash(path) -> str:
@@ -628,7 +636,8 @@ def save_pickle(toas: TOAs, picklefile=None):
     content_hash = (_tim_content_hash(toas.filename)
                     if toas.filename is not None else None)
     key = _pickle_settings_key(toas.ephem, toas.planets, toas.include_gps,
-                               toas.include_bipm, toas.bipm_version)
+                               toas.include_bipm, toas.bipm_version,
+                               getattr(toas, "include_site_clock", True))
     import gzip
 
     with gzip.open(picklefile, "wb") as f:
@@ -639,7 +648,7 @@ def save_pickle(toas: TOAs, picklefile=None):
 
 def load_pickle(timfile, picklefile=None, ephem="de440s", planets=False,
                 include_gps=True, include_bipm=True,
-                bipm_version="BIPM2019") -> TOAs | None:
+                bipm_version="BIPM2019", include_site_clock=True) -> TOAs | None:
     """Load cached TOAs if fresh, else None (reference: toa.py::load_pickle)."""
     import gzip
     import pickle
@@ -654,7 +663,7 @@ def load_pickle(timfile, picklefile=None, ephem="de440s", planets=False,
         with gzip.open(picklefile, "rb") as f:
             blob = pickle.load(f)
         key = _pickle_settings_key(ephem, planets, include_gps, include_bipm,
-                                   bipm_version)
+                                   bipm_version, include_site_clock)
         if blob["settings"] != key:
             return None
         if timfile is not None:
@@ -677,6 +686,7 @@ def get_TOAs(timfile, ephem="de440s", planets=False, model=None,
     ``usepickle=True`` a content-hash-validated cache next to the tim
     file skips the clock/TDB/posvel pipeline on reload.
     """
+    uncorr = False
     if model is not None:
         ephem = getattr(model, "EPHEM", None) and model.EPHEM.value or ephem
         if getattr(model, "PLANET_SHAPIRO", None) is not None and model.PLANET_SHAPIRO.value:
@@ -695,9 +705,11 @@ def get_TOAs(timfile, ephem="de440s", planets=False, model=None,
             elif cv in ("TT(TAI)", "UTC(NIST)", "UTC"):
                 include_bipm = False
             elif cv == "UNCORR":
-                # tempo2: no clock corrections at all
+                # tempo2: no clock corrections at all (site chain is
+                # switched off on the TOAs object below)
                 include_bipm = False
                 include_gps = False
+                uncorr = True
             else:
                 warnings.warn(
                     f"unrecognized CLOCK realization {clock.value!r}; "
@@ -707,7 +719,8 @@ def get_TOAs(timfile, ephem="de440s", planets=False, model=None,
         cached = load_pickle(timfile, ephem=ephem, planets=planets,
                              include_gps=include_gps,
                              include_bipm=include_bipm,
-                             bipm_version=bipm_version)
+                             bipm_version=bipm_version,
+                             include_site_clock=not uncorr)
         if cached is not None:
             return cached
     t = _read_tim_native(str(timfile), ephem=ephem, planets=planets,
@@ -720,6 +733,7 @@ def get_TOAs(timfile, ephem="de440s", planets=False, model=None,
                  bipm_version=bipm_version)
         t.commands = commands
         t.filename = str(timfile)
+    t.include_site_clock = not uncorr
     t.apply_clock_corrections(limits=limits)
     t.compute_TDBs()
     t.compute_posvels()

@@ -250,6 +250,8 @@ def test_get_toas_honors_model_clock_directive():
         m4 = get_model(base + "CLOCK UNCORR\n")
         t4 = get_TOAs(timf, model=m4)
         assert not t4.include_bipm and not t4.include_gps
+        assert not t4.include_site_clock
+        assert np.all(t4.clock_corr_s == 0.0)  # truly raw TOAs
         import pytest, warnings as w
         m5 = get_model(base + "CLOCK TT(PTB)\n")
         with pytest.warns(UserWarning, match="unrecognized CLOCK"):
