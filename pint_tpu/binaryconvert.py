@@ -148,7 +148,7 @@ def convert_binary(model, output: str):
         skip = ()
         if output == "DDS":
             skip = ("SINI",)
-        elif output == "ELL1H":
+        elif output in ("ELL1H", "DDH"):
             skip = ("M2", "SINI")
         _apply(comp, vals, skip=skip)
     # Shapiro reparameterizations apply across ALL branches (e.g.
@@ -171,7 +171,7 @@ def _shapiro_m2_sini(vals, current):
         u_sini = (np.exp(-sm) * us) if us else None
         m2, um, _ = vals.get("M2", (None, None, True))
         return m2, sini, um, u_sini
-    if current == "ELL1H":
+    if current in ("ELL1H", "DDH"):
         h3, uh3, _ = vals.get("H3", (None, None, True))
         if not h3:
             return None
@@ -218,7 +218,14 @@ def _derive_shapiro_reparam(comp, vals, current, output):
             comp.SHAPMAX.uncertainty = (
                 float(usini / (1.0 - sini)) if usini else None)
             comp.SHAPMAX.frozen = shap_frozen
-    elif output == "ELL1H":
+        # an orthometric source (DDH/ELL1H) carries no literal M2 for
+        # _apply to copy — write the derived companion mass or the DDS
+        # Shapiro range is silently zero
+        if m2 is not None and "M2" in comp.params and comp.M2.value is None:
+            comp.M2.value = float(m2)
+            comp.M2.uncertainty = float(um) if um else None
+            comp.M2.frozen = shap_frozen
+    elif output in ("ELL1H", "DDH"):
         if sini is not None and m2 is not None and 0 < sini < 1.0:
             cosi = np.sqrt(1.0 - sini**2)
             st = sini / (1.0 + cosi)
@@ -231,7 +238,7 @@ def _derive_shapiro_reparam(comp, vals, current, output):
             if um or ust:
                 comp.H3.uncertainty = float(_TSUN_S * st**3 * np.hypot(
                     um or 0.0, 3 * m2 / st * (ust or 0.0)))
-    elif current in ("DDS", "ELL1H"):
+    elif current in ("DDS", "ELL1H", "DDH"):
         # leaving a reparameterized model: write plain M2/SINI if present
         if "SINI" in comp.params and sini is not None:
             comp.SINI.value = float(sini)

@@ -22,11 +22,12 @@ def add_binary_component(model, binary_name: str, keys: dict):
         from .bt import BinaryBT, BinaryBTX
 
         comp = BinaryBTX() if name == "BTX" else BinaryBT()
-    elif name in ("DD", "DDS", "DDGR", "DDK"):
-        from .dd import BinaryDD, BinaryDDS, BinaryDDK, BinaryDDGR
+    elif name in ("DD", "DDS", "DDGR", "DDK", "DDH"):
+        from .dd import (BinaryDD, BinaryDDGR, BinaryDDH, BinaryDDK,
+                         BinaryDDS)
 
         comp = {"DD": BinaryDD, "DDS": BinaryDDS, "DDK": BinaryDDK,
-                "DDGR": BinaryDDGR}[name]()
+                "DDGR": BinaryDDGR, "DDH": BinaryDDH}[name]()
     else:
         raise ValueError(f"unsupported BINARY model {binary_name!r}")
     model.add_component(comp)

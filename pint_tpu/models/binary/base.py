@@ -198,3 +198,16 @@ def kepler_solve(M, e, iters=8):
     for _ in range(iters):
         E = E - (E - e * jnp.sin(E) - M) / (1.0 - e * jnp.cos(E))
     return E
+
+
+def orthometric_shapiro_rs(h3, sigma):
+    """(range r [s], shape sini) from the orthometric Shapiro
+    parameters (Freire & Wex 2010: sini = 2 sigma/(1+sigma^2),
+    r = h3/sigma^3). Single home for the mapping shared by BinaryELL1H
+    and BinaryDDH; sigma = 0 (unset) degrades to r = h3, sini = 0
+    rather than dividing by zero."""
+    import jax.numpy as jnp
+
+    sini = 2.0 * sigma / (1.0 + sigma**2)
+    r = h3 / jnp.where(sigma == 0.0, 1.0, sigma**3)
+    return r, sini

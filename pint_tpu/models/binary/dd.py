@@ -46,6 +46,11 @@ class BinaryDD(PulsarBinary):
     def sini(self, params):
         return params.get("SINI", 0.0)
 
+    def shapiro_rs(self, params):
+        """(range r [s], shape s) of the Shapiro delay — the hook DDH
+        overrides with the orthometric parameterization."""
+        return TSUN_S * params.get("M2", 0.0), self.sini(params)
+
     def _dd_delay_at(self, params, prep, delay_accum):
         import jax.numpy as jnp
 
@@ -67,8 +72,7 @@ class BinaryDD(PulsarBinary):
         roemer = alpha * (cu - er) + beta * su
         einstein = params.get("GAMMA", 0.0) * su
         # Shapiro (DD86 eq. 26)
-        r = TSUN_S * params.get("M2", 0.0)
-        s = self.sini(params)
+        r, s = self.shapiro_rs(params)
         shapiro = -2.0 * r * jnp.log(1.0 - e * cu
                                      - s * (so * (cu - e)
                                             + jnp.sqrt(1.0 - e**2) * co * su))
@@ -182,6 +186,56 @@ class BinaryDDS(BinaryDD):
         import jax.numpy as jnp
 
         return 1.0 - jnp.exp(-params.get("SHAPMAX", 0.0))
+
+
+class BinaryDDH(BinaryDD):
+    """DDH: DD with the orthometric Shapiro parameterization
+    (H3 + STIGMA, or H3 + H4 with sigma = H4/H3) of Freire & Wex 2010
+    in place of (M2, SINI) — better-conditioned for intermediate
+    inclinations (reference: binary_dd.py::BinaryDDH / DDH_model.py).
+    M2/SINI are REMOVED: they would be silent no-ops here (the delay
+    never reads them), exactly why the reference's DDH drops them.
+    """
+
+    binary_model_name = "DDH"
+
+    def __init__(self):
+        super().__init__()
+        self.remove_param("M2")
+        self.remove_param("SINI")
+        self.add_param(floatParameter(
+            "H3", units="s", description="Orthometric amplitude h3"))
+        self.add_param(floatParameter(
+            "H4", units="s", description="Orthometric amplitude h4"))
+        self.add_param(floatParameter(
+            "STIGMA", units="", aliases=("VARSIGMA",),
+            description="Orthometric ratio"))
+
+    def validate(self):
+        super().validate()
+        if self.H3.value is None:
+            raise MissingParameter("BinaryDDH", "H3")
+        if self.STIGMA.value is None and self.H4.value is None:
+            raise MissingParameter(
+                "BinaryDDH", "STIGMA",
+                "DDH needs STIGMA (or H4, for sigma = H4/H3) with H3")
+
+    def _stigma(self, params):
+        import jax.numpy as jnp
+
+        if self.STIGMA.value is not None:
+            return params.get("STIGMA", 0.0)
+        h3 = params.get("H3", 0.0)
+        return params.get("H4", 0.0) / jnp.where(h3 == 0.0, 1.0, h3)
+
+    def shapiro_rs(self, params):
+        from .base import orthometric_shapiro_rs
+
+        return orthometric_shapiro_rs(params.get("H3", 0.0),
+                                      self._stigma(params))
+
+    def sini(self, params):
+        return self.shapiro_rs(params)[1]
 
 
 class BinaryDDK(BinaryDD):

@@ -128,12 +128,12 @@ class BinaryELL1H(BinaryELL1):
     def shapiro_rs(self, params):
         import jax.numpy as jnp
 
+        from .base import orthometric_shapiro_rs
+
         h3 = params.get("H3", 0.0)
         if self.STIGMA.value is not None:
             sig = params.get("STIGMA", 0.0)
         else:
             # sigma = H4/H3 (Freire & Wex 2010 eq. 25)
             sig = params.get("H4", 0.0) / jnp.where(h3 == 0.0, 1.0, h3)
-        sini = 2.0 * sig / (1.0 + sig**2)
-        r = h3 / jnp.where(sig == 0.0, 1.0, sig**3)
-        return r, sini
+        return orthometric_shapiro_rs(h3, sig)

@@ -28,6 +28,8 @@ def choose_model(keys: set[str]) -> str:
         if "H3" in keys or "H4" in keys or "STIGMA" in keys or "STIG" in keys:
             return "ELL1H"
         return "ELL1"
+    if "H3" in keys or "STIGMA" in keys or "STIG" in keys:
+        return "DDH"  # eccentric orbit with orthometric Shapiro
     if "M2" in keys or "SINI" in keys or "SHAPMAX" in keys:
         return "DD"
     return "BT"

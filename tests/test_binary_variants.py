@@ -230,3 +230,103 @@ def test_mixed_structure_pta_fleet():
             par = getattr(f.model, pname)
             tol = max(1e-2 * (par.uncertainty or 1e-12), 1e-15)
             assert abs(xs[i][j] - par.value) <= tol, (i, pname)
+
+
+def test_ddh_matches_dd_at_equivalent_shapiro():
+    """DDH (orthometric H3/STIGMA) must reproduce DD's delays when the
+    parameters map through Freire & Wex 2010: STIGMA = SINI/(1+cos i),
+    H3 = Tsun*M2*STIGMA^3 (reference: DDH_model.py)."""
+    import numpy as np
+
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    m2, sini = 0.35, 0.92
+    cosi = np.sqrt(1 - sini**2)
+    stigma = sini / (1 + cosi)
+    h3 = 4.925490947e-6 * m2 * stigma**3
+    base = ("PSR TDDH\nRAJ 10:00:00\nDECJ 20:00:00\nF0 150.0 1\n"
+            "PEPOCH 55300\nDM 5.0\n")
+    orb = "PB 8.0\nA1 12.0\nT0 55300\nECC 0.12\nOM 45.0\nGAMMA 1e-4\n"
+    m_dd = get_model(base + "BINARY DD\n" + orb +
+                     f"M2 {m2}\nSINI {sini}\n")
+    m_ddh = get_model(base + "BINARY DDH\n" + orb +
+                      f"H3 {h3}\nSTIGMA {stigma}\n")
+    mjds = np.linspace(55300, 55316, 400)
+    t = make_fake_toas_fromMJDs(mjds, m_dd, error_us=1.0, freq_mhz=1400.0,
+                                obs="@", add_noise=False, iterations=0)
+    d_dd = np.asarray(m_dd.prepare(t).delay())
+    d_ddh = np.asarray(m_ddh.prepare(t).delay())
+    # identical to sub-ns (same physics, different parameterization)
+    assert np.abs(d_dd - d_ddh).max() < 1e-10
+
+
+def test_convert_binary_dd_to_ddh_roundtrip():
+    from pint_tpu.binaryconvert import convert_binary
+    from pint_tpu.models import get_model
+
+    par = ("PSR TCONV\nRAJ 10:00:00\nDECJ 20:00:00\nF0 150.0 1\n"
+           "PEPOCH 55300\nDM 5.0\nBINARY DD\nPB 8.0\nA1 12.0\nT0 55300\n"
+           "ECC 0.12\nOM 45.0\nM2 0.35 1\nSINI 0.92 1\n")
+    m = get_model(par)
+    m_ddh = convert_binary(m, "DDH")
+    assert "BinaryDDH" in m_ddh.components
+    import numpy as np
+
+    sini, m2 = 0.92, 0.35
+    cosi = np.sqrt(1 - sini**2)
+    st = sini / (1 + cosi)
+    assert m_ddh.STIGMA.value == pytest.approx(st, rel=1e-12)
+    assert m_ddh.H3.value == pytest.approx(4.925490947e-6 * m2 * st**3,
+                                           rel=1e-12)
+    back = convert_binary(m_ddh, "DD")
+    assert back.SINI.value == pytest.approx(sini, rel=1e-10)
+    assert back.M2.value == pytest.approx(m2, rel=1e-10)
+
+
+def test_ddh_h4_fallback_and_validation():
+    """DDH accepts H3+H4 (sigma = H4/H3) like ELL1H, rejects H3 alone,
+    and drops the no-op M2/SINI params (review findings)."""
+    import numpy as np
+
+    from pint_tpu.models import get_model
+    from pint_tpu.models.timing_model import MissingParameter
+
+    base = ("PSR TDDH2\nRAJ 10:00:00\nDECJ 20:00:00\nF0 150.0 1\n"
+            "PEPOCH 55300\nDM 5.0\nBINARY DDH\nPB 8.0\nA1 12.0\nT0 55300\n"
+            "ECC 0.12\nOM 45.0\n")
+    stigma = 0.55
+    h3 = 4.925490947e-6 * 0.35 * stigma**3
+    m_h4 = get_model(base + f"H3 {h3}\nH4 {h3 * stigma}\n")
+    m_st = get_model(base + f"H3 {h3}\nSTIGMA {stigma}\n")
+    assert "M2" not in m_h4.params and "SINI" not in m_h4.params
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    mjds = np.linspace(55300, 55316, 100)
+    t = make_fake_toas_fromMJDs(mjds, m_st, error_us=1.0, freq_mhz=1400.0,
+                                obs="@", add_noise=False, iterations=0)
+    d1 = np.asarray(m_h4.prepare(t).delay())
+    d2 = np.asarray(m_st.prepare(t).delay())
+    assert np.abs(d1 - d2).max() < 1e-12  # H4/H3 route == STIGMA route
+    with pytest.raises(MissingParameter):
+        get_model(base + f"H3 {h3}\n")  # H3 alone: loud, not wrong
+
+
+def test_convert_ddh_to_dds_keeps_companion_mass():
+    """DDH -> DDS must derive M2 (review finding: the Shapiro range was
+    silently zero)."""
+    from pint_tpu.binaryconvert import convert_binary
+    from pint_tpu.models import get_model
+
+    import numpy as np
+
+    sini, m2 = 0.92, 0.35
+    st = sini / (1 + np.sqrt(1 - sini**2))
+    par = ("PSR TC2\nRAJ 10:00:00\nDECJ 20:00:00\nF0 150.0 1\n"
+           "PEPOCH 55300\nDM 5.0\nBINARY DDH\nPB 8.0\nA1 12.0\nT0 55300\n"
+           f"ECC 0.12\nOM 45.0\nH3 {4.925490947e-6 * m2 * st**3} 1\n"
+           f"STIGMA {st} 1\n")
+    m = get_model(par)
+    dds = convert_binary(m, "DDS")
+    assert dds.M2.value == pytest.approx(m2, rel=1e-10)
+    assert dds.SHAPMAX.value == pytest.approx(-np.log(1 - sini), rel=1e-10)

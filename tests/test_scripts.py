@@ -191,5 +191,6 @@ EPS2 -4.0e-7 1
     # model choice heuristics (reference: t2binary2pint mapping)
     assert choose_model({"KIN", "ECC"}) == "DDK"
     assert choose_model({"EPS1", "H3"}) == "ELL1H"
+    assert choose_model({"ECC", "OM", "H3", "STIG"}) == "DDH"
     assert choose_model({"ECC", "OM", "M2", "SINI"}) == "DD"
     assert choose_model({"ECC", "OM"}) == "BT"
