@@ -267,13 +267,25 @@ def gls_normal(Mfull, r, sigma, sqrt_phi_inv):
     """
     import jax.numpy as jnp
 
-    Mw = Mfull / sigma[:, None]
-    norm = jnp.hypot(column_norms(Mw), sqrt_phi_inv)
-    Mn = Mw / norm
-    q = sqrt_phi_inv / norm  # <= 1 by construction
+    Mn, norm, q = gls_whiten(Mfull, sigma, sqrt_phi_inv)
     A = Mn.T @ Mn + jnp.diag(q * q)
     b = Mn.T @ (r / sigma)
     return A, b, norm
+
+
+def gls_whiten(Mfull, sigma, sqrt_phi_inv):
+    """(Mn, norm, q): whitened, prior-folded, column-normalized design
+    — the shared first half of gls_normal, also used by the PTA path's
+    analytic-ECORR step so the normalization convention has exactly
+    one home. q = sqrt_phi_inv/norm is <= 1 by construction
+    (column_norms never returns 0, so norm > 0 even for zero columns
+    with zero prior)."""
+    import jax.numpy as jnp
+
+    Mw = Mfull / sigma[:, None]
+    norm = jnp.hypot(column_norms(Mw), sqrt_phi_inv)
+    Mn = Mw / norm
+    return Mn, norm, sqrt_phi_inv / norm
 
 
 def gls_solve(Mfull, r, sigma, sqrt_phi_inv, threshold=1e-12):
