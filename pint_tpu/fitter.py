@@ -329,6 +329,68 @@ def stack_noise_bases(M, bases):
     return Mfull, sqrt_phi_inv, nparam
 
 
+_degraded_f64_cache = None
+
+
+def degraded_f64() -> bool:
+    """True when the default backend's float64 is emulated with a
+    reduced significand (axon TPU: ~47 bits, 2^-50 is lost in 1+eps).
+    Cached once per process; triggers backend init on first call."""
+    global _degraded_f64_cache
+    if _degraded_f64_cache is None:
+        import jax
+        import jax.numpy as jnp
+
+        # traced inputs + a barrier on the sum: neither constant folding
+        # nor the (a+b)-a -> b rewrite may hide the backend's true
+        # compiled rounding of the ADD
+        probe = jax.jit(
+            lambda a, b: jax.lax.optimization_barrier(a + b) - a)(
+            jnp.asarray(1.0, jnp.float64), jnp.asarray(2.0 ** -50,
+                                                       jnp.float64))
+        _degraded_f64_cache = bool(float(probe) == 0.0)
+    return _degraded_f64_cache
+
+
+_warned_degraded = False
+
+
+def _warn_degraded_once():
+    global _warned_degraded
+    if _warned_degraded or not degraded_f64():
+        return
+    _warned_degraded = True
+    import warnings
+
+    warnings.warn(
+        "this backend's float64 is emulated with a reduced significand "
+        "(~47 bits): ill-conditioned fits lose precision. The plain "
+        "fitters keep the best-chi2 iterate as a safeguard, but prefer "
+        "the CPU backend (jax.config.update('jax_platforms', 'cpu') "
+        "before any jax use) for final parameter estimation.")
+
+
+def marginalized_chi2(r, sigma_s, bases, threshold=1e-12):
+    """Whitened chi2 of a residual vector at FIXED parameters, with any
+    correlated-noise basis amplitudes marginalized (Woodbury:
+    r^T C^-1 r = |rw|^2 - b.dxn over the noise columns alone). This is
+    the actual GLS objective the safeguarded fitters compare between
+    iterates — unlike gls_solve's return value, it involves no
+    parameter step, so a corrupted design-matrix projection cannot make
+    it look better than it is."""
+    import jax.numpy as jnp
+
+    rw2 = float(jnp.sum(jnp.square(r / sigma_s)))
+    B = bases[0] if bases is not None else None
+    if B is None or not B.shape[1]:
+        return rw2
+    Mfull, sqrt_phi_inv, _ = stack_noise_bases(
+        jnp.zeros((r.shape[0], 0)), bases)
+    A, b, _ = gls_normal(Mfull, r, sigma_s, sqrt_phi_inv)
+    dxn, _ = gls_eigh_solve(A, b, threshold)
+    return rw2 - float(b @ dxn)
+
+
 def wls_step(Mw, rw, threshold=1e-12):
     """Column-normalized whitened SVD solve: returns
     (dx, cov_normalized, norm).
@@ -377,28 +439,56 @@ class WLSFitter(Fitter):
     """
 
     def fit_toas(self, maxiter=2, threshold=1e-12):
+        import jax.numpy as jnp
+
         corr = _correlated_noise_components(self.model)
         if corr:
             raise CorrelatedErrors(corr)
         _reject_free_dmjump(self.model)
+        _warn_degraded_once()
         prepared = self.model.prepare(self.toas)
         resid_fn = prepared.residual_vector_fn(track_mode=self._track_mode())
         dm_fn, labels = prepared.designmatrix_fn()
         noff = _n_offset(labels)
-        x = prepared.vector_from_params()
-        covn = norm = None
-        for _ in range(maxiter):
+        f0 = prepared.params0["F"][0]
+
+        def whitened(x):
             r = resid_fn(x)
-            sigma_s = prepared.scaled_sigma_us(prepared.params_with_vector(x)) * 1e-6
+            sigma_s = prepared.scaled_sigma_us(
+                prepared.params_with_vector(x)) * 1e-6
+            return r / sigma_s, sigma_s
+
+        x = prepared.vector_from_params()
+        rw, sigma_s = whitened(x)
+        chi2 = float(jnp.sum(jnp.square(rw)))
+        # best-iterate safeguard: a plain Gauss-Newton step can increase
+        # chi2 (strong nonlinearity, or a corrupted normal-equation
+        # projection on degraded-f64 backends); never hand back an
+        # iterate worse than one already evaluated
+        best = (chi2, x, None)
+        first_cov = None
+        for _ in range(maxiter):
             M = dm_fn(x)
-            f0 = prepared.params0["F"][0]
             Mw = (M / f0) / sigma_s[:, None]
-            rw = r / sigma_s
             dx_all, covn, norm = wls_step(Mw, rw, threshold)
+            if first_cov is None:
+                first_cov = (covn, norm)
             x = x - dx_all[noff:]
+            rw, sigma_s = whitened(x)
+            chi2 = float(jnp.sum(jnp.square(rw)))
+            if chi2 < best[0]:
+                best = (chi2, x, (covn, norm))
+        if chi2 - best[0] > 1e-6 * max(1.0, best[0]):
+            import warnings
+
+            warnings.warn(
+                f"WLS iteration increased chi2 ({best[0]:.6g} -> "
+                f"{chi2:.6g}); keeping the best evaluated iterate")
+        chi2, x, cov = best
         self._sync_model_from_vector(prepared, x)
-        if covn is not None:
-            cov_all = cov_from_normalized(covn, norm)
+        cov = cov or first_cov
+        if cov is not None:
+            cov_all = cov_from_normalized(*cov)
             self._set_uncertainties(prepared, cov_all[noff:, noff:])
         self.resids = Residuals(self.toas, self.model)
         self.converged = True
@@ -416,6 +506,7 @@ class DownhillWLSFitter(WLSFitter):
         if corr:
             raise CorrelatedErrors(corr)
         _reject_free_dmjump(self.model)
+        _warn_degraded_once()
         prepared = self.model.prepare(self.toas)
         resid_fn = prepared.residual_vector_fn(track_mode=self._track_mode())
         dm_fn, labels = prepared.designmatrix_fn()
@@ -496,39 +587,69 @@ class GLSFitter(Fitter):
         return None, None
 
     def fit_toas(self, maxiter=2, threshold=1e-12, tol=0.0):
-        import jax.numpy as jnp
-
         _reject_free_dmjump(self.model)
-        chi2 = None
+        _warn_degraded_once()
         prepared = self.model.prepare(self.toas)
         resid_fn = prepared.residual_vector_fn(track_mode=self._track_mode())
         dm_fn, labels = prepared.designmatrix_fn()
         noff = _n_offset(labels)
-        x = prepared.vector_from_params()
-        cov = None
-        last_chi2 = None
-        for _ in range(maxiter):
+        f0 = prepared.params0["F"][0]
+
+        def state_at(x):
             p = prepared.params_with_vector(x)
             r = resid_fn(x)
             sigma_s = prepared.scaled_sigma_us(p) * 1e-6
-            M = dm_fn(x)
-            f0 = prepared.params0["F"][0]
-            M = M / f0
             bases = self._noise_bases(prepared, p)
+            return r, sigma_s, bases
+
+        x = prepared.vector_from_params()
+        r, sigma_s, bases = state_at(x)
+        chi2 = marginalized_chi2(r, sigma_s, bases, threshold)
+        # best-iterate safeguard on the ACTUAL marginalized chi2 (see
+        # marginalized_chi2): a Gauss-Newton step through a
+        # near-degenerate direction can diverge when the normal-equation
+        # projection is corrupted (degraded-f64 backends) or the
+        # linearization is poor; never return a worse iterate than one
+        # already evaluated
+        best = (chi2, x, None, None)
+        first_cov = first_na = None
+        nparam = None
+        last_chi2 = None
+        for _ in range(maxiter):
+            M = dm_fn(x) / f0
             Mfull, sqrt_phi_inv, nparam = stack_noise_bases(M, bases)
             # shared whitened/normalized/prior-weighted eigh solve (see
             # gls_solve; threshold semantics anchored by
             # tests/test_gls_threshold.py)
-            dx, cov, chi2 = gls_solve(Mfull, r, sigma_s, sqrt_phi_inv,
-                                      threshold)
+            dx, cov, _ = gls_solve(Mfull, r, sigma_s, sqrt_phi_inv,
+                                   threshold)
+            noise_ampls = (np.asarray(dx[nparam:])
+                           if bases[0] is not None else None)
+            if first_cov is None:
+                # the first solve is evaluated AT x0 — it is the cov /
+                # amplitude partner of the starting state, used when no
+                # step improves chi2 (e.g. refit of a converged model)
+                first_cov, first_na = cov, noise_ampls
             x = x - dx[noff:nparam]
-            self.noise_ampls = (np.asarray(dx[nparam:])
-                                if bases[0] is not None else None)
+            r, sigma_s, bases = state_at(x)
+            chi2 = marginalized_chi2(r, sigma_s, bases, threshold)
+            if chi2 < best[0]:
+                best = (chi2, x, cov, noise_ampls)
             if (tol and last_chi2 is not None
                     and abs(last_chi2 - chi2) < tol * max(1.0, abs(last_chi2))):
                 break
             last_chi2 = chi2
+        if chi2 - best[0] > 1e-6 * max(1.0, best[0]):
+            import warnings
+
+            warnings.warn(
+                f"GLS iteration increased chi2 ({best[0]:.6g} -> "
+                f"{chi2:.6g}); keeping the best evaluated iterate")
+        chi2, x, cov, self.noise_ampls = best
+        if self.noise_ampls is None:
+            self.noise_ampls = first_na
         self._sync_model_from_vector(prepared, x)
+        cov = cov if cov is not None else first_cov
         if cov is not None:
             cov_host = cov_from_normalized(*cov)
             self._set_uncertainties(prepared, cov_host[noff:nparam, noff:nparam])
@@ -581,17 +702,12 @@ class WidebandTOAFitter(GLSFitter):
                  for n in names]
         return DesignMatrix(M_dm, "dm", "pc cm^-3", names, units)
 
-    def _wideband_system(self):
-        """(prepared, combined DesignMatrix, r, sigma, noff, x0,
-        (B, w_us2)) for the current model state. B holds the TOA-noise
-        basis columns (ECORR/red noise) zero-padded over the DM rows —
-        DM measurements are uncorrelated with the TOA noise processes
-        (reference: wideband GLS stacks noise bases exactly like the
-        narrowband fitter, on the time block only)."""
+    def _wideband_rstate(self):
+        """(prepared, valid, r, sigma, (B, w_us2)) at the current model
+        state — the residual/noise half of _wideband_system, cheap
+        enough (no design matrices, no fresh jit) for the final
+        safeguard evaluation."""
         import jax.numpy as jnp
-
-        from .pint_matrix import (DesignMatrix,
-                                  combine_design_matrices_by_quantity)
 
         prepared = self.model.prepare(self.toas)
         wb = WidebandTOAResiduals(self.toas, self.model, prepared=prepared)
@@ -600,14 +716,27 @@ class WidebandTOAFitter(GLSFitter):
         r_dm = jnp.asarray(wb.dm.calc_dm_resids()[valid])
         sigma_t = prepared.scaled_sigma_us() * 1e-6
         sigma_dm = jnp.asarray(wb.dm.dm_error[valid])
+        r = jnp.concatenate([r_t, r_dm])
+        sigma = jnp.concatenate([sigma_t, sigma_dm])
+        bases = self._noise_bases_padded(prepared, int(valid.sum()))
+        return prepared, valid, r, sigma, bases
+
+    def _wideband_system(self):
+        """(prepared, combined DesignMatrix, r, sigma, noff, x0,
+        (B, w_us2)) for the current model state. B holds the TOA-noise
+        basis columns (ECORR/red noise) zero-padded over the DM rows —
+        DM measurements are uncorrelated with the TOA noise processes
+        (reference: wideband GLS stacks noise bases exactly like the
+        narrowband fitter, on the time block only)."""
+        from .pint_matrix import (DesignMatrix,
+                                  combine_design_matrices_by_quantity)
+
+        prepared, valid, r, sigma, bases = self._wideband_rstate()
         dm_time = DesignMatrix.from_prepared(prepared, self.model)
         dm_dm = self._dm_designmatrix(prepared, valid)
         combined = combine_design_matrices_by_quantity([dm_time, dm_dm])
         self.design_matrix = combined
-        r = jnp.concatenate([r_t, r_dm])
-        sigma = jnp.concatenate([sigma_t, sigma_dm])
         noff = _n_offset(combined.param_names)
-        bases = self._noise_bases_padded(prepared, int(valid.sum()))
         return (prepared, combined, r, sigma, noff,
                 prepared.vector_from_params(), bases)
 
@@ -621,11 +750,14 @@ class WidebandTOAFitter(GLSFitter):
                 [B, jnp.zeros((n_dm_rows, B.shape[1]))], axis=0)
         return (B, w_us2)
 
-    def _wideband_chi2_fn(self, prepared, bases=(None, None)):
+    def _wideband_chi2_fn(self, prepared, bases=(None, None),
+                          threshold=1e-12):
         """Jit-backed GLS objective chi2(x) over [time; DM] rows: the
         whitened chi2 with any noise-basis amplitudes marginalized at
         fixed x (Woodbury: |rw|^2 - b.dxn). One compiled function per
-        outer iteration; line-search probes pay no host re-prepare."""
+        outer iteration; line-search probes pay no host re-prepare.
+        ``threshold`` must match the solve's, or the two chi2 measures
+        disagree on near-degenerate noise directions."""
         import jax
         import jax.numpy as jnp
 
@@ -656,25 +788,30 @@ class WidebandTOAFitter(GLSFitter):
             if B is None:
                 return rw2
             A, b, _ = gls_normal(B, r, sigma, sqrt_phi_inv)
-            dxn, _ = gls_eigh_solve(A, b)
+            dxn, _ = gls_eigh_solve(A, b, threshold)
             return rw2 - b @ dxn
 
         return chi2_of
 
-    def _wideband_chi2(self):
+    def _wideband_chi2(self, threshold=1e-12):
         """GLS objective at the CURRENT model state."""
         prepared = self.model.prepare(self.toas)
         wb_valid = WidebandDMResiduals(self.toas, self.model,
                                        prepared=prepared).valid
         bases = self._noise_bases_padded(prepared, int(wb_valid.sum()))
-        fn = self._wideband_chi2_fn(prepared, bases)
+        fn = self._wideband_chi2_fn(prepared, bases, threshold)
         return float(fn(prepared.vector_from_params()))
 
     def fit_toas(self, maxiter=2, threshold=1e-12):
+        _warn_degraded_once()
         chi2 = None
+        best = None  # (actual chi2, prepared, x0) of the best state seen
         for _ in range(maxiter):
             prepared, combined, r, sigma, noff, x0, bases = \
                 self._wideband_system()
+            chi2_act = marginalized_chi2(r, sigma, bases, threshold)
+            if best is None or chi2_act < best[0]:
+                best = (chi2_act, prepared, x0)
             Mfull, sqrt_phi_inv, nparam = stack_noise_bases(
                 combined.matrix, bases)
             dx_all, cov, chi2 = gls_solve(Mfull, r, sigma, sqrt_phi_inv,
@@ -685,6 +822,28 @@ class WidebandTOAFitter(GLSFitter):
             cov_all = cov_from_normalized(*cov)
             self._set_uncertainties(prepared, cov_all[noff:nparam,
                                                       noff:nparam])
+        # best-iterate safeguard (see GLSFitter.fit_toas): compare the
+        # final state's actual marginalized chi2 — SAME threshold as the
+        # in-loop evaluations — against the best one and revert if an
+        # iteration diverged
+        _, _, r, sigma, bases = self._wideband_rstate()
+        final_chi2 = marginalized_chi2(r, sigma, bases, threshold)
+        if (best is not None
+                and final_chi2 - best[0] > 1e-6 * max(1.0, best[0])):
+            import warnings
+
+            warnings.warn(
+                f"wideband GLS iteration increased chi2 ({best[0]:.6g} "
+                f"-> {final_chi2:.6g}); reverting to the best evaluated "
+                "iterate (reported uncertainties are from the last "
+                "solve; noise amplitudes are cleared)")
+            chi2, prepared, x0 = best
+            self._sync_model_from_vector(prepared, x0)
+            # the amplitudes solved at the diverged state do not belong
+            # to the reverted parameters
+            self.noise_ampls = None
+        else:
+            chi2 = final_chi2
         self.resids = WidebandTOAResiduals(self.toas, self.model)
         self.converged = True
         self.chi2_whitened = chi2
@@ -706,7 +865,7 @@ class WidebandDownhillFitter(WidebandTOAFitter):
                 self._wideband_system()
             # one jitted GLS objective per outer iteration; line-search
             # probes marginalize the (fixed) bases on device
-            chi2_fn = self._wideband_chi2_fn(prepared, bases)
+            chi2_fn = self._wideband_chi2_fn(prepared, bases, threshold)
             chi2_of = lambda x: float(chi2_fn(x))  # noqa: E731
             if best_chi2 is None:
                 best_chi2 = chi2_of(x0)
@@ -754,7 +913,7 @@ class WidebandLMFitter(WidebandTOAFitter):
         import jax.numpy as jnp
 
         lm = lm_lambda0
-        best_chi2 = self._wideband_chi2()
+        best_chi2 = self._wideband_chi2(threshold)
         for _ in range(maxiter):
             prepared, combined, r, sigma, noff, x0, bases = \
                 self._wideband_system()
@@ -765,7 +924,7 @@ class WidebandLMFitter(WidebandTOAFitter):
             dxn = jnp.linalg.solve(A_damped, b)
             dx = (dxn / norm)[noff:nparam]
             self._sync_model_from_vector(prepared, x0 - dx)
-            chi2 = self._wideband_chi2()
+            chi2 = self._wideband_chi2(threshold)
             if chi2 <= best_chi2 + 1e-12:
                 accepted = chi2 < best_chi2 - tol * max(1.0, best_chi2)
                 best_chi2 = min(best_chi2, chi2)

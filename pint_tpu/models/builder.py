@@ -65,6 +65,39 @@ def get_model(parfile, allow_name_mixing=False) -> TimingModel:
         else:
             keys[canon] = fields
 
+    # tempo1-style P0/P1 spin parameterization -> F0/F1 (with
+    # uncertainty propagation; reference analog: utils.py::p_to_f —
+    # upstream requires F0, but P0 par files are common in old archives)
+    if "P0" in keys and "F0" not in keys:
+        def _vfu(fields):
+            val = float(fields[0])
+            fit, unc = "0", None
+            rest = list(fields[1:])
+            if rest and rest[0] in ("0", "1"):
+                fit = rest.pop(0)
+            if rest:
+                unc = float(rest[0])
+            return val, fit, unc
+
+        from ..utils import p_to_f, pferrs
+
+        p0, fit0, u0 = _vfu(keys.pop("P0"))
+        keys["F0"] = [repr(1.0 / p0), fit0] + (
+            [repr(u0 / p0**2)] if u0 is not None else [])
+        had_p1 = "P1" in keys
+        p1, fit1, u1 = (_vfu(keys.pop("P1")) if had_p1
+                        else (0.0, "0", None))
+        if had_p1 or "P2" in keys:
+            keys["F1"] = [repr(-p1 / p0**2), fit1]
+            if u0 is not None or u1 is not None:
+                _, _, _, f1err = pferrs(p0, u0 or 0.0, p1, u1 or 0.0)
+                keys["F1"].append(repr(f1err))
+        if "P2" in keys:
+            p2, fit2, _ = _vfu(keys.pop("P2"))
+            f2 = p_to_f(p0, p1, p2)[2]
+            keys["F2"] = [repr(f2), fit2]
+        warnings.warn("converted P0/P1/P2 spin parameters to F0/F1/F2")
+
     model = TimingModel(name=str(parfile) if isinstance(parfile, (str, os.PathLike)) else "")
     unrecognized = {}
 
@@ -141,6 +174,26 @@ def get_model(parfile, allow_name_mixing=False) -> TimingModel:
         from .wave import DMWaveX
 
         model.add_component(DMWaveX())
+    if ("CM" in keys or "CM1" in keys or "TNCHROMIDX" in keys
+            or any(k.startswith(("CMX_", "CMWXFREQ_")) for k in keys)
+            or any(k in ("TNCHROMAMP", "TNCHROMGAM", "TNCHROMC")
+                   for k in keys)):
+        from .chromatic import ChromaticCM, ChromaticCMX, CMWaveX
+
+        # ChromaticCM always rides along: it owns TNCHROMIDX, the one
+        # home of the chromatic index that CMX/CMWaveX/PLChromNoise read
+        cm_comp = ChromaticCM()
+        if "CM" not in keys:
+            cm_comp.CM.value = 0.0
+        model.add_component(cm_comp)
+        if any(k.startswith("CMX_") for k in keys):
+            model.add_component(ChromaticCMX())
+        if any(k.startswith("CMWXFREQ_") for k in keys):
+            model.add_component(CMWaveX())
+    if any(k in ("TNCHROMAMP", "TNCHROMGAM", "TNCHROMC") for k in keys):
+        from .noise import PLChromNoise
+
+        model.add_component(PLChromNoise())
     if any(k.startswith("SWXDM_") for k in keys):
         from .solar_wind import SolarWindDispersionX
 
@@ -187,6 +240,26 @@ def get_model(parfile, allow_name_mixing=False) -> TimingModel:
                       if k.startswith("DMWXFREQ_")})
         for idx in ids:
             dwx.add_dmwavex(idx)
+    if "ChromaticCM" in model.components:
+        cmc = model.components["ChromaticCM"]
+        i = 1
+        while f"CM{i}" in keys:
+            cmc.add_cmterm(i)
+            i += 1
+    if "ChromaticCMX" in model.components:
+        cx = model.components["ChromaticCMX"]
+        ids = sorted({int(k.split("_")[1]) for k in keys
+                      if k.startswith("CMX_")})
+        for idx in ids:
+            lo = float(keys.get(f"CMXR1_{idx:04d}", ["0"])[0])
+            hi = float(keys.get(f"CMXR2_{idx:04d}", ["0"])[0])
+            cx.add_cmx_range(idx, lo, hi)
+    if "CMWaveX" in model.components:
+        cwx = model.components["CMWaveX"]
+        ids = sorted({int(k.split("_")[1]) for k in keys
+                      if k.startswith("CMWXFREQ_")})
+        for idx in ids:
+            cwx.add_cmwavex(idx)
     if "SolarWindDispersionX" in model.components:
         swx = model.components["SolarWindDispersionX"]
         ids = sorted({int(k.split("_")[1]) for k in keys

@@ -294,3 +294,56 @@ class PLDMNoise(NoiseComponent):
         gamma = params["TNDMGAM"]
         return prep["dmrn_F"], powerlaw_phi(
             A, gamma, prep["dmrn_freqs"], prep["dmrn_tspan_s"])
+
+
+class PLChromNoise(NoiseComponent):
+    """Power-law chromatic noise with a variable spectral index in
+    frequency (reference: noise_model.py::PLChromNoise): the PLDMNoise
+    machinery with the per-TOA basis scaling (f_ref/nu)^alpha, where
+    alpha is the model's chromatic index TNCHROMIDX (owned by
+    ChromaticCM, default 4 — the thin-screen scattering expectation).
+    Params TNCHROMAMP (log10), TNCHROMGAM, TNCHROMC.
+    """
+
+    category = "pl_chrom_noise"
+    order = 94
+    F_REF_MHZ = 1400.0
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("TNCHROMAMP", units="log10",
+                                      description="log10 chromatic-noise amplitude"))
+        self.add_param(floatParameter("TNCHROMGAM", units="",
+                                      description="Chromatic-noise spectral index"))
+        p = floatParameter("TNCHROMC", units="",
+                           description="Number of harmonics")
+        p.value = 30
+        self.add_param(p)
+
+    def device_slot(self, pname):
+        return pname, None
+
+    def pack(self, model, toas, prep, params0):
+        import jax.numpy as jnp
+
+        F, freqs, tspan_s = fourier_basis(toas, int(self.TNCHROMC.value or 30))
+        # chromatic index is static at pack time (like the basis span);
+        # default matches ChromaticCM.DEFAULT_CHROM_IDX
+        alpha = 4.0
+        cm = model.components.get("ChromaticCM")
+        if cm is not None and cm.TNCHROMIDX.value is not None:
+            alpha = float(cm.TNCHROMIDX.value)
+        with np.errstate(divide="ignore"):
+            chrom = np.where(np.isfinite(toas.freq_mhz),
+                             (self.F_REF_MHZ / toas.freq_mhz) ** alpha, 0.0)
+        prep["chromrn_F"] = jnp.asarray(F * chrom[:, None])
+        prep["chromrn_freqs"] = jnp.asarray(freqs)
+        prep["chromrn_tspan_s"] = tspan_s
+        for pname in ("TNCHROMAMP", "TNCHROMGAM"):
+            params0[pname] = getattr(self, pname).value or 0.0
+
+    def basis_weight(self, params, prep):
+        A = 10.0 ** params["TNCHROMAMP"]
+        gamma = params["TNCHROMGAM"]
+        return prep["chromrn_F"], powerlaw_phi(
+            A, gamma, prep["chromrn_freqs"], prep["chromrn_tspan_s"])

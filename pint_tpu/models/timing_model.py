@@ -386,13 +386,30 @@ class PreparedTiming:
         return out
 
     def params_with_vector(self, x):
-        """Overlay flat free-param vector x onto params0 pytree."""
+        """Overlay flat free-param vector x onto params0 pytree.
+
+        Under a trace, every value in the returned pytree is routed
+        through ``lax.optimization_barrier``: without it, the frozen
+        params0 entries become compile-time CONSTANTS inside whatever
+        jit wraps this call, and on the axon TPU backend XLA's
+        simplifier then folds parts of the emulated-float64 phase
+        pipeline at single-f32 precision (measured: 3.6e-3 cycles =
+        f32-eps-level phase error in residual_vector_fn, while the
+        identical math with params as traced INPUTS is accurate to
+        1e-9 cycles). The barrier makes the constants opaque, matching
+        the traced-input graph. It is the identity on values and has a
+        transparent JVP, so jacfwd design matrices are unaffected.
+        """
+        import jax
+
         p = dict(self.params0)
         for i, (_, key, idx) in enumerate(self.free_param_map()):
             if idx is None:
                 p[key] = x[i]
             else:
                 p = {**p, key: p[key].at[idx].set(x[i])}
+        if any(isinstance(v, jax.core.Tracer) for v in jax.tree.leaves(p)):
+            p = jax.lax.optimization_barrier(p)
         return p
 
     def vector_from_params(self, params=None):

@@ -294,6 +294,9 @@ class PTABatch:
         import jax
         import jax.numpy as jnp
 
+        from ..fitter import _warn_degraded_once
+
+        _warn_degraded_once()
         resid_fn = self._resid_fn()
 
         def one_step(x, params, batch, prep):
@@ -408,8 +411,10 @@ class PTABatch:
         import jax
         import jax.numpy as jnp
 
-        from ..fitter import (gls_eigh_solve, gls_normal, gls_whiten,
-                              stack_noise_bases)
+        from ..fitter import (_warn_degraded_once, gls_eigh_solve, gls_normal,
+                              gls_whiten, stack_noise_bases)
+
+        _warn_degraded_once()
 
         if ecorr_mode not in ("auto", "dense"):
             raise ValueError(

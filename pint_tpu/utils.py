@@ -184,3 +184,194 @@ def dmxparse(fitter):
         "bins": bins,
         "mean_dmx": float(np.mean(dmxs)) if dmxs else np.nan,
     }
+
+def p_to_f(p, pd=None, pdd=None):
+    """Period (derivatives) -> frequency (derivatives)
+    (reference: utils.py::p_to_f; also the inverse, since the transform
+    is an involution): f = 1/p, fd = -pd/p^2,
+    fdd = 2 pd^2/p^3 - pdd/p^2."""
+    p = np.asarray(p, dtype=np.float64) if not np.isscalar(p) else float(p)
+    f = 1.0 / p
+    if pd is None:
+        return (f,)
+    fd = -pd / p**2
+    if pdd is None:
+        return f, fd
+    fdd = 2.0 * pd**2 / p**3 - pdd / p**2
+    return f, fd, fdd
+
+
+def pferrs(porf, porferr, pdorfd=None, pdorfderr=None):
+    """Propagate uncertainties through the period<->frequency transform
+    (reference: utils.py::pferrs): returns (forp, forperr[, fdorpd,
+    fdorpderr])."""
+    forp = 1.0 / porf
+    forperr = porferr / porf**2
+    if pdorfd is None:
+        return forp, forperr
+    fdorpd = -pdorfd / porf**2
+    fdorpderr = np.sqrt((4.0 * pdorfd**2 * porferr**2) / porf**6
+                        + pdorfderr**2 / porf**4)
+    return forp, forperr, fdorpd, fdorpderr
+
+
+def ELL1_check(A1, ECC, TRES_us, NTOA, outstring=True):
+    """Is the ELL1 low-eccentricity approximation adequate?
+    (reference: utils.py::ELL1_check). The neglected O(e^2) Roemer term
+    has amplitude ~ (A1/c) * e^2; ELL1 is fine when that is well below
+    the weighted timing precision TRES/sqrt(NTOA). A1 in light-seconds,
+    TRES in us."""
+    lhs_us = A1 * ECC**2 * 1e6
+    rhs_us = TRES_us / np.sqrt(max(NTOA, 1))
+    ok = lhs_us <= rhs_us
+    if not outstring:
+        return ok
+    rel = "<=" if ok else ">"
+    return (f"ELL1 is {'ok' if ok else 'NOT ok'}: asini/c * ecc^2 = "
+            f"{lhs_us:.3g} us {rel} TRES/sqrt(NTOA) = {rhs_us:.3g} us")
+
+
+def _wavex_like_setup(model, comp_name, add_method, freq_prefix, T_span_days,
+                      n_freqs=None, freqs=None):
+    if (n_freqs is None) == (freqs is None):
+        raise ValueError("give exactly one of n_freqs or freqs")
+    if freqs is None:
+        freqs = [(k + 1) / float(T_span_days) for k in range(n_freqs)]
+    comp = model.components[comp_name]
+    # continue after the HIGHEST existing index: par files may define a
+    # non-contiguous family (e.g. ids [2, 3]), and add_param silently
+    # overwrites on collision
+    start = max(getattr(comp, "wx_ids"), default=0)
+    for j, f in enumerate(freqs, start=start + 1):
+        getattr(comp, add_method)(j, freq_per_day=float(f))
+    model.setup()
+    return [getattr(model, f"{freq_prefix}_{i:04d}").value
+            for i in comp.wx_ids]
+
+
+def wavex_setup(model, T_span_days, n_freqs=None, freqs=None):
+    """Attach/extend a WaveX component with harmonics of 1/T_span (or
+    explicit frequencies, 1/day) (reference: utils.py::wavex_setup).
+    Returns the component's frequency list."""
+    from .models.wave import WaveX
+
+    if "WaveX" not in model.components:
+        model.add_component(WaveX())
+    return _wavex_like_setup(model, "WaveX", "add_wavex", "WXFREQ",
+                             T_span_days, n_freqs, freqs)
+
+
+def dmwavex_setup(model, T_span_days, n_freqs=None, freqs=None):
+    """DMWaveX analog of wavex_setup (reference: utils.py::dmwavex_setup)."""
+    from .models.wave import DMWaveX
+
+    if "DMWaveX" not in model.components:
+        model.add_component(DMWaveX())
+    return _wavex_like_setup(model, "DMWaveX", "add_dmwavex", "DMWXFREQ",
+                             T_span_days, n_freqs, freqs)
+
+
+def cmwavex_setup(model, T_span_days, n_freqs=None, freqs=None):
+    """CMWaveX analog of wavex_setup (reference: utils.py::cmwavex_setup).
+    Ensures ChromaticCM rides along as the home of TNCHROMIDX."""
+    from .models.chromatic import ChromaticCM, CMWaveX
+
+    if "ChromaticCM" not in model.components:
+        cm = ChromaticCM()
+        cm.CM.value = 0.0
+        model.add_component(cm)
+    if "CMWaveX" not in model.components:
+        model.add_component(CMWaveX())
+    return _wavex_like_setup(model, "CMWaveX", "add_cmwavex", "CMWXFREQ",
+                             T_span_days, n_freqs, freqs)
+
+
+def translate_wave_to_wavex(model):
+    """Convert a Wave component (harmonic pairs of WAVE_OM) into an
+    equivalent WaveX component (reference:
+    utils.py::translate_wave_to_wavex).
+
+    Wave adds PHASE F0*sum[A sin(k w t) + B cos(k w t)] while WaveX adds
+    DELAY sum[WXSIN sin + WXCOS cos] (phase -= F0*delay), so the
+    amplitudes transfer with a sign flip; WXFREQ_k = k*WAVE_OM/(2 pi)
+    per day.
+    """
+    from .models.wave import WaveX
+
+    wave = model.components.get("Wave")
+    if wave is None:
+        raise ValueError("model has no Wave component")
+    om = wave.WAVE_OM.value
+    epoch = wave.WAVEEPOCH.value if wave.WAVEEPOCH.value is not None else None
+    if "WaveX" in model.components:
+        raise ValueError("model already has WaveX")
+    wx = WaveX()
+    model.add_component(wx)
+    if epoch is not None:
+        model.WXEPOCH.set_mjd(int(epoch), (epoch % 1) * 86400.0)
+    for k, i in enumerate(wave.wave_ids, start=1):
+        a, b = getattr(wave, f"WAVE{i}").value
+        j = wx.add_wavex(freq_per_day=k * om / (2.0 * np.pi))
+        getattr(model, f"WXSIN_{j:04d}").value = -a
+        getattr(model, f"WXCOS_{j:04d}").value = -b
+    model.remove_component("Wave")
+    model.setup()
+    return model
+
+
+def translate_wavex_to_wave(model):
+    """Inverse of translate_wave_to_wavex (reference:
+    utils.py::translate_wavex_to_wave). Requires the WaveX frequencies
+    to be consecutive harmonics of the lowest one."""
+    from .models.wave import Wave
+
+    wx = model.components.get("WaveX")
+    if wx is None:
+        raise ValueError("model has no WaveX component")
+    freqs = [getattr(model, f"WXFREQ_{i:04d}").value for i in wx.wx_ids]
+    if not freqs:
+        raise ValueError("WaveX has no terms")
+    base = freqs[0]
+    for k, f in enumerate(freqs, start=1):
+        if abs(f - k * base) > 1e-9 * base:
+            raise ValueError(
+                "WaveX frequencies are not consecutive harmonics; "
+                "cannot express as Wave")
+    epoch = model.WXEPOCH.value if model.WXEPOCH.value is not None else None
+    if "Wave" in model.components:
+        raise ValueError("model already has Wave")
+    amps = [(-getattr(model, f"WXSIN_{i:04d}").value,
+             -getattr(model, f"WXCOS_{i:04d}").value) for i in wx.wx_ids]
+    model.remove_component("WaveX")
+    wave = Wave()
+    model.add_component(wave)
+    model.WAVE_OM.value = 2.0 * np.pi * base
+    if epoch is not None:
+        model.WAVEEPOCH.set_mjd(int(epoch), (epoch % 1) * 86400.0)
+    for a, b in amps:
+        i = wave.add_wave()
+        getattr(model, f"WAVE{i}").value = (a, b)
+    model.setup()
+    return model
+
+
+def get_wavex_freqs(model, prefix="WXFREQ"):
+    """Frequencies (1/day) of a WaveX-family component in index order
+    (reference: utils.py::get_wavex_freqs)."""
+    comp = {"WXFREQ": "WaveX", "DMWXFREQ": "DMWaveX",
+            "CMWXFREQ": "CMWaveX"}[prefix]
+    c = model.components[comp]
+    return [getattr(model, f"{prefix}_{i:04d}").value for i in c.wx_ids]
+
+
+def get_wavex_amps(model, sin_prefix="WXSIN", cos_prefix="WXCOS"):
+    """(sin, cos) amplitude arrays of a WaveX-family component
+    (reference: utils.py::get_wavex_amps)."""
+    comp = {"WXSIN": "WaveX", "DMWXSIN": "DMWaveX",
+            "CMWXSIN": "CMWaveX"}[sin_prefix]
+    c = model.components[comp]
+    s = np.array([getattr(model, f"{sin_prefix}_{i:04d}").value
+                  for i in c.wx_ids])
+    co = np.array([getattr(model, f"{cos_prefix}_{i:04d}").value
+                   for i in c.wx_ids])
+    return s, co

@@ -1,0 +1,186 @@
+"""Chromatic (variable-index) model family: ChromaticCM/CMX, CMWaveX,
+PLChromNoise.
+
+(reference patterns: tests/test_cm.py / tests/test_cmwavex.py upstream —
+the alpha=2 limit must reduce exactly to the DM components, windows must
+be local, and fits must recover injected values.)
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+warnings.simplefilter("ignore")
+
+from pint_tpu.models import get_model
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+BASE = """
+PSR TESTCHROM
+RAJ 16:00:51.9
+DECJ -30:53:49.3
+F0 277.94 1
+F1 -7.3e-16 1
+PEPOCH 55300
+DM 52.33 1
+"""
+
+
+def _toas(m, n=60, span=(55000, 55600), freqs=(800.0, 1400.0), **kw):
+    mjds = np.linspace(*span, n)
+    f = np.where(np.arange(n) % 2, freqs[0], freqs[1])
+    return make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=f,
+                                   obs="gbt", add_noise=False, **kw)
+
+
+def test_cm_alpha2_equals_dm_delay():
+    """At TNCHROMIDX=2, CM is exactly a DM: the chromatic delay of
+    CM=x must equal the dispersion delay of an extra DM=x."""
+    m_cm = get_model(BASE + "CM 0.5\nTNCHROMIDX 2\n")
+    m_dm = get_model(BASE.replace("DM 52.33 1", "DM 52.83 1"))
+    t = _toas(m_cm)
+    r_cm = Residuals(t, m_cm).time_resids
+    r_dm = Residuals(t, m_dm).time_resids
+    np.testing.assert_allclose(np.asarray(r_cm), np.asarray(r_dm),
+                               atol=1e-12)
+
+
+def test_cm_taylor_term():
+    """CM1 advances CM(t) linearly in Julian years from CMEPOCH."""
+    m = get_model(BASE + "CM 0.1\nCM1 0.05\nCMEPOCH 55300\nTNCHROMIDX 4\n")
+    t = _toas(m)
+    prepared = m.prepare(t)
+    cmc = m.components["ChromaticCM"]
+    cm_t = np.asarray(cmc.cm_value(prepared.params0, prepared.prep))
+    yrs = np.asarray(prepared.prep["cmepoch_dt"]) / (365.25 * 86400.0)
+    np.testing.assert_allclose(cm_t, 0.1 + 0.05 * yrs, rtol=1e-12)
+
+
+def test_cmx_window_locality_and_scaling():
+    """CMX perturbs only in-window TOAs, scaled as nu^-alpha."""
+    from pint_tpu.constants import DMconst
+
+    par_on = BASE + ("CM 0.0\nTNCHROMIDX 4\nCMX_0001 0.02 1\n"
+                     "CMXR1_0001 55100\nCMXR2_0001 55200\n")
+    m_on = get_model(par_on)
+    m_off = get_model(BASE)
+    t = _toas(m_off)
+    d_on = np.asarray(Residuals(t, m_on).time_resids)
+    d_off = np.asarray(Residuals(t, m_off).time_resids)
+    mjds = t.get_mjds()
+    inside = (mjds >= 55100) & (mjds <= 55200)
+    nu = np.asarray(t.freq_mhz)
+    expect = DMconst * 0.02 / nu**4
+    # the model's extra delay moves residuals by the per-TOA delay minus
+    # the weighted-mean subtraction (a constant), so compare the
+    # mean-removed in/out splits separately
+    delta = d_off - d_on
+    delta = delta - delta[~inside].mean()
+    np.testing.assert_allclose(delta[~inside], 0.0, atol=1e-12)
+    np.testing.assert_allclose(delta[inside] - delta[inside].mean(),
+                               expect[inside] - expect[inside].mean(),
+                               atol=1e-12)
+
+
+def test_cm_fit_recovery():
+    """A WLS fit with two widely spaced bands recovers an injected CM
+    perturbation (alpha=4 is separable from DM's alpha=2)."""
+    from pint_tpu.fitter import WLSFitter
+
+    true = get_model(BASE + "CM 0.030\nTNCHROMIDX 4\n")
+    t = make_fake_toas_fromMJDs(
+        np.linspace(55000, 55600, 120), true, error_us=0.5,
+        freq_mhz=np.tile([400.0, 800.0, 1400.0, 3000.0], 30),
+        obs="gbt", add_noise=True, seed=7)
+    wrong = get_model(BASE + "CM 0.0 1\nTNCHROMIDX 4\n")
+    f = WLSFitter(t, wrong)
+    f.fit_toas(maxiter=3)
+    assert abs(f.model.CM.value - 0.030) < 5 * f.model.CM.uncertainty
+
+
+def test_cmwavex_alpha2_equals_dmwavex():
+    par_cm = BASE + ("CM 0.0\nTNCHROMIDX 2\nCMWXEPOCH 55300\n"
+                     "CMWXFREQ_0001 0.004\nCMWXSIN_0001 0.01\n"
+                     "CMWXCOS_0001 -0.006\n")
+    par_dm = BASE + ("DMWXEPOCH 55300\nDMWXFREQ_0001 0.004\n"
+                     "DMWXSIN_0001 0.01\nDMWXCOS_0001 -0.006\n")
+    m_cm = get_model(par_cm)
+    m_dm = get_model(par_dm)
+    t = _toas(m_dm)
+    r_cm = np.asarray(Residuals(t, m_cm).time_resids)
+    r_dm = np.asarray(Residuals(t, m_dm).time_resids)
+    np.testing.assert_allclose(r_cm, r_dm, atol=1e-12)
+
+
+def test_cmwavex_fit_recovery():
+    from pint_tpu.fitter import WLSFitter
+
+    true = get_model(BASE + ("CM 0.0\nTNCHROMIDX 4\nCMWXEPOCH 55300\n"
+                             "CMWXFREQ_0001 0.003\nCMWXSIN_0001 0.012\n"
+                             "CMWXCOS_0001 -0.004\n"))
+    t = make_fake_toas_fromMJDs(
+        np.linspace(55000, 55600, 160), true, error_us=0.5,
+        freq_mhz=np.tile([400.0, 800.0, 1400.0, 3000.0], 40),
+        obs="gbt", add_noise=True, seed=11)
+    guess = get_model(BASE + ("CM 0.0\nTNCHROMIDX 4\nCMWXEPOCH 55300\n"
+                              "CMWXFREQ_0001 0.003\nCMWXSIN_0001 0.0 1\n"
+                              "CMWXCOS_0001 0.0 1\n"))
+    f = WLSFitter(t, guess)
+    f.fit_toas(maxiter=3)
+    assert abs(f.model.CMWXSIN_0001.value - 0.012) \
+        < 5 * f.model.CMWXSIN_0001.uncertainty
+    assert abs(f.model.CMWXCOS_0001.value - (-0.004)) \
+        < 5 * f.model.CMWXCOS_0001.uncertainty
+
+
+def test_plchrom_basis_and_gls():
+    """PLChromNoise basis = Fourier basis row-scaled by (1400/nu)^alpha;
+    GLS runs; at alpha=2 the basis equals PLDMNoise's."""
+    from pint_tpu.fitter import GLSFitter
+
+    par = BASE + ("CM 0.0\nTNCHROMIDX 4\n"
+                  "TNCHROMAMP -13.2\nTNCHROMGAM 3.0\nTNCHROMC 8\n")
+    m = get_model(par)
+    assert "PLChromNoise" in m.components
+    t = _toas(m)
+    prepared = m.prepare(t)
+    F = np.asarray(prepared.prep["chromrn_F"])
+    assert F.shape == (60, 16)
+    chrom = (1400.0 / np.asarray(t.freq_mhz)) ** 4
+    # column-0 sin basis over the span, rescaled per TOA
+    mjds = t.get_mjds()
+    tspan_s = (mjds.max() - mjds.min() + 1.0) * 86400.0
+    t_s = (mjds - mjds.min()) * 86400.0
+    np.testing.assert_allclose(
+        F[:, 0], np.sin(2 * np.pi * t_s / tspan_s) * chrom, atol=1e-10)
+    f = GLSFitter(t, m)
+    chi2 = f.fit_toas()
+    assert np.isfinite(chi2)
+
+    # alpha=2 degeneracy with PLDMNoise
+    par2 = BASE + ("CM 0.0\nTNCHROMIDX 2\n"
+                   "TNCHROMAMP -13.2\nTNCHROMGAM 3.0\nTNCHROMC 8\n")
+    pardm = BASE + "TNDMAMP -13.2\nTNDMGAM 3.0\nTNDMC 8\n"
+    p2 = get_model(par2).prepare(t)
+    pdm = get_model(pardm).prepare(t)
+    np.testing.assert_allclose(np.asarray(p2.prep["chromrn_F"]),
+                               np.asarray(pdm.prep["dmrn_F"]), atol=1e-12)
+
+
+def test_chromatic_parfile_round_trip():
+    par = BASE + ("CM 0.02 1\nCM1 0.001\nCMEPOCH 55300\nTNCHROMIDX 4\n"
+                  "CMX_0001 0.01 1\nCMXR1_0001 54900\nCMXR2_0001 55100\n"
+                  "CMWXFREQ_0001 0.003\nCMWXSIN_0001 0.005 1\n"
+                  "CMWXCOS_0001 -0.002 1\n"
+                  "TNCHROMAMP -13.5\nTNCHROMGAM 3.0\nTNCHROMC 8\n")
+    m = get_model(par)
+    m2 = get_model(m.as_parfile())
+    for pname in ("CM", "CM1", "TNCHROMIDX", "CMX_0001", "CMWXFREQ_0001",
+                  "CMWXSIN_0001", "CMWXCOS_0001", "TNCHROMAMP",
+                  "TNCHROMGAM", "TNCHROMC"):
+        assert getattr(m2, pname).value == getattr(m, pname).value, pname
+    assert not m2.unrecognized
+    # free flags survive
+    assert set(m2.free_params) == set(m.free_params)

@@ -1,0 +1,145 @@
+"""Best-iterate safeguard in the plain fitters.
+
+A plain Gauss-Newton step can increase chi2 — through strong
+nonlinearity or (observed on the axon TPU backend, whose emulated f64
+carries a ~47-bit significand) a corrupted normal-equation projection
+along a near-degenerate direction. The plain WLS/GLS/wideband fitters
+must never hand back an iterate worse than one they already evaluated.
+The poisoned-step tests simulate the corruption deterministically by
+monkeypatching the solver to return a huge bogus step.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+warnings.simplefilter("ignore")
+
+from pint_tpu import fitter as F
+from pint_tpu.models import get_model
+from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+PAR = """
+PSR TESTSAFE
+RAJ 12:10:00.0
+DECJ 09:00:00.0
+F0 218.8 1
+F1 -4e-16 1
+PEPOCH 55300
+DM 15.0 1
+"""
+
+
+def _toas(m, n=80, **kw):
+    mjds = np.linspace(55000, 55600, n)
+    f = np.where(np.arange(n) % 2, 800.0, 1400.0)
+    return make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=f,
+                                   obs="gbt", add_noise=True, seed=5, **kw)
+
+
+def test_wls_poisoned_step_reverts(monkeypatch):
+    m = get_model(PAR)
+    t = _toas(m)
+    # clean fit for the expected answer
+    clean = F.WLSFitter(t, get_model(PAR))
+    clean_chi2 = clean.fit_toas(maxiter=2)
+
+    real_step = F.wls_step
+    calls = {"n": 0}
+
+    def poisoned(Mw, rw, threshold=1e-12):
+        dx, covn, norm = real_step(Mw, rw, threshold)
+        calls["n"] += 1
+        if calls["n"] == 2:  # second iteration steps off a cliff
+            dx = dx + 1e-6
+        return dx, covn, norm
+
+    monkeypatch.setattr(F, "wls_step", poisoned)
+    f = F.WLSFitter(t, get_model(PAR))
+    with pytest.warns(UserWarning, match="increased chi2"):
+        chi2 = f.fit_toas(maxiter=2)
+    # the good first step was kept, the poisoned second discarded
+    assert chi2 < clean_chi2 * 1.01
+    assert abs(f.model.F0.value - clean.model.F0.value) < 1e-9
+
+
+def test_gls_poisoned_step_reverts(monkeypatch):
+    par = PAR + "EFAC -f L-wide 1.1\nRNAMP 1e-14\nRNIDX -3\nTNREDC 5\n"
+    m = get_model(par)
+    t = _toas(m)
+    for fl in t.flags:
+        fl["f"] = "L-wide"
+    clean = F.GLSFitter(t, get_model(par))
+    clean_chi2 = clean.fit_toas(maxiter=2)
+
+    real_solve = F.gls_solve
+    calls = {"n": 0}
+
+    def poisoned(Mfull, r, sigma, sqrt_phi_inv, threshold=1e-12):
+        dx, cov, chi2 = real_solve(Mfull, r, sigma, sqrt_phi_inv, threshold)
+        calls["n"] += 1
+        if calls["n"] == 2:
+            dx = dx + 1e-5
+        return dx, cov, chi2
+
+    monkeypatch.setattr(F, "gls_solve", poisoned)
+    f = F.GLSFitter(t, get_model(par))
+    with pytest.warns(UserWarning, match="increased chi2"):
+        chi2 = f.fit_toas(maxiter=2)
+    assert chi2 < clean_chi2 * 1.01
+
+
+def test_gls_clean_fit_unchanged():
+    """The safeguard must not disturb a well-behaved fit: same fitted
+    values as before, chi2 monotone path accepted."""
+    par = PAR + "EFAC -f L-wide 1.1\nRNAMP 1e-14\nRNIDX -3\nTNREDC 5\n"
+    m = get_model(par)
+    t = _toas(m)
+    for fl in t.flags:
+        fl["f"] = "L-wide"
+    start = get_model(par)
+    start.F0.value += 1e-9
+    f = F.GLSFitter(t, start)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)
+        # no "increased chi2" warning on a clean fit (clock warnings
+        # are already emitted once by earlier tests in this process)
+        chi2 = f.fit_toas(maxiter=3)
+    assert np.isfinite(chi2)
+    assert abs(f.model.F0.value - 218.8) < 5e-11
+
+
+def test_marginalized_chi2_matches_plain_when_no_bases():
+    import jax.numpy as jnp
+
+    r = jnp.asarray(np.linspace(-1e-6, 1e-6, 10))
+    sig = jnp.full(10, 1e-6)
+    assert F.marginalized_chi2(r, sig, (None, None)) == pytest.approx(
+        float(jnp.sum(jnp.square(r / sig))))
+
+
+def test_marginalized_chi2_reduces_with_basis():
+    """Marginalizing a basis that spans the residual lowers chi2."""
+    import jax.numpy as jnp
+
+    n = 40
+    t = np.linspace(0, 1, n)
+    sig = jnp.full(n, 1.0)
+    shape = np.sin(2 * np.pi * t)
+    r = jnp.asarray(3.0 * shape)
+    B = jnp.asarray(shape[:, None])
+    w = jnp.asarray([1e16])  # loose prior (10^16 us^2 = 10^4 s^2)
+    chi2_plain = float(jnp.sum(jnp.square(r)))
+    chi2_marg = F.marginalized_chi2(r, sig, (B, w))
+    assert chi2_marg < 0.01 * chi2_plain
+
+
+def test_degraded_probe_runs():
+    """The probe returns a bool and is cached; on the CPU test backend
+    f64 is IEEE so it must be False."""
+    assert F.degraded_f64() in (True, False)
+    import jax
+
+    if jax.default_backend() == "cpu":
+        assert F.degraded_f64() is False

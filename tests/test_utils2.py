@@ -1,0 +1,144 @@
+"""Utility-surface parity: p_to_f/pferrs, ELL1_check, wavex_setup
+family, Wave<->WaveX translation, P0/P1 par conversion.
+
+(reference patterns: tests/test_utils.py, tests/test_wavex.py
+upstream.)
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+warnings.simplefilter("ignore")
+
+from pint_tpu import utils as U
+from pint_tpu.models import get_model
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+BASE = """
+PSR TESTU2
+RAJ 12:10:00.0
+DECJ 09:00:00.0
+F0 218.8 1
+F1 -4e-16 1
+PEPOCH 55300
+DM 15.0 1
+"""
+
+
+def test_p_to_f_roundtrip():
+    p0, p1 = 0.005, 1e-20
+    f0, f1 = U.p_to_f(p0, p1)
+    assert f0 == pytest.approx(200.0)
+    p0b, p1b = U.p_to_f(f0, f1)
+    assert p0b == pytest.approx(p0)
+    assert p1b == pytest.approx(p1)
+    f0c, f1c, f2c = U.p_to_f(p0, p1, 0.0)
+    assert f2c == pytest.approx(2 * p1**2 / p0**3)
+
+
+def test_pferrs():
+    f, ferr = U.pferrs(0.005, 1e-12)
+    assert f == pytest.approx(200.0)
+    assert ferr == pytest.approx(1e-12 / 0.005**2)
+    f, ferr, fd, fderr = U.pferrs(0.005, 1e-12, 1e-20, 1e-22)
+    assert fd == pytest.approx(-1e-20 / 0.005**2)
+    assert fderr > 0
+
+
+def test_p0_parfile_conversion():
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        m = get_model("PSR T\nP0 0.005 1 1e-12\nP1 1e-20 1\nPEPOCH 55000\nDM 10\n")
+    assert any("P0/P1" in str(x.message) for x in w)
+    assert m.F0.value == pytest.approx(200.0)
+    assert m.F1.value == pytest.approx(-1e-20 / 0.005**2)
+    assert not m.F0.frozen and not m.F1.frozen
+    assert m.F0.uncertainty == pytest.approx(1e-12 / 0.005**2)
+
+
+def test_ell1_check():
+    assert U.ELL1_check(1.9, 1e-7, 0.5, 1000, outstring=False)
+    assert not U.ELL1_check(10.0, 0.01, 0.1, 100, outstring=False)
+    s = U.ELL1_check(1.9, 1e-7, 0.5, 1000)
+    assert "ok" in s
+
+
+def test_wavex_setup_and_translation_equivalence():
+    """A Wave model and its WaveX translation produce identical
+    residuals."""
+    par = BASE + ("WAVEEPOCH 55300\nWAVE_OM 0.02\n"
+                  "WAVE1 0.0002 -0.0001\nWAVE2 -5e-5 8e-5\n")
+    m_wave = get_model(par)
+    t = make_fake_toas_fromMJDs(np.linspace(55000, 55600, 80),
+                                get_model(BASE), error_us=1.0,
+                                freq_mhz=1400.0, obs="gbt", add_noise=False)
+    r_wave = np.asarray(Residuals(t, m_wave).time_resids)
+    m_wx = U.translate_wave_to_wavex(get_model(par))
+    assert "Wave" not in m_wx.components and "WaveX" in m_wx.components
+    r_wx = np.asarray(Residuals(t, m_wx).time_resids)
+    # not exact: Wave evaluates its harmonics at the delay-corrected
+    # time, WaveX at barycentric dt — difference O(amp * om * delay)
+    # ~ 2e-4 s * 0.02/day * 500 s ~ 3e-8 s (same approximation as the
+    # reference's translate_wave_to_wavex)
+    np.testing.assert_allclose(r_wave, r_wx, atol=1e-7)
+    # round-trip back is EXACT in parameters
+    m_back = U.translate_wavex_to_wave(m_wx)
+    assert "Wave" in m_back.components
+    assert m_back.WAVE_OM.value == pytest.approx(0.02)
+    assert getattr(m_back, "WAVE1").value[0] == pytest.approx(0.0002)
+    assert getattr(m_back, "WAVE2").value[1] == pytest.approx(8e-5)
+    np.testing.assert_allclose(
+        np.asarray(Residuals(t, m_back).time_resids), r_wave, atol=1e-12)
+
+
+def test_wavex_setup_creates_harmonics():
+    m = get_model(BASE)
+    freqs = U.wavex_setup(m, T_span_days=500.0, n_freqs=4)
+    np.testing.assert_allclose(freqs, np.arange(1, 5) / 500.0)
+    assert "WXSIN_0003" in m.params
+    # explicit frequencies extend the family
+    more = U.wavex_setup(m, T_span_days=500.0, freqs=[0.05])
+    assert more[-1] == pytest.approx(0.05)
+    with pytest.raises(ValueError):
+        U.wavex_setup(m, 500.0)
+
+
+def test_dmwavex_cmwavex_setup():
+    m = get_model(BASE)
+    U.dmwavex_setup(m, 400.0, n_freqs=2)
+    assert "DMWXSIN_0002" in m.params
+    m2 = get_model(BASE)
+    U.cmwavex_setup(m2, 400.0, n_freqs=2)
+    assert "CMWXSIN_0002" in m2.params
+    assert "ChromaticCM" in m2.components  # TNCHROMIDX home rides along
+    s, c = U.get_wavex_amps(m2, "CMWXSIN", "CMWXCOS")
+    assert len(s) == 2 and np.all(s == 0)
+    assert U.get_wavex_freqs(m2, "CMWXFREQ") == pytest.approx(
+        [1 / 400.0, 2 / 400.0])
+
+
+def test_p2_parfile_conversion():
+    m = get_model("PSR T\nP0 0.005\nP1 1e-20\nP2 1e-30 1\nPEPOCH 55000\nDM 10\n")
+    assert m.F2.value == pytest.approx(
+        2 * 1e-20**2 / 0.005**3 - 1e-30 / 0.005**2)
+    assert not m.F2.frozen
+    # P2 without P1 still produces F1=0 so the F-family is contiguous
+    m2 = get_model("PSR T\nP0 0.005\nP2 1e-30\nPEPOCH 55000\nDM 10\n")
+    assert m2.F1.value == 0.0 and m2.F2.value is not None
+
+
+def test_wavex_setup_noncontiguous_ids():
+    """Extending a WaveX family whose par ids don't start at 1 must not
+    collide with existing parameters."""
+    par = BASE + ("WXFREQ_0002 0.004\nWXSIN_0002 1e-5\nWXCOS_0002 0.0\n"
+                  "WXFREQ_0003 0.008\nWXSIN_0003 0.0\nWXCOS_0003 0.0\n")
+    m = get_model(par)
+    wx = m.components["WaveX"]
+    assert wx.wx_ids == [2, 3]
+    U.wavex_setup(m, 500.0, n_freqs=1)
+    assert wx.wx_ids == [2, 3, 4]
+    assert m.WXSIN_0002.value == pytest.approx(1e-5)  # untouched
+    assert m.WXFREQ_0004.value == pytest.approx(1 / 500.0)
