@@ -93,9 +93,10 @@ def get_model(parfile, allow_name_mixing=False) -> TimingModel:
                 _, _, _, f1err = pferrs(p0, u0 or 0.0, p1, u1 or 0.0)
                 keys["F1"].append(repr(f1err))
         if "P2" in keys:
-            p2, fit2, _ = _vfu(keys.pop("P2"))
+            p2, fit2, u2 = _vfu(keys.pop("P2"))
             f2 = p_to_f(p0, p1, p2)[2]
-            keys["F2"] = [repr(f2), fit2]
+            keys["F2"] = [repr(f2), fit2] + (
+                [repr(u2 / p0**2)] if u2 is not None else [])
         warnings.warn("converted P0/P1/P2 spin parameters to F0/F1/F2")
 
     model = TimingModel(name=str(parfile) if isinstance(parfile, (str, os.PathLike)) else "")

@@ -143,6 +143,17 @@ class ChromaticCMX(DelayComponent):
 
     def validate(self):
         super().validate()
+        # a missing CMXR1/CMXR2 pair parses as the empty window [0, 0],
+        # whose design column is identically zero — a silently
+        # degenerate fit (reference behavior: MissingParameter)
+        for i in self.cmx_ids:
+            r1 = getattr(self, f"CMXR1_{i:04d}").value
+            r2 = getattr(self, f"CMXR2_{i:04d}").value
+            if r1 is None or r2 is None or not r1 < r2:
+                raise MissingParameter(
+                    "ChromaticCMX", f"CMXR1_{i:04d}/CMXR2_{i:04d}",
+                    f"CMX_{i:04d} needs a non-empty MJD window "
+                    f"(got [{r1}, {r2}])")
 
     def pack(self, model, toas, prep, params0):
         import jax.numpy as jnp

@@ -120,6 +120,17 @@ class DispersionDMX(DelayComponent):
             warnings.warn("bare DMX is a legacy bin-width marker, not a "
                           "fittable parameter; freezing it")
             self.DMX.frozen = True
+        # a missing DMXR1/DMXR2 pair parses as the empty window [0, 0]
+        # -> identically-zero design column, silently degenerate fit
+        # (reference behavior: MissingParameter)
+        for i in self.dmx_ids:
+            r1 = getattr(self, f"DMXR1_{i:04d}").value
+            r2 = getattr(self, f"DMXR2_{i:04d}").value
+            if r1 is None or r2 is None or not r1 < r2:
+                raise MissingParameter(
+                    "DispersionDMX", f"DMXR1_{i:04d}/DMXR2_{i:04d}",
+                    f"DMX_{i:04d} needs a non-empty MJD window "
+                    f"(got [{r1}, {r2}])")
 
     def add_dmx_range(self, index, mjd_start, mjd_end, value=0.0, frozen=True):
         from .parameter import floatParameter

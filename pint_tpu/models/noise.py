@@ -250,7 +250,59 @@ class PLRedNoise(NoiseComponent):
         return prep["rn_F"], powerlaw_phi(A, gamma, f, tspan)
 
 
-class PLDMNoise(NoiseComponent):
+class _PLScaledNoise(NoiseComponent):
+    """Shared machinery for power-law noise whose Fourier basis is
+    row-scaled per TOA by (f_ref/nu)^alpha: PLDMNoise (alpha = 2) and
+    PLChromNoise (alpha = the model's TNCHROMIDX). Subclasses set the
+    parameter names and the prep-key prefix; the basis/weight math has
+    exactly one home so the two cannot diverge."""
+
+    F_REF_MHZ = 1400.0
+    AMP = GAM = NHARM = PREP = None  # subclass config
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter(self.AMP, units="log10",
+                                      description="log10 noise amplitude"))
+        self.add_param(floatParameter(self.GAM, units="",
+                                      description="Noise spectral index"))
+        p = floatParameter(self.NHARM, units="",
+                           description="Number of harmonics")
+        p.value = 30
+        self.add_param(p)
+
+    def device_slot(self, pname):
+        return pname, None
+
+    def _alpha(self, model):
+        raise NotImplementedError
+
+    def pack(self, model, toas, prep, params0):
+        import jax.numpy as jnp
+
+        F, freqs, tspan_s = fourier_basis(
+            toas, int(getattr(self, self.NHARM).value or 30))
+        alpha = self._alpha(model)
+        # chromatic scaling; infinite-frequency TOAs see none of this
+        # noise
+        with np.errstate(divide="ignore"):
+            chrom = np.where(np.isfinite(toas.freq_mhz),
+                             (self.F_REF_MHZ / toas.freq_mhz) ** alpha, 0.0)
+        prep[f"{self.PREP}_F"] = jnp.asarray(F * chrom[:, None])
+        prep[f"{self.PREP}_freqs"] = jnp.asarray(freqs)
+        prep[f"{self.PREP}_tspan_s"] = tspan_s
+        for pname in (self.AMP, self.GAM):
+            params0[pname] = getattr(self, pname).value or 0.0
+
+    def basis_weight(self, params, prep):
+        A = 10.0 ** params[self.AMP]
+        gamma = params[self.GAM]
+        return prep[f"{self.PREP}_F"], powerlaw_phi(
+            A, gamma, prep[f"{self.PREP}_freqs"],
+            prep[f"{self.PREP}_tspan_s"])
+
+
+class PLDMNoise(_PLScaledNoise):
     """Power-law DM (chromatic) noise (reference: noise_model.py::
     PLDMNoise): same Fourier machinery as PLRedNoise, but the basis is
     scaled per TOA by (f_ref/nu)^2, f_ref = 1400 MHz — achromatic in
@@ -260,43 +312,13 @@ class PLDMNoise(NoiseComponent):
 
     category = "pl_dm_noise"
     order = 93
-    F_REF_MHZ = 1400.0
+    AMP, GAM, NHARM, PREP = "TNDMAMP", "TNDMGAM", "TNDMC", "dmrn"
 
-    def __init__(self):
-        super().__init__()
-        self.add_param(floatParameter("TNDMAMP", units="log10",
-                                      description="log10 DM-noise amplitude"))
-        self.add_param(floatParameter("TNDMGAM", units="",
-                                      description="DM-noise spectral index"))
-        p = floatParameter("TNDMC", units="", description="Number of harmonics")
-        p.value = 30
-        self.add_param(p)
-
-    def device_slot(self, pname):
-        return pname, None
-
-    def pack(self, model, toas, prep, params0):
-        import jax.numpy as jnp
-
-        F, freqs, tspan_s = fourier_basis(toas, int(self.TNDMC.value or 30))
-        # chromatic scaling; infinite-frequency TOAs see no DM noise
-        with np.errstate(divide="ignore"):
-            chrom = np.where(np.isfinite(toas.freq_mhz),
-                             (self.F_REF_MHZ / toas.freq_mhz) ** 2, 0.0)
-        prep["dmrn_F"] = jnp.asarray(F * chrom[:, None])
-        prep["dmrn_freqs"] = jnp.asarray(freqs)
-        prep["dmrn_tspan_s"] = tspan_s
-        for pname in ("TNDMAMP", "TNDMGAM"):
-            params0[pname] = getattr(self, pname).value or 0.0
-
-    def basis_weight(self, params, prep):
-        A = 10.0 ** params["TNDMAMP"]
-        gamma = params["TNDMGAM"]
-        return prep["dmrn_F"], powerlaw_phi(
-            A, gamma, prep["dmrn_freqs"], prep["dmrn_tspan_s"])
+    def _alpha(self, model):
+        return 2.0
 
 
-class PLChromNoise(NoiseComponent):
+class PLChromNoise(_PLScaledNoise):
     """Power-law chromatic noise with a variable spectral index in
     frequency (reference: noise_model.py::PLChromNoise): the PLDMNoise
     machinery with the per-TOA basis scaling (f_ref/nu)^alpha, where
@@ -307,43 +329,12 @@ class PLChromNoise(NoiseComponent):
 
     category = "pl_chrom_noise"
     order = 94
-    F_REF_MHZ = 1400.0
+    AMP, GAM, NHARM, PREP = "TNCHROMAMP", "TNCHROMGAM", "TNCHROMC", "chromrn"
 
-    def __init__(self):
-        super().__init__()
-        self.add_param(floatParameter("TNCHROMAMP", units="log10",
-                                      description="log10 chromatic-noise amplitude"))
-        self.add_param(floatParameter("TNCHROMGAM", units="",
-                                      description="Chromatic-noise spectral index"))
-        p = floatParameter("TNCHROMC", units="",
-                           description="Number of harmonics")
-        p.value = 30
-        self.add_param(p)
-
-    def device_slot(self, pname):
-        return pname, None
-
-    def pack(self, model, toas, prep, params0):
-        import jax.numpy as jnp
-
-        F, freqs, tspan_s = fourier_basis(toas, int(self.TNCHROMC.value or 30))
-        # chromatic index is static at pack time (like the basis span);
-        # default matches ChromaticCM.DEFAULT_CHROM_IDX
-        alpha = 4.0
+    def _alpha(self, model):
+        # static at pack time (like the basis span); default matches
+        # ChromaticCM.DEFAULT_CHROM_IDX
         cm = model.components.get("ChromaticCM")
         if cm is not None and cm.TNCHROMIDX.value is not None:
-            alpha = float(cm.TNCHROMIDX.value)
-        with np.errstate(divide="ignore"):
-            chrom = np.where(np.isfinite(toas.freq_mhz),
-                             (self.F_REF_MHZ / toas.freq_mhz) ** alpha, 0.0)
-        prep["chromrn_F"] = jnp.asarray(F * chrom[:, None])
-        prep["chromrn_freqs"] = jnp.asarray(freqs)
-        prep["chromrn_tspan_s"] = tspan_s
-        for pname in ("TNCHROMAMP", "TNCHROMGAM"):
-            params0[pname] = getattr(self, pname).value or 0.0
-
-    def basis_weight(self, params, prep):
-        A = 10.0 ** params["TNCHROMAMP"]
-        gamma = params["TNCHROMGAM"]
-        return prep["chromrn_F"], powerlaw_phi(
-            A, gamma, prep["chromrn_freqs"], prep["chromrn_tspan_s"])
+            return float(cm.TNCHROMIDX.value)
+        return 4.0

@@ -185,34 +185,22 @@ def dmxparse(fitter):
         "mean_dmx": float(np.mean(dmxs)) if dmxs else np.nan,
     }
 
-def p_to_f(p, pd=None, pdd=None):
-    """Period (derivatives) -> frequency (derivatives)
-    (reference: utils.py::p_to_f; also the inverse, since the transform
-    is an involution): f = 1/p, fd = -pd/p^2,
-    fdd = 2 pd^2/p^3 - pdd/p^2."""
-    p = np.asarray(p, dtype=np.float64) if not np.isscalar(p) else float(p)
-    f = 1.0 / p
-    if pd is None:
-        return (f,)
-    fd = -pd / p**2
-    if pdd is None:
-        return f, fd
-    fdd = 2.0 * pd**2 / p**3 - pdd / p**2
-    return f, fd, fdd
+def p_to_f(p, pd=0.0, pdd=None):
+    """Period (derivatives) -> frequency (derivatives); an involution
+    (reference: utils.py::p_to_f). One implementation shared with
+    derived_quantities.p_to_f."""
+    from .derived_quantities import p_to_f as _p2f
+
+    return _p2f(p, pd, pdd)
 
 
 def pferrs(porf, porferr, pdorfd=None, pdorfderr=None):
     """Propagate uncertainties through the period<->frequency transform
-    (reference: utils.py::pferrs): returns (forp, forperr[, fdorpd,
-    fdorpderr])."""
-    forp = 1.0 / porf
-    forperr = porferr / porf**2
-    if pdorfd is None:
-        return forp, forperr
-    fdorpd = -pdorfd / porf**2
-    fdorpderr = np.sqrt((4.0 * pdorfd**2 * porferr**2) / porf**6
-                        + pdorfderr**2 / porf**4)
-    return forp, forperr, fdorpd, fdorpderr
+    (reference: utils.py::pferrs). Shared implementation with
+    derived_quantities.pferrs."""
+    from .derived_quantities import pferrs as _pf
+
+    return _pf(porf, porferr, pdorfd, pdorfderr)
 
 
 def ELL1_check(A1, ECC, TRES_us, NTOA, outstring=True):
@@ -302,7 +290,7 @@ def translate_wave_to_wavex(model):
     if wave is None:
         raise ValueError("model has no Wave component")
     om = wave.WAVE_OM.value
-    epoch = wave.WAVEEPOCH.value if wave.WAVEEPOCH.value is not None else None
+    epoch = wave.WAVEEPOCH.value
     if "WaveX" in model.components:
         raise ValueError("model already has WaveX")
     wx = WaveX()
@@ -337,7 +325,7 @@ def translate_wavex_to_wave(model):
             raise ValueError(
                 "WaveX frequencies are not consecutive harmonics; "
                 "cannot express as Wave")
-    epoch = model.WXEPOCH.value if model.WXEPOCH.value is not None else None
+    epoch = model.WXEPOCH.value
     if "Wave" in model.components:
         raise ValueError("model already has Wave")
     amps = [(-getattr(model, f"WXSIN_{i:04d}").value,

@@ -184,3 +184,19 @@ def test_chromatic_parfile_round_trip():
     assert not m2.unrecognized
     # free flags survive
     assert set(m2.free_params) == set(m.free_params)
+
+
+def test_cmx_missing_window_raises():
+    """CMX_#### without CMXR1/CMXR2 must not silently parse into an
+    empty window (zero design column)."""
+    from pint_tpu.models.timing_model import MissingParameter
+
+    with pytest.raises(MissingParameter):
+        get_model(BASE + "CM 0.0\nCMX_0001 0.02 1\n")
+
+
+def test_dmx_missing_window_raises():
+    from pint_tpu.models.timing_model import MissingParameter
+
+    with pytest.raises(MissingParameter):
+        get_model(BASE + "DMX_0001 0.001 1\n")

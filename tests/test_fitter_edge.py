@@ -1,0 +1,131 @@
+"""Fitter edge cases: frozen params, exact degeneracies, simulation
+noise statistics, random-model spread.
+
+(reference patterns: tests/test_fitter.py degenerate/frozen handling,
+tests/test_fake_toas.py statistics upstream.)
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+warnings.simplefilter("ignore")
+
+from pint_tpu.fitter import WLSFitter, GLSFitter
+from pint_tpu.models import get_model
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+BASE = """
+PSR TESTEDGE
+RAJ 12:10:00.0
+DECJ 09:00:00.0
+F0 218.8 1
+F1 -4e-16 1
+PEPOCH 55300
+DM 15.0 1
+"""
+
+
+def _toas(m, n=100, error_us=1.0, seed=1, **kw):
+    mjds = np.linspace(55000, 55600, n)
+    f = np.where(np.arange(n) % 2, 800.0, 1400.0)
+    return make_fake_toas_fromMJDs(mjds, m, error_us=error_us, freq_mhz=f,
+                                   obs="gbt", add_noise=True, seed=seed, **kw)
+
+
+def test_frozen_param_does_not_move():
+    m = get_model(BASE.replace("F1 -4e-16 1", "F1 -4e-16"))
+    assert "F1" not in m.free_params
+    t = _toas(m)
+    f = WLSFitter(t, m)
+    f.fit_toas(maxiter=2)
+    assert f.model.F1.value == -4e-16
+    assert f.model.F1.uncertainty is None
+    # design matrix carries only the free columns (+offset)
+    dm = f.get_designmatrix()
+    assert "F1" not in dm.param_names
+    assert "F0" in dm.param_names
+
+
+def test_exactly_degenerate_jumps_dropped_not_exploded():
+    """Two JUMPs selecting the SAME TOA subset are exactly degenerate
+    with each other; the threshold cut must zero one combination
+    instead of producing a huge anticorrelated pair."""
+    par = BASE + "JUMP -f L 0.0 1\nJUMP -f L 0.0 1\n"
+    m = get_model(par)
+    t = _toas(m)
+    for i, fl in enumerate(t.flags):
+        fl["f"] = "L" if i < 50 else "R"
+    f = WLSFitter(t, m)
+    chi2 = f.fit_toas(maxiter=2)
+    assert np.isfinite(chi2)
+    j1 = f.model.JUMP1.value
+    j2 = f.model.JUMP2.value
+    # the degenerate difference direction is cut: neither jump runs away
+    assert abs(j1) < 1e-3 and abs(j2) < 1e-3
+
+
+def test_white_noise_statistics():
+    """add_noise=True produces residuals with chi2/dof ~ 1."""
+    m = get_model(BASE)
+    chis = []
+    for seed in range(5):
+        t = _toas(m, n=200, seed=seed)
+        r = Residuals(t, m)
+        chis.append(float(r.chi2) / (len(t) - 1))
+    mean_red = np.mean(chis)
+    # 5x199 dof: expect 1 +/- ~0.045; allow 4 sigma
+    assert 0.8 < mean_red < 1.2, mean_red
+
+
+def test_efac_scales_noise_draw_and_chi2():
+    """EFAC both scales the simulated noise and the sigma used in chi2,
+    so reduced chi2 stays ~1 while raw residual rms doubles."""
+    par = BASE + "EFAC -f L 2.0\n"
+    m = get_model(par)
+    mjds = np.linspace(55000, 55600, 300)
+    t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=1400.0,
+                                obs="gbt", add_noise=True, seed=4)
+    for fl in t.flags:
+        fl["f"] = "L"
+    # re-resolve masks after editing flags by re-preparing via Residuals
+    r = Residuals(t, m)
+    red = float(r.chi2) / (len(t) - 1)
+    rms_us = float(np.std(np.asarray(r.time_resids))) * 1e6
+    # the draw was made with EFAC applied at simulation time IF flags
+    # were set pre-draw; here flags were set after, so the draw is 1 us
+    # and scaled sigma is 2 us -> reduced chi2 ~ 0.25
+    assert red < 0.5
+    assert rms_us < 1.5
+
+
+def test_random_models_spread_tracks_covariance():
+    from pint_tpu.simulation import calculate_random_models
+
+    m = get_model(BASE)
+    t = _toas(m, n=150)
+    f = WLSFitter(t, m)
+    f.fit_toas(maxiter=2)
+    dphase = np.asarray(calculate_random_models(f, t, n_models=40, seed=9))
+    assert dphase.shape[0] == 40
+    # spread grows toward the span edges (F1 uncertainty dominates)
+    spread = dphase.std(axis=0)
+    mid = spread[len(spread) // 2]
+    edge = max(spread[0], spread[-1])
+    assert edge > mid
+    assert np.all(np.isfinite(spread))
+
+
+def test_gls_tol_early_stop_matches_full():
+    par = BASE + "RNAMP 5e-15\nRNIDX -3\nTNREDC 8\n"
+    m1 = get_model(par)
+    m2 = get_model(par)
+    t = _toas(m1, n=120)
+    f1 = GLSFitter(t, m1)
+    c1 = f1.fit_toas(maxiter=10, tol=1e-10)
+    f2 = GLSFitter(t, m2)
+    c2 = f2.fit_toas(maxiter=10)
+    assert c1 == pytest.approx(c2, rel=1e-6)
+    assert f1.model.F0.value == pytest.approx(f2.model.F0.value, abs=1e-12)
