@@ -43,8 +43,12 @@ def _sqrt(x):
 
 
 def p_to_f(p, pd, pdd=None):
-    """(P [s], Pdot) -> (F0 [Hz], F1); inverse of itself.
-    (reference: derived_quantities.py::p_to_f)"""
+    """(P [s], Pdot) -> (F0 [Hz], F1); inverse of itself. Accepts
+    scalars or array-likes (reference: derived_quantities.py::p_to_f)."""
+    import numpy as np
+
+    p = np.asarray(p, dtype=np.float64) if not np.isscalar(p) else p
+    pd = np.asarray(pd, dtype=np.float64) if not np.isscalar(pd) else pd
     f = 1.0 / p
     fd = -pd / p**2
     if pdd is None:

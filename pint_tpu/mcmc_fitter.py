@@ -66,18 +66,41 @@ class MCMCFitter(Fitter):
         return {p: flat[:, i] for i, p in enumerate(self.bt.param_labels)}
 
 
+def _normalized_template(template):
+    t = np.asarray(template, float)
+    return t / t.mean() if abs(t.mean() - 1.0) > 1e-6 else t
+
+
+def _binned_template_lnlike(prepared, template, weights, x):
+    """lnL = sum_i w_i-weighted ln T(phi_i(x)) for one photon dataset —
+    the single home of the binned-template likelihood used by
+    MCMCFitterBinnedTemplate and CompositeMCMCFitter. Traceable in x
+    (callers decide whether/where to jit)."""
+    import jax.numpy as jnp
+
+    from .templates import photon_loglike
+
+    p = prepared.params_with_vector(x)
+    frac = prepared._phase_continuous(p)
+    phase = frac - jnp.floor(frac)  # [0, 1)
+    nb = template.shape[0]
+    idx = jnp.clip((phase * nb).astype(jnp.int32), 0, nb - 1)
+    rate = jnp.asarray(template)[idx]
+    w = None if weights is None else jnp.asarray(weights)
+    return photon_loglike(rate, w)
+
+
 class MCMCFitterBinnedTemplate(MCMCFitter):
     """Photon-event likelihood: lnL = sum_i ln T(phi_i) with a binned
     pulse template T (reference: mcmc_fitter.py::MCMCFitterBinnedTemplate).
 
     The timing model maps photon TOAs to phases on device; the template
-    lookup is a gather — the whole likelihood stays jitted.
+    lookup is a gather — the whole likelihood stays jitted (bayesian.py
+    jits _lnlike_raw).
     """
 
     def __init__(self, toas, model, template, weights=None, **kw):
-        self.template = np.asarray(template, float)
-        if abs(self.template.mean() - 1.0) > 1e-6:
-            self.template = self.template / self.template.mean()
+        self.template = _normalized_template(template)
         self.weights = None if weights is None else np.asarray(weights, float)
         super().__init__(toas, model, **kw)
         # replace the Gaussian TOA likelihood with the template one
@@ -85,16 +108,44 @@ class MCMCFitterBinnedTemplate(MCMCFitter):
         self.bt._lnlike_jit = None
 
     def _lnlike_template(self, x):
-        import jax.numpy as jnp
+        return _binned_template_lnlike(self.bt.prepared, self.template,
+                                       self.weights, x)
 
-        prepared = self.bt.prepared
-        p = prepared.params_with_vector(x)
-        frac = prepared._jit("phasec", prepared._phase_continuous)(p)
-        phase = frac - jnp.floor(frac)  # [0, 1)
-        nb = self.template.shape[0]
-        idx = jnp.clip((phase * nb).astype(jnp.int32), 0, nb - 1)
-        rate = jnp.asarray(self.template)[idx]
-        from .templates import photon_loglike
 
-        w = None if self.weights is None else jnp.asarray(self.weights)
-        return photon_loglike(rate, w)
+class CompositeMCMCFitter(MCMCFitter):
+    """Joint sampling over several photon datasets sharing one timing
+    model (reference: mcmc_fitter.py::CompositeMCMCFitter — e.g. Fermi
+    + NICER event lists, each with its own pulse template and weights).
+
+    lnL(x) = sum_k lnL_template_k(phases of toas_k under params x).
+    Each dataset gets its own PreparedTiming (its own packed arrays);
+    the shared free-parameter vector is defined by the model, so all
+    datasets see identical parameter ordering.
+    """
+
+    def __init__(self, toas_list, model, templates, weights_list=None,
+                 **kw):
+        if len(toas_list) != len(templates):
+            raise ValueError("need one template per TOA set")
+        if weights_list is not None and len(weights_list) != len(toas_list):
+            raise ValueError(
+                f"weights_list has {len(weights_list)} entries for "
+                f"{len(toas_list)} TOA sets; pass None for unweighted sets")
+        self.templates = [_normalized_template(t) for t in templates]
+        self.weights_list = (list(weights_list) if weights_list is not None
+                             else [None] * len(toas_list))
+        self.toas_list = list(toas_list)
+        # base class prepares dataset 0 (drives param ordering/scales)
+        super().__init__(toas_list[0], model, **kw)
+        self.prepareds = [self.bt.prepared] + [
+            self.model.prepare(t) for t in toas_list[1:]]
+        self.bt._lnlike_raw = self._lnlike_composite
+        self.bt._lnlike_jit = None
+
+    def _lnlike_composite(self, x):
+        total = 0.0
+        for prepared, template, weights in zip(
+                self.prepareds, self.templates, self.weights_list):
+            total = total + _binned_template_lnlike(prepared, template,
+                                                    weights, x)
+        return total

@@ -185,12 +185,15 @@ def dmxparse(fitter):
         "mean_dmx": float(np.mean(dmxs)) if dmxs else np.nan,
     }
 
-def p_to_f(p, pd=0.0, pdd=None):
+def p_to_f(p, pd=None, pdd=None):
     """Period (derivatives) -> frequency (derivatives); an involution
-    (reference: utils.py::p_to_f). One implementation shared with
-    derived_quantities.p_to_f."""
+    (reference: utils.py::p_to_f). Math lives in
+    derived_quantities.p_to_f; with pd omitted returns the 1-tuple
+    (f,) so `f, = p_to_f(p)` unpacking works."""
     from .derived_quantities import p_to_f as _p2f
 
+    if pd is None:
+        return (_p2f(p, 0.0)[0],)
     return _p2f(p, pd, pdd)
 
 
