@@ -154,20 +154,44 @@ def main():
            "); photon H-test throughput")
 
     # photon-domain side metric: H-test over 4M photon phases (the
-    # pallas streaming kernel on TPU; SURVEY.md 3.5 photon workload)
-    from pint_tpu.eventstats import hm
-
-    rng = np.random.default_rng(0)
+    # pallas streaming kernel on TPU; SURVEY.md 3.5 photon workload).
+    # This stage is OPTIONAL for the headline: the relay has been seen
+    # to wedge mid-run on exactly this transfer-heavy workload, and
+    # losing the whole JSON line to a side metric is unacceptable. A
+    # wedge blocks inside the runtime's C++ wait where Python signals
+    # never fire, so the stage runs in a CHILD process with a hard
+    # subprocess timeout (the only kill that works there).
+    htest_s = None
     n_ph = 4_000_000
-    phot = np.concatenate([(rng.normal(0.3, 0.04, n_ph // 4)) % 1.0,
-                           rng.uniform(0, 1, 3 * n_ph // 4)])
-    h = float(hm(phot, m=20))  # compile + warm
-    t0 = time.time()
-    runs = 3
-    for _ in range(runs):
-        h = float(hm(phot, m=20))
-    htest_s = (time.time() - t0) / runs
-    _stage(f"H-test 4M photons: {htest_s:.3f}s (H={h:.0f})")
+    child = (
+        "import warnings, time, json, sys, numpy as np\n"
+        "warnings.simplefilter('ignore')\n"
+        + ("import jax; jax.config.update('jax_platforms', 'cpu')\n"
+           if jax.default_backend() == "cpu" else "import jax\n") +
+        "import jax.numpy as jnp\n"
+        "from pint_tpu.eventstats import hm\n"
+        "rng = np.random.default_rng(0)\n"
+        f"n_ph = {n_ph}\n"
+        "phot = np.concatenate([(rng.normal(0.3, 0.04, n_ph//4)) % 1.0,\n"
+        "                       rng.uniform(0, 1, 3*n_ph//4)])\n"
+        "phot_dev = jax.device_put(jnp.asarray(phot))\n"
+        "h = float(hm(phot_dev, m=20))\n"
+        "t0 = time.time()\n"
+        "for _ in range(3): h = float(hm(phot_dev, m=20))\n"
+        "print(json.dumps({'s': (time.time()-t0)/3, 'h': h}))\n")
+    try:
+        import subprocess
+
+        out = subprocess.run(
+            [sys.executable, "-c", child], timeout=300, check=True,
+            capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        res = json.loads(out.stdout.strip().splitlines()[-1])
+        htest_s = res["s"]
+        _stage(f"H-test 4M photons: {htest_s:.3f}s (H={res['h']:.0f})")
+    except Exception as e:
+        _stage(f"H-test stage skipped ({type(e).__name__}); "
+               "headline JSON unaffected")
 
     total_toas = n_psr * n_toa
     rate = total_toas / gls_refit_s  # TOAs GLS-refit per second
@@ -186,8 +210,10 @@ def main():
         "wls_compile_s": round(wls_compile_s, 2),
         "wls_refit_wall_s": round(wls_refit_s, 4),
         "wls_toas_per_sec": round(total_toas / wls_refit_s, 1),
-        "htest_4M_photons_s": round(htest_s, 4),
-        "htest_photons_per_sec": round(n_ph / htest_s, 0),
+        "htest_4M_photons_s": (round(htest_s, 4)
+                               if htest_s is not None else None),
+        "htest_photons_per_sec": (round(n_ph / htest_s, 0)
+                                  if htest_s else None),
         "platform": jax.devices()[0].platform,
     }
     print(json.dumps({

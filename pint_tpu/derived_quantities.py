@@ -49,6 +49,8 @@ def p_to_f(p, pd, pdd=None):
 
     p = np.asarray(p, dtype=np.float64) if not np.isscalar(p) else p
     pd = np.asarray(pd, dtype=np.float64) if not np.isscalar(pd) else pd
+    if pdd is not None and not np.isscalar(pdd):
+        pdd = np.asarray(pdd, dtype=np.float64)
     f = 1.0 / p
     fd = -pd / p**2
     if pdd is None:

@@ -370,6 +370,38 @@ def _warn_degraded_once():
         "before any jax use) for final parameter estimation.")
 
 
+def device_memory_stats():
+    """bytes_in_use of the default device, or None where the backend
+    doesn't report memory (CPU). Part of the per-fit metrics surface
+    (SURVEY section 5: metrics/observability)."""
+    import jax
+
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        return int(stats.get("bytes_in_use")) if stats else None
+    except Exception:
+        return None
+
+
+def fit_metrics(t_start, prep_s, iter_s, toas, model):
+    """The uniform per-fit metrics dict (SURVEY section 5) — single
+    home shared by the single-pulsar fitters (PTABatch has its own
+    batch-shaped variant, _record_metrics)."""
+    import time
+
+    import jax
+
+    return {
+        "backend": jax.default_backend(),
+        "prepare_s": round(prep_s, 4),
+        "iteration_s": [round(s, 4) for s in iter_s],
+        "total_s": round(time.perf_counter() - t_start, 4),
+        "n_toas": len(toas),
+        "n_free": len(model.free_params),
+        "device_bytes_in_use": device_memory_stats(),
+    }
+
+
 def marginalized_chi2(r, sigma_s, bases, threshold=1e-12):
     """Whitened chi2 of a residual vector at FIXED parameters, with any
     correlated-noise basis amplitudes marginalized (Woodbury:
@@ -439,6 +471,9 @@ class WLSFitter(Fitter):
     """
 
     def fit_toas(self, maxiter=2, threshold=1e-12):
+        import time
+
+        import jax
         import jax.numpy as jnp
 
         corr = _correlated_noise_components(self.model)
@@ -446,11 +481,14 @@ class WLSFitter(Fitter):
             raise CorrelatedErrors(corr)
         _reject_free_dmjump(self.model)
         _warn_degraded_once()
+        t_start = time.perf_counter()
         prepared = self.model.prepare(self.toas)
+        prep_s = time.perf_counter() - t_start
         resid_fn = prepared.residual_vector_fn(track_mode=self._track_mode())
         dm_fn, labels = prepared.designmatrix_fn()
         noff = _n_offset(labels)
         f0 = prepared.params0["F"][0]
+        iter_s = []
 
         def whitened(x):
             r = resid_fn(x)
@@ -468,6 +506,7 @@ class WLSFitter(Fitter):
         best = (chi2, x, None)
         first_cov = None
         for _ in range(maxiter):
+            t_it = time.perf_counter()
             M = dm_fn(x)
             Mw = (M / f0) / sigma_s[:, None]
             dx_all, covn, norm = wls_step(Mw, rw, threshold)
@@ -476,6 +515,7 @@ class WLSFitter(Fitter):
             x = x - dx_all[noff:]
             rw, sigma_s = whitened(x)
             chi2 = float(jnp.sum(jnp.square(rw)))
+            iter_s.append(time.perf_counter() - t_it)
             if chi2 < best[0]:
                 best = (chi2, x, (covn, norm))
         if chi2 - best[0] > 1e-6 * max(1.0, best[0]):
@@ -492,6 +532,10 @@ class WLSFitter(Fitter):
             self._set_uncertainties(prepared, cov_all[noff:, noff:])
         self.resids = Residuals(self.toas, self.model)
         self.converged = True
+        # metrics surface: first iteration includes jit compile, later
+        # ones are steady state
+        self.metrics = fit_metrics(t_start, prep_s, iter_s, self.toas,
+                                   self.model)
         return self.resids.chi2
 
 
@@ -587,13 +631,18 @@ class GLSFitter(Fitter):
         return None, None
 
     def fit_toas(self, maxiter=2, threshold=1e-12, tol=0.0):
+        import time
+
         _reject_free_dmjump(self.model)
         _warn_degraded_once()
+        t_start = time.perf_counter()
         prepared = self.model.prepare(self.toas)
+        prep_s = time.perf_counter() - t_start
         resid_fn = prepared.residual_vector_fn(track_mode=self._track_mode())
         dm_fn, labels = prepared.designmatrix_fn()
         noff = _n_offset(labels)
         f0 = prepared.params0["F"][0]
+        iter_s = []
 
         def state_at(x):
             p = prepared.params_with_vector(x)
@@ -616,6 +665,7 @@ class GLSFitter(Fitter):
         nparam = None
         last_chi2 = None
         for _ in range(maxiter):
+            t_it = time.perf_counter()
             M = dm_fn(x) / f0
             Mfull, sqrt_phi_inv, nparam = stack_noise_bases(M, bases)
             # shared whitened/normalized/prior-weighted eigh solve (see
@@ -633,6 +683,7 @@ class GLSFitter(Fitter):
             x = x - dx[noff:nparam]
             r, sigma_s, bases = state_at(x)
             chi2 = marginalized_chi2(r, sigma_s, bases, threshold)
+            iter_s.append(time.perf_counter() - t_it)
             if chi2 < best[0]:
                 best = (chi2, x, cov, noise_ampls)
             if (tol and last_chi2 is not None
@@ -656,6 +707,8 @@ class GLSFitter(Fitter):
         self.resids = Residuals(self.toas, self.model)
         self.converged = True
         self.chi2_whitened = chi2
+        self.metrics = fit_metrics(t_start, prep_s, iter_s, self.toas,
+                                   self.model)
         return chi2
 
 

@@ -125,6 +125,8 @@ class CompositeMCMCFitter(MCMCFitter):
 
     def __init__(self, toas_list, model, templates, weights_list=None,
                  **kw):
+        if not toas_list:
+            raise ValueError("need at least one TOA set")
         if len(toas_list) != len(templates):
             raise ValueError("need one template per TOA set")
         if weights_list is not None and len(weights_list) != len(toas_list):

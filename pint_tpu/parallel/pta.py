@@ -339,8 +339,12 @@ class PTABatch:
                 x, chi2, cov = one_step(x, params, batch, prep)
             return x, chi2, cov
 
+        import time
+
+        t0 = time.perf_counter()
         key = ("wls", maxiter, threshold)
-        if key not in self._fns:
+        compiled = key in self._fns
+        if not compiled:
             self._fns[key] = jax.jit(jax.vmap(fit_one))
         x0 = self._x0()
         x, chi2, (covn, norm) = self._fns[key](x0, self.params,
@@ -354,7 +358,29 @@ class PTABatch:
         x, chi2, covn, norm = jax.device_get((x, chi2, covn, norm))
         cov = covn / (norm[:, :, None] * norm[:, None, :])
         x, chi2 = self._isolate_diverged(x0, x, chi2)
+        self._record_metrics("wls", t0, maxiter, warm=compiled)
         return x, chi2, cov
+
+    def _record_metrics(self, method, t0, maxiter, warm):
+        """Per-fit metrics surface (SURVEY section 5): wall time
+        (compile included when warm=False), batch shape, device
+        memory."""
+        import time
+
+        import jax
+
+        from ..fitter import device_memory_stats
+
+        self.metrics = {
+            "method": method,
+            "backend": jax.default_backend(),
+            "fit_wall_s": round(time.perf_counter() - t0, 4),
+            "includes_compile": not warm,
+            "maxiter": maxiter,
+            "n_pulsars": len(self.models),
+            "n_toas_total": int(sum(self.n_toas)),
+            "device_bytes_in_use": device_memory_stats(),
+        }
 
     def _noise_bw_fn(self, exclude_ecorr=False):
         """Pure (params, prep) -> (B, w_us2) stacking every noise
@@ -536,8 +562,12 @@ class PTABatch:
                 x, chi2, cov = one_step(x, params, batch, prep)
             return x, chi2, cov
 
+        import time
+
+        t0 = time.perf_counter()
         key = ("gls", maxiter, threshold, marginalize)
-        if key not in self._fns:
+        compiled = key in self._fns
+        if not compiled:
             self._fns[key] = jax.jit(jax.vmap(fit_one))
         x0 = self._x0()
         x, chi2, (covn, norm) = self._fns[key](x0, self.params,
@@ -546,6 +576,7 @@ class PTABatch:
         x, chi2, covn, norm = jax.device_get((x, chi2, covn, norm))
         cov = covn / (norm[:, :, None] * norm[:, None, :])
         x, chi2 = self._isolate_diverged(x0, x, chi2)
+        self._record_metrics("gls", t0, maxiter, warm=compiled)
         return x, chi2, cov
 
     @staticmethod

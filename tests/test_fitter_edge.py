@@ -129,3 +129,42 @@ def test_gls_tol_early_stop_matches_full():
     c2 = f2.fit_toas(maxiter=10)
     assert c1 == pytest.approx(c2, rel=1e-6)
     assert f1.model.F0.value == pytest.approx(f2.model.F0.value, abs=1e-12)
+
+
+def test_fit_metrics_surface():
+    """Every plain fit exposes a metrics dict (SURVEY section 5:
+    tracing/observability): prepare time, per-iteration wall times,
+    backend, device memory."""
+    m = get_model(BASE)
+    t = _toas(m, n=60)
+    f = WLSFitter(t, m)
+    f.fit_toas(maxiter=2)
+    mt = f.metrics
+    assert mt["backend"] in ("cpu", "tpu")
+    assert len(mt["iteration_s"]) == 2
+    assert mt["total_s"] >= sum(mt["iteration_s"])
+    assert mt["n_toas"] == 60
+
+    par = BASE + "RNAMP 5e-15\nRNIDX -3\nTNREDC 4\n"
+    mg = get_model(par)
+    fg = GLSFitter(t, mg)
+    fg.fit_toas(maxiter=2)
+    assert len(fg.metrics["iteration_s"]) == 2
+
+
+def test_pta_metrics_surface():
+    from pint_tpu.parallel import PTABatch
+
+    models, toas_list = [], []
+    for i in range(3):
+        par = BASE.replace("TESTEDGE", f"PM{i}")
+        m = get_model(par)
+        models.append(m)
+        toas_list.append(_toas(m, n=40, seed=i))
+    pta = PTABatch(models, toas_list)
+    pta.wls_fit(maxiter=2)
+    assert pta.metrics["includes_compile"] is True
+    assert pta.metrics["n_pulsars"] == 3
+    pta.wls_fit(maxiter=2)
+    assert pta.metrics["includes_compile"] is False
+    assert pta.metrics["fit_wall_s"] > 0
