@@ -544,6 +544,8 @@ class DownhillWLSFitter(WLSFitter):
 
     def fit_toas(self, maxiter=20, threshold=1e-12, min_lambda=1e-3, tol=1e-10,
                  raise_maxiter=False):
+        import time
+
         import jax.numpy as jnp
 
         corr = _correlated_noise_components(self.model)
@@ -551,10 +553,13 @@ class DownhillWLSFitter(WLSFitter):
             raise CorrelatedErrors(corr)
         _reject_free_dmjump(self.model)
         _warn_degraded_once()
+        t_start = time.perf_counter()
         prepared = self.model.prepare(self.toas)
+        prep_s = time.perf_counter() - t_start
         resid_fn = prepared.residual_vector_fn(track_mode=self._track_mode())
         dm_fn, labels = prepared.designmatrix_fn()
         noff = _n_offset(labels)
+        iter_s = []
 
         def chi2_of(x):
             r = resid_fn(x)
@@ -565,6 +570,7 @@ class DownhillWLSFitter(WLSFitter):
         best_chi2 = chi2_of(x)
         covn = norm = None
         for it in range(maxiter):
+            t_it = time.perf_counter()
             r = resid_fn(x)
             sigma_s = prepared.scaled_sigma_us(prepared.params_with_vector(x)) * 1e-6
             M = dm_fn(x)
@@ -583,6 +589,7 @@ class DownhillWLSFitter(WLSFitter):
                     x = x - lam * dx
                     break
                 lam *= 0.5
+            iter_s.append(time.perf_counter() - t_it)
             if lam < min_lambda or not improved:
                 break
         else:
@@ -598,6 +605,8 @@ class DownhillWLSFitter(WLSFitter):
             self._set_uncertainties(prepared, cov_all[noff:, noff:])
         self.resids = Residuals(self.toas, self.model)
         self.converged = True
+        self.metrics = fit_metrics(t_start, prep_s, iter_s, self.toas,
+                                   self.model)
         return self.resids.chi2
 
 
@@ -856,10 +865,15 @@ class WidebandTOAFitter(GLSFitter):
         return float(fn(prepared.vector_from_params()))
 
     def fit_toas(self, maxiter=2, threshold=1e-12):
+        import time
+
         _warn_degraded_once()
+        t_start = time.perf_counter()
+        iter_s = []
         chi2 = None
         best = None  # (actual chi2, prepared, x0) of the best state seen
         for _ in range(maxiter):
+            t_it = time.perf_counter()
             prepared, combined, r, sigma, noff, x0, bases = \
                 self._wideband_system()
             chi2_act = marginalized_chi2(r, sigma, bases, threshold)
@@ -875,6 +889,7 @@ class WidebandTOAFitter(GLSFitter):
             cov_all = cov_from_normalized(*cov)
             self._set_uncertainties(prepared, cov_all[noff:nparam,
                                                       noff:nparam])
+            iter_s.append(time.perf_counter() - t_it)
         # best-iterate safeguard (see GLSFitter.fit_toas): compare the
         # final state's actual marginalized chi2 — SAME threshold as the
         # in-loop evaluations — against the best one and revert if an
@@ -900,6 +915,10 @@ class WidebandTOAFitter(GLSFitter):
         self.resids = WidebandTOAResiduals(self.toas, self.model)
         self.converged = True
         self.chi2_whitened = chi2
+        # wideband re-prepares inside each iteration, so prepare time is
+        # folded into iteration_s rather than reported separately
+        self.metrics = fit_metrics(t_start, 0.0, iter_s, self.toas,
+                                   self.model)
         # the whitened/marginalized value, like GLSFitter — the raw
         # resids.chi2 would be noise-realization-inflated under
         # correlated models
@@ -912,8 +931,13 @@ class WidebandDownhillFitter(WidebandTOAFitter):
 
     def fit_toas(self, maxiter=15, threshold=1e-12, min_lambda=1e-3,
                  tol=1e-9, raise_maxiter=False):
+        import time
+
+        t_start = time.perf_counter()
+        iter_s = []
         best_chi2 = None
         for it in range(maxiter):
+            t_it = time.perf_counter()
             prepared, combined, r, sigma, noff, x0, bases = \
                 self._wideband_system()
             # one jitted GLS objective per outer iteration; line-search
@@ -944,6 +968,7 @@ class WidebandDownhillFitter(WidebandTOAFitter):
             cov_all = cov_from_normalized(*cov)
             self._set_uncertainties(prepared, cov_all[noff:nparam,
                                                       noff:nparam])
+            iter_s.append(time.perf_counter() - t_it)
             if lam < min_lambda or not improved:
                 break
         else:
@@ -952,6 +977,8 @@ class WidebandDownhillFitter(WidebandTOAFitter):
         self.resids = WidebandTOAResiduals(self.toas, self.model)
         self.converged = True
         self.chi2_whitened = best_chi2
+        self.metrics = fit_metrics(t_start, 0.0, iter_s, self.toas,
+                                   self.model)
         return best_chi2
 
 
@@ -963,11 +990,16 @@ class WidebandLMFitter(WidebandTOAFitter):
 
     def fit_toas(self, maxiter=20, threshold=1e-12, lm_lambda0=1e-3,
                  tol=1e-9):
+        import time
+
         import jax.numpy as jnp
 
+        t_start = time.perf_counter()
+        iter_s = []
         lm = lm_lambda0
         best_chi2 = self._wideband_chi2(threshold)
         for _ in range(maxiter):
+            t_it = time.perf_counter()
             prepared, combined, r, sigma, noff, x0, bases = \
                 self._wideband_system()
             Mfull, sqrt_phi_inv, nparam = stack_noise_bases(
@@ -978,6 +1010,7 @@ class WidebandLMFitter(WidebandTOAFitter):
             dx = (dxn / norm)[noff:nparam]
             self._sync_model_from_vector(prepared, x0 - dx)
             chi2 = self._wideband_chi2(threshold)
+            iter_s.append(time.perf_counter() - t_it)
             if chi2 <= best_chi2 + 1e-12:
                 accepted = chi2 < best_chi2 - tol * max(1.0, best_chi2)
                 best_chi2 = min(best_chi2, chi2)
@@ -1008,6 +1041,8 @@ class WidebandLMFitter(WidebandTOAFitter):
         self.resids = WidebandTOAResiduals(self.toas, self.model)
         self.converged = True
         self.chi2_whitened = best_chi2
+        self.metrics = fit_metrics(t_start, 0.0, iter_s, self.toas,
+                                   self.model)
         return best_chi2
 
 
@@ -1021,11 +1056,15 @@ class PowellFitter(Fitter):
     """
 
     def fit_toas(self, maxiter=2000, xtol=1e-8):
+        import time
+
         import jax.numpy as jnp
         from scipy.optimize import minimize
 
         _reject_free_dmjump(self.model)
+        t_start = time.perf_counter()
         prepared = self.model.prepare(self.toas)
+        prep_s = time.perf_counter() - t_start
         resid_fn = prepared.residual_vector_fn(track_mode=self._track_mode())
         dm_fn, labels = prepared.designmatrix_fn()
         noff = _n_offset(labels)
@@ -1053,6 +1092,9 @@ class PowellFitter(Fitter):
         self._sync_model_from_vector(prepared, x0 + res.x * scale)
         self.resids = Residuals(self.toas, self.model)
         self.converged = bool(res.success)
+        self.metrics = fit_metrics(t_start, prep_s, [], self.toas,
+                                   self.model)
+        self.metrics["n_evaluations"] = int(res.nfev)
         return self.resids.chi2
 
 

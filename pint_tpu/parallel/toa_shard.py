@@ -17,6 +17,29 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def _data_spec(x, n_rows, axis):
+    """PartitionSpec sharding whichever dimension carries the TOA axis
+    (detected by length == n_rows); everything else replicated. Single
+    home for the by-shape heuristic used by every sharded entry point."""
+    nd = getattr(x, "ndim", 0)
+    if nd == 0:
+        return P()
+    dims = [None] * nd
+    for i, s in enumerate(x.shape):
+        if s == n_rows:
+            dims[i] = axis
+            break
+    return P(*dims)
+
+
+def _place(mesh, tree, specs):
+    """Re-place committed single-device arrays onto the mesh shardings
+    so shard_map accepts them."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s))
+        if isinstance(x, jax.Array) else x, tree, specs)
+
+
 def sharded_residuals(template_model, static, mesh: Mesh, params, batch, prep,
                       axis="toa"):
     """Residual seconds with the TOA axis sharded over ``mesh``.
@@ -41,36 +64,14 @@ def sharded_residuals(template_model, static, mesh: Mesh, params, batch, prep,
         return frac / params["F"][0]
 
     n_toa = batch.tdb_sec.shape[0]
-
-    def data_spec(x):
-        """Shard whichever dimension carries the TOA axis; replicate
-        everything else. Handles (n,), (n, 3), (k, n) masks/bases, and
-        (n_planets, n, 3) planet tensors by shape, not position."""
-        nd = getattr(x, "ndim", 0)
-        if nd == 0:
-            return P()
-        dims = [None] * nd
-        for i, s in enumerate(x.shape):
-            if s == n_toa:
-                dims[i] = axis
-                break
-        return P(*dims)
-
-    batch_specs = jax.tree_util.tree_map(data_spec, batch)
-    prep_specs = jax.tree_util.tree_map(data_spec, prep)
-    # inputs may be committed to a single device by the staged batched
-    # transfer (PreparedTiming); re-place them onto the mesh sharding
-    # so shard_map accepts them
-    from jax.sharding import NamedSharding
-
-    def place(tree, specs):
-        return jax.tree_util.tree_map(
-            lambda x, s: jax.device_put(x, NamedSharding(mesh, s))
-            if isinstance(x, jax.Array) else x, tree, specs)
-
-    params = place(params, jax.tree_util.tree_map(lambda _: P(), params))
-    batch = place(batch, batch_specs)
-    prep = place(prep, prep_specs)
+    batch_specs = jax.tree_util.tree_map(
+        lambda a: _data_spec(a, n_toa, axis), batch)
+    prep_specs = jax.tree_util.tree_map(
+        lambda a: _data_spec(a, n_toa, axis), prep)
+    params = _place(mesh, params,
+                    jax.tree_util.tree_map(lambda _: P(), params))
+    batch = _place(mesh, batch, batch_specs)
+    prep = _place(mesh, prep, prep_specs)
     fn = jax.shard_map(
         local, mesh=mesh,
         in_specs=(jax.tree_util.tree_map(lambda _: P(), params),
@@ -91,3 +92,144 @@ def sharded_chi2(template_model, static, mesh, params, batch, prep, axis="toa"):
     # placements inside one jitted expression
     sig = np.asarray(pure_sigma_fn(template_model, static)(params, batch, prep)) * 1e-6
     return float(np.sum(np.square(np.asarray(r) / sig)))
+
+
+def _pad_single(prepared, n_pad):
+    """Pad one pulsar's (batch, prep arrays) TOA dims to n_pad rows so
+    the axis divides evenly across shards. Padded rows get the
+    _PAD_SIGMA sentinel (vanish from every whitened reduction); basis
+    rows pad with zeros."""
+    import numpy as np
+
+    from ..toa import TOABatch
+    from .pta import _PAD_SIGMA, _is_static, _toa_dim_pad
+
+    n = prepared.batch.n_toas
+    static, arrays = {}, {}
+    for k, v in prepared.prep.items():
+        if k in ("T_ld", "pepoch_day", "pepoch_sec"):
+            continue
+        if _is_static(k, v):
+            static[k] = v
+        else:
+            arrays[k] = jnp.asarray(_toa_dim_pad(v, n, n_pad))
+    fields = {}
+    for name in TOABatch._fields:
+        a = np.asarray(getattr(prepared.batch, name))
+        if n_pad != n:
+            if name == "error_us":
+                a = np.concatenate([a, np.full(n_pad - n, _PAD_SIGMA)])
+            elif a.ndim >= 1 and a.shape[0] == n:
+                a = np.concatenate(
+                    [a, np.repeat(a[-1:], n_pad - n, axis=0)], axis=0)
+            elif a.ndim == 3 and a.shape[1] == n:  # planet (np, n, 3)
+                a = np.concatenate(
+                    [a, np.repeat(a[:, -1:], n_pad - n, axis=1)], axis=1)
+        fields[name] = jnp.asarray(a)
+    return TOABatch(**fields), arrays, static
+
+
+def sharded_gls_fit(model, toas, mesh: Mesh, maxiter=2, threshold=1e-12,
+                    axis="toa"):
+    """Single-pulsar GLS fit with the TOA axis sharded over ``mesh`` —
+    the sequence-parallel path for a pulsar whose TOA/photon count
+    outgrows one chip (SURVEY section 5 "long-context").
+
+    Per shard: local residuals + local jacfwd design block + local
+    noise-basis rows; cross-shard coupling is the weighted mean (psum),
+    the exponent-safe column norms (pmax + psum), and the
+    normal-equation partials A = psum(Mn_loc^T Mn_loc),
+    b = psum(Mn_loc^T z_loc) — the tiny (k x k) prior-folded eigh solve
+    then runs replicated. ECORR epochs may straddle shard boundaries
+    here: the epochs enter as explicit basis COLUMNS (Woodbury), whose
+    psum accumulation is exact regardless of row placement — only the
+    batched path's analytic Sherman-Morrison marginalization needs
+    epoch locality.
+
+    Returns (x, whitened_chi2, cov) as numpy, matching
+    fitter.GLSFitter on the same data (pinned by test_parallel.py).
+    """
+    import numpy as np
+
+    from ..fitter import (_reject_free_dmjump, cov_from_normalized,
+                          gls_eigh_solve)
+    from .pta import pure_phase_fn, pure_sigma_fn
+
+    _reject_free_dmjump(model)
+    n_dev = mesh.devices.size
+    prepared = model.prepare(toas)
+    n = prepared.batch.n_toas
+    n_pad = ((n + n_dev - 1) // n_dev) * n_dev
+    batch, arrays, static = _pad_single(prepared, n_pad)
+    phase = pure_phase_fn(model, static)
+    sigma_fn = pure_sigma_fn(model, static)
+    noise_comps = [c for c in model.components.values()
+                   if getattr(c, "basis_weight", None) is not None]
+    free = prepared.free_param_map()
+    nparam = len(free) + 1  # + offset column
+    x0 = jnp.asarray(prepared.vector_from_params())
+
+    batch_specs = jax.tree_util.tree_map(
+        lambda a: _data_spec(a, n_pad, axis), batch)
+    prep_specs = jax.tree_util.tree_map(
+        lambda a: _data_spec(a, n_pad, axis), arrays)
+    batch = _place(mesh, batch, batch_specs)
+    arrays = _place(mesh, arrays, prep_specs)
+
+    def local(x, batch, prep):
+        def resid_of(xv):
+            p = prepared.params_with_vector(xv)
+            ph = phase(p, batch, prep)
+            frac = ph - jnp.floor(ph + 0.5)
+            sig = sigma_fn(p, batch, prep) * 1e-6
+            w = 1.0 / jnp.square(sig)
+            sw = jax.lax.psum(jnp.sum(frac * w), axis)
+            tw = jax.lax.psum(jnp.sum(w), axis)
+            return (frac - sw / tw) / p["F"][0], sig
+
+        r, sig = resid_of(x)
+        M = jax.jacfwd(lambda xv: resid_of(xv)[0])(x)
+        M = jnp.concatenate([jnp.ones((M.shape[0], 1)), M], axis=1)
+        p = prepared.params_with_vector(x)
+        full = {**prep, **static}
+        sqrt_phi_inv = jnp.zeros(nparam)
+        for c in noise_comps:
+            B, w_us2 = c.basis_weight(p, full)
+            if B.shape[1]:
+                M = jnp.concatenate([M, B], axis=1)
+                spi = jnp.where(
+                    w_us2 > 0,
+                    1.0 / (jnp.sqrt(jnp.where(w_us2 > 0, w_us2, 1.0))
+                           * 1e-6), 0.0)
+                sqrt_phi_inv = jnp.concatenate([sqrt_phi_inv, spi])
+        # exponent-safe global column norms (see fitter.column_norms):
+        # peak-scale via pmax, then a psum'd sum of squares
+        Mw = M / sig[:, None]
+        amax = jax.lax.pmax(jnp.max(jnp.abs(Mw), axis=0), axis)
+        amax = jnp.where(amax == 0, 1.0, amax)
+        ss = jax.lax.psum(jnp.sum(jnp.square(Mw / amax), axis=0), axis)
+        cn = amax * jnp.where(ss == 0, 1.0, jnp.sqrt(ss))
+        norm = jnp.hypot(cn, sqrt_phi_inv)
+        Mn = Mw / norm
+        q = sqrt_phi_inv / norm
+        z = r / sig
+        A = jax.lax.psum(Mn.T @ Mn, axis) + jnp.diag(q * q)
+        b = jax.lax.psum(Mn.T @ z, axis)
+        rw2 = jax.lax.psum(jnp.sum(jnp.square(z)), axis)
+        dxn, covn = gls_eigh_solve(A, b, threshold)
+        chi2 = rw2 - b @ dxn
+        dx = dxn / norm
+        return x - dx[1:nparam], chi2, covn[1:nparam, 1:nparam], norm[1:nparam]
+
+    step = jax.jit(jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(), batch_specs, prep_specs),
+        out_specs=(P(), P(), P(), P())))
+
+    # x must live replicated on the SAME mesh as the sharded data
+    x = jax.device_put(x0, NamedSharding(mesh, P()))
+    for _ in range(maxiter):
+        x, chi2, covn, norm = step(x, batch, arrays)
+    x, chi2, covn, norm = jax.device_get((x, chi2, covn, norm))
+    cov = cov_from_normalized(covn, norm)
+    return x, float(chi2), cov

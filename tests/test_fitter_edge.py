@@ -168,3 +168,23 @@ def test_pta_metrics_surface():
     pta.wls_fit(maxiter=2)
     assert pta.metrics["includes_compile"] is False
     assert pta.metrics["fit_wall_s"] > 0
+
+
+def test_metrics_on_downhill_and_wideband():
+    from pint_tpu.fitter import DownhillWLSFitter, WidebandTOAFitter
+
+    m = get_model(BASE)
+    t = _toas(m, n=60)
+    f = DownhillWLSFitter(t, m)
+    f.fit_toas(maxiter=5)
+    assert f.metrics["n_toas"] == 60 and f.metrics["iteration_s"]
+
+    # wideband: give the TOAs DM measurements via flags
+    mw = get_model(BASE)
+    tw = _toas(mw, n=40)
+    for fl in tw.flags:
+        fl["pp_dm"] = "15.0"
+        fl["pp_dme"] = "1e-3"
+    fw = WidebandTOAFitter(tw, mw)
+    fw.fit_toas(maxiter=2)
+    assert fw.metrics["iteration_s"] and fw.metrics["total_s"] > 0
