@@ -172,20 +172,24 @@ def main():
 
     def _htest_stage():
         nonlocal htest_s, htest_h
-        import jax.numpy as jnp
+        try:
+            import jax.numpy as jnp
 
-        from pint_tpu.eventstats import hm
+            from pint_tpu.eventstats import hm
 
-        rng = np.random.default_rng(0)
-        phot = np.concatenate([(rng.normal(0.3, 0.04, n_ph // 4)) % 1.0,
-                               rng.uniform(0, 1, 3 * n_ph // 4)])
-        phot_dev = jax.device_put(jnp.asarray(phot))
-        h = float(hm(phot_dev, m=20))  # compile + warm
-        t0 = time.time()
-        for _ in range(3):
-            h = float(hm(phot_dev, m=20))
-        htest_s = (time.time() - t0) / 3
-        htest_h = h
+            rng = np.random.default_rng(0)
+            phot = np.concatenate([(rng.normal(0.3, 0.04, n_ph // 4)) % 1.0,
+                                   rng.uniform(0, 1, 3 * n_ph // 4)])
+            phot_dev = jax.device_put(jnp.asarray(phot))
+            h = float(hm(phot_dev, m=20))  # compile + warm
+            t0 = time.time()
+            for _ in range(3):
+                h = float(hm(phot_dev, m=20))
+            htest_h = h
+            htest_s = (time.time() - t0) / 3  # set LAST: completion marker
+        except Exception as e:  # report the skip; headline unaffected
+            _stage(f"H-test stage failed ({type(e).__name__}: {e}); "
+                   "headline JSON unaffected")
 
     import threading
 
@@ -193,12 +197,14 @@ def main():
     th.start()
     th.join(timeout=300)
     wedged = th.is_alive()
+    # snapshot ONCE: a late-finishing thread must not race the JSON
+    htest_s = None if wedged else htest_s
+    htest_done_s = htest_s
     if wedged:
         _stage("H-test stage timed out (wedged device?); headline JSON "
                "unaffected — will hard-exit after printing")
-        htest_s = None
-    elif htest_s is not None:
-        _stage(f"H-test 4M photons: {htest_s:.3f}s (H={htest_h:.0f})")
+    elif htest_done_s is not None:
+        _stage(f"H-test 4M photons: {htest_done_s:.3f}s (H={htest_h:.0f})")
 
     total_toas = n_psr * n_toa
     rate = total_toas / gls_refit_s  # TOAs GLS-refit per second
@@ -217,10 +223,10 @@ def main():
         "wls_compile_s": round(wls_compile_s, 2),
         "wls_refit_wall_s": round(wls_refit_s, 4),
         "wls_toas_per_sec": round(total_toas / wls_refit_s, 1),
-        "htest_4M_photons_s": (round(htest_s, 4)
-                               if htest_s is not None else None),
-        "htest_photons_per_sec": (round(n_ph / htest_s, 0)
-                                  if htest_s else None),
+        "htest_4M_photons_s": (round(htest_done_s, 4)
+                               if htest_done_s is not None else None),
+        "htest_photons_per_sec": (round(n_ph / htest_done_s, 0)
+                                  if htest_done_s else None),
         "htest_includes_transfer": False,
         "platform": jax.devices()[0].platform,
     }

@@ -598,6 +598,8 @@ class DownhillWLSFitter(WLSFitter):
             # state is kept on the model either way.
             if raise_maxiter:
                 self._sync_model_from_vector(prepared, x)
+                self.metrics = fit_metrics(t_start, prep_s, iter_s,
+                                           self.toas, self.model)
                 raise MaxiterReached(maxiter, best_chi2)
         self._sync_model_from_vector(prepared, x)
         if covn is not None:
@@ -973,6 +975,8 @@ class WidebandDownhillFitter(WidebandTOAFitter):
                 break
         else:
             if raise_maxiter:
+                self.metrics = fit_metrics(t_start, 0.0, iter_s, self.toas,
+                                           self.model)
                 raise MaxiterReached(maxiter, best_chi2)
         self.resids = WidebandTOAResiduals(self.toas, self.model)
         self.converged = True

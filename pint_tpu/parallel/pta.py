@@ -53,6 +53,42 @@ def _toa_dim_pad(arr, n_toa, n_max):
     return a
 
 
+def _pad_single(prepared, n_pad):
+    """Pad one pulsar's (batch, prep arrays) TOA dims to n_pad rows so
+    the axis divides evenly across shards. Padded rows get the
+    _PAD_SIGMA sentinel (vanish from every whitened reduction); basis
+    rows pad with zeros."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ..toa import TOABatch
+
+    n = prepared.batch.n_toas
+    static, arrays = {}, {}
+    for k, v in prepared.prep.items():
+        if k in ("T_ld", "pepoch_day", "pepoch_sec"):
+            continue
+        if _is_static(k, v):
+            static[k] = v
+        else:
+            arrays[k] = jnp.asarray(_toa_dim_pad(v, n, n_pad))
+    fields = {}
+    for name in TOABatch._fields:
+        a = np.asarray(getattr(prepared.batch, name))
+        if n_pad != n:
+            if name == "error_us":
+                a = np.concatenate([a, np.full(n_pad - n, _PAD_SIGMA)])
+            elif a.ndim >= 1 and a.shape[0] == n:
+                a = np.concatenate(
+                    [a, np.repeat(a[-1:], n_pad - n, axis=0)], axis=0)
+            elif a.ndim == 3 and a.shape[1] == n:  # planet (np, n, 3)
+                a = np.concatenate(
+                    [a, np.repeat(a[:, -1:], n_pad - n, axis=1)], axis=1)
+        fields[name] = jnp.asarray(a)
+    return TOABatch(**fields), arrays, static
+
+
 def _pad_to(a, shape):
     out = np.zeros(shape, dtype=np.asarray(a).dtype)
     sl = tuple(slice(0, s) for s in np.asarray(a).shape)

@@ -94,41 +94,6 @@ def sharded_chi2(template_model, static, mesh, params, batch, prep, axis="toa"):
     return float(np.sum(np.square(np.asarray(r) / sig)))
 
 
-def _pad_single(prepared, n_pad):
-    """Pad one pulsar's (batch, prep arrays) TOA dims to n_pad rows so
-    the axis divides evenly across shards. Padded rows get the
-    _PAD_SIGMA sentinel (vanish from every whitened reduction); basis
-    rows pad with zeros."""
-    import numpy as np
-
-    from ..toa import TOABatch
-    from .pta import _PAD_SIGMA, _is_static, _toa_dim_pad
-
-    n = prepared.batch.n_toas
-    static, arrays = {}, {}
-    for k, v in prepared.prep.items():
-        if k in ("T_ld", "pepoch_day", "pepoch_sec"):
-            continue
-        if _is_static(k, v):
-            static[k] = v
-        else:
-            arrays[k] = jnp.asarray(_toa_dim_pad(v, n, n_pad))
-    fields = {}
-    for name in TOABatch._fields:
-        a = np.asarray(getattr(prepared.batch, name))
-        if n_pad != n:
-            if name == "error_us":
-                a = np.concatenate([a, np.full(n_pad - n, _PAD_SIGMA)])
-            elif a.ndim >= 1 and a.shape[0] == n:
-                a = np.concatenate(
-                    [a, np.repeat(a[-1:], n_pad - n, axis=0)], axis=0)
-            elif a.ndim == 3 and a.shape[1] == n:  # planet (np, n, 3)
-                a = np.concatenate(
-                    [a, np.repeat(a[:, -1:], n_pad - n, axis=1)], axis=1)
-        fields[name] = jnp.asarray(a)
-    return TOABatch(**fields), arrays, static
-
-
 def sharded_gls_fit(model, toas, mesh: Mesh, maxiter=2, threshold=1e-12,
                     axis="toa"):
     """Single-pulsar GLS fit with the TOA axis sharded over ``mesh`` —
@@ -153,7 +118,7 @@ def sharded_gls_fit(model, toas, mesh: Mesh, maxiter=2, threshold=1e-12,
 
     from ..fitter import (_reject_free_dmjump, cov_from_normalized,
                           gls_eigh_solve)
-    from .pta import pure_phase_fn, pure_sigma_fn
+    from .pta import _pad_single, pure_phase_fn, pure_sigma_fn
 
     _reject_free_dmjump(model)
     n_dev = mesh.devices.size

@@ -366,3 +366,93 @@ def get_wavex_amps(model, sin_prefix="WXSIN", cos_prefix="WXCOS"):
     co = np.array([getattr(model, f"{cos_prefix}_{i:04d}").value
                    for i in c.wx_ids])
     return s, co
+
+
+def plrednoise_to_wavex(model, toas=None, t_span_days=None):
+    """Replace a PLRedNoise component by a WaveX with the same number
+    of harmonics over the data span, amplitudes free (reference:
+    utils.py::plrednoise_to_wavex — turns the marginalized power-law
+    process into explicitly fit Fourier modes for noise analysis).
+
+    Give either ``toas`` (span measured from the data, + 1 day like the
+    noise fourier_basis) or ``t_span_days``. Returns the model.
+    """
+    comp = model.components.get("PLRedNoise")
+    if comp is None:
+        raise ValueError("model has no PLRedNoise component")
+    if (toas is None) == (t_span_days is None):
+        raise ValueError("give exactly one of toas or t_span_days")
+    if toas is not None:
+        mjds = toas.get_mjds()
+        t_span_days = float(mjds.max() - mjds.min() + 1.0)
+    n_harm = comp.n_harmonics()
+    model.remove_component("PLRedNoise")
+    wavex_setup(model, t_span_days, n_freqs=n_harm)
+    for i in model.components["WaveX"].wx_ids:
+        getattr(model, f"WXSIN_{i:04d}").frozen = False
+        getattr(model, f"WXCOS_{i:04d}").frozen = False
+    model.setup()
+    return model
+
+
+def wavex_to_plrednoise(model, t_span_days=None):
+    """Fit a power law to a WaveX component's per-harmonic power and
+    replace it by PLRedNoise (reference: utils.py::wavex_to_plrednoise).
+
+    Per-harmonic variance estimate phi_k = (WXSIN_k^2 + WXCOS_k^2)/2
+    [s^2] is matched to the enterprise-convention PSD integral
+    phi(f) = A^2/(12 pi^2) (f/f_yr)^(-gamma) yr^3 / T_span by weighted
+    least squares in log space (uncertainty-weighted when the
+    amplitudes carry uncertainties). Requires the WaveX frequencies to
+    be consecutive harmonics of 1/T_span; T_span is inferred from the
+    lowest frequency when not given.
+    """
+    wx = model.components.get("WaveX")
+    if wx is None:
+        raise ValueError("model has no WaveX component")
+    ids = wx.wx_ids
+    if len(ids) < 2:
+        raise ValueError("need >= 2 WaveX harmonics to fit a power law")
+    freqs_pd = np.array([getattr(model, f"WXFREQ_{i:04d}").value
+                         for i in ids])
+    if t_span_days is None:
+        t_span_days = 1.0 / freqs_pd[0]
+    f_hz = freqs_pd / 86400.0
+    phi = np.empty(len(ids))
+    wgt = np.ones(len(ids))
+    for k, i in enumerate(ids):
+        s = getattr(model, f"WXSIN_{i:04d}")
+        c = getattr(model, f"WXCOS_{i:04d}")
+        phi[k] = 0.5 * (s.value**2 + c.value**2)
+        if s.uncertainty is not None and c.uncertainty is not None:
+            # var of log phi ~ (2 s ds)^2+(2 c dc)^2 over (2 phi)^2
+            num = (s.value * s.uncertainty)**2 + (c.value * c.uncertainty)**2
+            wgt[k] = (phi[k]**2) / num if num > 0 else 1.0
+    good = phi > 0
+    if good.sum() < 2:
+        raise ValueError("WaveX amplitudes are all zero; nothing to fit")
+    fyr = 1.0 / (365.25 * 86400.0)
+    tspan_s = t_span_days * 86400.0
+    # log phi = log[A^2/(12 pi^2) f_yr^gamma yr^3 / tspan] - gamma log f
+    y = np.log(phi[good])
+    xlg = np.log(f_hz[good] / fyr)
+    w = wgt[good]
+    W = np.sum(w)
+    xm = np.sum(w * xlg) / W
+    ym = np.sum(w * y) / W
+    slope = np.sum(w * (xlg - xm) * (y - ym)) / np.sum(w * (xlg - xm)**2)
+    gamma = -slope
+    const = ym - slope * xm  # log phi at f = f_yr
+    # const = log(A^2/(12 pi^2) yr^3 / tspan)
+    A2 = np.exp(const) * 12.0 * np.pi**2 * tspan_s * fyr**3
+    log10_A = 0.5 * np.log10(A2)
+    from .models.noise import PLRedNoise
+
+    model.remove_component("WaveX")
+    pl = PLRedNoise()
+    model.add_component(pl)
+    model.TNREDAMP.value = float(log10_A)
+    model.TNREDGAM.value = float(gamma)
+    model.TNREDC.value = len(ids)
+    model.setup()
+    return model

@@ -142,3 +142,61 @@ def test_wavex_setup_noncontiguous_ids():
     assert wx.wx_ids == [2, 3, 4]
     assert m.WXSIN_0002.value == pytest.approx(1e-5)  # untouched
     assert m.WXFREQ_0004.value == pytest.approx(1 / 500.0)
+
+
+def test_plrednoise_wavex_round_trip_structure():
+    par = BASE + "TNREDAMP -13.0\nTNREDGAM 3.5\nTNREDC 12\n"
+    m = get_model(par)
+    U.plrednoise_to_wavex(m, t_span_days=600.0)
+    assert "PLRedNoise" not in m.components
+    wx = m.components["WaveX"]
+    assert len(wx.wx_ids) == 12
+    assert not m.WXSIN_0001.frozen
+    np.testing.assert_allclose(m.WXFREQ_0001.value, 1 / 600.0)
+
+
+def test_wavex_to_plrednoise_recovers_powerlaw():
+    """WaveX amplitudes drawn exactly on a power law convert back to
+    the generating (log10 A, gamma)."""
+    m = get_model(BASE)
+    tspan = 500.0
+    n_harm = 15
+    U.wavex_setup(m, tspan, n_freqs=n_harm)
+    log10_A, gamma = -13.2, 3.4
+    A = 10.0**log10_A
+    fyr = 1.0 / (365.25 * 86400.0)
+    tspan_s = tspan * 86400.0
+    for k, i in enumerate(m.components["WaveX"].wx_ids, start=1):
+        f = k / tspan_s
+        phi = A**2 / (12 * np.pi**2) * (f / fyr) ** (-gamma) / fyr**3 / tspan_s
+        amp = np.sqrt(phi)  # put all power in sin, none in cos
+        getattr(m, f"WXSIN_{i:04d}").value = amp * np.sqrt(2)
+        getattr(m, f"WXCOS_{i:04d}").value = 0.0
+    U.wavex_to_plrednoise(m, t_span_days=tspan)
+    assert "WaveX" not in m.components and "PLRedNoise" in m.components
+    assert m.TNREDGAM.value == pytest.approx(gamma, abs=1e-6)
+    assert m.TNREDAMP.value == pytest.approx(log10_A, abs=1e-6)
+    assert m.TNREDC.value == n_harm
+
+
+def test_wavex_to_plrednoise_estimation_from_fit():
+    """End-to-end: simulate red noise, fit WaveX amplitudes, recover a
+    plausible spectral index."""
+    from pint_tpu.fitter import WLSFitter
+
+    true = get_model(BASE + "TNREDAMP -12.3\nTNREDGAM 3.0\nTNREDC 8\n")
+    mjds = np.linspace(55000, 55600, 300)
+    t = make_fake_toas_fromMJDs(mjds, true, error_us=0.5, freq_mhz=1400.0,
+                                obs="gbt", add_noise=True,
+                                add_correlated_noise=True, seed=12)
+    fitm = get_model(BASE)
+    U.wavex_setup(fitm, 601.0, n_freqs=8)
+    for i in fitm.components["WaveX"].wx_ids:
+        getattr(fitm, f"WXSIN_{i:04d}").frozen = False
+        getattr(fitm, f"WXCOS_{i:04d}").frozen = False
+    f = WLSFitter(t, fitm)
+    f.fit_toas(maxiter=3)
+    out = U.wavex_to_plrednoise(f.model)
+    # one realization of 8 harmonics: loose bounds only
+    assert 0.5 < out.TNREDGAM.value < 6.5
+    assert -15.0 < out.TNREDAMP.value < -10.0
