@@ -198,8 +198,7 @@ def main():
     th.join(timeout=300)
     wedged = th.is_alive()
     # snapshot ONCE: a late-finishing thread must not race the JSON
-    htest_s = None if wedged else htest_s
-    htest_done_s = htest_s
+    htest_done_s = None if wedged else htest_s
     if wedged:
         _stage("H-test stage timed out (wedged device?); headline JSON "
                "unaffected — will hard-exit after printing")

@@ -16,10 +16,14 @@ chip gives the TPU numbers, CPU runs give a floor).
 
 import json
 import os
+import sys
 import time
 import warnings
 
 warnings.simplefilter("ignore")
+
+# runnable as `python benchmarks/baseline_configs.py` too
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
@@ -158,6 +162,11 @@ def config4_downhill_gls_10k():
 
 
 def main():
+    # same wedged-relay guard as the headline bench: measure on CPU
+    # rather than die when the tunneled device won't materialize
+    from bench import _guard_wedged_device
+
+    _guard_wedged_device()
     results = []
     for fn in (config1_ngc6440e, config2_gls_msp, config3_wideband,
                config4_downhill_gls_10k):

@@ -22,6 +22,39 @@ def _jnp():
     return jnp
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=64)
+def _stat_jit(m, weighted, stat):
+    """One compiled program per (m, weighted, z2m|hm): the eager
+    composition paid one dispatch round-trip PER OP, which behind a
+    tunneled device (~10-90 ms each) dwarfed the kernel itself."""
+    import jax
+    import jax.numpy as jnp
+
+    from .kernels import harmonic_sums
+
+    def z_of(ph, w):
+        c, s = harmonic_sums(ph, m, weights=w)
+        if w is None:
+            norm = ph.shape[-1] / 2.0
+        else:
+            norm = jnp.sum(w ** 2) / 2.0
+        return jnp.cumsum((c ** 2 + s ** 2) / norm)
+
+    if stat == "z2m":
+        f = z_of
+    else:
+        def f(ph, w):
+            k = jnp.arange(1, m + 1)
+            return jnp.max(z_of(ph, w) - 4.0 * k + 4.0)
+
+    if weighted:
+        return jax.jit(f)
+    return jax.jit(lambda ph: f(ph, None))
+
+
 def z2m(phases, m=2):
     """Z^2_m test statistic for each harmonic count 1..m.
 
@@ -29,43 +62,32 @@ def z2m(phases, m=2):
     (reference: eventstats.py::z2m). The harmonic sums go through the
     pallas streaming kernel on TPU at photon scale
     (pint_tpu/kernels/harmonics.py); small or CPU batches use the
-    identical-math jnp path.
+    identical-math jnp path. One jitted program per (m,) — no
+    per-op dispatch.
     """
     jnp = _jnp()
-    from .kernels import harmonic_sums
-
-    n = jnp.asarray(phases).shape[-1]
-    c, s = harmonic_sums(phases, m)
-    terms = (2.0 / n) * (c**2 + s**2)
-    return jnp.cumsum(terms)
+    return _stat_jit(int(m), False, "z2m")(jnp.asarray(phases))
 
 
 def z2mw(phases, weights, m=2):
     """Weighted Z^2_m (reference: eventstats.py::z2mw)."""
     jnp = _jnp()
-    from .kernels import harmonic_sums
-
-    w = jnp.asarray(weights)
-    c, s = harmonic_sums(phases, m, weights=w)
-    norm = jnp.sum(w**2) / 2.0
-    return jnp.cumsum((c**2 + s**2) / norm)
+    return _stat_jit(int(m), True, "z2m")(jnp.asarray(phases),
+                                          jnp.asarray(weights))
 
 
 def hm(phases, m=20):
     """H-test statistic (de Jager, Raubenheimer & Swanepoel 1989):
     H = max_{1<=k<=m} (Z^2_k - 4k + 4)  (reference: eventstats.py::hm)."""
     jnp = _jnp()
-    z = z2m(phases, m=m)
-    k = jnp.arange(1, m + 1)
-    return jnp.max(z - 4.0 * k + 4.0)
+    return _stat_jit(int(m), False, "hm")(jnp.asarray(phases))
 
 
 def hmw(phases, weights, m=20):
     """Weighted H-test (reference: eventstats.py::hmw)."""
     jnp = _jnp()
-    z = z2mw(phases, weights, m=m)
-    k = jnp.arange(1, m + 1)
-    return jnp.max(z - 4.0 * k + 4.0)
+    return _stat_jit(int(m), True, "hm")(jnp.asarray(phases),
+                                         jnp.asarray(weights))
 
 
 def sf_hm(h, logprob=False):

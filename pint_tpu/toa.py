@@ -333,8 +333,14 @@ class TOAs:
             for i in range(len(self)):
                 mjd_str = format_mjd(int(self.day[i]), float(self.sec[i]), 16)
                 flags = " ".join(f"-{k} {v}" for k, v in self.flags[i].items())
+                # error with full precision (%.3f silently truncated
+                # e.g. 1.8125 -> 1.812; caught by
+                # test_property.py::test_tim_write_read_roundtrip_random)
+                err = f"{self.error_us[i]:.6f}".rstrip("0").rstrip(".")
+                if "." not in err and "e" not in err:
+                    err += ".0"
                 f.write(f"{name} {self.freq_mhz[i]:.6f} {mjd_str} "
-                        f"{self.error_us[i]:.3f} {self.obs[i]} {flags}\n".rstrip() + "\n")
+                        f"{err} {self.obs[i]} {flags}\n".rstrip() + "\n")
 
 
 # --------------------------------------------------------------------------

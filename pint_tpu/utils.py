@@ -382,6 +382,11 @@ def plrednoise_to_wavex(model, toas=None, t_span_days=None):
         raise ValueError("model has no PLRedNoise component")
     if (toas is None) == (t_span_days is None):
         raise ValueError("give exactly one of toas or t_span_days")
+    if "WaveX" in model.components:
+        raise ValueError(
+            "model already has a WaveX component; merging the red-noise "
+            "harmonics into it would mix frequency sets — remove one "
+            "first")
     if toas is not None:
         mjds = toas.get_mjds()
         t_span_days = float(mjds.max() - mjds.min() + 1.0)
@@ -415,8 +420,18 @@ def wavex_to_plrednoise(model, t_span_days=None):
         raise ValueError("need >= 2 WaveX harmonics to fit a power law")
     freqs_pd = np.array([getattr(model, f"WXFREQ_{i:04d}").value
                          for i in ids])
+    # the power-law amplitude convention is defined over consecutive
+    # harmonics k/T_span; a sparse or non-harmonic set would silently
+    # bias TNREDAMP by the inferred-span factor
+    base = freqs_pd[0]
+    if not np.allclose(freqs_pd,
+                       np.arange(1, len(ids) + 1) * base,
+                       rtol=1e-6):
+        raise ValueError(
+            "WaveX frequencies are not consecutive harmonics of the "
+            "lowest one; cannot convert to PLRedNoise")
     if t_span_days is None:
-        t_span_days = 1.0 / freqs_pd[0]
+        t_span_days = 1.0 / base
     f_hz = freqs_pd / 86400.0
     phi = np.empty(len(ids))
     wgt = np.ones(len(ids))

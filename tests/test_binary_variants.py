@@ -330,3 +330,45 @@ def test_convert_ddh_to_dds_keeps_companion_mass():
     dds = convert_binary(m, "DDS")
     assert dds.M2.value == pytest.approx(m2, rel=1e-10)
     assert dds.SHAPMAX.value == pytest.approx(-np.log(1 - sini), rel=1e-10)
+
+
+def test_kepler_high_eccentricity_convergence():
+    """The fixed-iteration Kepler solve must stay at machine precision
+    even at e=0.9 (BT/DD family): E - e sin E = M residual < 1e-13 for
+    every mean anomaly."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pint_tpu.models.binary.base import kepler_solve
+
+    e = 0.9
+    M = jnp.asarray(np.linspace(-20, 20, 4001))
+    E = kepler_solve(M, e)
+    resid = np.asarray(E - e * jnp.sin(E) - M)
+    assert np.abs(resid).max() < 1e-12
+
+
+def test_dd_high_eccentricity_fit_recovery():
+    """A DD binary at e=0.6 (Hulse-Taylor-like) round-trips through
+    simulate -> perturb -> fit."""
+    import numpy as np
+
+    from pint_tpu.fitter import DownhillWLSFitter
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    par = ("PSR THTLIKE\nRAJ 19:15:28\nDECJ 16:06:27\nF0 16.94 1\n"
+           "F1 -2.5e-15 1\nPEPOCH 55300\nDM 168.77 1\nBINARY DD\n"
+           "PB 0.322997 1\nA1 2.3418 1\nECC 0.6171 1\nOM 292.54 1\n"
+           "T0 55301.0 1\nM2 1.39\nSINI 0.73\nGAMMA 0.0043\n")
+    true = get_model(par)
+    t = make_fake_toas_fromMJDs(np.linspace(55000, 55600, 250), true,
+                                error_us=5.0, freq_mhz=1400.0, obs="gbt",
+                                add_noise=True, seed=8)
+    start = get_model(par)
+    start.ECC.value += 1e-5
+    start.OM.value += 0.01
+    f = DownhillWLSFitter(t, start)
+    f.fit_toas(maxiter=15)
+    assert abs(f.model.ECC.value - 0.6171) < 5 * (f.model.ECC.uncertainty or 1)
+    assert abs(f.model.OM.value - 292.54) < 5 * (f.model.OM.uncertainty or 1)

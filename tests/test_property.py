@@ -118,3 +118,84 @@ def test_native_parser_agrees_with_python(tmp_path_factory, rows):
     assert np.array_equal(tn.error_us, tp.error_us)
     assert list(tn.obs.astype(str)) == list(tp.obs.astype(str))
     assert tn.flags == tp.flags
+
+
+@given(
+    st.floats(min_value=-1e8, max_value=1e8, allow_nan=False),
+    st.floats(min_value=-1e-6, max_value=1e-6, allow_nan=False),
+    st.floats(min_value=-1e8, max_value=1e8, allow_nan=False),
+    st.floats(min_value=-1e-6, max_value=1e-6, allow_nan=False),
+)
+@settings(max_examples=200, deadline=None)
+def test_dd_add_mul_vs_longdouble(ah, al, bh, bl):
+    """Double-double add/mul track x86 80-bit longdouble to well below
+    f64 ulp of the result (the dd pair carries ~32 digits; longdouble
+    ~19 — longdouble is the weaker link, so agreement to ~1e-17
+    relative pins both)."""
+    import numpy as np
+
+    from pint_tpu import dd
+
+    x = dd.from_2sum(ah, al)
+    y = dd.from_2sum(bh, bl)
+    xl = np.longdouble(ah) + np.longdouble(al)
+    yl = np.longdouble(bh) + np.longdouble(bl)
+
+    # bound relative to the INPUT magnitude: under catastrophic
+    # cancellation longdouble's own representation error of the inputs
+    # (its 64-bit mantissa) dominates, and dd is the more accurate side
+    scale = max(abs(xl), abs(yl), np.longdouble(1e-30))
+
+    s = dd.add(x, y)
+    sl = xl + yl
+    err = abs((np.longdouble(s.hi) + np.longdouble(s.lo)) - sl)
+    assert err <= scale * np.longdouble(4e-17) + np.longdouble(1e-30)
+
+    p = dd.mul(x, y)
+    pl = xl * yl
+    err = abs((np.longdouble(p.hi) + np.longdouble(p.lo)) - pl)
+    assert err <= (abs(pl) + scale**2 * np.longdouble(1e-16)) \
+        * np.longdouble(4e-17) + np.longdouble(1e-30)
+
+
+@given(
+    st.lists(
+        st.tuples(
+            st.floats(min_value=50001.0, max_value=59999.0,
+                      allow_nan=False),
+            st.floats(min_value=0.01, max_value=100.0, allow_nan=False),
+            st.floats(min_value=100.0, max_value=5000.0, allow_nan=False),
+            st.sampled_from(["gbt", "arecibo", "parkes", "@"]),
+            st.sampled_from([{}, {"be": "GUPPI"}, {"f": "L-wide", "pta": "NG"}]),
+        ),
+        min_size=1, max_size=12),
+)
+@settings(max_examples=40, deadline=None)
+def test_tim_write_read_roundtrip_random(tmp_path_factory, rows):
+    """TOAs -> write_TOA_file -> get_TOAs preserves times (to ns),
+    errors, frequencies, observatories, and flags."""
+    import numpy as np
+
+    from pint_tpu.toa import TOA, TOAs, get_TOAs
+
+    toalist = [TOA(int(m), (m - int(m)) * 86400.0, error_us=e,
+                   freq_mhz=f, obs=o, flags=dict(fl))
+               for m, e, f, o, fl in rows]
+    t = TOAs(toalist)
+    d = tmp_path_factory.mktemp("timrt")
+    path = str(d / "rt.tim")
+    t.write_TOA_file(path)
+    t2 = get_TOAs(path, usepickle=False)
+    assert len(t2) == len(t)
+    order = np.argsort(t.day * 86400.0 + t.sec)
+    order2 = np.argsort(t2.day * 86400.0 + t2.sec)
+    for i, j in zip(order, order2):
+        dt = (t.day[i] - t2.day[j]) * 86400.0 + (t.sec[i] - t2.sec[j])
+        assert abs(dt) < 1e-9
+        assert abs(t.error_us[i] - t2.error_us[j]) < 1e-6
+        assert abs(t.freq_mhz[i] - t2.freq_mhz[j]) < 1e-6
+        assert t.obs[i] == t2.obs[j]
+        for k, v in t.flags[i].items():
+            if k == "simulated":
+                continue
+            assert t2.flags[j].get(k) == v, (k, v, t2.flags[j])
