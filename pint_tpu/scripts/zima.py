@@ -22,6 +22,9 @@ def main(argv=None) -> int:
     p.add_argument("--freq", type=float, default=1400.0, help="MHz")
     p.add_argument("--obs", default="gbt")
     p.add_argument("--addnoise", action="store_true")
+    p.add_argument("--addcorrnoise", action="store_true",
+                   help="also draw the model's correlated-noise "
+                        "realizations (ECORR/red/DM/chromatic noise)")
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--inputtim", help="take MJDs/freqs/errors from this tim"
                    " file instead of a uniform grid")
@@ -32,13 +35,15 @@ def main(argv=None) -> int:
 
     model = get_model(args.parfile)
     if args.inputtim:
-        toas = make_fake_toas_fromtim(args.inputtim, model,
-                                      add_noise=args.addnoise, seed=args.seed)
+        toas = make_fake_toas_fromtim(
+            args.inputtim, model, add_noise=args.addnoise,
+            add_correlated_noise=args.addcorrnoise, seed=args.seed)
     else:
         toas = make_fake_toas_uniform(
             args.startMJD, args.startMJD + args.duration, args.ntoa, model,
             error_us=args.error, freq_mhz=args.freq, obs=args.obs,
-            add_noise=args.addnoise, seed=args.seed)
+            add_noise=args.addnoise,
+            add_correlated_noise=args.addcorrnoise, seed=args.seed)
     toas.write_TOA_file(args.timfile, name="zima")
     print(f"Wrote {len(toas)} simulated TOAs to {args.timfile}")
     return 0

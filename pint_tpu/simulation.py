@@ -45,10 +45,15 @@ def _apply_noise(toas: TOAs, model, rng, white=True, correlated=False):
     component's (basis, weights) pair — ECORR per-epoch offsets and
     power-law red-noise Fourier amplitudes — exactly as the GLS fit
     models them (reference: simulation.py add_correlated_noise)."""
+    prepared = model.prepare(toas) if (white or correlated) else None
     if white:
-        toas.sec = toas.sec + rng.standard_normal(len(toas)) * toas.error_us * 1e-6
+        # draw at the MODEL-scaled uncertainty (EFAC/EQUAD applied to
+        # mask-matched TOAs), so simulated data matches what the fitter
+        # whitens with (reference: simulation.py uses
+        # model.scaled_toa_uncertainty, not the raw tim errors)
+        sigma_us = np.asarray(prepared.scaled_sigma_us())
+        toas.sec = toas.sec + rng.standard_normal(len(toas)) * sigma_us * 1e-6
     if correlated:
-        prepared = model.prepare(toas)
         for comp in model.components.values():
             bw = getattr(comp, "basis_weight", None)
             if bw is None:
@@ -73,27 +78,34 @@ def _apply_noise(toas: TOAs, model, rng, white=True, correlated=False):
 def make_fake_toas_uniform(startMJD, endMJD, ntoas, model, error_us=1.0,
                            freq_mhz=1400.0, obs="gbt", add_noise=False,
                            add_correlated_noise=False,
-                           seed=None, iterations=4) -> TOAs:
+                           seed=None, iterations=4, flags=None) -> TOAs:
     """(reference: simulation.py::make_fake_toas_uniform)"""
     mjds = np.linspace(startMJD, endMJD, ntoas)
     return make_fake_toas_fromMJDs(mjds, model, error_us=error_us,
                                    freq_mhz=freq_mhz, obs=obs,
                                    add_noise=add_noise,
                                    add_correlated_noise=add_correlated_noise,
-                                   seed=seed, iterations=iterations)
+                                   seed=seed, iterations=iterations,
+                                   flags=flags)
 
 
 def make_fake_toas_fromMJDs(mjds, model, error_us=1.0, freq_mhz=1400.0,
                             obs="gbt", add_noise=False,
                             add_correlated_noise=False, seed=None,
-                            iterations=4) -> TOAs:
-    """(reference: simulation.py::make_fake_toas_fromMJDs)"""
+                            iterations=4, flags=None) -> TOAs:
+    """(reference: simulation.py::make_fake_toas_fromMJDs)
+
+    ``flags`` (dict) is applied to every TOA at creation, BEFORE any
+    correlated-noise draw — mask-selected noise (EFAC/ECORR "-f L")
+    only realizes on TOAs whose flags match at draw time.
+    """
     mjds = np.asarray(mjds, dtype=np.float64)
     freq = np.broadcast_to(np.asarray(freq_mhz, dtype=np.float64), mjds.shape)
     err = np.broadcast_to(np.asarray(error_us, dtype=np.float64), mjds.shape)
+    base_flags = {"simulated": "1", **{k: str(v) for k, v in (flags or {}).items()}}
     toalist = [
         TOA(int(m), (m - int(m)) * 86400.0, error_us=float(e), freq_mhz=float(f),
-            obs=obs, flags={"simulated": "1"})
+            obs=obs, flags=dict(base_flags))
         for m, e, f in zip(mjds, err, freq)
     ]
     ephem = "de440s"
@@ -117,7 +129,9 @@ def make_fake_toas_fromtim(timfile, model, add_noise=False,
     ephem = "de440s"
     if "EPHEM" in model.params and model.EPHEM.value:
         ephem = model.EPHEM.value.lower()
-    toas = TOAs(toalist, ephem=ephem)
+    planets = (bool(model.PLANET_SHAPIRO.value)
+               if "PLANET_SHAPIRO" in model.params else False)
+    toas = TOAs(toalist, ephem=ephem, planets=planets)
     _iterate_zero_residuals(toas, model)
     if add_noise or add_correlated_noise:
         _apply_noise(toas, model, np.random.default_rng(seed),

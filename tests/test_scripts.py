@@ -194,3 +194,27 @@ EPS2 -4.0e-7 1
     assert choose_model({"ECC", "OM", "H3", "STIG"}) == "DDH"
     assert choose_model({"ECC", "OM", "M2", "SINI"}) == "DD"
     assert choose_model({"ECC", "OM"}) == "BT"
+
+
+def test_zima_correlated_noise(tmp_path):
+    """--addcorrnoise draws the model's red-noise realization: the
+    written TOAs show excess low-frequency power over white noise."""
+    from pint_tpu.scripts import zima
+
+    par = tmp_path / "z.par"
+    par.write_text("PSR TZC\nRAJ 1:00:00\nDECJ 2:00:00\nF0 150.0 1\n"
+                   "F1 -1e-15 1\nPEPOCH 56100\nDM 12\n"
+                   "TNREDAMP -11.0\nTNREDGAM 4.0\nTNREDC 6\n")
+    out_w = tmp_path / "white.tim"
+    out_c = tmp_path / "corr.tim"
+    for out, extra in ((out_w, []), (out_c, ["--addcorrnoise"])):
+        assert zima.main([str(par), str(out), "--ntoa", "80",
+                          "--addnoise", "--seed", "5"] + extra) == 0
+    from pint_tpu.models import get_model
+    from pint_tpu.residuals import Residuals
+    from pint_tpu.toa import get_TOAs
+
+    m = get_model(str(par))
+    rw = np.asarray(Residuals(get_TOAs(str(out_w)), m).time_resids)
+    rc = np.asarray(Residuals(get_TOAs(str(out_c)), m).time_resids)
+    assert rc.std() > 3 * rw.std()
