@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import io
 import os
+import re
 import warnings
 
 from ..utils import interesting_lines, split_prefixed_name
@@ -28,6 +29,11 @@ ALIASES = {
     "CLK": "CLOCK", "T2EFAC": "EFAC", "T2EQUAD": "EQUAD", "NE1AU": "NE_SW",
     "SOLARN0": "NE_SW",
 }
+
+# FD1JUMP (canonical, reference: fdjump.py) or FDJUMP1 (tempo2 alias);
+# order 0 (a constant jump) is not a valid FD term and falls through to
+# the unrecognized-line report
+_FDJUMP_RE = re.compile(r"^FD([1-9]\d*)JUMP$|^FDJUMP([1-9]\d*)$")
 
 TOP_LEVEL_STR = ("PSR", "EPHEM", "CLOCK", "UNITS", "TIMEEPH", "T2CMETHOD",
                  "TZRSITE", "INFO", "DCOVFILE", "TRACK", "MODE", "EPHVER",
@@ -59,8 +65,12 @@ def get_model(parfile, allow_name_mixing=False) -> TimingModel:
     repeats = []
     for k, fields in entries:
         canon = ALIASES.get(k, k)
+        # FDJUMP3 (tempo2 spelling) -> FD3JUMP (canonical)
+        m_fdj = _FDJUMP_RE.match(canon)
+        if m_fdj:
+            canon = f"FD{m_fdj.group(1) or m_fdj.group(2)}JUMP"
         if canon in ("JUMP", "EFAC", "EQUAD", "ECORR", "DMEFAC", "DMEQUAD",
-                     "DMJUMP"):
+                     "DMJUMP") or m_fdj:
             repeats.append((canon, fields))
         else:
             keys[canon] = fields
@@ -147,6 +157,10 @@ def get_model(parfile, allow_name_mixing=False) -> TimingModel:
         model.add_component(PhaseOffset())
     if any(c == "JUMP" for c, _ in repeats):
         model.add_component(PhaseJump())
+    if any(_FDJUMP_RE.match(c) for c, _ in repeats):
+        from .frequency_dependent import FDJump
+
+        model.add_component(FDJump())
     if any(c == "DMJUMP" for c, _ in repeats):
         from .dispersion import DispersionJump
 
@@ -337,8 +351,15 @@ def get_model(parfile, allow_name_mixing=False) -> TimingModel:
     dmjump_comp = model.components.get("DispersionJump")
     noise_comp = model.components.get("ScaleToaError")
     ecorr_comp = model.components.get("EcorrNoise")
+    fdjump_comp = model.components.get("FDJump")
     for canon, fields in repeats:
-        if canon == "JUMP" and jump_comp is not None:
+        # canon is already canonical FD<n>JUMP here (first loop rewrites
+        # the FDJUMP<n> spelling), so only group(1) can match
+        m_fdj = _FDJUMP_RE.match(canon)
+        if m_fdj and fdjump_comp is not None:
+            p = fdjump_comp.add_fdjump(int(m_fdj.group(1)))
+            p.from_parfile_fields(fields)
+        elif canon == "JUMP" and jump_comp is not None:
             p = jump_comp.add_jump()
             p.from_parfile_fields(fields)
         elif canon == "DMJUMP" and dmjump_comp is not None:

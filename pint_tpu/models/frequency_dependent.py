@@ -1,14 +1,17 @@
 """Frequency-dependent (profile evolution) delay.
 
 (reference: src/pint/models/frequency_dependent.py::FD — FD1..FDn;
-delay = sum_i FDi * log(freq/1 GHz)^i, FDi in seconds.)
+delay = sum_i FDi * log(freq/1 GHz)^i, FDi in seconds; and
+src/pint/models/fdjump.py::FDJump — system-dependent FD<n>JUMP mask
+parameters with the FDJUMPLOG basis convention.)
 """
 
 from __future__ import annotations
 
 import numpy as np
 
-from .parameter import prefixParameter
+from .parameter import (boolParameter, maskParameter, pack_mask_values,
+                        prefixParameter)
 from .timing_model import DelayComponent
 
 
@@ -46,4 +49,76 @@ class FD(DelayComponent):
         for i in range(params["FD"].shape[0]):
             out = out + params["FD"][i] * lp
             lp = lp * logf
+        return jnp.where(jnp.isfinite(batch.freq_mhz), out, 0.0)
+
+
+class FDJump(DelayComponent):
+    """System-dependent profile-frequency-evolution jumps
+    (reference: src/pint/models/fdjump.py::FDJump).
+
+    ``FD<n>JUMP <mask> <value>`` adds ``value * b(nu)^n`` seconds of
+    delay to mask-selected TOAs, where the basis is
+    ``b = log(nu / 1 GHz)`` when ``FDJUMPLOG`` is true (PINT's FD
+    convention, the default) or ``b = nu / 1 GHz`` when false
+    (tempo2's linear convention). Multiple systems repeat the same
+    order with different masks, exactly like EFAC/EQUAD repetition.
+    """
+
+    category = "fdjump"
+    order = 41
+
+    def __init__(self):
+        super().__init__()
+        # parallel lists over mask-parameter slots
+        self.fdjump_names: list[str] = []
+        self.fdjump_orders: list[int] = []
+        p = boolParameter("FDJUMPLOG",
+                          description="log-frequency FDJUMP basis (Y) "
+                                      "vs linear tempo2 basis (N)")
+        p.value = True
+        self.add_param(p)
+
+    def add_fdjump(self, n, key="", key_value=(), value=0.0, frozen=False):
+        """Add one FD<n>JUMP mask parameter (order n >= 1)."""
+        n = int(n)
+        if n < 1:
+            raise ValueError(f"FDJUMP order must be >= 1, got {n}")
+        seq = sum(1 for o in self.fdjump_orders if o == n) + 1
+        name = f"FD{n}JUMP{seq}"
+        p = maskParameter(name, f"FD{n}JUMP", seq, units="s", frozen=frozen)
+        p.key = key
+        p.key_value = list(key_value)
+        p.value = value
+        self.add_param(p)
+        self.fdjump_names.append(name)
+        self.fdjump_orders.append(int(n))
+        return p
+
+    def device_slot(self, pname):
+        return "FDJUMP", self.fdjump_names.index(pname)
+
+    def pack(self, model, toas, prep, params0):
+        import jax.numpy as jnp
+
+        vals, masks = pack_mask_values(self, self.fdjump_names, toas)
+        params0["FDJUMP"] = vals
+        prep["fdjump_masks"] = jnp.asarray(masks)
+        prep["fdjump_orders"] = np.asarray(self.fdjump_orders, dtype=np.int64)
+        prep["fdjump_log"] = bool(self.FDJUMPLOG.value)
+
+    def delay(self, params, batch, prep, delay_accum):
+        import jax.numpy as jnp
+
+        vals = params["FDJUMP"]
+        if vals.shape[0] == 0:
+            return jnp.zeros_like(batch.freq_mhz)
+        nu = batch.freq_mhz / 1000.0  # GHz
+        if prep["fdjump_log"]:
+            b = jnp.log(nu)
+            b = jnp.where(jnp.isfinite(b), b, 0.0)
+        else:
+            b = jnp.where(jnp.isfinite(nu), nu, 0.0)
+        orders = prep["fdjump_orders"]  # static host ints
+        basis = jnp.stack([b ** int(n) for n in orders])  # (P, N)
+        out = (vals[:, None] * prep["fdjump_masks"] * basis).sum(axis=0)
         return jnp.where(jnp.isfinite(batch.freq_mhz), out, 0.0)

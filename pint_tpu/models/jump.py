@@ -6,9 +6,7 @@ jump_phase = -F0 * JUMP over the selected TOAs.)
 
 from __future__ import annotations
 
-import numpy as np
-
-from .parameter import maskParameter
+from .parameter import maskParameter, pack_mask_values
 from .timing_model import DelayComponent, PhaseComponent
 
 
@@ -36,15 +34,9 @@ class PhaseJump(PhaseComponent):
     def pack(self, model, toas, prep, params0):
         import jax.numpy as jnp
 
-        if not self.jump_ids:
-            params0["JUMP"] = np.zeros(0)
-            prep["jump_masks"] = jnp.zeros((0, len(toas)))
-            return
-        vals = np.array([getattr(self, f"JUMP{i}").value or 0.0
-                         for i in self.jump_ids])
+        vals, masks = pack_mask_values(
+            self, [f"JUMP{i}" for i in self.jump_ids], toas)
         params0["JUMP"] = vals
-        masks = np.stack([getattr(self, f"JUMP{i}").resolve_mask(toas)
-                          for i in self.jump_ids]).astype(np.float64)
         prep["jump_masks"] = jnp.asarray(masks)
 
     def phase(self, params, batch, prep, delay_total):
@@ -80,15 +72,9 @@ class DelayJump(DelayComponent):
     def pack(self, model, toas, prep, params0):
         import jax.numpy as jnp
 
-        if not self.jump_ids:
-            params0["DJUMP"] = np.zeros(0)
-            prep["djump_masks"] = jnp.zeros((0, len(toas)))
-            return
-        vals = np.array([getattr(self, f"DJUMP{i}").value or 0.0
-                         for i in self.jump_ids])
+        vals, masks = pack_mask_values(
+            self, [f"DJUMP{i}" for i in self.jump_ids], toas)
         params0["DJUMP"] = vals
-        masks = np.stack([getattr(self, f"DJUMP{i}").resolve_mask(toas)
-                          for i in self.jump_ids]).astype(np.float64)
         prep["djump_masks"] = jnp.asarray(masks)
 
     def delay(self, params, batch, prep, delay_accum):

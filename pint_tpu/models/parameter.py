@@ -384,3 +384,18 @@ class funcParameter(floatParameter):
 
     def as_parfile_line(self):
         return ""  # derived values never round-trip into par files
+
+
+def pack_mask_values(component, names, toas):
+    """Shared pack-time evaluation for a component's maskParameter
+    slots: returns (values, masks) as float64 arrays of shapes (P,)
+    and (P, n_toa). Empty name list gives ((0,), (0, n_toa)) so device
+    code can contract unconditionally. Used by PhaseJump/DelayJump/
+    FDJump and any future mask-family component."""
+    if not names:
+        return (np.zeros(0), np.zeros((0, len(toas))))
+    vals = np.array([getattr(component, nm).value or 0.0 for nm in names],
+                    dtype=np.float64)
+    masks = np.stack([getattr(component, nm).resolve_mask(toas)
+                      for nm in names]).astype(np.float64)
+    return vals, masks

@@ -203,3 +203,45 @@ def test_design_matrix_matches_numeric():
         col = np.asarray(M[:, j])
         scale = max(np.max(np.abs(num)), 1e-30)
         np.testing.assert_allclose(col / scale, num / scale, atol=5e-5)
+
+
+def test_fdjump_masked_delay_both_conventions():
+    """FD<n>JUMP adds value*log(nu/GHz)^n seconds only on mask-selected
+    TOAs; FDJUMPLOG N switches to the linear tempo2 basis (reference:
+    fdjump.py::FDJump). Both FD1JUMP and FDJUMP1 spellings parse."""
+    toas = _toas(get_model(BASE))
+    freqs = np.asarray(toas.freq_mhz)
+    sel = (freqs >= 1000) & (freqs <= 1500)
+    lf = np.log(freqs / 1000.0)
+
+    m = get_model(BASE + "FD1JUMP freq 1000 1500 3e-5 1\n"
+                  "FDJUMP2 freq 1000 1500 -1e-5\n")
+    assert "FDJump" in m.components
+    assert m.FDJUMPLOG.value is True
+    d = (np.asarray(m.prepare(toas).delay())
+         - np.asarray(get_model(BASE).prepare(toas).delay()))
+    expect = sel * (3e-5 * lf - 1e-5 * lf**2)
+    np.testing.assert_allclose(d, expect, atol=1e-14)
+
+    m2 = get_model(BASE + "FDJUMPLOG N\nFD1JUMP freq 1000 1500 3e-5\n")
+    d2 = (np.asarray(m2.prepare(toas).delay())
+          - np.asarray(get_model(BASE).prepare(toas).delay()))
+    np.testing.assert_allclose(d2, sel * 3e-5 * (freqs / 1000.0), atol=1e-14)
+
+
+def test_fdjump_fit_recovery_and_roundtrip():
+    true = get_model(BASE + "FD1JUMP freq 1000 1500 2e-5\n")
+    toas = _toas(true, n=100, seed=7)
+    fit = get_model(BASE + "FD1JUMP freq 1000 1500 0 1\n")
+    fit.free_params = ["FD1JUMP1"]
+    f = WLSFitter(toas, fit)
+    f.fit_toas()
+    assert abs(f.model.FD1JUMP1.value - 2e-5) < 2e-6
+    # par round-trip preserves the mask, value, and basis convention
+    text = f.model.as_parfile()
+    assert "FD1JUMP" in text and "freq 1000 1500" in text
+    m2 = get_model(text)
+    assert abs(m2.FD1JUMP1.value - f.model.FD1JUMP1.value) < 1e-12
+    d1 = np.asarray(f.model.prepare(toas).delay())
+    d2 = np.asarray(m2.prepare(toas).delay())
+    np.testing.assert_allclose(d1, d2, atol=1e-13)
