@@ -25,6 +25,13 @@ def main(argv=None) -> int:
     p.add_argument("--addcorrnoise", action="store_true",
                    help="also draw the model's correlated-noise "
                         "realizations (ECORR/red/DM/chromatic noise)")
+    p.add_argument("--wideband", action="store_true",
+                   help="attach per-TOA wideband DM measurements "
+                        "(-pp_dm/-pp_dme flags) at the model DM")
+    p.add_argument("--dmerror", type=float, default=1e-4,
+                   help="wideband DM uncertainty, pc cm^-3")
+    p.add_argument("--fuzzdays", type=float, default=0.0,
+                   help="jitter the uniform epochs by up to +/-this/2 days")
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--inputtim", help="take MJDs/freqs/errors from this tim"
                    " file instead of a uniform grid")
@@ -37,13 +44,16 @@ def main(argv=None) -> int:
     if args.inputtim:
         toas = make_fake_toas_fromtim(
             args.inputtim, model, add_noise=args.addnoise,
-            add_correlated_noise=args.addcorrnoise, seed=args.seed)
+            add_correlated_noise=args.addcorrnoise, seed=args.seed,
+            wideband=args.wideband, dm_error_pccm3=args.dmerror)
     else:
         toas = make_fake_toas_uniform(
             args.startMJD, args.startMJD + args.duration, args.ntoa, model,
             error_us=args.error, freq_mhz=args.freq, obs=args.obs,
             add_noise=args.addnoise,
-            add_correlated_noise=args.addcorrnoise, seed=args.seed)
+            add_correlated_noise=args.addcorrnoise, seed=args.seed,
+            wideband=args.wideband, dm_error_pccm3=args.dmerror,
+            fuzz_days=args.fuzzdays)
     toas.write_TOA_file(args.timfile, name="zima")
     print(f"Wrote {len(toas)} simulated TOAs to {args.timfile}")
     return 0

@@ -75,30 +75,82 @@ def _apply_noise(toas: TOAs, model, rng, white=True, correlated=False):
     toas.compute_posvels()
 
 
+def _check_wideband_args(model, dm_error_pccm3):
+    """Fail fast (before the zero-residual iteration) on wideband
+    requests the model/arguments cannot satisfy."""
+    if "DispersionDM" not in model.components:
+        raise ValueError(
+            "wideband=True needs a dispersion model (DM in the par "
+            "file) to predict per-TOA DM values")
+    if not (dm_error_pccm3 > 0):
+        raise ValueError(
+            f"dm_error_pccm3 must be > 0 (got {dm_error_pccm3}); the "
+            "wideband fit whitens DM residuals by this uncertainty")
+
+
+def _add_wideband_dm(toas: TOAs, model, rng, dm_error_pccm3, add_noise):
+    """Attach wideband DM measurements (-pp_dm/-pp_dme flags) equal to
+    the model's DM prediction, optionally with Gaussian scatter at the
+    stated DM uncertainty (reference: simulation.py wideband=True —
+    fake TOAs carry pp_dm/pp_dme so WidebandTOAFitter has DM data)."""
+    from .residuals import wideband_dm_model
+
+    prepared = model.prepare(toas)
+    dm_model = np.asarray(wideband_dm_model(model, prepared.params0,
+                                            prepared.prep))
+    dm_obs = dm_model.copy()
+    if add_noise:
+        dm_obs = dm_obs + rng.standard_normal(len(toas)) * dm_error_pccm3
+    for fl, dv in zip(toas.flags, dm_obs):
+        fl["pp_dm"] = repr(float(dv))
+        fl["pp_dme"] = repr(float(dm_error_pccm3))
+
+
 def make_fake_toas_uniform(startMJD, endMJD, ntoas, model, error_us=1.0,
                            freq_mhz=1400.0, obs="gbt", add_noise=False,
                            add_correlated_noise=False,
-                           seed=None, iterations=4, flags=None) -> TOAs:
-    """(reference: simulation.py::make_fake_toas_uniform)"""
+                           seed=None, iterations=4, flags=None,
+                           wideband=False, dm_error_pccm3=1e-4,
+                           fuzz_days=0.0) -> TOAs:
+    """(reference: simulation.py::make_fake_toas_uniform — ``fuzz``
+    jitters the nominally uniform epochs by up to +/-fuzz_days/2 so
+    simulated cadences don't alias)."""
     mjds = np.linspace(startMJD, endMJD, ntoas)
+    if fuzz_days:
+        fuzz_rng = np.random.default_rng(None if seed is None else seed + 1)
+        mjds = np.sort(mjds + fuzz_rng.uniform(-fuzz_days / 2, fuzz_days / 2,
+                                               ntoas))
     return make_fake_toas_fromMJDs(mjds, model, error_us=error_us,
                                    freq_mhz=freq_mhz, obs=obs,
                                    add_noise=add_noise,
                                    add_correlated_noise=add_correlated_noise,
                                    seed=seed, iterations=iterations,
-                                   flags=flags)
+                                   flags=flags, wideband=wideband,
+                                   dm_error_pccm3=dm_error_pccm3)
+
+
+def make_fake_toas(*args, **kw) -> TOAs:
+    """Alias for :func:`make_fake_toas_uniform`
+    (reference: simulation.py historical make_fake_toas name)."""
+    return make_fake_toas_uniform(*args, **kw)
 
 
 def make_fake_toas_fromMJDs(mjds, model, error_us=1.0, freq_mhz=1400.0,
                             obs="gbt", add_noise=False,
                             add_correlated_noise=False, seed=None,
-                            iterations=4, flags=None) -> TOAs:
+                            iterations=4, flags=None,
+                            wideband=False, dm_error_pccm3=1e-4) -> TOAs:
     """(reference: simulation.py::make_fake_toas_fromMJDs)
 
     ``flags`` (dict) is applied to every TOA at creation, BEFORE any
     correlated-noise draw — mask-selected noise (EFAC/ECORR "-f L")
     only realizes on TOAs whose flags match at draw time.
+    ``wideband=True`` attaches per-TOA DM measurements as
+    -pp_dm/-pp_dme flags at the model's DM (scattered by
+    ``dm_error_pccm3`` when ``add_noise``).
     """
+    if wideband:
+        _check_wideband_args(model, dm_error_pccm3)
     mjds = np.asarray(mjds, dtype=np.float64)
     freq = np.broadcast_to(np.asarray(freq_mhz, dtype=np.float64), mjds.shape)
     err = np.broadcast_to(np.asarray(error_us, dtype=np.float64), mjds.shape)
@@ -114,17 +166,23 @@ def make_fake_toas_fromMJDs(mjds, model, error_us=1.0, freq_mhz=1400.0,
     planets = bool(model.PLANET_SHAPIRO.value) if "PLANET_SHAPIRO" in model.params else False
     toas = TOAs(toalist, ephem=ephem, planets=planets)
     _iterate_zero_residuals(toas, model, iterations=iterations)
+    rng = np.random.default_rng(seed)
     if add_noise or add_correlated_noise:
-        _apply_noise(toas, model, np.random.default_rng(seed),
+        _apply_noise(toas, model, rng,
                      white=add_noise, correlated=add_correlated_noise)
+    if wideband:
+        _add_wideband_dm(toas, model, rng, dm_error_pccm3, add_noise)
     return toas
 
 
 def make_fake_toas_fromtim(timfile, model, add_noise=False,
-                           add_correlated_noise=False, seed=None) -> TOAs:
+                           add_correlated_noise=False, seed=None,
+                           wideband=False, dm_error_pccm3=1e-4) -> TOAs:
     """(reference: simulation.py::make_fake_toas_fromtim)"""
     from .toa import read_tim_file
 
+    if wideband:
+        _check_wideband_args(model, dm_error_pccm3)
     toalist, _ = read_tim_file(str(timfile))
     ephem = "de440s"
     if "EPHEM" in model.params and model.EPHEM.value:
@@ -133,9 +191,12 @@ def make_fake_toas_fromtim(timfile, model, add_noise=False,
                if "PLANET_SHAPIRO" in model.params else False)
     toas = TOAs(toalist, ephem=ephem, planets=planets)
     _iterate_zero_residuals(toas, model)
+    rng = np.random.default_rng(seed)
     if add_noise or add_correlated_noise:
-        _apply_noise(toas, model, np.random.default_rng(seed),
+        _apply_noise(toas, model, rng,
                      white=add_noise, correlated=add_correlated_noise)
+    if wideband:
+        _add_wideband_dm(toas, model, rng, dm_error_pccm3, add_noise)
     return toas
 
 

@@ -229,3 +229,30 @@ def test_wideband_gls_with_red_noise_and_ecorr():
     fl = WidebandLMFitter(t, m4)
     fl.fit_toas(maxiter=10)
     assert abs(fl.model.F0.value - m.F0.value) < 1e-10
+
+
+def test_simulated_wideband_roundtrip(tmp_path):
+    """zima --wideband writes -pp_dm/-pp_dme flags at the model DM;
+    a WidebandTOAFitter on the written tim recovers a perturbed DM
+    (reference: zima --wideband + simulation.py wideband TOAs)."""
+    from pint_tpu.scripts import zima
+    from pint_tpu.toa import get_TOAs
+
+    par = tmp_path / "wb.par"
+    par.write_text("PSR TWB\nRAJ 2:00:00\nDECJ 3:00:00\nF0 250.0 1\n"
+                   "F1 -4e-16 1\nPEPOCH 55500\nDM 31.5 1\n")
+    tim = tmp_path / "wb.tim"
+    assert zima.main([str(par), str(tim), "--ntoa", "60", "--startMJD",
+                      "55000", "--duration", "800", "--addnoise",
+                      "--wideband", "--dmerror", "2e-4",
+                      "--seed", "4"]) == 0
+    t = get_TOAs(str(tim))
+    dms = [f.get("pp_dm") for f in t.flags]
+    assert all(d is not None for d in dms)
+    assert abs(np.mean([float(d) for d in dms]) - 31.5) < 1e-3
+    m = get_model(str(par))
+    m.DM.value += 3e-3  # perturb; DM data must pull it back
+    f = WidebandTOAFitter(t, m)
+    f.fit_toas()
+    assert abs(f.model.DM.value - 31.5) < 5 * f.model.DM.uncertainty
+    assert f.model.DM.uncertainty < 1e-4
