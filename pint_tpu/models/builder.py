@@ -123,10 +123,15 @@ def get_model(parfile, allow_name_mixing=False) -> TimingModel:
     if "DMX" in keys or any(k.startswith("DMX_") for k in keys):
         model.add_component(DispersionDMX())
     model.add_component(SolarSystemShapiro())
-    if "NE_SW" in keys or "SWM" in keys:
+    has_tnsw = any(k.startswith("TNSW") for k in keys)
+    if "NE_SW" in keys or "SWM" in keys or has_tnsw:
         from .solar_wind import SolarWindDispersion
 
         model.add_component(SolarWindDispersion())
+    if has_tnsw:
+        from .noise import PLSWNoise
+
+        model.add_component(PLSWNoise())
     if "CORRECT_TROPOSPHERE" in keys:
         from .troposphere import TroposphereDelay
 

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..constants import SECS_PER_DAY
+from ..constants import C_M_S, DMconst, SECS_PER_DAY
 from .parameter import maskParameter, floatParameter
 from .timing_model import Component
 
@@ -259,6 +259,7 @@ class _PLScaledNoise(NoiseComponent):
 
     F_REF_MHZ = 1400.0
     AMP = GAM = NHARM = PREP = None  # subclass config
+    PHI_SCALE = 1.0  # basis-weight unit conversion (see PLSWNoise)
 
     def __init__(self):
         super().__init__()
@@ -277,18 +278,22 @@ class _PLScaledNoise(NoiseComponent):
     def _alpha(self, model):
         raise NotImplementedError
 
+    def _row_scale(self, model, toas, prep, params0):
+        """Per-TOA multiplier on the Fourier basis rows. Default: the
+        chromatic factor (f_ref/nu)^alpha; infinite-frequency TOAs see
+        none of this noise."""
+        alpha = self._alpha(model)
+        with np.errstate(divide="ignore"):
+            return np.where(np.isfinite(toas.freq_mhz),
+                            (self.F_REF_MHZ / toas.freq_mhz) ** alpha, 0.0)
+
     def pack(self, model, toas, prep, params0):
         import jax.numpy as jnp
 
         F, freqs, tspan_s = fourier_basis(
             toas, int(getattr(self, self.NHARM).value or 30))
-        alpha = self._alpha(model)
-        # chromatic scaling; infinite-frequency TOAs see none of this
-        # noise
-        with np.errstate(divide="ignore"):
-            chrom = np.where(np.isfinite(toas.freq_mhz),
-                             (self.F_REF_MHZ / toas.freq_mhz) ** alpha, 0.0)
-        prep[f"{self.PREP}_F"] = jnp.asarray(F * chrom[:, None])
+        scale = self._row_scale(model, toas, prep, params0)
+        prep[f"{self.PREP}_F"] = jnp.asarray(F * scale[:, None])
         prep[f"{self.PREP}_freqs"] = jnp.asarray(freqs)
         prep[f"{self.PREP}_tspan_s"] = tspan_s
         for pname in (self.AMP, self.GAM):
@@ -297,7 +302,7 @@ class _PLScaledNoise(NoiseComponent):
     def basis_weight(self, params, prep):
         A = 10.0 ** params[self.AMP]
         gamma = params[self.GAM]
-        return prep[f"{self.PREP}_F"], powerlaw_phi(
+        return prep[f"{self.PREP}_F"], self.PHI_SCALE * powerlaw_phi(
             A, gamma, prep[f"{self.PREP}_freqs"],
             prep[f"{self.PREP}_tspan_s"])
 
@@ -338,3 +343,55 @@ class PLChromNoise(_PLScaledNoise):
         if cm is not None and cm.TNCHROMIDX.value is not None:
             return float(cm.TNCHROMIDX.value)
         return 4.0
+
+
+class PLSWNoise(_PLScaledNoise):
+    """Power-law solar-wind (NE_SW) noise (reference:
+    noise_model.py::PLSWNoise *(version-dependent; Susarla et al.
+    2024 stochastic solar-wind model)*).
+
+    A Gaussian process on the solar-wind electron density NE_SW(t):
+    Fourier basis rows are scaled per TOA by the time-delay signature
+    of a unit NE_SW change,
+
+        d(delay)/d(NE_SW) = DMconst * geom_pc(t) / nu^2   [s / cm^-3]
+
+    (geometry from SolarWindDispersion's line-of-sight integral, so
+    the noise peaks at solar conjunction and scales as 1/nu^2).
+    TNSWAMP is the log10 amplitude of the NE_SW power law in the
+    enterprise convention with NE_SW in cm^-3 (PHI_SCALE removes the
+    s^2 -> us^2 factor powerlaw_phi applies for dimensionless bases:
+    here the basis itself carries us per cm^-3, so the weights stay in
+    (cm^-3)^2 and the covariance comes out in us^2).
+    Params TNSWAMP (log10), TNSWGAM, TNSWC.
+    """
+
+    category = "pl_sw_noise"
+    order = 95
+    AMP, GAM, NHARM, PREP = "TNSWAMP", "TNSWGAM", "TNSWC", "swrn"
+    PHI_SCALE = 1e-12
+
+    def _row_scale(self, model, toas, prep, params0):
+        astrom = next((c for c in model.delay_components()
+                       if c.category == "astrometry"), None)
+        has_sw = ("SolarWindDispersion" in model.components
+                  or "SolarWindDispersionX" in model.components)
+        if not has_sw or astrom is None:
+            raise ValueError(
+                "PLSWNoise needs a solar-wind component (NE_SW or SWX) "
+                "and an astrometry component to evaluate the "
+                "line-of-sight geometry")
+        # geometry per unit NE_SW at the start-of-fit position (static
+        # during a fit, like the basis span): DM_sw/NE_SW in pc cm^-3
+        # per cm^-3, times DMconst/nu^2 -> seconds, times 1e6 -> us.
+        # The geometry formula's one home is solar_wind.py (p=2 reduces
+        # exactly to the (pi - theta)/(r sin theta) factor).
+        from .solar_wind import solar_wind_geometry_p
+
+        n_hat = np.asarray(astrom.ssb_to_psb_xyz(params0, prep))
+        sun_ls = toas.obs_sun.pos / C_M_S
+        geom_pc = np.asarray(solar_wind_geometry_p(sun_ls, n_hat, 2.0))
+        with np.errstate(divide="ignore"):
+            per_f2 = np.where(np.isfinite(toas.freq_mhz),
+                              1.0 / np.square(toas.freq_mhz), 0.0)
+        return 1e6 * DMconst * geom_pc * per_f2

@@ -181,3 +181,73 @@ def test_ecorr_average():
     r2 = Residuals(t, m2)
     avg2 = r2.ecorr_average()
     assert len(avg2["mjds"]) == len(t)
+
+
+SW_PAR = """
+PSR TESTSW
+RAJ 12:00:00.0
+DECJ 2:00:00.0
+F0 218.8 1
+F1 -4e-16 1
+PEPOCH 55500
+DM 15.99 1
+NE_SW 8.0
+"""
+
+
+def test_plswnoise_basis_is_solar_wind_signature():
+    """PLSWNoise basis rows equal the plain Fourier basis scaled by
+    the per-TOA delay of a unit NE_SW change (reference:
+    noise_model.py::PLSWNoise — solar-wind GP rides the line-of-sight
+    geometry and 1/nu^2)."""
+    from pint_tpu.models.noise import fourier_basis
+
+    par = SW_PAR + "TNSWAMP 0.0\nTNSWGAM 2.0\nTNSWC 10\n"
+    m = get_model(par)
+    assert "PLSWNoise" in m.components
+    rng = np.random.default_rng(5)
+    mjds = np.sort(rng.uniform(55000, 55700, 60))
+    freqs = np.where(np.arange(60) % 2, 800.0, 400.0)
+    t = make_fake_toas_fromMJDs(mjds, m, error_us=0.5, freq_mhz=freqs,
+                                obs="gbt", add_noise=False, iterations=1)
+    prep = m.prepare(t)
+    comp = m.components["PLSWNoise"]
+    F, phi = comp.basis_weight(prep.params0, prep.prep)
+    assert F.shape == (60, 20)
+    phi = np.asarray(phi)
+    assert (phi > 0).all() and phi[0] > phi[-2]
+    # unit-NE_SW delay from the SolarWindDispersion component itself
+    d1 = np.asarray(get_model(par.replace("NE_SW 8.0", "NE_SW 1.0")).prepare(t).delay())
+    d0 = np.asarray(get_model(par.replace("NE_SW 8.0", "NE_SW 0.0")).prepare(t).delay())
+    scale_us = 1e6 * (d1 - d0)
+    F0, _, _ = fourier_basis(t, 10)
+    # rtol limited by cancellation in d1-d0 (full-pipeline delays)
+    np.testing.assert_allclose(np.asarray(F), F0 * scale_us[:, None],
+                               rtol=1e-6, atol=1e-12)
+
+
+def test_plswnoise_gls_whitening_roundtrip():
+    """Simulated PLSWNoise realizations are absorbed by the GLS basis:
+    whitened chi2 near dof, and the par round-trips the TNSW params."""
+    par = SW_PAR + "TNSWAMP -5.5\nTNSWGAM 2.0\nTNSWC 8\n"
+    m = get_model(par)
+    rng = np.random.default_rng(9)
+    mjds = np.sort(rng.uniform(55000, 55700, 80))
+    freqs = np.where(np.arange(80) % 2, 800.0, 400.0)
+    t = make_fake_toas_fromMJDs(mjds, m, error_us=0.3, freq_mhz=freqs,
+                                obs="gbt", add_noise=True,
+                                add_correlated_noise=True, seed=9,
+                                iterations=2)
+    # the SW draw must actually perturb the TOAs beyond white noise
+    r = np.asarray(Residuals(t, m, subtract_mean=False).time_resids)
+    assert r.std() > 1.0e-6  # white floor is 0.3 us
+    f = DownhillGLSFitter(t, copy.deepcopy(m))
+    chi2 = f.fit_toas()
+    dof = len(t) - len(m.free_params) - 1
+    assert chi2 / dof < 2.5
+    assert f.noise_ampls is not None and np.abs(f.noise_ampls).max() > 0
+    # round-trip
+    m2 = get_model(f.model.as_parfile())
+    assert "PLSWNoise" in m2.components
+    assert m2.TNSWAMP.value == pytest.approx(-5.5)
+    assert m2.TNSWGAM.value == pytest.approx(2.0)
