@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import numpy as np
 
-from .mjd import Epochs
 from .toa import TOA, TOAs
 from .residuals import Residuals
 
@@ -27,12 +26,7 @@ def _iterate_zero_residuals(toas: TOAs, model, iterations=4):
         toas.compute_posvels()
         r = Residuals(toas, model, subtract_mean=False, track_mode="nearest")
         shift = np.asarray(r.calc_time_resids())
-        toas.sec = toas.sec - shift
-        norm = Epochs(toas.day, toas.sec, "utc").normalized()
-        toas.day, toas.sec = norm.day, norm.sec
-        toas.tdb = None
-        toas.ssb_obs = None
-        toas._clock_applied = False
+        toas.adjust_times(-shift)
     toas.apply_clock_corrections()
     toas.compute_TDBs()
     toas.compute_posvels()
@@ -46,13 +40,14 @@ def _apply_noise(toas: TOAs, model, rng, white=True, correlated=False):
     power-law red-noise Fourier amplitudes — exactly as the GLS fit
     models them (reference: simulation.py add_correlated_noise)."""
     prepared = model.prepare(toas) if (white or correlated) else None
+    delta_s = np.zeros(len(toas))
     if white:
         # draw at the MODEL-scaled uncertainty (EFAC/EQUAD applied to
         # mask-matched TOAs), so simulated data matches what the fitter
         # whitens with (reference: simulation.py uses
         # model.scaled_toa_uncertainty, not the raw tim errors)
         sigma_us = np.asarray(prepared.scaled_sigma_us())
-        toas.sec = toas.sec + rng.standard_normal(len(toas)) * sigma_us * 1e-6
+        delta_s += rng.standard_normal(len(toas)) * sigma_us * 1e-6
     if correlated:
         for comp in model.components.values():
             bw = getattr(comp, "basis_weight", None)
@@ -64,12 +59,8 @@ def _apply_noise(toas: TOAs, model, rng, white=True, correlated=False):
             if B.size == 0:
                 continue
             amps_us = rng.standard_normal(B.shape[1]) * np.sqrt(w)
-            toas.sec = toas.sec + (B @ amps_us) * 1e-6
-    norm = Epochs(toas.day, toas.sec, "utc").normalized()
-    toas.day, toas.sec = norm.day, norm.sec
-    toas.tdb = None
-    toas.ssb_obs = None
-    toas._clock_applied = False
+            delta_s += (B @ amps_us) * 1e-6
+    toas.adjust_times(delta_s)
     toas.apply_clock_corrections()
     toas.compute_TDBs()
     toas.compute_posvels()

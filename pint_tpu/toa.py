@@ -233,7 +233,13 @@ class TOAs:
     def mask(self, condition: np.ndarray) -> "TOAs":
         if self._flags_raw is not None:
             self.flags  # materialize before subsetting
-        out = TOAs([], ephem=self.ephem, planets=self.planets)
+        out = TOAs([], ephem=self.ephem, planets=self.planets,
+                   include_gps=self.include_gps,
+                   include_bipm=self.include_bipm,
+                   bipm_version=self.bipm_version)
+        out.include_site_clock = self.include_site_clock
+        out.commands = list(self.commands)
+        out.filename = self.filename
         for attr in ("day", "sec", "error_us", "freq_mhz", "obs", "clock_corr_s"):
             setattr(out, attr, getattr(self, attr)[condition])
         out._flags = (None if self._flags is None else
@@ -250,6 +256,47 @@ class TOAs:
             out.planet_pos = {p: v[condition] for p, v in self.planet_pos.items()}
         out._clock_applied = self._clock_applied
         return out
+
+    def select(self, condition: np.ndarray):
+        """In-place subset with a restore stack (reference:
+        toa.py::TOAs.select — the stateful counterpart of the
+        functional :meth:`mask`; each call pushes the current state,
+        :meth:`unselect` pops back to it)."""
+        stack = getattr(self, "_selection", [])
+        saved = dict(self.__dict__)
+        if self._flags is not None:
+            # snapshot flag dicts: mask() reuses the dict objects, so
+            # without this a flag edit while selected would leak into
+            # the restored state
+            saved["_flags"] = [dict(f) for f in self._flags]
+        sub = self.mask(np.asarray(condition, dtype=bool))
+        self.__dict__ = dict(sub.__dict__)
+        self._selection = stack + [saved]
+
+    def unselect(self):
+        """Undo the last :meth:`select` (reference: toa.py::TOAs.unselect)."""
+        stack = getattr(self, "_selection", [])
+        if not stack:
+            raise ValueError("no prior TOAs.select() state to restore")
+        self.__dict__ = stack[-1]
+
+    def print_summary(self):
+        """(reference: toa.py::TOAs.print_summary)"""
+        print(self.get_summary())
+
+    def adjust_times(self, delta_sec):
+        """Shift the UTC TOA times in place by ``delta_sec`` (scalar or
+        per-TOA array) and invalidate every derived column (TDB,
+        posvels, clock state) so they recompute lazily (reference:
+        toa.py::TOAs.adjust_TOAs)."""
+        self.sec = self.sec + np.asarray(delta_sec)
+        norm = Epochs(self.day, self.sec, "utc").normalized()
+        self.day, self.sec = norm.day, norm.sec
+        self.tdb = None
+        self.ssb_obs = None
+        self.obs_sun = None
+        self.planet_pos = {}
+        self._clock_applied = False
 
     def get_flag_value(self, flag: str, fill=""):
         if self._flags_raw is not None:

@@ -324,3 +324,34 @@ def test_tim_command_state_shared_with_includes(tmp_path):
     assert tags == ["1", None, "2", None]
     # FORMAT 1 carries into the child (it parsed as tempo2)
     assert toas[2].flags["name"] == "t4"
+
+
+def test_toas_select_unselect_stack(tmp_path):
+    """Stateful select/unselect with nesting (reference:
+    toa.py::TOAs.select/unselect): each select subsets in place,
+    each unselect restores the previous state exactly."""
+    from pint_tpu.toa import TOAs
+
+    t = TOAs.from_arrays(np.arange(55000, 55020), np.linspace(0, 600, 20),
+                         error_us=1.0, freq_mhz=1400.0, obs="gbt")
+    for i, f in enumerate(t.flags):
+        f["grp"] = "A" if i < 12 else "B"
+    n0 = len(t)
+    t.select(np.array([f["grp"] == "A" for f in t.flags]))
+    assert len(t) == 12
+    t.select(t.get_mjds() < 55006)
+    assert len(t) == 6
+    # flag edits while selected must NOT leak into the restored state
+    t.flags[0]["cut"] = "snr"
+    t.unselect()
+    assert len(t) == 12 and all(f["grp"] == "A" for f in t.flags)
+    assert "cut" not in t.flags[0]
+    t.unselect()
+    assert len(t) == n0
+    with pytest.raises(ValueError):
+        t.unselect()
+    # clock-chain configuration survives select (mask used to reset it)
+    t.include_site_clock = False
+    t.bipm_version = "BIPM2015"
+    t.select(t.get_mjds() < 55010)
+    assert t.include_site_clock is False and t.bipm_version == "BIPM2015"
