@@ -58,8 +58,14 @@ def parse_parfile(parfile) -> list[tuple[str, list[str]]]:
     return out
 
 
-def get_model(parfile, allow_name_mixing=False) -> TimingModel:
-    """(reference: model_builder.py::get_model)"""
+def get_model(parfile, allow_name_mixing=False, allow_tcb=False) -> TimingModel:
+    """(reference: model_builder.py::get_model)
+
+    ``allow_tcb``: a par file with UNITS TCB raises by default (the
+    framework computes in TDB); ``True`` converts it to TDB on load
+    with a warning; ``"raw"`` keeps the TCB values untouched
+    (reference: model_builder.py allow_tcb semantics).
+    """
     entries = parse_parfile(parfile)
     keys = {}
     repeats = []
@@ -380,14 +386,34 @@ def get_model(parfile, allow_name_mixing=False) -> TimingModel:
         warnings.warn(f"unrecognized par lines: {sorted(unrecognized)}")
     model.setup()
     model.validate()
+    units = ((model.UNITS.value or "").upper()
+             if "UNITS" in model.params else "")
+    if units in ("TCB", "SI"):  # tempo2 'UNITS SI' = TCB timescale
+        if allow_tcb == "raw":
+            pass
+        elif allow_tcb:
+            warnings.warn("par file is in TCB units; converting to TDB "
+                          "on load (reference: model_builder.py allow_tcb)")
+            from .tcb_conversion import convert_tcb_tdb
+
+            convert_tcb_tdb(model)
+        else:
+            raise ValueError(
+                "par file has UNITS TCB but the framework computes in "
+                "TDB. Pass allow_tcb=True to convert on load, "
+                "allow_tcb='raw' to keep TCB values, or convert the "
+                "file with the tcb2tdb script.")
+    elif units not in ("", "TDB"):
+        raise ValueError(f"unrecognized UNITS {units!r} in par file "
+                         "(expected TDB, TCB, or SI)")
     return model
 
 
-def get_model_and_toas(parfile, timfile, **kw):
+def get_model_and_toas(parfile, timfile, allow_tcb=False, **kw):
     """(reference: model_builder.py::get_model_and_toas)"""
     from ..toa import get_TOAs
 
-    model = get_model(parfile)
+    model = get_model(parfile, allow_tcb=allow_tcb)
     ephem = "de440s"
     if "EPHEM" in model.params and model.EPHEM.value:
         ephem = model.EPHEM.value.lower()

@@ -18,7 +18,12 @@ def main(argv=None) -> int:
     from ..models import get_model
     from ..models.tcb_conversion import convert_tcb_tdb
 
-    model = get_model(args.input_par)
+    model = get_model(args.input_par, allow_tcb="raw")
+    units = (model.UNITS.value or "").upper() if "UNITS" in model.params else ""
+    if units not in ("TCB", "SI"):
+        print(f"input par file is not in TCB units (UNITS "
+              f"{units or 'TDB'}); refusing to convert", file=sys.stderr)
+        return 1
     convert_tcb_tdb(model)
     model.write_parfile(args.output_par)
     print(f"Wrote TDB par file {args.output_par}")

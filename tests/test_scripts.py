@@ -95,9 +95,16 @@ def test_tcb2tdb(parfile, tmp_path, capsys):
     from pint_tpu.models import get_model
     from pint_tpu.models.tcb_conversion import convert_tcb_tdb, IFTE_K
 
-    m = get_model(PAR + "UNITS TCB\n")
+    # a TCB par file is refused by default, converted with allow_tcb=True,
+    # and kept raw with allow_tcb="raw" (reference: get_model allow_tcb)
+    with pytest.raises(ValueError, match="TCB"):
+        get_model(PAR + "UNITS TCB\n")
+    m_auto = get_model(PAR + "UNITS TCB\n", allow_tcb=True)
+    assert m_auto.UNITS.value == "TDB"
+    m = get_model(PAR + "UNITS TCB\n", allow_tcb="raw")
     f0_tcb = m.F0.value
     pepoch_tcb = m.PEPOCH.value
+    assert m_auto.F0.value == pytest.approx(f0_tcb * IFTE_K, rel=1e-15)
     convert_tcb_tdb(m)
     assert m.F0.value == pytest.approx(f0_tcb * IFTE_K, rel=1e-15)
     assert m.PEPOCH.value < pepoch_tcb  # pulled toward IFTE_MJD0
