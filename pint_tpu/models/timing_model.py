@@ -317,6 +317,62 @@ class TimingModel:
         prep = self.prepare(toas)
         return prep.scaled_sigma_us()
 
+    def _delay_until(self, prepared, stop_comp):
+        """Accumulated delay over delay_components() up to but
+        excluding ``stop_comp`` (None = all components) — the one home
+        of the partial-delay accumulator the convenience methods use
+        (same convention as PreparedTiming._delay_fn)."""
+        import jax.numpy as jnp
+
+        d = jnp.zeros_like(prepared.batch.tdb_sec)
+        for comp in self.delay_components():
+            if comp is stop_comp:
+                break
+            d = d + comp.delay(prepared.params0, prepared.batch,
+                               prepared.prep, d)
+        return d
+
+    def get_barycentric_toas(self, toas, cutoff_component=None):
+        """Barycentric arrival times [TDB MJD, float64] — the TDB TOA
+        times minus every delay up to but excluding
+        ``cutoff_component`` (default: the binary component, so
+        binary pulsars get infinite-frequency barycentric orbital
+        times; isolated pulsars get all delays removed)
+        (reference: timing_model.py::TimingModel.get_barycentric_toas).
+        """
+        prepared = self.prepare(toas)
+        delays = self.delay_components()
+        if cutoff_component is None:
+            stop = next((c for c in delays
+                         if c.category == "pulsar_system"), None)
+        else:
+            stop = next((c for c in delays
+                         if c.__class__.__name__ == cutoff_component), None)
+            if stop is None:
+                raise KeyError(f"no delay component named "
+                               f"{cutoff_component!r} (have "
+                               f"{[c.__class__.__name__ for c in delays]})")
+        d = np.asarray(self._delay_until(prepared, stop))
+        return (np.asarray(prepared.batch.tdb_day)
+                + (np.asarray(prepared.batch.tdb_sec) - d) / SECS_PER_DAY)
+
+    def orbital_phase(self, toas, radians=False):
+        """Mean orbital phase at each TOA — cycles in [0, 1) by
+        default, radians in [0, 2 pi) with ``radians=True`` — measured
+        from the binary epoch (T0, or TASC for ELL1 models)
+        (reference: timing_model.py::TimingModel.orbital_phase).
+        """
+        binary = next((c for c in self.delay_components()
+                       if c.category == "pulsar_system"), None)
+        if binary is None:
+            raise AttributeError("model has no binary component")
+        prepared = self.prepare(toas)
+        d = self._delay_until(prepared, binary)
+        phi = np.asarray(binary.orbital_phase(prepared.params0,
+                                              prepared.prep, d))
+        cycles = (phi / (2.0 * np.pi)) % 1.0
+        return cycles * (2.0 * np.pi) if radians else cycles
+
     def map_component(self, name: str):
         for comp in self.components.values():
             if name in comp.params:

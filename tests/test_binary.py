@@ -228,3 +228,88 @@ def test_btx_fb_harmonics():
                                 obs="gbt", add_noise=False)
     r = np.asarray(Residuals(t, m_b, subtract_mean=False).calc_time_resids())
     assert np.abs(r).max() < 2e-9
+
+
+def test_get_barycentric_toas():
+    """Barycentric TOAs strip delays up to the binary: for an isolated
+    pulsar they equal TDB minus ALL delays; for a binary, the residual
+    difference is exactly the orbital delay (A1-scale, PB-periodic)
+    (reference: TimingModel.get_barycentric_toas)."""
+    import numpy as np
+
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    iso_par = ("PSR TBARY\nRAJ 6:00:00\nDECJ 10:00:00\nF0 100.0 1\n"
+               "PEPOCH 55500\nDM 10.0\n")
+    m_iso = get_model(iso_par)
+    t = make_fake_toas_uniform(55000, 55400, 50, m_iso, error_us=1.0,
+                               freq_mhz=800.0)
+    prep = m_iso.prepare(t)
+    bary = m_iso.get_barycentric_toas(t)
+    expect = (np.asarray(prep.batch.tdb_day)
+              + (np.asarray(prep.batch.tdb_sec)
+                 - np.asarray(prep.delay())) / 86400.0)
+    np.testing.assert_allclose(bary, expect, rtol=0, atol=1e-12)
+
+    bin_par = iso_par + ("BINARY ELL1\nPB 2.5\nA1 4.0\nTASC 55001.0\n"
+                         "EPS1 1e-6\nEPS2 -2e-6\n")
+    m_bin = get_model(bin_par)
+    t2 = make_fake_toas_uniform(55000, 55400, 200, m_bin, error_us=1.0,
+                                freq_mhz=800.0)
+    prep2 = m_bin.prepare(t2)
+    bary2 = m_bin.get_barycentric_toas(t2)
+    full2 = (np.asarray(prep2.batch.tdb_day)
+             + (np.asarray(prep2.batch.tdb_sec)
+                - np.asarray(prep2.delay())) / 86400.0)
+    orb_s = (bary2 - full2) * 86400.0  # the stripped binary delay
+    # near-circular orbit: Roemer amplitude ~ A1 = 4 ls
+    assert 3.5 < np.max(np.abs(orb_s)) < 4.5
+    # PB-periodic: fold at PB and check smoothness (max gap-jump small
+    # compared to amplitude when sorted by orbital phase)
+    phase = np.modf((bary2 - 55001.0) / 2.5)[0] % 1.0
+    order = np.argsort(phase)
+    jumps = np.abs(np.diff(orb_s[order]))
+    assert np.max(jumps) < 1.0  # smooth sinusoid, no phase scatter
+    # explicit cutoff by component name matches the default
+    np.testing.assert_allclose(
+        bary2, m_bin.get_barycentric_toas(t2, cutoff_component="BinaryELL1"),
+        atol=0)
+    # non-delay component names are rejected, not silently all-stripped
+    import pytest
+    with pytest.raises(KeyError):
+        m_bin.get_barycentric_toas(t2, cutoff_component="Spindown")
+
+
+def test_model_orbital_phase():
+    """Model-level orbital phase: zero at the epoch (TASC), half a
+    cycle at TASC + PB/2, monotonic fold (reference:
+    TimingModel.orbital_phase)."""
+    import numpy as np
+
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    par = ("PSR TORB\nRAJ 6:00:00\nDECJ 10:00:00\nF0 100.0 1\n"
+           "PEPOCH 55500\nDM 10.0\nBINARY ELL1\nPB 2.0\nA1 3.0\n"
+           "TASC 55200.0\nEPS1 1e-6\nEPS2 -2e-6\n")
+    m = get_model(par)
+    # TOAs at exact multiples/half-multiples of PB from TASC (pick the
+    # barycentric epoch; topocentric offsets shift phase by < Roemer/PB)
+    mjds = np.array([55200.0, 55202.0, 55201.0, 55203.0, 55200.5])
+    t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=1400.0,
+                                iterations=1)
+    ph = m.orbital_phase(t)
+    # Roemer/clock offsets move the fold point by up to ~500 s / PB ~ 3e-3
+    tol = 5e-3
+    assert abs(ph[0] - round(ph[0])) % 1.0 < tol or abs(ph[0] - 1) < tol
+    for k, expect in ((1, 0.0), (2, 0.5), (3, 0.5), (4, 0.25)):
+        d = min(abs(ph[k] - expect), abs(ph[k] - expect - 1),
+                abs(ph[k] - expect + 1))
+        assert d < tol, (k, ph[k], expect)
+    ph_rad = m.orbital_phase(t, radians=True)
+    np.testing.assert_allclose(ph_rad, ph * 2 * np.pi, atol=1e-12)
+    # isolated model refuses
+    import pytest
+    with pytest.raises(AttributeError):
+        get_model(par.split("BINARY")[0]).orbital_phase(t)
