@@ -211,6 +211,50 @@ class InteractivePulsar:
         raise ValueError(f"unknown color mode {mode!r}; "
                          f"choose from {self.COLOR_MODES}")
 
+    # -- x-axis quantities (reference: pintk/plk.py xy-axis choices) --
+
+    X_AXIS_CHOICES = ("mjd", "serial", "year", "day of year", "frequency",
+                      "TOA error", "orbital phase")
+
+    def xvals(self, mode="mjd"):
+        """Per-TOA x-axis values for the residual plot, matching plk's
+        x-axis dropdown. 'orbital phase' requires a binary model."""
+        import datetime
+
+        from .constants import DAYS_PER_JULIAN_YEAR, MJD_J2000
+
+        t = self.toas
+        if mode == "mjd":
+            return t.get_mjds()
+        if mode == "serial":
+            return np.arange(len(t), dtype=float)
+        if mode == "year":
+            return 2000.0 + (t.get_mjds() - MJD_J2000) / DAYS_PER_JULIAN_YEAR
+        if mode == "day of year":
+            mjd0 = datetime.date(1858, 11, 17).toordinal()
+            return np.array(
+                [datetime.date.fromordinal(int(m) + mjd0).timetuple().tm_yday
+                 + (m % 1.0) for m in t.get_mjds()])
+        if mode == "frequency":
+            f = np.asarray(t.freq_mhz)
+            # infinite-frequency (barycentered) TOAs would break axis
+            # autoscale; nan makes matplotlib skip them
+            return np.where(np.isfinite(f), f, np.nan)
+        if mode == "TOA error":
+            return np.asarray(t.error_us)
+        if mode == "orbital phase":
+            return self.model.orbital_phase(t)
+        raise ValueError(f"unknown x-axis mode {mode!r}; "
+                         f"choose from {self.X_AXIS_CHOICES}")
+
+    def x_axis_choices(self):
+        """The modes valid for THIS model (orbital phase only for
+        binaries)."""
+        has_binary = any(c.category == "pulsar_system"
+                         for c in self.model.delay_components())
+        return tuple(m for m in self.X_AXIS_CHOICES
+                     if has_binary or m != "orbital phase")
+
     # -- fit-parameter checkboxes (reference: plk fitbox) --
 
     def set_fit_params(self, names):

@@ -56,6 +56,10 @@ class PlkGui:
         om = _tk.OptionMenu(bar, self.colormode, "default", "obs", "freq",
                             "jump", command=lambda *_: self.redraw())
         om.pack(side=_tk.LEFT)
+        self.xaxis = _tk.StringVar(value="mjd")
+        xom = _tk.OptionMenu(bar, self.xaxis, *session.x_axis_choices(),
+                             command=lambda *_: self.redraw())
+        xom.pack(side=_tk.LEFT)
         _tk.Checkbutton(bar, text="random models", variable=self.show_random,
                         command=self.redraw).pack(side=_tk.LEFT)
 
@@ -97,29 +101,35 @@ class PlkGui:
     def redraw(self):
         s = self.session
         self.ax.clear()
-        mjds = s.toas.get_mjds()
+        xmode = self.xaxis.get()
+        xs = s.xvals(xmode)
+        self._xs, self._xs_mode = xs, xmode  # reused by drag-selection
         r = s.resids_us()
         err = np.asarray(s.toas.error_us)
         labels = s.color_categories(mode=self.colormode.get())
         cats = sorted(set(labels), key=str)
         for ci, label in enumerate(cats):
             mask = labels == label
-            self.ax.errorbar(mjds[mask], r[mask], yerr=err[mask], fmt=".",
+            self.ax.errorbar(xs[mask], r[mask], yerr=err[mask], fmt=".",
                              ms=4, color=COLORS[ci % len(COLORS)],
                              label=str(label))
         sel = getattr(s, "selected", None)
         if sel is not None and np.any(sel):
-            self.ax.plot(mjds[sel], r[sel], "o", mfc="none", ms=9,
+            self.ax.plot(xs[sel], r[sel], "o", mfc="none", ms=9,
                          color="black", label="selected")
-        if self.show_random.get() and getattr(s, "last_fit", None) is not None:
+        # the spread band only makes sense on time-ordered axes: on
+        # frequency/error/orbital-phase it would pair temporally
+        # unrelated residuals into a crisscrossing envelope
+        if (self.show_random.get() and xmode in ("mjd", "year", "serial")
+                and getattr(s, "last_fit", None) is not None):
             spread = s.random_models(n_models=20)
-            order = np.argsort(mjds)
+            order = np.argsort(xs)
             self.ax.fill_between(
-                mjds[order],
+                xs[order],
                 (r + spread.std(axis=0) * 1e6)[order],
                 (r - spread.std(axis=0) * 1e6)[order],
                 alpha=0.15, color="gray", label="model spread")
-        self.ax.set_xlabel("MJD")
+        self.ax.set_xlabel(self.xaxis.get())
         self.ax.set_ylabel("residual [us]")
         if len(cats) > 1 or self.show_random.get():
             self.ax.legend(loc="best", fontsize=8)
@@ -132,7 +142,7 @@ class PlkGui:
         wrms = np.sqrt(np.sum(w * r**2) / np.sum(w))
         self.status.config(text=f"{len(s.toas)} TOAs   wrms {wrms:.3f} us")
 
-    # ---- mouse selection (rectangle in MJD) ----
+    # ---- mouse selection (x-range in the CURRENT axis quantity) ----
 
     def on_press(self, event):
         if event.inaxes is self.ax:
@@ -145,7 +155,13 @@ class PlkGui:
         lo, hi = sorted((self._press, event.xdata))
         self._press = None
         if hi - lo > 1e-6:
-            self.session.select_mjd_range(lo, hi)
+            # reuse the draw's xvals (orbital phase recomputation is a
+            # full prepare+delay chain) unless the axis changed mid-drag
+            xs = (self._xs if getattr(self, "_xs_mode", None)
+                  == self.xaxis.get()
+                  else self.session.xvals(self.xaxis.get()))
+            with np.errstate(invalid="ignore"):
+                self.session.select((xs >= lo) & (xs <= hi))
             self.redraw()
 
     # ---- button handlers: pure delegation ----

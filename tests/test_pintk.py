@@ -366,6 +366,7 @@ def test_pintk_gui_plotting_logic_headless():
     gui.canvas = types.SimpleNamespace(draw_idle=lambda: None)
     gui.status = types.SimpleNamespace(config=lambda **kw: None)
     gui.show_random = types.SimpleNamespace(get=lambda: False)
+    gui.xaxis = types.SimpleNamespace(get=lambda: "mjd")
     for mode in ("default", "obs", "freq", "jump"):
         gui.colormode = types.SimpleNamespace(get=lambda m=mode: m)
         gui.redraw()
@@ -373,5 +374,44 @@ def test_pintk_gui_plotting_logic_headless():
     s.fit()
     gui.show_random = types.SimpleNamespace(get=lambda: True)
     gui.redraw()
+    # every x-axis mode draws (orbital phase absent: isolated pulsar)
+    gui.show_random = types.SimpleNamespace(get=lambda: False)
+    for xmode in s.x_axis_choices():
+        gui.xaxis = types.SimpleNamespace(get=lambda m=xmode: m)
+        gui.redraw()
     # selection ring drawn: one line beyond the errorbar sets
     assert any(ln.get_label() == "selected" for ln in gui.ax.lines)
+
+
+def test_xvals_modes():
+    """x-axis quantities for the plk plot (reference: plk.py x-axis
+    dropdown): serial/year/day-of-year/frequency/error, and orbital
+    phase only for binary models."""
+    m = get_model(PAR)
+    # include MJD 51544.5 (J2000.0, year 2000.0) and 58849.25
+    # (2020-01-01, day-of-year 1.25)
+    mjds = np.array([51544.5, 55000.0, 58849.25])
+    t = make_fake_toas_fromMJDs(mjds, m, error_us=2.0, freq_mhz=700.0,
+                                obs="gbt", iterations=1)
+    s = InteractivePulsar(get_model(PAR), t)
+    np.testing.assert_allclose(s.xvals("serial"), [0, 1, 2])
+    assert abs(s.xvals("year")[0] - 2000.0) < 1e-9
+    # zero-residual iteration shifts TOA times by ~ms: loose tolerance
+    assert abs(s.xvals("day of year")[2] - 1.25) < 1e-6
+    np.testing.assert_allclose(s.xvals("frequency"), 700.0)
+    np.testing.assert_allclose(s.xvals("TOA error"), 2.0)
+    np.testing.assert_allclose(s.xvals("mjd"), t.get_mjds())
+    assert "orbital phase" not in s.x_axis_choices()
+    with pytest.raises(ValueError, match="x-axis"):
+        s.xvals("nope")
+
+    bin_par = PAR + ("BINARY ELL1\nPB 3.0\nA1 5.0\nTASC 55001.0\n"
+                     "EPS1 1e-6\nEPS2 1e-6\n")
+    mb = get_model(bin_par)
+    tb = make_fake_toas_fromMJDs(np.linspace(55000, 55100, 20), mb,
+                                 error_us=1.0, freq_mhz=1400.0,
+                                 obs="gbt", iterations=1)
+    sb = InteractivePulsar(get_model(bin_par), tb)
+    assert "orbital phase" in sb.x_axis_choices()
+    ph = sb.xvals("orbital phase")
+    assert ph.shape == (20,) and np.all((ph >= 0) & (ph < 1))
