@@ -54,7 +54,7 @@ class AbsPhase(PhaseComponent):
         import jax.numpy as jnp
 
         if "TZRMJD" not in model.params or model.TZRMJD.value is None:
-            prep["tzr_frac"] = 0.0
+            prep["tzr_frac"] = jnp.float64(0.0)
             return
         # the TZR phase depends only on the model, not the data TOAs;
         # cache it across prepare() calls keyed on full model state
@@ -73,7 +73,7 @@ class AbsPhase(PhaseComponent):
             tzr_frac = float(np.asarray(ph.frac)[0])
             tzr_int = float(np.asarray(ph.int_)[0])
             self._tzr_cache = (key, tzr_int, tzr_frac)
-        prep["tzr_frac"] = tzr_frac
+        prep["tzr_frac"] = jnp.float64(tzr_frac)
         # fold the integer reference into the packed integer phase so
         # Phase.int_ counts pulses since the TZR TOA
         prep["phi_ref_int"] = prep["phi_ref_int"] - jnp.float64(tzr_int)

@@ -86,7 +86,7 @@ class PulsarBinary(DelayComponent):
         if self.fb_ids:
             fb = np.array([getattr(self, f"FB{i}").value or 0.0 for i in self.fb_ids])
             params0["FB"] = fb
-            prep["FB_ref"] = fb
+            prep["FB_ref"] = jnp.asarray(fb)
             norb = np.zeros_like(dt_ld)
             fact = LD(1.0)
             for i, f in enumerate(fb):
@@ -101,9 +101,9 @@ class PulsarBinary(DelayComponent):
         n_int = np.floor(norb + LD(0.5))
         prep["norb_ref_frac"] = jnp.asarray((norb - n_int).astype(np.float64))
         prep["norb_ref_int"] = jnp.asarray(n_int.astype(np.float64))
-        prep["PB_ref"] = self.PB.value or 0.0
-        prep["PBDOT_ref"] = pbdot
-        prep["T0_ref"] = ep.value
+        prep["PB_ref"] = jnp.asarray(self.PB.value or 0.0, jnp.float64)
+        prep["PBDOT_ref"] = jnp.asarray(pbdot, jnp.float64)
+        prep["T0_ref"] = jnp.asarray(ep.value, jnp.float64)
         for pname in self.params:
             par = getattr(self, pname)
             if pname.startswith("FB"):

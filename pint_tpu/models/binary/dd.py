@@ -208,7 +208,7 @@ class BinaryDDH(BinaryDD):
         self.add_param(floatParameter(
             "H4", units="s", description="Orthometric amplitude h4"))
         self.add_param(floatParameter(
-            "STIGMA", units="", aliases=("VARSIGMA",),
+            "STIGMA", units="", aliases=("VARSIGMA", "STIG"),
             description="Orthometric ratio"))
 
     def validate(self):

@@ -122,7 +122,7 @@ class BinaryELL1H(BinaryELL1):
         super().__init__()
         self.add_param(floatParameter("H3", units="s", description="Orthometric amplitude h3"))
         self.add_param(floatParameter("H4", units="s", description="Orthometric amplitude h4"))
-        self.add_param(floatParameter("STIGMA", units="", aliases=("VARSIGMA",),
+        self.add_param(floatParameter("STIGMA", units="", aliases=("VARSIGMA", "STIG"),
                                       description="Orthometric ratio"))
 
     def shapiro_rs(self, params):

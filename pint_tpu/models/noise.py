@@ -221,7 +221,7 @@ class PLRedNoise(NoiseComponent):
         F, freqs, tspan_s = fourier_basis(toas, self.n_harmonics())
         prep["rn_F"] = jnp.asarray(F)
         prep["rn_freqs"] = jnp.asarray(freqs)
-        prep["rn_tspan_s"] = tspan_s
+        prep["rn_tspan_s"] = jnp.asarray(tspan_s, jnp.float64)
         for pname in ("RNAMP", "RNIDX", "TNREDAMP", "TNREDGAM"):
             params0[pname] = getattr(self, pname).value or 0.0
 
@@ -295,7 +295,7 @@ class _PLScaledNoise(NoiseComponent):
         scale = self._row_scale(model, toas, prep, params0)
         prep[f"{self.PREP}_F"] = jnp.asarray(F * scale[:, None])
         prep[f"{self.PREP}_freqs"] = jnp.asarray(freqs)
-        prep[f"{self.PREP}_tspan_s"] = tspan_s
+        prep[f"{self.PREP}_tspan_s"] = jnp.asarray(tspan_s, jnp.float64)
         for pname in (self.AMP, self.GAM):
             params0[pname] = getattr(self, pname).value or 0.0
 

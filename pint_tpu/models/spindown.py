@@ -61,9 +61,11 @@ class Spindown(PhaseComponent):
     # ---- host pack ----
 
     def pack(self, model, toas, prep, params0):
+        import jax.numpy as jnp
+
         F_ref = self.fvalues()
         params0["F"] = F_ref.copy()
-        prep["F_ref"] = F_ref  # static
+        prep["F_ref"] = jnp.asarray(F_ref)  # traced arg: values change per refit
         T = prep["T_ld"]  # longdouble seconds since PEPOCH
         phi = np.zeros_like(T)
         fact = LD(1.0)
@@ -71,8 +73,6 @@ class Spindown(PhaseComponent):
             fact = fact * LD(i + 1)
             phi = phi + LD(f) * T ** (i + 1) / fact
         phi_int = np.floor(phi + LD(0.5))
-        import jax.numpy as jnp
-
         prep["phi_ref_int"] = jnp.asarray(phi_int.astype(np.float64))
         prep["phi_ref_frac"] = jnp.asarray((phi - phi_int).astype(np.float64))
 

@@ -188,3 +188,44 @@ def test_metrics_on_downhill_and_wideband():
     fw = WidebandTOAFitter(tw, mw)
     fw.fit_toas(maxiter=2)
     assert fw.metrics["iteration_s"] and fw.metrics["total_s"] > 0
+
+
+def test_compile_cache_reuse_and_structure_isolation():
+    """The process-global compile cache must (a) serve repeat fits of
+    the same model structure with ZERO new compilations — the
+    change-par-and-refit latency contract — and (b) key distinct
+    trace-time parameterizations (DDH H4/H3 vs H3/STIGMA) separately."""
+    import numpy as np
+
+    from pint_tpu.models import get_model
+    from pint_tpu.models.timing_model import _GLOBAL_FNS
+    from pint_tpu.fitter import WLSFitter
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    par = ("PSR TCACHE\nRAJ 2:00:00\nDECJ 8:00:00\nF0 173.9 1\n"
+           "F1 -1e-15 1\nPEPOCH 55400\nDM 7.0 1\n")
+    m = get_model(par)
+    t = make_fake_toas_uniform(55000, 55800, 40, m, error_us=1.0,
+                               add_noise=True, seed=6)
+    WLSFitter(t, m).fit_toas(maxiter=2)
+    n0 = len(_GLOBAL_FNS)
+    for _ in range(2):  # in-place refits of the now-updated model
+        chi2 = WLSFitter(t, m).fit_toas(maxiter=2)
+    assert len(_GLOBAL_FNS) == n0, "refit of same structure recompiled"
+    assert np.isfinite(chi2)
+    # distinct parameterizations (value PRESENCE) must not share keys
+    ddh = ("BINARY DDH\nPB 1.5\nA1 3.0\nECC 0.01\nOM 30\nT0 55400\n")
+    m_h4 = get_model(par + ddh + "H3 1e-7\nH4 8e-8\n")
+    m_st = get_model(par + ddh + "H3 1e-7\nSTIGMA 0.8\n")
+    k_h4 = m_h4.prepare(t)._structure_key()
+    k_st = m_st.prepare(t)._structure_key()
+    assert k_h4 != k_st
+    # freezing a param after prepare() must change the key (a stale
+    # key would overlay a shorter x onto the old free-param slots)
+    pt = m.prepare(t)
+    k_before = pt._structure_key()
+    m.F1.frozen = True
+    try:
+        assert pt._structure_key() != k_before
+    finally:
+        m.F1.frozen = False
