@@ -79,12 +79,27 @@ class Residuals:
         mean = np.sum(r * w) / np.sum(w)
         return float(np.sqrt(np.sum(w * (r - mean) ** 2) / np.sum(w)))
 
+    def calc_whitened_resids(self, params=None):
+        """Residuals divided by the scaled uncertainties —
+        dimensionless, unit variance when the white-noise model is
+        right (reference: residuals.py::Residuals.calc_whitened_resids)."""
+        r = self.calc_time_resids(params)
+        sigma_s = self.prepared.scaled_sigma_us(params) * 1e-6
+        return r / sigma_s
+
     def calc_chi2(self, params=None):
         import jax.numpy as jnp
 
-        r = self.calc_time_resids(params)
-        sigma_s = self.prepared.scaled_sigma_us(params) * 1e-6
-        return jnp.sum(jnp.square(r / sigma_s))
+        return jnp.sum(jnp.square(self.calc_whitened_resids(params)))
+
+    def lnlikelihood(self, params=None):
+        """Gaussian white-noise log-likelihood
+        -(chi2 + sum log(2 pi sigma^2)) / 2 (reference:
+        residuals.py::Residuals.lnlikelihood; correlated noise belongs
+        to the GLS/Bayesian machinery, not this quick diagnostic)."""
+        w = np.asarray(self.calc_whitened_resids(params))
+        sigma_s = np.asarray(self.prepared.scaled_sigma_us(params)) * 1e-6
+        return -0.5 * float(np.sum(w**2) + np.sum(np.log(2.0 * np.pi * sigma_s**2)))
 
     @property
     def chi2(self):

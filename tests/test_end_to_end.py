@@ -243,3 +243,31 @@ TNREDC 20
         if a.uncertainty is not None:  # uncertainties are state too
             assert b.uncertainty is not None, f"uncertainty of {p} dropped"
             assert np.isclose(a.uncertainty, b.uncertainty, rtol=1e-4), p
+
+
+def test_whitened_resids_and_lnlikelihood():
+    """Whitened residuals have ~unit variance on well-modeled data and
+    lnlikelihood = -(chi2 + sum log 2 pi sigma^2)/2, maximized at the
+    true parameters (reference: Residuals.calc_whitened_resids /
+    lnlikelihood)."""
+    import numpy as np
+
+    from pint_tpu.models import get_model
+    from pint_tpu.residuals import Residuals
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    par = ("PSR TLNL\nRAJ 7:30:00\nDECJ -2:00:00\nF0 340.1 1\n"
+           "F1 -1e-15 1\nPEPOCH 55500\nDM 12.5 1\nEFAC -f L 1.4\n")
+    m = get_model(par)
+    t = make_fake_toas_uniform(55000, 56000, 120, m, error_us=1.0,
+                               add_noise=True, flags={"f": "L"}, seed=12)
+    r = Residuals(t, m)
+    w = np.asarray(r.calc_whitened_resids())
+    assert 0.7 < w.std() < 1.3  # EFAC 1.4 accounted for in whitening
+    sigma = np.asarray(r.prepared.scaled_sigma_us()) * 1e-6
+    expect = -0.5 * (r.chi2 + np.sum(np.log(2 * np.pi * sigma**2)))
+    assert abs(r.lnlikelihood() - expect) < 1e-9
+    # worse parameters give lower likelihood
+    m2 = get_model(par)
+    m2.F0.value += 3e-9
+    assert Residuals(t, m2).lnlikelihood() < r.lnlikelihood()
