@@ -22,6 +22,10 @@ def add_binary_component(model, binary_name: str, keys: dict):
         from .bt import BinaryBT, BinaryBTX
 
         comp = BinaryBTX() if name == "BTX" else BinaryBT()
+    elif name == "BT_PIECEWISE":
+        from .bt_piecewise import BinaryBTPiecewise
+
+        comp = BinaryBTPiecewise()
     elif name in ("DD", "DDS", "DDGR", "DDK", "DDH"):
         from .dd import (BinaryDD, BinaryDDGR, BinaryDDH, BinaryDDK,
                          BinaryDDS)

@@ -97,8 +97,9 @@ class Residuals:
         -(chi2 + sum log(2 pi sigma^2)) / 2 (reference:
         residuals.py::Residuals.lnlikelihood; correlated noise belongs
         to the GLS/Bayesian machinery, not this quick diagnostic)."""
-        w = np.asarray(self.calc_whitened_resids(params))
+        r = np.asarray(self.calc_time_resids(params))
         sigma_s = np.asarray(self.prepared.scaled_sigma_us(params)) * 1e-6
+        w = r / sigma_s
         return -0.5 * float(np.sum(w**2) + np.sum(np.log(2.0 * np.pi * sigma_s**2)))
 
     @property
