@@ -255,10 +255,39 @@ class TimingModel:
 
     # ---- par file round trip (reference: TimingModel.as_parfile) ----
 
-    def as_parfile(self) -> str:
+    # parameter spellings tempo/tempo2 expect on output; our reader
+    # accepts both spellings, so format="pint" files stay canonical
+    # (reference: parameter.py tempo2-alias handling in as_parfile)
+    _TEMPO2_RENAMES = {
+        "EFAC": "T2EFAC", "EQUAD": "T2EQUAD", "STIGMA": "VARSIGMA",
+        "A1DOT": "XDOT", "NE_SW": "NE1AU", "ELONG": "LAMBDA",
+        "ELAT": "BETA", "PMELONG": "PMLAMBDA", "PMELAT": "PMBETA",
+    }
+    _TEMPO_RENAMES = {
+        "ELONG": "LAMBDA", "ELAT": "BETA",
+        "PMELONG": "PMLAMBDA", "PMELAT": "PMBETA", "NE_SW": "SOLARN0",
+        "A1DOT": "XDOT", "STIGMA": "VARSIGMA",
+    }
+
+    def as_parfile(self, format="pint") -> str:
+        """Serialize the model (reference: TimingModel.as_parfile with
+        format in {"pint", "tempo", "tempo2"}: same parameter values,
+        target-program spellings — T2EFAC/VARSIGMA/LAMBDA..., and a
+        MODE 1 header for tempo)."""
+        if format not in ("pint", "tempo", "tempo2"):
+            raise ValueError(f"unknown par file format {format!r} "
+                             "(expected pint, tempo, or tempo2)")
+        renames = (self._TEMPO2_RENAMES if format == "tempo2"
+                   else self._TEMPO_RENAMES if format == "tempo" else {})
         lines = []
+        if format == "tempo":
+            lines.append(f"{'MODE':<15} 1\n")
         for p in self.top_params:
+            if format == "tempo" and p == "MODE":
+                continue
             lines.append(self._top[p].as_parfile_line())
+        if format in ("tempo", "tempo2") and "UNITS" not in self.top_params:
+            lines.append(f"{'UNITS':<15} TDB\n")
         ordered = list(self.delay_components()) + list(self.phase_components())
         # noise components are neither delay nor phase but their
         # EFAC/EQUAD/ECORR/red-noise params are model state too — a par
@@ -273,11 +302,25 @@ class TimingModel:
                 lines.append(f"{'BINARY':<15} {name}\n")
             for pname in comp.params:
                 lines.append(getattr(comp, pname).as_parfile_line())
+        if renames:
+            out = []
+            for l in lines:
+                head = l.split(" ", 1)[0] if l else ""
+                if head in renames:
+                    body = l.rstrip("\n")
+                    rest = body[len(head):].lstrip()
+                    # keep the original name-field width (15 for plain
+                    # params, 8 for mask prefixes) so columns stay aligned
+                    field_w = len(body) - len(rest) - 1
+                    new = renames[head]
+                    l = f"{new:<{max(field_w, len(new))}} {rest}\n"
+                out.append(l)
+            lines = out
         return "".join(l for l in lines if l)
 
-    def write_parfile(self, path):
+    def write_parfile(self, path, format="pint"):
         with open(path, "w") as f:
-            f.write(self.as_parfile())
+            f.write(self.as_parfile(format=format))
 
     def compare(self, other: "TimingModel") -> str:
         """Pre/post-fit comparison table (reference: TimingModel.compare)."""

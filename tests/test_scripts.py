@@ -225,3 +225,46 @@ def test_zima_correlated_noise(tmp_path):
     rw = np.asarray(Residuals(get_TOAs(str(out_w)), m).time_resids)
     rc = np.asarray(Residuals(get_TOAs(str(out_c)), m).time_resids)
     assert rc.std() > 3 * rw.std()
+
+
+def test_convert_parfile_formats(tmp_path, capsys):
+    """as_parfile(format=) + convert_parfile script: tempo2 spellings
+    out (T2EFAC/VARSIGMA/LAMBDA), values intact, file loads back
+    (reference: scripts/convert_parfile.py)."""
+    from pint_tpu.models import get_model
+    from pint_tpu.scripts import convert_parfile
+
+    par = ("PSR TCONVP\nELONG 93.0 1\nELAT 21.0 1\nF0 218.81 1\n"
+           "PEPOCH 55000\nDM 15.99 1\nNE_SW 7.9\n"
+           "BINARY ELL1H\nPB 66.0\nA1 32.3\nTASC 55001\n"
+           "EPS1 1e-7\nEPS2 -2e-7\nH3 2.7e-7\nSTIGMA 0.72\n"
+           "EFAC -f L-wide 1.1\nEQUAD -f L-wide 0.3\n")
+    src = tmp_path / "in.par"
+    src.write_text(par)
+    m = get_model(str(src))
+
+    t2 = m.as_parfile(format="tempo2")
+    for spell in ("T2EFAC", "T2EQUAD", "VARSIGMA", "LAMBDA", "BETA",
+                  "NE1AU", "UNITS           TDB"):
+        assert spell in t2, spell
+    assert "\nSTIGMA " not in t2 and "\nEFAC " not in t2
+    m2 = get_model(t2)
+    assert m2.STIGMA.value == m.STIGMA.value
+    assert m2.ELONG.value == m.ELONG.value
+    assert m2.EFAC1.value == m.EFAC1.value
+
+    t1 = m.as_parfile(format="tempo")
+    assert t1.startswith("MODE")
+    assert "LAMBDA" in t1 and "SOLARN0" in t1
+    assert get_model(t1).NE_SW.value == m.NE_SW.value
+
+    with pytest.raises(ValueError, match="format"):
+        m.as_parfile(format="tempo3")
+
+    out = tmp_path / "out.par"
+    assert convert_parfile.main([str(src), "-f", "tempo2",
+                                 "-o", str(out)]) == 0
+    assert "VARSIGMA" in out.read_text()
+    # stdout mode
+    assert convert_parfile.main([str(src)]) == 0
+    assert "ELONG" in capsys.readouterr().out
