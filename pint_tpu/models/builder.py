@@ -28,6 +28,11 @@ ALIASES = {
     "LAMBDA": "ELONG", "BETA": "ELAT", "PMLAMBDA": "PMELONG", "PMBETA": "PMELAT",
     "CLK": "CLOCK", "T2EFAC": "EFAC", "T2EQUAD": "EQUAD", "NE1AU": "NE_SW",
     "SOLARN0": "NE_SW",
+    # temponest spellings (reference: noise_model.py aliases; TNEQ and
+    # TNGlobalEQ carry log10-second values, converted on read below;
+    # TNGlobalEF is a plain all-TOA EFAC — the selector-less mask line
+    # parses to the all-TOA mask already, so an alias suffices)
+    "TNEF": "EFAC", "TNECORR": "ECORR", "TNGLOBALEF": "EFAC",
 }
 
 # FD1JUMP (canonical, reference: fdjump.py) or FDJUMP1 (tempo2 alias);
@@ -76,7 +81,7 @@ def get_model(parfile, allow_name_mixing=False, allow_tcb=False) -> TimingModel:
         if m_fdj:
             canon = f"FD{m_fdj.group(1) or m_fdj.group(2)}JUMP"
         if canon in ("JUMP", "EFAC", "EQUAD", "ECORR", "DMEFAC", "DMEQUAD",
-                     "DMJUMP") or m_fdj:
+                     "DMJUMP", "TNEQ", "TNGLOBALEQ") or m_fdj:
             repeats.append((canon, fields))
         else:
             keys[canon] = fields
@@ -184,11 +189,13 @@ def get_model(parfile, allow_name_mixing=False, allow_tcb=False) -> TimingModel:
         from .absolute_phase import AbsPhase
 
         model.add_component(AbsPhase())
-    if any(c in ("EFAC", "EQUAD", "ECORR", "DMEFAC", "DMEQUAD") for c, _ in repeats) or any(
+    if any(c in ("EFAC", "EQUAD", "ECORR", "DMEFAC", "DMEQUAD", "TNEQ",
+                 "TNGLOBALEQ") for c, _ in repeats) or any(
             k.startswith(("RNAMP", "RNIDX", "TNRED", "TNDM")) for k in keys):
         from .noise import ScaleToaError, EcorrNoise, PLRedNoise, PLDMNoise
 
-        if any(c in ("EFAC", "EQUAD", "DMEFAC", "DMEQUAD") for c, _ in repeats):
+        if any(c in ("EFAC", "EQUAD", "DMEFAC", "DMEQUAD", "TNEQ",
+                     "TNGLOBALEQ") for c, _ in repeats):
             model.add_component(ScaleToaError())
         if any(c == "ECORR" for c, _ in repeats):
             model.add_component(EcorrNoise())
@@ -378,6 +385,16 @@ def get_model(parfile, allow_name_mixing=False, allow_tcb=False) -> TimingModel:
             p.from_parfile_fields(fields)
         elif canon in ("EFAC", "EQUAD", "DMEFAC", "DMEQUAD") and noise_comp is not None:
             noise_comp.add_mask_param(canon, fields)
+        elif canon in ("TNEQ", "TNGLOBALEQ") and noise_comp is not None:
+            # temponest EQUAD: log10(equad / s) -> us
+            import math
+
+            p = noise_comp.add_mask_param("EQUAD", fields)
+            if p.value is not None:
+                v = p.value
+                p.value = 10.0**v * 1e6
+                if p.uncertainty is not None:
+                    p.uncertainty = math.log(10.0) * p.value * p.uncertainty
         elif canon == "ECORR" and ecorr_comp is not None:
             ecorr_comp.add_mask_param(fields)
 

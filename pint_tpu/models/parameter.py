@@ -295,10 +295,18 @@ class maskParameter(floatParameter):
         self.key = ""
         self.key_value: list[str] = []
 
+    @staticmethod
+    def _is_flag_token(tok) -> bool:
+        # "-f"/"-fe" are flag selectors; "-6.0"/"-1e-5" are negative
+        # values (e.g. a selector-less global TNGlobalEQ line)
+        t = str(tok)
+        return (t.startswith("-") and len(t) > 1
+                and not (t[1].isdigit() or t[1] == "."))
+
     def from_parfile_fields(self, fields):
         # e.g. "EFAC -f L-wide 1.1" parsed from fields after name:
         # [-f, L-wide, 1.1, [fit], [unc]] or "JUMP MJD 55000 55100 1e-6 1"
-        if fields and str(fields[0]).startswith("-"):
+        if fields and self._is_flag_token(fields[0]):
             self.key = str(fields[0])
             self.key_value = [str(fields[1])]
             rest = fields[2:]

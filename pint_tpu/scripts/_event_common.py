@@ -1,0 +1,42 @@
+"""Shared helpers for the photon-event MCMC scripts
+(event_optimize / event_optimize_multiple)."""
+
+from __future__ import annotations
+
+
+def empirical_template(model, toas, weights, nbins):
+    """Binned folded profile at the input model, mean-normalized with a
+    floor so empty bins don't zero the template likelihood."""
+    import numpy as np
+
+    ph = np.asarray(model.phase(toas).frac) % 1.0
+    hist, _ = np.histogram(ph, bins=nbins, range=(0, 1), weights=weights)
+    return np.maximum(hist / hist.mean(), 1e-3)
+
+
+def default_priors(model, toas_list):
+    """Uniform box per free param: width from the par-file uncertainty
+    when present, else a generous span-scaled phase-safe box
+    (reference: event_optimize errs=... defaults per param)."""
+    span_s = max((t.day.max() - t.day.min()) * 86400.0
+                 for t in toas_list) or 86400.0
+    prior_info = {}
+    for pname in model.free_params:
+        par = getattr(model, pname)
+        half = (5.0 * par.uncertainty if par.uncertainty
+                else max(abs(par.value) * 1e-6, 1.0 / span_s))
+        prior_info[pname] = {"min": par.value - half, "max": par.value + half}
+    return prior_info
+
+
+def report_fit(fit, outfile=None):
+    """Print the max-posterior summary and per-param table; optionally
+    write the post-fit par file."""
+    print(f"max posterior = {fit.maxpost:.2f}  "
+          f"accept = {fit.sampler.accept_frac:.2f}")
+    for pname in fit.bt.param_labels:
+        par = getattr(fit.model, pname)
+        print(f"  {pname:10s} {par.value:.12g} +- {par.uncertainty:.3g}")
+    if outfile:
+        fit.model.write_parfile(outfile)
+        print(f"Wrote {outfile}")

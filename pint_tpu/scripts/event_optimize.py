@@ -32,6 +32,7 @@ def main(argv=None) -> int:
     from ..event_toas import load_event_TOAs, get_event_weights
     from ..mcmc_fitter import MCMCFitterBinnedTemplate
     from ..models import get_model
+    from ._event_common import default_priors, empirical_template, report_fit
 
     model = get_model(args.parfile)
     toas = load_event_TOAs(args.eventfile, args.mission,
@@ -43,32 +44,11 @@ def main(argv=None) -> int:
         template = tpl[:, 1] if tpl.ndim == 2 else tpl
         template = template / template.mean()
     else:
-        # empirical template: binned folded profile at the input model
-        ph = np.asarray(model.phase(toas).frac) % 1.0
-        hist, _ = np.histogram(ph, bins=args.nbins, range=(0, 1),
-                               weights=weights)
-        template = np.maximum(hist / hist.mean(), 1e-3)
-    # default priors: uniform around the par value, width set by the
-    # par-file uncertainty when present else a generous phase-safe box
-    # (reference: event_optimize errs=... defaults per param)
-    prior_info = {}
-    span_s = (toas.day.max() - toas.day.min()) * 86400.0 or 86400.0
-    for pname in model.free_params:
-        par = getattr(model, pname)
-        half = (5.0 * par.uncertainty if par.uncertainty
-                else max(abs(par.value) * 1e-6, 1.0 / span_s))
-        prior_info[pname] = {"min": par.value - half, "max": par.value + half}
+        template = empirical_template(model, toas, weights, args.nbins)
     fit = MCMCFitterBinnedTemplate(toas, model, template, weights=weights,
-                                   prior_info=prior_info)
+                                   prior_info=default_priors(model, [toas]))
     fit.fit_toas(n_steps=args.nsteps)
-    print(f"max posterior = {fit.maxpost:.2f}  "
-          f"accept = {fit.sampler.accept_frac:.2f}")
-    for pname in fit.bt.param_labels:
-        par = getattr(fit.model, pname)
-        print(f"  {pname:10s} {par.value:.12g} +- {par.uncertainty:.3g}")
-    if args.outfile:
-        fit.model.write_parfile(args.outfile)
-        print(f"Wrote {args.outfile}")
+    report_fit(fit, args.outfile)
     return 0
 
 

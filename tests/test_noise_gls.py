@@ -251,3 +251,36 @@ def test_plswnoise_gls_whitening_roundtrip():
     assert "PLSWNoise" in m2.components
     assert m2.TNSWAMP.value == pytest.approx(-5.5)
     assert m2.TNSWGAM.value == pytest.approx(2.0)
+
+
+def test_temponest_noise_spellings():
+    """TNEF/TNEQ/TNECORR/TNGlobalEF/TNGlobalEQ parse to the canonical
+    EFAC/EQUAD/ECORR params; TNEQ-family values are log10-seconds
+    (reference: noise_model.py temponest aliases)."""
+    import numpy as np
+
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    base = ("PSR TTNN\nRAJ 10:00:00\nDECJ 20:00:00\nF0 150.0 1\n"
+            "PEPOCH 55300\nDM 5.0\n")
+    tn = (base + "TNEF -f L-wide 1.3\nTNEQ -f L-wide -5.69897000433602\n"
+          "TNECORR -f L-wide 0.8\nTNGlobalEF 1.1\nTNGlobalEQ -6.0\n")
+    canon = (base + "EFAC -f L-wide 1.3\nEQUAD -f L-wide 2.0\n"
+             "ECORR -f L-wide 0.8\nEFAC 1.1\nEQUAD 1.0\n")
+    m_tn = get_model(tn)
+    m_c = get_model(canon)
+    assert not m_tn.unrecognized
+    # 10**-5.699 s = 2.0 us; 10**-6 s = 1.0 us
+    np.testing.assert_allclose(m_tn.EQUAD1.value, 2.0, rtol=1e-12)
+    np.testing.assert_allclose(m_tn.EQUAD2.value, 1.0, rtol=1e-12)
+    assert m_tn.ECORR1.value == 0.8 and m_tn.EFAC2.value == 1.1
+    mjds = np.sort(55300 + np.repeat(np.arange(20), 2) * 5
+                   + np.tile([0.0, 1e-5], 20))
+    t = make_fake_toas_fromMJDs(mjds, m_c, error_us=1.0, freq_mhz=1400.0,
+                                obs="gbt", add_noise=False, iterations=0)
+    for f in t.flags:
+        f["f"] = "L-wide"
+    s_tn = np.asarray(m_tn.scaled_toa_uncertainty(t))
+    s_c = np.asarray(m_c.scaled_toa_uncertainty(t))
+    np.testing.assert_allclose(s_tn, s_c, rtol=1e-12)

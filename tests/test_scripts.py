@@ -268,3 +268,40 @@ def test_convert_parfile_formats(tmp_path, capsys):
     # stdout mode
     assert convert_parfile.main([str(src)]) == 0
     assert "ELONG" in capsys.readouterr().out
+
+
+def test_event_optimize_multiple_smoke(tmp_path, capsys):
+    """event_optimize_multiple jointly samples two event lists
+    (reference: scripts/event_optimize_multiple.py)."""
+    from pint_tpu.io.fits import write_fits_table
+    from pint_tpu.scripts import event_optimize_multiple
+
+    par = ("PSR TESTEOM\nRAJ 05:00:00\nDECJ 20:00:00\nF0 10.0 1\nF1 0\n"
+           "PEPOCH 56000\nDM 0\n")
+    parfile = tmp_path / "eom.par"
+    parfile.write_text(par)
+    rng = np.random.default_rng(5)
+    mjdref = 56658.000777592593
+    evts = []
+    for k in range(2):
+        n = 400
+        phases = (rng.vonmises(np.pi, 5.0, n) / (2 * np.pi)) % 1.0
+        pulse_n = rng.integers(0, 10 * 86400 * 10, n)
+        mjds = 56000.0 + ((pulse_n + phases) / 10.0) / 86400.0
+        met = (np.asarray(mjds, np.longdouble) - mjdref) * 86400.0
+        evt = str(tmp_path / f"eom{k}.fits")
+        write_fits_table(evt, {"TIME": np.asarray(met, float)},
+                         {"MJDREFI": 56658, "MJDREFF": mjdref - 56658,
+                          "TIMESYS": "TDB", "TELESCOP": "NICER"})
+        evts.append(evt)
+    listing = tmp_path / "sets.txt"
+    listing.write_text(f"# dataset list\n{evts[0]} nicer\n{evts[1]}\n")
+    out_par = str(tmp_path / "eom_post.par")
+    assert event_optimize_multiple.main(
+        [str(listing), str(parfile), "--nsteps", "50",
+         "--outfile", out_par]) == 0
+    cap = capsys.readouterr().out
+    assert cap.count("Read 400 photons") == 2
+    assert "max posterior" in cap
+    import os
+    assert os.path.exists(out_par)
