@@ -1,2 +1,2 @@
 from .pta import PTABatch, PTAFleet, stack_prepared  # noqa: F401
-from .mesh import make_mesh, shard_batch  # noqa: F401
+from .mesh import make_mesh, make_mesh2d, shard_batch  # noqa: F401

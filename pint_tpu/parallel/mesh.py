@@ -23,12 +23,36 @@ def make_mesh(n_pulsar_shards=None, devices=None) -> Mesh:
     return Mesh(np.array(devices[:n]), axis_names=("pulsar",))
 
 
-def shard_batch(tree, mesh: Mesh):
-    """Place a stacked per-pulsar pytree with the pulsar axis sharded."""
-    sharding = NamedSharding(mesh, P("pulsar"))
+def make_mesh2d(n_pulsar_shards, n_toa_shards, devices=None) -> Mesh:
+    """2-D ('pulsar', 'toa') mesh: pulsar data parallelism combined
+    with TOA-axis (sequence) sharding inside each pulsar shard. The
+    per-TOA physics is pointwise, so GSPMD only inserts collectives
+    for the few cross-TOA reductions (mean subtraction, normal
+    equations) — these ride ICI (SURVEY.md section 2.2)."""
+    devices = devices if devices is not None else jax.devices()
+    n = n_pulsar_shards * n_toa_shards
+    if len(devices) < n:
+        raise ValueError(f"mesh {n_pulsar_shards}x{n_toa_shards} needs "
+                         f"{n} devices, have {len(devices)}")
+    grid = np.array(devices[:n]).reshape(n_pulsar_shards, n_toa_shards)
+    return Mesh(grid, axis_names=("pulsar", "toa"))
+
+
+def shard_batch(tree, mesh: Mesh, n_toa=None):
+    """Place a stacked per-pulsar pytree with the pulsar axis sharded.
+
+    On a 2-D ('pulsar', 'toa') mesh, leaves whose SECOND axis is the
+    (padded) TOA axis — length ``n_toa`` divisible by the toa mesh
+    size — are sharded along it too; everything else stays replicated
+    across the toa axis (correct, just not memory-split)."""
+    toa_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get("toa")
 
     def put(x):
-        return jax.device_put(x, sharding)
+        spec = P("pulsar")
+        if (toa_size and n_toa and getattr(x, "ndim", 0) >= 2
+                and x.shape[1] == n_toa and n_toa % toa_size == 0):
+            spec = P("pulsar", "toa")
+        return jax.device_put(x, NamedSharding(mesh, spec))
 
     return jax.tree_util.tree_map(put, tree)
 

@@ -236,9 +236,10 @@ class PTABatch:
         if mesh is not None:
             from .mesh import shard_batch
 
+            n_max = int(self.batch.tdb_sec.shape[1])
             self.params = shard_batch(self.params, mesh)
-            self.prep = shard_batch(self.prep, mesh)
-            self.batch = shard_batch(self.batch, mesh)
+            self.prep = shard_batch(self.prep, mesh, n_toa=n_max)
+            self.batch = shard_batch(self.batch, mesh, n_toa=n_max)
         self._fns = {}
         self._ecorr_marg_ok = None  # lazy host check, cached (gls_fit)
 

@@ -18,8 +18,10 @@ def default_priors(model, toas_list):
     """Uniform box per free param: width from the par-file uncertainty
     when present, else a generous span-scaled phase-safe box
     (reference: event_optimize errs=... defaults per param)."""
-    span_s = max((t.day.max() - t.day.min()) * 86400.0
-                 for t in toas_list) or 86400.0
+    # joint span across ALL datasets: the phase-safe F0 box must cover
+    # the full baseline, not the longest single campaign
+    span_s = (max(t.day.max() for t in toas_list)
+              - min(t.day.min() for t in toas_list)) * 86400.0 or 86400.0
     prior_info = {}
     for pname in model.free_params:
         par = getattr(model, pname)
