@@ -756,7 +756,8 @@ class WidebandTOAFitter(GLSFitter):
             from .residuals import wideband_dm_model
 
             p = prepared.params_with_vector(x)
-            dm = wideband_dm_model(self.model, p, prepared.prep)
+            dm = wideband_dm_model(self.model, p, prepared.prep,
+                                   batch=prepared.batch)
             return dm[jnp.asarray(np.flatnonzero(valid))]
 
         x0 = prepared.vector_from_params()
@@ -845,7 +846,8 @@ class WidebandTOAFitter(GLSFitter):
             p = prepared.params_with_vector(x)
             r_t = resid_fn(x)
             sig_t = prepared.scaled_sigma_us(p) * 1e-6
-            dm = wideband_dm_model(self.model, p, prepared.prep)[idx]
+            dm = wideband_dm_model(self.model, p, prepared.prep,
+                                   batch=prepared.batch)[idx]
             r = jnp.concatenate([r_t, dm_meas - dm])
             sigma = jnp.concatenate([sig_t, sigma_dm])
             rw2 = jnp.sum(jnp.square(r / sigma))

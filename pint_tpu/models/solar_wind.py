@@ -198,13 +198,22 @@ class SolarWindDispersionX(SolarWindDispersion):
     def delay(self, params, batch, prep, delay_accum):
         import jax.numpy as jnp
 
+        dm = self.swx_dm(params, batch, prep)
+        f2 = jnp.square(batch.freq_mhz)
+        return jnp.where(jnp.isfinite(f2), DMconst * dm / f2, 0.0)
+
+    def swx_dm(self, params, batch, prep):
+        """Per-TOA solar-wind DM [pc cm^-3]: SWX windows (max-DM
+        convention) + NE_SW base outside every window. Shared by
+        delay() and TimingModel.total_dm."""
+        import jax.numpy as jnp
+
         astrom = next((c for c in self._parent.delay_components()
                        if c.category == "astrometry"), None)
         masks = prep["swx_masks"]
-        f2 = jnp.square(batch.freq_mhz)
         base_dm = self.solar_wind_dm(params, batch, prep)
         if masks.shape[0] == 0 or astrom is None:
-            return jnp.where(jnp.isfinite(f2), DMconst * base_dm / f2, 0.0)
+            return base_dm
         n_hat = astrom.ssb_to_psb_xyz(params, prep)
         # per-window geometry (k, n): window j uses its own power index
         G = solar_wind_geometry_p(batch.obs_sun_ls[None, :, :],
@@ -217,5 +226,4 @@ class SolarWindDispersionX(SolarWindDispersion):
         gmax = jnp.where(gmax > 0, gmax, 1.0)
         dm_x = jnp.sum((params["SWXDM"] / gmax)[:, None] * G * masks, axis=0)
         in_any = jnp.clip(jnp.sum(masks, axis=0), 0.0, 1.0)
-        dm = dm_x + base_dm * (1.0 - in_any)
-        return jnp.where(jnp.isfinite(f2), DMconst * dm / f2, 0.0)
+        return dm_x + base_dm * (1.0 - in_any)

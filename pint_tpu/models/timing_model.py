@@ -360,6 +360,39 @@ class TimingModel:
         prep = self.prepare(toas)
         return prep.scaled_sigma_us()
 
+    def total_dm(self, toas):
+        """Model DM at each TOA [pc/cm^3]: every nu^-2 dispersion
+        contribution — Taylor DM series, DMX windows, DMWaveX, solar
+        wind (reference: TimingModel.total_dm). DMJUMP offsets are
+        excluded — they apply to wideband DM measurements, not the
+        model DM."""
+        from ..residuals import wideband_dm_model
+
+        prepared = self.prepare(toas)
+        return np.asarray(wideband_dm_model(
+            self, prepared.params0, prepared.prep, batch=prepared.batch,
+            include_jumps=False))
+
+    def d_phase_d_toa(self, toas, sample_step_s=1.0):
+        """Instantaneous topocentric spin frequency [Hz] at each TOA
+        (reference: TimingModel.d_phase_d_toa — a finite-difference
+        sample window through the full pipeline, so every delay's time
+        dependence, including Doppler from observatory motion, is in
+        the derivative)."""
+        h = float(sample_step_s)
+        # mask(all-True) is the cheap structural copy: fresh day/sec/
+        # clock arrays, no duplication of cached posvel/ephemeris data
+        keep = np.ones(len(toas), dtype=bool)
+        tp = toas.mask(keep)
+        tp.adjust_times(+h)
+        tm = toas.mask(keep)
+        tm.adjust_times(-h)
+        php = self.prepare(tp).phase()
+        phm = self.prepare(tm).phase()
+        dint = np.asarray(php.int_) - np.asarray(phm.int_)
+        dfrac = np.asarray(php.frac) - np.asarray(phm.frac)
+        return (dint + dfrac) / (2.0 * h)
+
     def _delay_until(self, prepared, stop_comp):
         """Accumulated delay over delay_components() up to but
         excluding ``stop_comp`` (None = all components) — the one home

@@ -180,14 +180,21 @@ class DMWaveX(DelayComponent):
                   + (toas.tdb.sec - sec) / SECS_PER_DAY)
         prep["dmwavex_dt_day"] = jnp.asarray(dt_day)
 
+    def dm_value(self, params, prep):
+        """Fourier DM contribution [pc cm^-3] (shared by delay and
+        TimingModel.total_dm / the wideband DM model)."""
+        import jax.numpy as jnp
+
+        t = prep["dmwavex_dt_day"]
+        arg = 2.0 * jnp.pi * params["DMWXFREQ"] * t[:, None]
+        return jnp.sum(params["DMWXSIN"] * jnp.sin(arg)
+                       + params["DMWXCOS"] * jnp.cos(arg), axis=-1)
+
     def delay(self, params, batch, prep, delay_accum):
         import jax.numpy as jnp
 
         from ..constants import DMconst
 
-        t = prep["dmwavex_dt_day"]
-        arg = 2.0 * jnp.pi * params["DMWXFREQ"] * t[:, None]
-        dm = jnp.sum(params["DMWXSIN"] * jnp.sin(arg)
-                     + params["DMWXCOS"] * jnp.cos(arg), axis=-1)
+        dm = self.dm_value(params, prep)
         f2 = jnp.square(batch.freq_mhz)
         return jnp.where(jnp.isfinite(f2), DMconst * dm / f2, 0.0)

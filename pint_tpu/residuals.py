@@ -165,18 +165,36 @@ class Residuals:
         return out
 
 
-def wideband_dm_model(model, params, prep):
-    """Effective per-TOA model DM for the wideband comparison:
-    DM(t) Taylor series + DMX windows + DMJUMP mask offsets. The one
-    assembly point shared by WidebandDMResiduals and the wideband
-    fitter's DM design block, so derivatives and residuals can't
-    disagree (reference: dispersion components' contribution to
-    WidebandDMResiduals)."""
+def wideband_dm_model(model, params, prep, batch=None, include_jumps=True):
+    """Effective per-TOA model DM: DM(t) Taylor series + DMX windows
+    + DMWaveX Fourier terms (+ solar wind when ``batch`` is given;
+    its geometry needs the Sun vectors) + DMJUMP mask offsets. The one
+    assembly point shared by WidebandDMResiduals, the wideband
+    fitter's DM design block, and TimingModel.total_dm, so
+    derivatives, residuals, and the reported model DM can't disagree
+    (reference: dispersion components' contribution to
+    WidebandDMResiduals / TimingModel.total_dm)."""
+    import jax.numpy as jnp
+
     comp = model.components.get("DispersionDM")
-    dm = comp.dm_value(params, prep)
+    # a model can carry DMX/DMWaveX/solar-wind dispersion without a
+    # Taylor DM line (builder adds the components independently)
+    dm = (comp.dm_value(params, prep) if comp is not None
+          else jnp.zeros_like(prep["T_hi"]))
     if "DispersionDMX" in model.components:
         dm = dm + params["DMX"] @ prep["dmx_masks"]
-    if "DispersionJump" in model.components and len(params.get("DMJUMP", ())):
+    if "DMWaveX" in model.components:
+        dm = dm + model.components["DMWaveX"].dm_value(params, prep)
+    if batch is not None:
+        sw = model.components.get("SolarWindDispersionX")
+        if sw is not None:
+            dm = dm + sw.swx_dm(params, batch, prep)
+        else:
+            sw = model.components.get("SolarWindDispersion")
+            if sw is not None:
+                dm = dm + sw.solar_wind_dm(params, batch, prep)
+    if (include_jumps and "DispersionJump" in model.components
+            and len(params.get("DMJUMP", ()))):
         # upstream sign convention (dispersion_model.py::DispersionJump
         # jump_dm): the jump enters the MODEL DM with a minus sign, so
         # d(DM_resid)/d(DMJUMP) = +1 and par files interchange with the
@@ -206,7 +224,8 @@ class WidebandDMResiduals:
 
     def calc_dm_resids(self, params=None):
         p = self.prepared.params0 if params is None else params
-        dm_model = wideband_dm_model(self.model, p, self.prepared.prep)
+        dm_model = wideband_dm_model(self.model, p, self.prepared.prep,
+                                     batch=self.prepared.batch)
         return self.dm_observed - np.asarray(dm_model)
 
     @property

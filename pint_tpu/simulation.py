@@ -88,7 +88,8 @@ def _add_wideband_dm(toas: TOAs, model, rng, dm_error_pccm3, add_noise):
 
     prepared = model.prepare(toas)
     dm_model = np.asarray(wideband_dm_model(model, prepared.params0,
-                                            prepared.prep))
+                                            prepared.prep,
+                                            batch=prepared.batch))
     dm_obs = dm_model.copy()
     if add_noise:
         dm_obs = dm_obs + rng.standard_normal(len(toas)) * dm_error_pccm3

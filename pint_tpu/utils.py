@@ -471,3 +471,23 @@ def wavex_to_plrednoise(model, t_span_days=None):
     model.TNREDC.value = len(ids)
     model.setup()
     return model
+
+
+def akaike_information_criterion(model, toas):
+    """AIC = 2k - 2 ln L over the white-noise likelihood, k = free
+    params + 1 (implicit phase offset) (reference:
+    src/pint/utils.py::akaike_information_criterion)."""
+    from .residuals import Residuals
+
+    k = len(model.free_params) + 1
+    return 2.0 * k - 2.0 * Residuals(toas, model).lnlikelihood()
+
+
+def bayesian_information_criterion(model, toas):
+    """BIC = k ln n - 2 ln L (reference:
+    src/pint/utils.py::bayesian_information_criterion)."""
+    from .residuals import Residuals
+
+    k = len(model.free_params) + 1
+    return (k * float(np.log(len(toas)))
+            - 2.0 * Residuals(toas, model).lnlikelihood())

@@ -245,3 +245,101 @@ def test_get_derived_params():
     assert d["MASSFN_Msun"][0] == pytest.approx(float(mass_function(2.0, 3.0)))
     assert d["MC_MIN_Msun"][0] < d["MC_MED_Msun"][0]
     assert 0.5 < d["MP_Msun"][0] < 3.0
+
+
+def test_d_phase_d_toa_spin_frequency():
+    """d_phase_d_toa: F0 + F1*dt exactly at the barycenter; Doppler-
+    modulated at a ground site (reference: TimingModel.d_phase_d_toa)."""
+    import numpy as np
+
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    par = ("PSR TDPDT\nRAJ 6:00:00\nDECJ 10:00:00\nF0 300.0 1\n"
+           "F1 -1e-13 1\nPEPOCH 55000\nDM 0\n")
+    m = get_model(par)
+    mjds = np.linspace(54800, 55200, 12)
+    t_bary = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=1400.0,
+                                     obs="@", add_noise=False, iterations=0)
+    f_bary = m.d_phase_d_toa(t_bary)
+    dt = (np.asarray(t_bary.tdb.day) - 55000) * 86400.0 \
+        + np.asarray(t_bary.tdb.sec)
+    expect = 300.0 - 1e-13 * dt
+    np.testing.assert_allclose(f_bary, expect, rtol=1e-10)
+
+    t_gbt = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=1400.0,
+                                    obs="gbt", add_noise=False, iterations=0)
+    f_gbt = m.d_phase_d_toa(t_gbt)
+    frac = f_gbt / expect - 1.0
+    # Earth orbital Doppler: |v/c| <= ~1.1e-4, and it must actually vary
+    assert np.abs(frac).max() < 1.2e-4
+    assert np.abs(frac).max() > 1e-6
+    assert frac.std() > 1e-6
+
+
+def test_total_dm_sums_dispersion_components():
+    """total_dm = DM Taylor series + DMX window + solar wind
+    (reference: TimingModel.total_dm)."""
+    import numpy as np
+
+    from pint_tpu.constants import DMconst
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    par = ("PSR TTDM\nRAJ 6:00:00\nDECJ 10:00:00\nF0 300.0 1\n"
+           "PEPOCH 55000\nDM 15.0 1\nDM1 0.002\nDMEPOCH 55000\n"
+           "DMX_0001 0.01\nDMXR1_0001 55100\nDMXR2_0001 55200\n"
+           "DMWXEPOCH 55000\nDMWXFREQ_0001 0.005\n"
+           "DMWXSIN_0001 0.003\nDMWXCOS_0001 -0.001\n"
+           "NE_SW 8.0\n")
+    m = get_model(par)
+    mjds = np.linspace(54900, 55300, 40)
+    t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=1400.0,
+                                obs="gbt", add_noise=False, iterations=0)
+    dm = m.total_dm(t)
+    # DM series by hand (Julian years since DMEPOCH)
+    dt_yr = ((np.asarray(t.tdb.day) - 55000) * 86400.0
+             + np.asarray(t.tdb.sec)) / (365.25 * 86400.0)
+    expect = 15.0 + 0.002 * dt_yr
+    win = (t.get_mjds() >= 55100) & (t.get_mjds() <= 55200)
+    expect = expect + 0.01 * win
+    # DMWaveX Fourier term (dt in days from DMWXEPOCH)
+    dt_day = (np.asarray(t.tdb.day) - 55000) + np.asarray(t.tdb.sec) / 86400.0
+    arg = 2 * np.pi * 0.005 * dt_day
+    expect = expect + 0.003 * np.sin(arg) - 0.001 * np.cos(arg)
+    # solar wind adds a small positive DM; subtract the no-SW model
+    m0 = get_model(par.replace("NE_SW 8.0\n", ""))
+    dm0 = m0.total_dm(t)
+    np.testing.assert_allclose(dm0, expect, rtol=0, atol=1e-12)
+    sw = dm - dm0
+    assert (sw > 0).all() and sw.max() < 1.0  # ne_sw=8: small DM, varies
+    assert sw.std() > 0
+    # and the solar-wind DM matches the component's delay * f^2/DMconst
+    # (delta of two full delay chains would add ~1e-13 s f64 noise from
+    # the ~500 s Roemer term, so compare against the component directly)
+    import jax.numpy as jnp
+
+    pp = m.prepare(t)
+    comp = m.components["SolarWindDispersion"]
+    d_sw = np.asarray(comp.delay(pp.params0, pp.batch, pp.prep,
+                                 jnp.zeros(len(t))))
+    np.testing.assert_allclose(sw, d_sw * 1400.0**2 / DMconst, rtol=1e-9)
+
+
+def test_total_dm_without_taylor_dm_line():
+    """A par with solar wind / DMX but no DM line still reports its
+    dispersion (review finding: the Taylor base is optional)."""
+    import numpy as np
+
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    par = ("PSR TNODM\nRAJ 6:00:00\nDECJ 10:00:00\nF0 300.0 1\n"
+           "PEPOCH 55000\nNE_SW 8.0\n")
+    m = get_model(par)
+    assert "DispersionDM" not in m.components
+    t = make_fake_toas_fromMJDs(np.linspace(54900, 55100, 10), m,
+                                error_us=1.0, freq_mhz=1400.0, obs="gbt",
+                                add_noise=False, iterations=0)
+    dm = m.total_dm(t)
+    assert (dm > 0).all() and dm.max() < 1.0  # pure solar-wind DM

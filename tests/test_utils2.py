@@ -200,3 +200,37 @@ def test_wavex_to_plrednoise_estimation_from_fit():
     # one realization of 8 harmonics: loose bounds only
     assert 0.5 < out.TNREDGAM.value < 6.5
     assert -15.0 < out.TNREDAMP.value < -10.0
+
+
+def test_information_criteria_prefer_true_model():
+    """AIC/BIC penalize an overparameterized model on white-noise data
+    (reference: utils.py::akaike_information_criterion)."""
+    import numpy as np
+
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+    from pint_tpu.fitter import WLSFitter
+    from pint_tpu.utils import (akaike_information_criterion,
+                                bayesian_information_criterion)
+
+    par = ("PSR TAIC\nRAJ 6:00:00\nDECJ 10:00:00\nF0 200.0 1\nF1 -1e-14 1\n"
+           "PEPOCH 55000\nDM 10.0 1\n")
+    true = get_model(par)
+    t = make_fake_toas_fromMJDs(np.linspace(54700, 55300, 150), true,
+                                error_us=1.0, freq_mhz=1400.0, obs="gbt",
+                                add_noise=True, seed=6)
+    f_true = WLSFitter(t, true)
+    f_true.fit_toas(maxiter=3)
+    # overparameterized: 6 extra glitch params the data doesn't need
+    over = get_model(par + "GLEP_1 55000\nGLPH_1 0 1\nGLF0_1 0 1\n"
+                     "GLF1_1 0 1\n")
+    f_over = WLSFitter(t, over)
+    f_over.fit_toas(maxiter=3)
+    aic_t = akaike_information_criterion(f_true.model, t)
+    aic_o = akaike_information_criterion(f_over.model, t)
+    bic_t = bayesian_information_criterion(f_true.model, t)
+    bic_o = bayesian_information_criterion(f_over.model, t)
+    assert np.isfinite([aic_t, aic_o, bic_t, bic_o]).all()
+    assert aic_t < aic_o and bic_t < bic_o
+    # BIC penalizes extra params harder than AIC at n=150
+    assert (bic_o - bic_t) > (aic_o - aic_t)
