@@ -185,14 +185,19 @@ def wideband_dm_model(model, params, prep, batch=None, include_jumps=True):
         dm = dm + params["DMX"] @ prep["dmx_masks"]
     if "DMWaveX" in model.components:
         dm = dm + model.components["DMWaveX"].dm_value(params, prep)
-    if batch is not None:
-        sw = model.components.get("SolarWindDispersionX")
-        if sw is not None:
-            dm = dm + sw.swx_dm(params, batch, prep)
-        else:
-            sw = model.components.get("SolarWindDispersion")
-            if sw is not None:
-                dm = dm + sw.solar_wind_dm(params, batch, prep)
+    sw = (model.components.get("SolarWindDispersionX")
+          or model.components.get("SolarWindDispersion"))
+    if sw is not None:
+        if batch is None:
+            # dropping the solar-wind term silently would reintroduce
+            # the derivatives-vs-residuals divergence this function
+            # exists to prevent
+            raise ValueError(
+                "model has a solar-wind component; wideband_dm_model "
+                "needs the TOA batch (Sun vectors) — pass batch=")
+        dm = dm + (sw.swx_dm(params, batch, prep)
+                   if hasattr(sw, "swx_dm")
+                   else sw.solar_wind_dm(params, batch, prep))
     if (include_jumps and "DispersionJump" in model.components
             and len(params.get("DMJUMP", ()))):
         # upstream sign convention (dispersion_model.py::DispersionJump

@@ -473,21 +473,31 @@ def wavex_to_plrednoise(model, t_span_days=None):
     return model
 
 
+def _white_noise_lnlikelihood(model, toas):
+    """ln L for the information criteria — white-noise only, so a
+    model with correlated noise (ECORR/red noise) is rejected loudly
+    rather than silently mis-ranked (reference: src/pint/utils.py
+    akaike_information_criterion guard)."""
+    from .fitter import CorrelatedErrors, _correlated_noise_components
+    from .residuals import Residuals
+
+    corr = _correlated_noise_components(model)
+    if corr:
+        raise CorrelatedErrors(corr)
+    return Residuals(toas, model).lnlikelihood()
+
+
 def akaike_information_criterion(model, toas):
     """AIC = 2k - 2 ln L over the white-noise likelihood, k = free
     params + 1 (implicit phase offset) (reference:
     src/pint/utils.py::akaike_information_criterion)."""
-    from .residuals import Residuals
-
     k = len(model.free_params) + 1
-    return 2.0 * k - 2.0 * Residuals(toas, model).lnlikelihood()
+    return 2.0 * k - 2.0 * _white_noise_lnlikelihood(model, toas)
 
 
 def bayesian_information_criterion(model, toas):
     """BIC = k ln n - 2 ln L (reference:
     src/pint/utils.py::bayesian_information_criterion)."""
-    from .residuals import Residuals
-
     k = len(model.free_params) + 1
     return (k * float(np.log(len(toas)))
-            - 2.0 * Residuals(toas, model).lnlikelihood())
+            - 2.0 * _white_noise_lnlikelihood(model, toas))

@@ -1,0 +1,53 @@
+"""Golden-tensor regression tests.
+
+(reference test pattern: SURVEY.md section 4 pattern 1 — the reference
+pins physics against precomputed TEMPO/Tempo2 outputs. No external
+golden files can exist offline, so these tensors are this framework's
+own frozen outputs on the shipped NGC6440E example; they pin the FULL
+pipeline (tim parse -> clock -> TDB -> ephemeris -> delays -> phase ->
+residuals) against accidental physics drift across refactors. Any
+intentional physics change must regenerate them (see the module
+docstring of the generator block in git history) and justify the delta
+in the commit message.
+"""
+
+import os
+import warnings
+
+import numpy as np
+
+warnings.simplefilter("ignore")
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+PAR = os.path.join(HERE, "..", "pint_tpu", "data", "examples", "NGC6440E.par")
+TIM = os.path.join(HERE, "..", "pint_tpu", "data", "examples", "NGC6440E.tim")
+
+
+def test_ngc6440e_prefit_residuals_frozen():
+    from pint_tpu.models import get_model
+    from pint_tpu.residuals import Residuals
+    from pint_tpu.toa import get_TOAs
+
+    golden = np.load(os.path.join(HERE, "golden",
+                                  "ngc6440e_prefit_resids_us.npy"))
+    m = get_model(PAR)
+    t = get_TOAs(TIM, usepickle=False)
+    r = Residuals(t, m)
+    resid_us = np.asarray(r.calc_time_resids()) * 1e6
+    assert resid_us.shape == golden.shape
+    # 1 ns bar: any real physics change shows up orders of magnitude
+    # above this; pure refactors must stay below it
+    np.testing.assert_allclose(resid_us, golden, rtol=0, atol=1e-3)
+    assert abs(r.rms_weighted() * 1e6 - 23.349206) < 1e-3
+
+
+def test_ngc6440e_delays_frozen():
+    from pint_tpu.models import get_model
+    from pint_tpu.toa import get_TOAs
+
+    golden = np.load(os.path.join(HERE, "golden", "ngc6440e_delays_s.npy"))
+    m = get_model(PAR)
+    t = get_TOAs(TIM, usepickle=False)
+    d = np.asarray(m.delay(t))
+    # delays are ~500 s (Roemer); 1 ns absolute agreement
+    np.testing.assert_allclose(d, golden, rtol=0, atol=1e-9)

@@ -234,3 +234,21 @@ def test_information_criteria_prefer_true_model():
     assert aic_t < aic_o and bic_t < bic_o
     # BIC penalizes extra params harder than AIC at n=150
     assert (bic_o - bic_t) > (aic_o - aic_t)
+
+
+def test_information_criteria_reject_correlated_noise():
+    import numpy as np
+    import pytest
+
+    from pint_tpu.fitter import CorrelatedErrors
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+    from pint_tpu.utils import akaike_information_criterion
+
+    m = get_model("PSR TAICC\nRAJ 6:00:00\nDECJ 10:00:00\nF0 200.0 1\n"
+                  "PEPOCH 55000\nDM 10.0\nECORR 0.5\n")
+    t = make_fake_toas_fromMJDs(np.linspace(54900, 55100, 20), m,
+                                error_us=1.0, freq_mhz=1400.0, obs="gbt",
+                                add_noise=True, seed=1)
+    with pytest.raises(CorrelatedErrors):
+        akaike_information_criterion(m, t)
