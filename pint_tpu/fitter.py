@@ -100,6 +100,48 @@ class Fitter:
             self.parameter_covariance_matrix, names, units)
         self.correlation_matrix = self.covariance_matrix.to_correlation()
 
+    def _capture_noise_bases(self, prepared, params=None):
+        """Store the per-component basis matrices (TOA rows) evaluated
+        at the fitted state, so get_noise_resids uses the EXACT bases
+        the amplitudes were solved against (param-dependent bases can
+        drift under a re-prepare) and pays no extra prepare."""
+        p = prepared.params0 if params is None else params
+        segs = []
+        # iteration order matches the bases assembly in _noise_bases /
+        # _noise_bases_padded (model.components dict order)
+        for name, comp in self.model.components.items():
+            bw = getattr(comp, "basis_weight", None)
+            if bw is None:
+                continue
+            B, _ = bw(p, prepared.prep)
+            if B.shape[1]:
+                segs.append((name, np.asarray(B)))
+        self._noise_basis_segments = segs
+
+    def get_noise_resids(self):
+        """Per-component noise realizations [s] from the last GLS-family
+        fit: {component name: basis @ fitted amplitudes} over the TOA
+        rows (reference: Residuals.noise_resids populated by GLSFitter).
+        Subtracting them from the time residuals whitens the correlated
+        part: r_white = r - sum(realizations)."""
+        if self.noise_ampls is None:
+            raise ValueError(
+                "no fitted noise amplitudes — run fit_toas() on a "
+                "GLS-family fitter with ECORR/red-noise components first")
+        if getattr(self, "_noise_basis_segments", None) is None:
+            self._capture_noise_bases(self.model.prepare(self.toas))
+        out = {}
+        k0 = 0
+        for name, B in self._noise_basis_segments:
+            k = B.shape[1]
+            out[name] = B @ np.asarray(self.noise_ampls[k0:k0 + k])
+            k0 += k
+        if k0 != len(self.noise_ampls):
+            raise RuntimeError(
+                f"noise basis layout changed since the fit "
+                f"({k0} columns vs {len(self.noise_ampls)} amplitudes)")
+        return out
+
     def get_designmatrix(self):
         """Labeled time-residual design matrix [s/param-unit]
         (reference: pint_matrix.py::DesignMatrix from
@@ -710,6 +752,9 @@ class GLSFitter(Fitter):
         chi2, x, cov, self.noise_ampls = best
         if self.noise_ampls is None:
             self.noise_ampls = first_na
+        if self.noise_ampls is not None:
+            self._capture_noise_bases(prepared,
+                                      prepared.params_with_vector(x))
         self._sync_model_from_vector(prepared, x)
         cov = cov if cov is not None else first_cov
         if cov is not None:

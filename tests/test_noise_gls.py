@@ -284,3 +284,28 @@ def test_temponest_noise_spellings():
     s_tn = np.asarray(m_tn.scaled_toa_uncertainty(t))
     s_c = np.asarray(m_c.scaled_toa_uncertainty(t))
     np.testing.assert_allclose(s_tn, s_c, rtol=1e-12)
+
+
+def test_get_noise_resids_whitens():
+    """The fitted red-noise realization explains the injected
+    correlated power: subtracting it returns the residual RMS to the
+    white level (reference: GLSFitter populating noise_resids)."""
+    par = ("PSR TNRZ\nRAJ 6:00:00\nDECJ 10:00:00\nF0 200.0 1\nF1 -1e-14 1\n"
+           "PEPOCH 55500\nDM 10.0\nTNREDAMP -13\nTNREDGAM 3.0\nTNREDC 15\n")
+    m = get_model(par)
+    t = make_fake_toas_fromMJDs(np.linspace(55000, 56000, 150), m,
+                                error_us=0.5, freq_mhz=1400.0, obs="gbt",
+                                add_noise=True, add_correlated_noise=True,
+                                seed=9)
+    f = GLSFitter(t, m)
+    f.fit_toas(maxiter=3)
+    nr = f.get_noise_resids()
+    assert set(nr) == {"PLRedNoise"}
+    r = np.asarray(f.resids.calc_time_resids())
+    r_white = r - nr["PLRedNoise"]
+    assert r.std() > 1.5 * r_white.std()  # realization carries real power
+    assert r_white.std() < 0.7e-6  # back to ~0.5 us white level
+    # unfitted model refuses
+    f2 = GLSFitter(t, get_model(par))
+    with pytest.raises(ValueError, match="amplitudes"):
+        f2.get_noise_resids()
