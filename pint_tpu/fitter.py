@@ -100,12 +100,15 @@ class Fitter:
             self.parameter_covariance_matrix, names, units)
         self.correlation_matrix = self.covariance_matrix.to_correlation()
 
-    def _capture_noise_bases(self, prepared, params=None):
-        """Store the per-component basis matrices (TOA rows) evaluated
-        at the fitted state, so get_noise_resids uses the EXACT bases
-        the amplitudes were solved against (param-dependent bases can
-        drift under a re-prepare) and pays no extra prepare."""
-        p = prepared.params0 if params is None else params
+    def _capture_noise_bases(self, prepared):
+        """Store the per-component basis matrices (TOA rows) from the
+        fit's own ``prepared``. Basis matrices are fixed per prepare
+        (only the prior weights depend on params), but a RE-prepare on
+        the post-fit model can rebuild them differently (e.g.
+        PLSWNoise's geometry row-scale uses the pack-time position) —
+        capturing here pairs get_noise_resids' bases with the exact
+        prepare the amplitudes were solved against, and skips the
+        extra prepare."""
         segs = []
         # iteration order matches the bases assembly in _noise_bases /
         # _noise_bases_padded (model.components dict order)
@@ -113,7 +116,7 @@ class Fitter:
             bw = getattr(comp, "basis_weight", None)
             if bw is None:
                 continue
-            B, _ = bw(p, prepared.prep)
+            B, _ = bw(prepared.params0, prepared.prep)
             if B.shape[1]:
                 segs.append((name, np.asarray(B)))
         self._noise_basis_segments = segs
@@ -753,8 +756,7 @@ class GLSFitter(Fitter):
         if self.noise_ampls is None:
             self.noise_ampls = first_na
         if self.noise_ampls is not None:
-            self._capture_noise_bases(prepared,
-                                      prepared.params_with_vector(x))
+            self._capture_noise_bases(prepared)
         self._sync_model_from_vector(prepared, x)
         cov = cov if cov is not None else first_cov
         if cov is not None:
@@ -961,6 +963,10 @@ class WidebandTOAFitter(GLSFitter):
             self.noise_ampls = None
         else:
             chi2 = final_chi2
+            if self.noise_ampls is not None:
+                # the loop's last `prepared` is the one the amplitudes
+                # were solved against
+                self._capture_noise_bases(prepared)
         self.resids = WidebandTOAResiduals(self.toas, self.model)
         self.converged = True
         self.chi2_whitened = chi2
@@ -1025,6 +1031,8 @@ class WidebandDownhillFitter(WidebandTOAFitter):
                 self.metrics = fit_metrics(t_start, 0.0, iter_s, self.toas,
                                            self.model)
                 raise MaxiterReached(maxiter, best_chi2)
+        if self.noise_ampls is not None:
+            self._capture_noise_bases(prepared)
         self.resids = WidebandTOAResiduals(self.toas, self.model)
         self.converged = True
         self.chi2_whitened = best_chi2
@@ -1087,6 +1095,8 @@ class WidebandLMFitter(WidebandTOAFitter):
             dx_all, _, _ = gls_solve(Mfull, r, sigma, sqrt_phi_inv)
             self.noise_ampls = (np.asarray(dx_all[nparam2:])
                                 if bases[0] is not None else None)
+            if self.noise_ampls is not None:
+                self._capture_noise_bases(prepared)
             self._set_uncertainties(prepared, cov_all[noff:nparam,
                                                       noff:nparam])
         self.resids = WidebandTOAResiduals(self.toas, self.model)

@@ -152,3 +152,72 @@ def test_astrometry_position_derivatives(prepared_sink):
     bound = 173.7 * 499.0
     assert np.abs(col).max() < bound
     assert np.abs(col).max() > 0.01 * bound
+
+
+def test_bt_piecewise_piece_derivative_columns():
+    """T0X/A1X piece columns: jacfwd vs central differences, and zero
+    outside the piece window (the gather must not leak)."""
+    par = ("PSR TDPW\nRAJ 10:00:00\nDECJ 20:00:00\nF0 150.0 1\n"
+           "PEPOCH 55300\nDM 5.0\nBINARY BT_piecewise\n"
+           "PB 8.0\nA1 12.0 1\nT0 55300 1\nECC 0.12\nOM 45.0\n"
+           "T0X_0001 55300.0001 1\nA1X_0001 12.01 1\n"
+           "XR1_0001 55350\nXR2_0001 55450\n")
+    m = get_model(par)
+    mjds = np.linspace(55300, 55500, 90)
+    t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=1400.0,
+                                obs="gbt", add_noise=False)
+    prepared = m.prepare(t)
+    dm_fn, labels = prepared.designmatrix_fn()
+    off = 1 if labels[0] == "Offset" else 0
+    x0 = np.asarray(prepared.vector_from_params())
+    M = np.asarray(dm_fn(prepared.vector_from_params()))
+    phase_fn = jax.jit(
+        lambda x: prepared._phase_continuous(prepared.params_with_vector(x)))
+    names = [n for n, _, _ in prepared.free_param_map()]
+    win = (t.get_mjds() >= 55350) & (t.get_mjds() < 55450)
+    for name, rel in (("T0X_0001", 1e-11), ("A1X_0001", 1e-8)):
+        j = names.index(name)
+        h = abs(x0[j]) * rel
+        xp, xm = x0.copy(), x0.copy()
+        xp[j] += h
+        xm[j] -= h
+        dnum = (np.asarray(phase_fn(xp)) - np.asarray(phase_fn(xm))) / (2 * h)
+        dana = M[:, off + j]
+        scale = max(np.abs(dnum).max(), np.abs(dana).max())
+        assert np.abs(dana - dnum).max() / scale < 2e-5, name
+        # mean subtraction spreads a constant over all TOAs; the
+        # *variation* must live only inside the window
+        outside = dana[~win]
+        assert np.ptp(outside) < 1e-6 * np.ptp(dana), name
+
+
+def test_swx_dm_derivative_columns():
+    """SWXDM piece columns: jacfwd vs central differences (the
+    window-normalized geometry factor is itself param-independent, so
+    the column must be exactly linear in SWXDM)."""
+    par = ("PSR TDSWX\nRAJ 10:00:00\nDECJ 20:00:00\nF0 150.0 1\n"
+           "PEPOCH 55300\nDM 5.0\nNE_SW 4.0\n"
+           "SWXDM_0001 0.002 1\nSWXR1_0001 55300\nSWXR2_0001 55400\n"
+           "SWXP_0001 2.2\n")
+    m = get_model(par)
+    mjds = np.linspace(55250, 55450, 60)
+    t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=1400.0,
+                                obs="gbt", add_noise=False)
+    prepared = m.prepare(t)
+    dm_fn, labels = prepared.designmatrix_fn()
+    off = 1 if labels[0] == "Offset" else 0
+    x0 = np.asarray(prepared.vector_from_params())
+    M = np.asarray(dm_fn(prepared.vector_from_params()))
+    phase_fn = jax.jit(
+        lambda x: prepared._phase_continuous(prepared.params_with_vector(x)))
+    names = [n for n, _, _ in prepared.free_param_map()]
+    j = names.index("SWXDM_0001")
+    h = 0.001
+    xp, xm = x0.copy(), x0.copy()
+    xp[j] += h
+    xm[j] -= h
+    dnum = (np.asarray(phase_fn(xp)) - np.asarray(phase_fn(xm))) / (2 * h)
+    dana = M[:, off + j]
+    scale = max(np.abs(dnum).max(), np.abs(dana).max())
+    assert scale > 0
+    assert np.abs(dana - dnum).max() / scale < 2e-5

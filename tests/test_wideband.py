@@ -213,6 +213,12 @@ def test_wideband_gls_with_red_noise_and_ecorr():
     fit = WidebandTOAFitter(t, m2)
     chi2 = fit.fit_toas(maxiter=3)
     assert fit.noise_ampls is not None and len(fit.noise_ampls) > 0
+    # per-component realizations over the TOA rows, eager-captured
+    # against the fit's own prepare
+    nr = fit.get_noise_resids()
+    assert set(nr) == {"EcorrNoise", "PLRedNoise"}
+    assert all(v.shape == (len(t),) for v in nr.values())
+    assert fit._noise_basis_segments is not None
     # F0 recovered despite injected red+ECORR noise
     assert abs(fit.model.F0.value - m.F0.value) < 5e-11
     assert abs(fit.model.DM.value - 15.99) < 1e-3
