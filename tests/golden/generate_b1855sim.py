@@ -1,0 +1,95 @@
+"""Regenerate the b1855sim golden pack (par/tim/prefit-resid tensor).
+
+Run after an INTENTIONAL physics change, then update the frozen wrms /
+whitened-chi2 constants in tests/test_golden.py from the printed
+values and justify the delta in the commit message:
+
+    python tests/golden/generate_b1855sim.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import warnings
+
+import numpy as np
+
+warnings.simplefilter("ignore")
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+PAR = """PSR B1855+09SIM
+RAJ 18:57:36.39
+DECJ 09:43:17.2
+PMRA -2.65 1
+PMDEC -5.42 1
+PX 0.7 1
+POSEPOCH 54000
+F0 186.49408156698 1
+F1 -6.2049e-16 1
+PEPOCH 54000
+DM 13.29 1
+DMX_0001 0.0012
+DMXR1_0001 53400
+DMXR2_0001 53500
+BINARY ELL1H
+PB 12.32717 1
+A1 9.230780 1
+TASC 53601.0 1
+EPS1 -2.15e-5 1
+EPS2 -3.1e-6 1
+H3 2.7e-7 1
+STIGMA 0.72 1
+EFAC -f L-wide 1.1
+EQUAD -f L-wide 0.3
+ECORR -f L-wide 0.7
+RNAMP 2e-13
+RNIDX -3.2
+TNREDC 20
+"""
+
+
+def main():
+    from pint_tpu.fitter import GLSFitter
+    from pint_tpu.models import get_model
+    from pint_tpu.residuals import Residuals
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+    from pint_tpu.toa import get_TOAs
+
+    parfile = os.path.join(HERE, "b1855sim.par")
+    timfile = os.path.join(HERE, "b1855sim.tim")
+    with open(parfile, "w") as fh:
+        fh.write(PAR)
+    m = get_model(parfile)
+    rng = np.random.default_rng(1855)
+    days = np.sort(rng.uniform(53300, 55300, 100))
+    mjds = np.sort(np.concatenate([days + k * 0.4 / 86400
+                                   for k in range(3)]))
+    freqs = np.tile([430.0, 1410.0, 2380.0], 100)
+    t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=freqs,
+                                obs="arecibo", add_noise=True,
+                                add_correlated_noise=True, seed=1855)
+    for f in t.flags:
+        f["f"] = "L-wide"
+    t.write_TOA_file(timfile)
+    t2 = get_TOAs(timfile, usepickle=False)
+    r = Residuals(t2, m)
+    resid_us = np.asarray(r.calc_time_resids()) * 1e6
+    np.save(os.path.join(HERE, "b1855sim_prefit_resids_us.npy"), resid_us)
+    f = GLSFitter(t2, m)
+    f.fit_toas(maxiter=2)
+    print("update tests/test_golden.py constants:")
+    print("  n=%d prefit wrms=%.6f us postfit whitened chi2=%.6f" % (
+        len(t2), r.rms_weighted() * 1e6, f.chi2_whitened))
+
+
+if __name__ == "__main__":
+    main()

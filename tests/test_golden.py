@@ -51,3 +51,31 @@ def test_ngc6440e_delays_frozen():
     d = np.asarray(m.delay(t))
     # delays are ~500 s (Roemer); 1 ns absolute agreement
     np.testing.assert_allclose(d, golden, rtol=0, atol=1e-9)
+
+
+def test_b1855sim_binary_noise_frozen():
+    """Golden pack #2: B1855-like ELL1H + DMX + EFAC/EQUAD/ECORR/red
+    noise, simulated once and committed as par/tim — pins the binary +
+    noise + multi-frequency pipeline (reference golden pattern:
+    B1855+09 NANOGrav 9yv1 GLS files, SURVEY.md section 4 pattern 1)."""
+    from pint_tpu.fitter import GLSFitter
+    from pint_tpu.models import get_model
+    from pint_tpu.residuals import Residuals
+    from pint_tpu.toa import get_TOAs
+
+    par = os.path.join(HERE, "golden", "b1855sim.par")
+    tim = os.path.join(HERE, "golden", "b1855sim.tim")
+    golden = np.load(os.path.join(HERE, "golden",
+                                  "b1855sim_prefit_resids_us.npy"))
+    m = get_model(par)
+    t = get_TOAs(tim, usepickle=False)
+    assert len(t) == 300
+    r = Residuals(t, m)
+    resid_us = np.asarray(r.calc_time_resids()) * 1e6
+    np.testing.assert_allclose(resid_us, golden, rtol=0, atol=1e-3)  # 1 ns
+    assert abs(r.rms_weighted() * 1e6 - 1.044006) < 1e-4
+    # GLS refit reproduces the frozen whitened chi2 (the full Woodbury
+    # noise path: ECORR quantization + red-noise Fourier basis)
+    f = GLSFitter(t, m)
+    f.fit_toas(maxiter=2)
+    assert abs(f.chi2_whitened - 207.511797) < 0.01
