@@ -145,6 +145,13 @@ class Fitter:
                 f"({k0} columns vs {len(self.noise_ampls)} amplitudes)")
         return out
 
+    def _attach_noise_resids(self):
+        """Set resids.noise_resids from the captured fit state
+        (reference parity: GLS fits attach per-component noise
+        realizations to the residuals)."""
+        self.resids.noise_resids = (self.get_noise_resids()
+                                    if self.noise_ampls is not None else {})
+
     def get_designmatrix(self):
         """Labeled time-residual design matrix [s/param-unit]
         (reference: pint_matrix.py::DesignMatrix from
@@ -763,6 +770,7 @@ class GLSFitter(Fitter):
             cov_host = cov_from_normalized(*cov)
             self._set_uncertainties(prepared, cov_host[noff:nparam, noff:nparam])
         self.resids = Residuals(self.toas, self.model)
+        self._attach_noise_resids()
         self.converged = True
         self.chi2_whitened = chi2
         self.metrics = fit_metrics(t_start, prep_s, iter_s, self.toas,
@@ -968,6 +976,7 @@ class WidebandTOAFitter(GLSFitter):
                 # were solved against
                 self._capture_noise_bases(prepared)
         self.resids = WidebandTOAResiduals(self.toas, self.model)
+        self._attach_noise_resids()
         self.converged = True
         self.chi2_whitened = chi2
         # wideband re-prepares inside each iteration, so prepare time is
@@ -1034,6 +1043,7 @@ class WidebandDownhillFitter(WidebandTOAFitter):
         if self.noise_ampls is not None:
             self._capture_noise_bases(prepared)
         self.resids = WidebandTOAResiduals(self.toas, self.model)
+        self._attach_noise_resids()
         self.converged = True
         self.chi2_whitened = best_chi2
         self.metrics = fit_metrics(t_start, 0.0, iter_s, self.toas,
@@ -1100,6 +1110,7 @@ class WidebandLMFitter(WidebandTOAFitter):
             self._set_uncertainties(prepared, cov_all[noff:nparam,
                                                       noff:nparam])
         self.resids = WidebandTOAResiduals(self.toas, self.model)
+        self._attach_noise_resids()
         self.converged = True
         self.chi2_whitened = best_chi2
         self.metrics = fit_metrics(t_start, 0.0, iter_s, self.toas,

@@ -26,35 +26,6 @@ warnings.simplefilter("ignore")
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 
-PAR = """PSR B1855+09SIM
-RAJ 18:57:36.39
-DECJ 09:43:17.2
-PMRA -2.65 1
-PMDEC -5.42 1
-PX 0.7 1
-POSEPOCH 54000
-F0 186.49408156698 1
-F1 -6.2049e-16 1
-PEPOCH 54000
-DM 13.29 1
-DMX_0001 0.0012
-DMXR1_0001 53400
-DMXR2_0001 53500
-BINARY ELL1H
-PB 12.32717 1
-A1 9.230780 1
-TASC 53601.0 1
-EPS1 -2.15e-5 1
-EPS2 -3.1e-6 1
-H3 2.7e-7 1
-STIGMA 0.72 1
-EFAC -f L-wide 1.1
-EQUAD -f L-wide 0.3
-ECORR -f L-wide 0.7
-RNAMP 2e-13
-RNIDX -3.2
-TNREDC 20
-"""
 
 
 def main():
@@ -64,10 +35,9 @@ def main():
     from pint_tpu.simulation import make_fake_toas_fromMJDs
     from pint_tpu.toa import get_TOAs
 
+    # the committed par file is the single source of truth
     parfile = os.path.join(HERE, "b1855sim.par")
     timfile = os.path.join(HERE, "b1855sim.tim")
-    with open(parfile, "w") as fh:
-        fh.write(PAR)
     m = get_model(parfile)
     rng = np.random.default_rng(1855)
     days = np.sort(rng.uniform(53300, 55300, 100))
