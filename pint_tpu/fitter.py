@@ -148,9 +148,15 @@ class Fitter:
     def _attach_noise_resids(self):
         """Set resids.noise_resids from the captured fit state
         (reference parity: GLS fits attach per-component noise
-        realizations to the residuals)."""
-        self.resids.noise_resids = (self.get_noise_resids()
-                                    if self.noise_ampls is not None else {})
+        realizations to the residuals). Wideband residuals get the
+        realizations on the inner TOA-residual object too — that is
+        where calc_whitened_resids does the subtraction."""
+        nr = (self.get_noise_resids()
+              if self.noise_ampls is not None else {})
+        self.resids.noise_resids = nr
+        inner = getattr(self.resids, "toa", None)
+        if inner is not None:
+            inner.noise_resids = nr
 
     def get_designmatrix(self):
         """Labeled time-residual design matrix [s/param-unit]

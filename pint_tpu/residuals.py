@@ -81,16 +81,28 @@ class Residuals:
 
     def calc_whitened_resids(self, params=None):
         """Residuals divided by the scaled uncertainties —
-        dimensionless, unit variance when the white-noise model is
-        right (reference: residuals.py::Residuals.calc_whitened_resids)."""
+        dimensionless, unit variance when the noise model is right
+        (reference: residuals.py::Residuals.calc_whitened_resids).
+        When a GLS fit attached ``noise_resids`` (per-component
+        correlated-noise realizations), they are subtracted first, so
+        the result is whitened against the FULL noise model — a
+        diagnostic/plotting surface. ``calc_chi2``/``lnlikelihood``
+        deliberately do NOT subtract them: the realization-conditioned
+        sum of squares lacks the amplitude-prior term (a^T Phi^-1 a)
+        and would read biased-low; the properly marginalized statistic
+        is the GLS fitter's ``chi2_whitened``."""
         r = self.calc_time_resids(params)
+        for v in (getattr(self, "noise_resids", None) or {}).values():
+            r = r - v
         sigma_s = self.prepared.scaled_sigma_us(params) * 1e-6
         return r / sigma_s
 
     def calc_chi2(self, params=None):
         import jax.numpy as jnp
 
-        return jnp.sum(jnp.square(self.calc_whitened_resids(params)))
+        r = self.calc_time_resids(params)
+        sigma_s = self.prepared.scaled_sigma_us(params) * 1e-6
+        return jnp.sum(jnp.square(r / sigma_s))
 
     def lnlikelihood(self, params=None):
         """Gaussian white-noise log-likelihood

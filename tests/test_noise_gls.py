@@ -309,3 +309,51 @@ def test_get_noise_resids_whitens():
     f2 = GLSFitter(t, get_model(par))
     with pytest.raises(ValueError, match="amplitudes"):
         f2.get_noise_resids()
+
+
+def test_whitened_resids_subtract_noise_realization():
+    """Post-GLS-fit residuals whiten against the FULL noise model:
+    calc_whitened_resids subtracts the attached realizations
+    (reference: Residuals.calc_whitened_resids with noise_resids)."""
+    par = ("PSR TWHN\nRAJ 6:00:00\nDECJ 10:00:00\nF0 200.0 1\nF1 -1e-14 1\n"
+           "PEPOCH 55500\nDM 10.0\nTNREDAMP -13\nTNREDGAM 3.0\nTNREDC 15\n")
+    m = get_model(par)
+    t = make_fake_toas_fromMJDs(np.linspace(55000, 56000, 150), m,
+                                error_us=0.5, freq_mhz=1400.0, obs="gbt",
+                                add_noise=True, add_correlated_noise=True,
+                                seed=9)
+    f = GLSFitter(t, m)
+    f.fit_toas(maxiter=3)
+    assert set(f.resids.noise_resids) == {"PLRedNoise"}
+    w = np.asarray(f.resids.calc_whitened_resids())
+    # whitened scatter back at the unit level; raw r/sigma inflated
+    r_over_sig = (np.asarray(f.resids.calc_time_resids())
+                  / (np.asarray(f.resids.prepared.scaled_sigma_us()) * 1e-6))
+    assert w.std() < 1.4
+    assert r_over_sig.std() > 1.5 * w.std()
+    # a fresh (unfitted) Residuals has no realization: unchanged path
+    r2 = Residuals(t, m)
+    assert not getattr(r2, "noise_resids", None)
+
+
+def test_chi2_stays_marginal_not_realization_conditioned():
+    """calc_chi2/lnlikelihood do NOT subtract the realization (no
+    amplitude-prior term available there); only calc_whitened_resids
+    does. The identity -2 lnL = chi2 + sum log(2 pi sigma^2) holds."""
+    par = ("PSR TWHC\nRAJ 6:00:00\nDECJ 10:00:00\nF0 200.0 1\nF1 -1e-14 1\n"
+           "PEPOCH 55500\nDM 10.0\nTNREDAMP -13\nTNREDGAM 3.0\nTNREDC 15\n")
+    m = get_model(par)
+    t = make_fake_toas_fromMJDs(np.linspace(55000, 56000, 150), m,
+                                error_us=0.5, freq_mhz=1400.0, obs="gbt",
+                                add_noise=True, add_correlated_noise=True,
+                                seed=9)
+    f = GLSFitter(t, m)
+    f.fit_toas(maxiter=3)
+    r = f.resids
+    raw = np.asarray(r.calc_time_resids())
+    sig = np.asarray(r.prepared.scaled_sigma_us()) * 1e-6
+    assert abs(r.chi2 - float(np.sum((raw / sig) ** 2))) < 1e-6
+    lhs = -2.0 * r.lnlikelihood() - float(np.sum(np.log(2 * np.pi * sig**2)))
+    assert abs(lhs - r.chi2) < 1e-6
+    # whitened view is realization-subtracted, so strictly smaller
+    assert float(np.sum(np.asarray(r.calc_whitened_resids())**2)) < r.chi2
