@@ -170,6 +170,15 @@ class Fitter:
     def print_summary(self):
         print(self.get_summary())
 
+    def plot(self, plotfile=None, title=None):
+        """Post-fit residual plot with error bars (reference:
+        fitter.py::Fitter.plot); delegates to
+        plot_utils.plot_residuals, returns the figure (or the saved
+        path when ``plotfile`` is given)."""
+        from .plot_utils import plot_residuals
+
+        return plot_residuals(self, plotfile=plotfile, title=title)
+
     def get_summary(self) -> str:
         """(reference: fitter.py::Fitter.get_summary)"""
         r = self.resids

@@ -54,7 +54,7 @@ def phaseogram(mjds, phases, weights=None, bins=64, rotate=0.0, size=5,
     if plotfile:
         fig.savefig(plotfile, dpi=120)
         plt.close(fig)
-        return None
+        return plotfile
     return fig
 
 
@@ -81,7 +81,7 @@ def phaseogram_binned(mjds, phases, weights=None, bins=64, ntimebins=32,
     if plotfile:
         fig.savefig(plotfile, dpi=120)
         plt.close(fig)
-        return None
+        return plotfile
     return fig
 
 
@@ -102,5 +102,5 @@ def plot_residuals(fitter, plotfile=None, title=None):
     if plotfile:
         fig.savefig(plotfile, dpi=120)
         plt.close(fig)
-        return None
+        return plotfile
     return fig

@@ -501,3 +501,64 @@ def bayesian_information_criterion(model, toas):
     k = len(model.free_params) + 1
     return (k * float(np.log(len(toas)))
             - 2.0 * _white_noise_lnlikelihood(model, toas))
+
+
+def list_parameters():
+    """Catalog of every parameter of every registered component:
+    [{name, component, kind, units, description, aliases}] (reference:
+    src/pint/utils.py::list_parameters — the docs/discovery helper).
+
+    Component modules register lazily (the builder imports on demand),
+    so the full surface is imported here first; components whose
+    parameter families are created per par-file line (glitches, jumps,
+    EFAC/EQUAD masks, DMX windows, WaveX terms...) get one exemplar
+    member so the family appears in the catalog."""
+    import importlib
+
+    for mod in ("spindown", "astrometry", "dispersion", "chromatic",
+                "solar_wind", "solar_system_shapiro", "troposphere",
+                "glitch", "wave", "frequency_dependent", "ifunc",
+                "piecewise", "jump", "phase_offset", "absolute_phase",
+                "noise", "binary.bt", "binary.bt_piecewise", "binary.dd",
+                "binary.ell1"):
+        importlib.import_module(f"pint_tpu.models.{mod}")
+    from .models.timing_model import Component
+
+    family_setup = {
+        "Glitch": lambda c: c.add_glitch(1),
+        "PhaseJump": lambda c: c.add_jump(),
+        "DelayJump": lambda c: c.add_jump(),
+        "DispersionJump": lambda c: c.add_dmjump(),
+        "ScaleToaError": lambda c: [c.add_mask_param(k, ["1.0"])
+                                    for k in ("EFAC", "EQUAD",
+                                              "DMEFAC", "DMEQUAD")],
+        "EcorrNoise": lambda c: c.add_mask_param(["0.5"]),
+        "FD": lambda c: c.add_fd(1),
+        "FDJump": lambda c: c.add_fdjump(1),
+        "IFunc": lambda c: c.add_ifunc(1),
+        "PiecewiseSpindown": lambda c: c.add_segment(1),
+        "DispersionDMX": lambda c: c.add_dmx_range(1, 50000, 50001),
+        "ChromaticCMX": lambda c: c.add_cmx_range(1, 50000, 50001),
+        "SolarWindDispersionX": lambda c: c.add_swx_range(1, 50000, 50001),
+        "Wave": lambda c: c.add_wave(1),
+        "WaveX": lambda c: c.add_wavex(1),
+        "DMWaveX": lambda c: c.add_dmwavex(1),
+        "CMWaveX": lambda c: c.add_cmwavex(1),
+        "ChromaticCM": lambda c: c.add_cmterm(1),
+        "BinaryBTPiecewise": lambda c: c.add_piece(1, 50000, 50001),
+    }
+    rows = []
+    for cname in sorted(Component.component_types):
+        cls = Component.component_types[cname]
+        comp = cls()  # every registered component constructs bare
+        setup = family_setup.get(cname)
+        if setup is not None:
+            setup(comp)
+        for pname in comp.params:
+            par = getattr(comp, pname)
+            rows.append({
+                "name": pname, "component": cname, "kind": par.kind,
+                "units": par.units, "description": par.description,
+                "aliases": list(par.aliases),
+            })
+    return rows

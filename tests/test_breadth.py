@@ -278,3 +278,26 @@ def test_checkpoint_cross_format_restore(tmp_path):
     assert state is not None
     np.testing.assert_allclose(state["x"], np.arange(4.0))
     assert ck_orbax.latest_iteration("t") == 2
+
+
+def test_fitter_plot_smoke(tmp_path):
+    """Fitter.plot writes a residual plot (reference: Fitter.plot)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import numpy as np
+
+    from pint_tpu.fitter import WLSFitter
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    m = get_model("PSR TPLOT\nRAJ 6:00:00\nDECJ 10:00:00\nF0 200.0 1\n"
+                  "PEPOCH 55000\nDM 10.0\n")
+    t = make_fake_toas_fromMJDs(np.linspace(54900, 55100, 25), m,
+                                error_us=1.0, freq_mhz=1400.0, obs="gbt",
+                                add_noise=True, seed=2)
+    f = WLSFitter(t, m)
+    f.fit_toas()
+    out = tmp_path / "resid.png"
+    f.plot(plotfile=str(out))
+    assert out.exists() and out.stat().st_size > 1000

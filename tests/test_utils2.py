@@ -252,3 +252,22 @@ def test_information_criteria_reject_correlated_noise():
                                 add_noise=True, seed=1)
     with pytest.raises(CorrelatedErrors):
         akaike_information_criterion(m, t)
+
+
+def test_list_parameters_catalog():
+    from pint_tpu.utils import list_parameters
+
+    rows = list_parameters()
+    by_name = {}
+    for r in rows:
+        by_name.setdefault(r["name"], []).append(r)
+    # spot checks across layers
+    assert any(r["component"] == "Spindown" for r in by_name["F0"])
+    assert any(r["component"].startswith("Binary") for r in by_name["PB"])
+    assert "XDOT" in by_name["A1DOT"][0]["aliases"]
+    assert by_name["DM"][0]["units"] in ("pc cm^-3", "pc/cm^3")
+    # par-line-created families appear via exemplar members
+    for fam in ("GLEP_1", "JUMP1", "EFAC1", "ECORR1", "DMX_0001",
+                "WXFREQ_0001", "T0X_0001"):
+        assert fam in by_name, fam
+    assert len(rows) > 100  # the full surface, not a stub
