@@ -343,3 +343,33 @@ def test_total_dm_without_taylor_dm_line():
                                 add_noise=False, iterations=0)
     dm = m.total_dm(t)
     assert (dm > 0).all() and dm.max() < 1.0  # pure solar-wind DM
+
+
+def test_d_phase_d_toa_doppler_matches_observatory_velocity():
+    """The apparent-frequency modulation equals +(v_obs . n_hat)/c
+    from the packed observatory velocities (delay = -r.n, so
+    f/F0 - 1 = -d(delay)/dt = +v.n_hat) — a quantitative anchor for
+    the full time-derivative chain, not just 'it varies'."""
+    import numpy as np
+
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    par = ("PSR TDOPP\nRAJ 6:00:00\nDECJ 10:00:00\nF0 300.0 1\n"
+           "PEPOCH 55000\nDM 0\n")
+    m = get_model(par)
+    mjds = np.linspace(54800, 55200, 16)
+    t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=1400.0,
+                                obs="gbt", add_noise=False, iterations=0)
+    f = m.d_phase_d_toa(t)
+    pp = m.prepare(t)
+    astrom = m.components["AstrometryEquatorial"]
+    n_hat = np.asarray(astrom.ssb_to_psb_xyz(pp.params0, pp.prep))
+    v = np.asarray(pp.batch.obs_vel_ls)  # [ls/s] = fraction of c
+    beta = np.sum(v * n_hat, axis=-1)
+    frac = f / 300.0 - 1.0
+    # pulse rate scales as d(t_emission)/d(t_arrival) = 1 - d(delay)/dt
+    # = 1 + v.n/c (delay = -r.n) at this precision (no binary, no
+    # dispersion drift)
+    np.testing.assert_allclose(frac, beta, rtol=0, atol=2e-9)
+    assert np.abs(beta).max() > 3e-5  # the anchor has real signal
