@@ -1,2 +1,4 @@
 from .pta import PTABatch, PTAFleet, stack_prepared  # noqa: F401
 from .mesh import make_mesh, make_mesh2d, shard_batch  # noqa: F401
+from .distributed import (initialize_distributed,  # noqa: F401
+                          process_pulsar_slice, global_pulsar_mesh)

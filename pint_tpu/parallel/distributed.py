@@ -1,0 +1,82 @@
+"""Multi-host (DCN) initialization for PTA fleets.
+
+The reference has no distributed backend at all (SURVEY.md section
+2.2: no NCCL/MPI/Gloo anywhere); the TPU-native equivalent is jax's
+built-in runtime: collectives ride ICI inside a slice and DCN across
+slices/hosts, with no framework-level transport code. What this module
+owns is the small amount of glue a pulsar-timing fleet needs:
+
+- ``initialize_distributed``: one-call `jax.distributed.initialize`
+  wrapper with env-var defaults (JAX_COORDINATOR_ADDRESS etc.), safe
+  to call in single-process runs (num_processes=1) — which is exactly
+  how the unit test exercises the real code path without a cluster.
+- ``process_pulsar_slice``: which pulsars THIS process should load and
+  pack. Host data (tim files) are process-local in a fleet; each host
+  packs its shard and the global mesh assembles the batch.
+- ``global_pulsar_mesh``: a 1-D 'pulsar' mesh over every device of
+  every process (jax.devices() is global after initialization).
+
+Recipe (documented in docs/tutorial_pta.md): initialize on every
+process, slice the pulsar list with process_pulsar_slice, build the
+local PTABatch arrays, and use
+``jax.make_array_from_process_local_data`` with a
+``NamedSharding(global_pulsar_mesh(), P('pulsar'))`` to assemble the
+fleet-wide batch; PTABatch's jitted fit programs then run unchanged —
+XLA inserts the (tiny) cross-host collectives.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def initialize_distributed(coordinator_address=None, num_processes=None,
+                           process_id=None, local_device_ids=None):
+    """Initialize the jax distributed runtime (DCN); idempotent.
+    Returns (process_id, num_processes).
+
+    Arguments left as None fall back to the JAX_* env vars when set
+    and otherwise stay None, so jax's built-in cluster auto-detection
+    (TPU pod metadata, SLURM, ...) keeps working — substituting
+    single-process defaults here would silently split a real fleet
+    into standalone hosts."""
+    import jax
+
+    if jax.distributed.is_initialized():
+        return jax.process_index(), jax.process_count()
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes, process_id=process_id,
+        local_device_ids=local_device_ids)
+    return jax.process_index(), jax.process_count()
+
+
+def process_pulsar_slice(n_pulsars, process_id=None, num_processes=None):
+    """Contiguous slice of pulsar indices THIS process loads/packs.
+
+    Contiguous (not strided) so each host's shard maps onto a
+    contiguous block of the 'pulsar' mesh axis — the layout
+    jax.make_array_from_process_local_data expects."""
+    import jax
+
+    pid = jax.process_index() if process_id is None else process_id
+    nproc = jax.process_count() if num_processes is None else num_processes
+    per = -(-n_pulsars // nproc)  # ceil
+    lo = min(pid * per, n_pulsars)
+    hi = min(lo + per, n_pulsars)
+    return slice(lo, hi)
+
+
+def global_pulsar_mesh():
+    """1-D 'pulsar' mesh over every device of every process
+    (jax.devices() is global after initialization) — the same mesh
+    mesh.py::make_mesh builds; aliased here for the fleet recipe."""
+    from .mesh import make_mesh
+
+    return make_mesh()
