@@ -31,6 +31,10 @@ def main(argv=None) -> int:
                    "PULSE_PHASE column here")
     p.add_argument("--absphase", action="store_true",
                    help="include absolute pulse numbers (needs TZR*)")
+    p.add_argument("--polycos", action="store_true",
+                   help="evaluate phases via generated polycos instead "
+                        "of the full pipeline (reference: photonphase "
+                        "--polycos fast path)")
     args = p.parse_args(argv)
 
     import numpy as np
@@ -53,7 +57,25 @@ def main(argv=None) -> int:
                            weightcolumn=args.weightcol,
                            minmjd=args.minMJD, maxmjd=args.maxMJD)
     print(f"Read {len(toas)} photons from {args.eventfile} ({mission})")
-    ph_obj = model.phase(toas)
+    if len(toas) == 0:
+        print("no photons in the MJD window", file=sys.stderr)
+        return 1
+    if args.polycos:
+        from types import SimpleNamespace
+
+        from ..polycos import Polycos
+
+        mjds = toas.get_mjds()
+        pcs = Polycos.generate_polycos(
+            model, float(mjds.min()) - 0.02, float(mjds.max()) + 0.02,
+            obs=str(toas.obs[0]), obsFreq=float(np.median(toas.freq_mhz)))
+        pi_, pf = pcs.eval_abs_phase(mjds)
+        print(f"Generated {len(pcs.entries)} polyco segments")
+        # pf is in [0, 1): int_ + frac is the exact absolute phase and
+        # the writer's negative-frac borrow is a no-op
+        ph_obj = SimpleNamespace(int_=pi_, frac=pf)
+    else:
+        ph_obj = model.phase(toas)
     phases = np.asarray(ph_obj.frac) % 1.0
     w = get_event_weights(toas)
     h = float(hmw(phases, w)) if w is not None else float(hm(phases))

@@ -305,3 +305,48 @@ def test_event_optimize_multiple_smoke(tmp_path, capsys):
     assert "max posterior" in cap
     import os
     assert os.path.exists(out_par)
+
+
+def test_photonphase_polycos_mode(parfile, tmp_path, capsys):
+    """--polycos gives the same phases as the full pipeline to
+    polyco-approximation accuracy (reference: photonphase --polycos)."""
+    from pint_tpu.io.fits import write_fits_table, get_table
+    from pint_tpu.models import get_model
+    from pint_tpu.scripts import photonphase
+
+    m = get_model(PAR)
+    f0 = m.F0.value
+    rng = np.random.default_rng(4)
+    n = 600
+    phases = (rng.vonmises(0.0, 6.0, n) / (2 * np.pi)) % 1.0
+    pulse_n = rng.integers(0, int(2.0 * 86400 * f0), n)
+    mjds = 55000.0 + ((pulse_n + phases) / f0) / 86400.0
+    mjdref = 56658.000777592593
+    met = (np.asarray(mjds, np.longdouble) - mjdref) * 86400.0
+    evt = str(tmp_path / "pev.fits")
+    write_fits_table(evt, {"TIME": np.asarray(met, float)},
+                     {"MJDREFI": 56658, "MJDREFF": mjdref - 56658,
+                      "TIMESYS": "TDB", "TELESCOP": "NICER"})
+    out_full = str(tmp_path / "full.fits")
+    assert photonphase.main([evt, parfile, "--absphase",
+                             "--outfile", out_full]) == 0
+    out_pc = str(tmp_path / "pc.fits")
+    assert photonphase.main([evt, parfile, "--polycos", "--absphase",
+                             "--outfile", out_pc]) == 0
+    cap = capsys.readouterr().out
+    assert "polyco segments" in cap
+    _, c_full = get_table(out_full, "EVENTS")
+    _, c_pc = get_table(out_pc, "EVENTS")
+    d = np.abs(np.asarray(c_full["PULSE_PHASE"])
+               - np.asarray(c_pc["PULSE_PHASE"]))
+    d = np.minimum(d, 1.0 - d)  # cyclic distance
+    # bound = polyco truncation + f32 PULSE_PHASE storage in FITS;
+    # 1e-5 cycles at F0=245 Hz is ~40 ns, far below X-ray timing needs
+    assert d.max() < 1e-5
+    # absolute pulse numbers agree exactly (int_ + frac invariant —
+    # review finding: the polyco path once dropped the borrowed cycle)
+    pn_full = np.asarray(c_full["PULSE_NUMBER"], np.float64)
+    pn_pc = np.asarray(c_pc["PULSE_NUMBER"], np.float64)
+    tot_full = pn_full + np.asarray(c_full["PULSE_PHASE"], np.float64)
+    tot_pc = pn_pc + np.asarray(c_pc["PULSE_PHASE"], np.float64)
+    assert np.abs(tot_full - tot_pc).max() < 1e-4
