@@ -152,12 +152,15 @@ def dmx_ranges(toas, binwidth_days=6.5):
     return ranges
 
 
-def dmxparse(fitter):
+def dmxparse(fitter, save=None):
     """Collect fitted DMX values/uncertainties/epochs into arrays
     (reference: utils.py::dmxparse; used for DM(t) plots and the
     NANOGrav dmxparse.out convention).
 
     Returns dict with keys dmxs, dmx_verrs, dmxeps, r1s, r2s, bins.
+    With ``save`` (a path or True for "dmxparse.out"), also writes the
+    NANOGrav-convention text file: a header with the mean DMX, then one
+    line per bin (epoch, value, error, R1, R2, label).
     """
     model = fitter.model
     comp = model.components.get("DispersionDMX")
@@ -175,7 +178,7 @@ def dmxparse(fitter):
         r2s.append(r2)
         eps.append(0.5 * ((r1 or 0.0) + (r2 or 0.0)))
         bins.append(f"DMX_{i:04d}")
-    return {
+    out = {
         "dmxs": np.array(dmxs),
         "dmx_verrs": np.array(verrs),
         "dmxeps": np.array(eps),
@@ -184,6 +187,18 @@ def dmxparse(fitter):
         "bins": bins,
         "mean_dmx": float(np.mean(dmxs)) if dmxs else np.nan,
     }
+    if save:
+        path = "dmxparse.out" if save is True else save
+        with open(path, "w") as fh:
+            fh.write("# Mean DMX value = %+.8e\n" % out["mean_dmx"])
+            fh.write("# Columns: DMXEP DMX_value DMX_var_err DMXR1 "
+                     "DMXR2 DMX_bin\n")
+            for i in range(len(bins)):
+                fh.write("%.4f %+.7e %.7e %.4f %.4f %s\n" % (
+                    out["dmxeps"][i], out["dmxs"][i] - out["mean_dmx"],
+                    out["dmx_verrs"][i], out["r1s"][i], out["r2s"][i],
+                    bins[i]))
+    return out
 
 def p_to_f(p, pd=None, pdd=None):
     """Period (derivatives) -> frequency (derivatives); an involution

@@ -271,3 +271,37 @@ def test_list_parameters_catalog():
                 "WXFREQ_0001", "T0X_0001"):
         assert fam in by_name, fam
     assert len(rows) > 100  # the full surface, not a stub
+
+
+def test_dmxparse_save_file(tmp_path):
+    """dmxparse(save=) writes the NANOGrav dmxparse.out convention
+    (mean-subtracted values, epoch/r1/r2/bin columns)."""
+    import numpy as np
+
+    from pint_tpu.fitter import WLSFitter
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+    from pint_tpu.utils import dmxparse
+
+    par = ("PSR TDMXP\nRAJ 6:00:00\nDECJ 10:00:00\nF0 200.0 1\n"
+           "PEPOCH 55100\nDM 12.0 1\n"
+           "DMX_0001 0.001 1\nDMXR1_0001 55000\nDMXR2_0001 55100\n"
+           "DMX_0002 -0.002 1\nDMXR1_0002 55100\nDMXR2_0002 55200\n")
+    m = get_model(par)
+    mjds = np.linspace(55000, 55200, 60)
+    freqs = np.where(np.arange(60) % 2, 1400.0, 800.0)
+    t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=freqs,
+                                obs="gbt", add_noise=True, seed=3)
+    f = WLSFitter(t, m)
+    f.fit_toas(maxiter=3)
+    out = tmp_path / "dmxparse.out"
+    d = dmxparse(f, save=str(out))
+    text = out.read_text()
+    assert "Mean DMX value" in text
+    lines = [l for l in text.splitlines() if not l.startswith("#")]
+    assert len(lines) == 2
+    ep, val, err, r1, r2, label = lines[0].split()
+    assert label == "DMX_0001" and float(r1) == 55000.0
+    # file stores mean-subtracted values
+    np.testing.assert_allclose(float(val), d["dmxs"][0] - d["mean_dmx"],
+                               atol=2e-7)
