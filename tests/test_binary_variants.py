@@ -454,3 +454,43 @@ def test_bt_piecewise_parfile_roundtrip_and_validation():
     with pytest.raises(ValueError, match="overlap"):
         get_model(par + "T0X_0002 55300.0003\n"
                   "XR1_0002 55390\nXR2_0002 55420\n")
+
+
+def test_ell1k_rotating_eccentricity_vector():
+    """ELL1k equals plain ELL1 with the analytically rotated/scaled
+    eccentricity vector at each epoch: eps' = (1 + LNEDOT dt) R(w) eps,
+    w = OMDOT dt (reference: ELL1k_model.py convention)."""
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    base = ("PSR TE1K\nRAJ 10:00:00\nDECJ 20:00:00\nF0 150.0 1\n"
+            "PEPOCH 55300\nDM 5.0\n")
+    orb = "PB 1.2\nA1 4.0\nTASC 55300\n"
+    e1, e2 = 3e-4, -1.5e-4
+    omdot_deg_yr, lnedot = 40.0, 3e-10  # rapid advance, e growth
+    m_k = get_model(base + "BINARY ELL1k\n" + orb +
+                    f"EPS1 {e1}\nEPS2 {e2}\n"
+                    f"OMDOT {omdot_deg_yr}\nLNEDOT {lnedot}\n")
+    mjds = np.linspace(55300, 55800, 7)
+    t = make_fake_toas_fromMJDs(mjds, m_k, error_us=1.0, freq_mhz=1400.0,
+                                obs="@", add_noise=False, iterations=0)
+    d_k = np.asarray(m_k.prepare(t).delay())
+    yr_s = 365.25 * 86400.0
+    for i, mjd in enumerate(t.get_mjds()):
+        dt = (mjd - 55300.0) * 86400.0
+        w = np.deg2rad(omdot_deg_yr) / yr_s * dt
+        s = 1.0 + lnedot * dt
+        e1p = s * (e1 * np.cos(w) + e2 * np.sin(w))
+        e2p = s * (e2 * np.cos(w) - e1 * np.sin(w))
+        m_i = get_model(base + "BINARY ELL1\n" + orb +
+                        f"EPS1 {float(e1p):.17g}\nEPS2 {float(e2p):.17g}\n")
+        d_i = np.asarray(m_i.prepare(t).delay())[i]
+        # same closed form; residual difference = the analytic dt here
+        # uses UTC MJDs while the model rotates eps in TDB seconds
+        # (~69 s offset -> e * omdot * 69 s * x / 2 ~ 1e-9)
+        assert abs(d_i - d_k[i]) < 5e-9, (i, d_i - d_k[i])
+    # and the rotation really matters at this OMDOT (not a trivial pass)
+    m_0 = get_model(base + "BINARY ELL1\n" + orb +
+                    f"EPS1 {e1}\nEPS2 {e2}\n")
+    d_0 = np.asarray(m_0.prepare(t).delay())
+    assert np.abs(d_0 - d_k).max() > 1e-5
