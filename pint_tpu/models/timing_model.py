@@ -393,20 +393,43 @@ class TimingModel:
         dfrac = np.asarray(php.frac) - np.asarray(phm.frac)
         return (dint + dfrac) / (2.0 * h)
 
-    def _delay_until(self, prepared, stop_comp):
-        """Accumulated delay over delay_components() up to but
-        excluding ``stop_comp`` (None = all components) — the one home
-        of the partial-delay accumulator the convenience methods use
-        (same convention as PreparedTiming._delay_fn)."""
+    def _delay_contributions(self, prepared):
+        """Yield (component, contribution) over delay_components() with
+        the chain's accumulation convention — the one home of the
+        partial-delay accumulator (same convention as
+        PreparedTiming._delay_fn); _delay_until and delay_breakdown
+        both consume it."""
         import jax.numpy as jnp
 
         d = jnp.zeros_like(prepared.batch.tdb_sec)
         for comp in self.delay_components():
+            di = comp.delay(prepared.params0, prepared.batch,
+                            prepared.prep, d)
+            d = d + di
+            yield comp, di
+
+    def _delay_until(self, prepared, stop_comp):
+        """Accumulated delay up to but excluding ``stop_comp``
+        (None = all components)."""
+        import jax.numpy as jnp
+
+        d = jnp.zeros_like(prepared.batch.tdb_sec)
+        for comp, di in self._delay_contributions(prepared):
             if comp is stop_comp:
                 break
-            d = d + comp.delay(prepared.params0, prepared.batch,
-                               prepared.prep, d)
+            d = d + di
         return d
+
+    def delay_breakdown(self, toas):
+        """{component name: per-TOA delay contribution [s]} in
+        evaluation order, each evaluated with the accumulated upstream
+        delay exactly as in the full chain, so the values sum to
+        ``delay(toas)`` (the reference exposes the same decomposition
+        via per-component cutoff delays; this is the diagnostic form
+        for delay-budget plots)."""
+        prepared = self.prepare(toas)
+        return {type(comp).__name__: np.asarray(di)
+                for comp, di in self._delay_contributions(prepared)}
 
     def get_barycentric_toas(self, toas, cutoff_component=None):
         """Barycentric arrival times [TDB MJD, float64] — the TDB TOA

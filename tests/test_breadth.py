@@ -301,3 +301,31 @@ def test_fitter_plot_smoke(tmp_path):
     out = tmp_path / "resid.png"
     f.plot(plotfile=str(out))
     assert out.exists() and out.stat().st_size > 1000
+
+
+def test_delay_breakdown_sums_to_total():
+    """delay_breakdown pieces sum to the full delay chain and carry
+    the expected per-component scales."""
+    import numpy as np
+
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    par = ("PSR TDBRK\nRAJ 6:00:00\nDECJ 10:00:00\nF0 200.0 1\n"
+           "PEPOCH 55000\nDM 15.0 1\nBINARY ELL1\nPB 5.7\nA1 3.36\n"
+           "TASC 55001\nEPS1 1e-5\nEPS2 -8e-6\nM2 0.2\nSINI 0.9\n")
+    m = get_model(par)
+    t = make_fake_toas_fromMJDs(np.linspace(54800, 55200, 30), m,
+                                error_us=1.0, freq_mhz=1400.0, obs="gbt",
+                                add_noise=False, iterations=0)
+    parts = m.delay_breakdown(t)
+    total = np.asarray(m.delay(t))
+    # 1 ns bound: the eager per-op sum and the fused jitted chain may
+    # round differently at the ~500 s Roemer scale (and TPU-emulated
+    # f64 has a higher error floor than exact-IEEE CPU)
+    np.testing.assert_allclose(sum(parts.values()), total, rtol=0,
+                               atol=1e-9)
+    assert np.abs(parts["AstrometryEquatorial"]).max() > 100.0  # Roemer
+    assert 1e-3 < np.abs(parts["DispersionDM"]).max() < 1.0
+    assert np.abs(parts["BinaryELL1"]).max() > 1.0  # x = 3.36 ls
+    assert np.abs(parts["SolarSystemShapiro"]).max() < 1e-3
