@@ -194,6 +194,22 @@ class Fitter:
             par = getattr(self.model, p)
             unc = f"{par.uncertainty:.3g}" if par.uncertainty else "-"
             lines.append(f"{p:<12}{par.value:>24.14g}{unc:>16}")
+        corr = getattr(self, "correlation_matrix", None)
+        if corr is not None:
+            strong = []
+            names = corr.labels(0)
+            c = np.asarray(corr.matrix)
+            for i in range(len(names)):
+                for j in range(i + 1, len(names)):
+                    if abs(c[i, j]) > 0.5:
+                        strong.append((abs(c[i, j]),
+                                       f"  {names[i]:<10} {names[j]:<10} "
+                                       f"{c[i, j]:+.3f}"))
+            if strong:
+                lines.append("")
+                lines.append("Strong parameter correlations (|r| > 0.5):")
+                lines.extend(s for _, s in
+                             sorted(strong, reverse=True)[:12])
         return "\n".join(lines)
 
     def ftest(self, other_chi2, other_dof):

@@ -113,6 +113,8 @@ def test_example_files_fit():
     assert f.resids.reduced_chi2 < 1.6
     summary = f.get_summary()
     assert "Chi2" in summary and "F0" in summary
+    # post-fit summaries list strong parameter correlations
+    assert "correlations" in summary  # F0/F1 are correlated here
 
 
 def test_parfile_roundtrip(model):
