@@ -217,6 +217,42 @@ class Fitter:
 
         return ftest(other_chi2, other_dof, self.resids.chi2, self.resids.dof)
 
+    def ftest_add_params(self, names, maxiter=None):
+        """Significance of freeing extra parameters (reference:
+        fitter.py::Fitter.ftest with remove=False): refit a model copy
+        with ``names`` unfrozen using this fitter's class, and return
+        {"p_value", "chi2", "dof", "fitter"} for the augmented fit.
+        Small p-value => the added parameters are significant. The
+        named parameters must already exist as frozen COMPONENT
+        parameters (prefix families are added via their component
+        first); ``maxiter=None`` keeps the fitter class's own
+        default."""
+        if not self.converged:
+            raise ValueError(
+                "run fit_toas() first: the F-test baseline must be the "
+                "fitted chi2, not the prefit residuals")
+        if isinstance(names, str):
+            names = [names]
+        # the Fitter constructor deep-copies the model, so unfreeze on
+        # the new fitter's private copy — one copy, not two
+        f2 = type(self)(self.toas, self.model)
+        for name in names:
+            if name not in f2.model.params or name in f2.model.top_params:
+                raise KeyError(
+                    f"{name!r} is not a fittable component parameter — "
+                    "add the component/prefix member first")
+            par = getattr(f2.model, name)
+            if not par.frozen:
+                raise ValueError(f"{name} is already free")
+            par.frozen = False
+        if maxiter is None:
+            f2.fit_toas()
+        else:
+            f2.fit_toas(maxiter=maxiter)
+        p = f2.ftest(self.resids.chi2, self.resids.dof)
+        return {"p_value": p, "chi2": f2.resids.chi2,
+                "dof": f2.resids.dof, "fitter": f2}
+
     def get_derived_params(self) -> dict:
         """Post-fit derived quantities with first-order propagated
         uncertainties (reference: fitter.py::Fitter.get_derived_params).

@@ -229,3 +229,54 @@ def test_compile_cache_reuse_and_structure_isolation():
         assert pt._structure_key() != k_before
     finally:
         m.F1.frozen = False
+
+
+def test_ftest_add_params_refit():
+    """ftest_add_params: freeing a parameter the data needs gives a
+    tiny p-value; freeing a useless one gives a large p-value
+    (reference: Fitter.ftest add/refit semantics)."""
+    import copy
+
+    import numpy as np
+
+    from pint_tpu.fitter import WLSFitter
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    # truth has F2; the base fit freezes it at zero
+    true = get_model("PSR TFTA\nRAJ 6:00:00\nDECJ 10:00:00\nF0 200.0 1\n"
+                     "F1 -1e-13 1\nF2 1e-23 1\nPEPOCH 55500\nDM 10.0\n")
+    t = make_fake_toas_fromMJDs(np.linspace(55000, 56000, 60), true,
+                                error_us=1.0, freq_mhz=1400.0, obs="gbt",
+                                add_noise=True, seed=5)
+    base = get_model("PSR TFTA\nRAJ 6:00:00\nDECJ 10:00:00\nF0 200.0 1\n"
+                     "F1 -1e-13 1\nF2 0 0\nPEPOCH 55500\nDM 10.0\n")
+    f = WLSFitter(t, base)
+    f.fit_toas(maxiter=5)
+    res = f.ftest_add_params("F2")
+    assert res["p_value"] < 1e-6  # F2 is really in the data
+    assert abs(res["fitter"].model.F2.value - 1e-23) \
+        < 5 * res["fitter"].model.F2.uncertainty
+    # a pointless parameter: DM1 on dispersionless-noise data
+    base2 = get_model("PSR TFTB\nRAJ 6:00:00\nDECJ 10:00:00\nF0 200.0 1\n"
+                      "F1 -1e-13 1\nPEPOCH 55500\nDM 10.0\nDM1 0 0\n")
+    t2 = make_fake_toas_fromMJDs(np.linspace(55000, 56000, 60), base2,
+                                 error_us=1.0, freq_mhz=1400.0, obs="gbt",
+                                 add_noise=True, seed=6)
+    f2 = WLSFitter(t2, base2)
+    f2.fit_toas(maxiter=5)
+    res2 = f2.ftest_add_params("DM1")
+    assert res2["p_value"] > 0.01
+    # already-free and unknown params are rejected loudly
+    import pytest
+
+    with pytest.raises(ValueError):
+        f.ftest_add_params("F0")
+    with pytest.raises(KeyError):
+        f.ftest_add_params("GLEP_7")
+    with pytest.raises(KeyError):
+        f.ftest_add_params("START")  # top-level params are not fittable
+    # unfitted baseline refused (prefit chi2 would fake significance)
+    f_raw = WLSFitter(t, base)
+    with pytest.raises(ValueError, match="fit_toas"):
+        f_raw.ftest_add_params("F2")
