@@ -158,6 +158,31 @@ class Fitter:
         if inner is not None:
             inner.noise_resids = nr
 
+    def _update_model_stats(self):
+        """Write fit bookkeeping into the model's top-level params so
+        post-fit par files carry START/FINISH/NTOA/TRES/CHI2
+        (reference: fitter.py::Fitter.update_model)."""
+        from .models.parameter import MJDParameter, floatParameter
+
+        mjds = self.toas.get_mjds()
+
+        def set_top(name, cls, value):
+            if name in self.model.top_params:
+                getattr(self.model, name).value = value
+            else:
+                p = cls(name)
+                p.value = value
+                self.model.add_top_param(p)
+
+        set_top("START", MJDParameter, float(mjds.min()))
+        set_top("FINISH", MJDParameter, float(mjds.max()))
+        set_top("NTOA", floatParameter, float(len(self.toas)))
+        set_top("TRES", floatParameter,
+                float(self.resids.rms_weighted() * 1e6))
+        chi2 = getattr(self, "chi2_whitened", None)
+        set_top("CHI2", floatParameter,
+                float(chi2 if chi2 is not None else self.resids.chi2))
+
     def get_designmatrix(self):
         """Labeled time-residual design matrix [s/param-unit]
         (reference: pint_matrix.py::DesignMatrix from
@@ -249,8 +274,17 @@ class Fitter:
             f2.fit_toas()
         else:
             f2.fit_toas(maxiter=maxiter)
-        p = f2.ftest(self.resids.chi2, self.resids.dof)
-        return {"p_value": p, "chi2": f2.resids.chi2,
+        # GLS-family fits: compare the marginalized (whitened) chi2 on
+        # BOTH sides — the raw white-noise sum is biased under
+        # correlated noise (see Residuals.calc_whitened_resids)
+        def _chi2(f):
+            c = getattr(f, "chi2_whitened", None)
+            return float(c) if c is not None else float(f.resids.chi2)
+
+        from .utils import ftest as _ftest
+
+        p = _ftest(_chi2(self), self.resids.dof, _chi2(f2), f2.resids.dof)
+        return {"p_value": p, "chi2": _chi2(f2),
                 "dof": f2.resids.dof, "fitter": f2}
 
     def get_derived_params(self) -> dict:
@@ -650,6 +684,7 @@ class WLSFitter(Fitter):
             cov_all = cov_from_normalized(*cov)
             self._set_uncertainties(prepared, cov_all[noff:, noff:])
         self.resids = Residuals(self.toas, self.model)
+        self._update_model_stats()
         self.converged = True
         # metrics surface: first iteration includes jit compile, later
         # ones are steady state
@@ -725,6 +760,7 @@ class DownhillWLSFitter(WLSFitter):
             cov_all = cov_from_normalized(covn, norm)
             self._set_uncertainties(prepared, cov_all[noff:, noff:])
         self.resids = Residuals(self.toas, self.model)
+        self._update_model_stats()
         self.converged = True
         self.metrics = fit_metrics(t_start, prep_s, iter_s, self.toas,
                                    self.model)
@@ -840,6 +876,7 @@ class GLSFitter(Fitter):
         self._attach_noise_resids()
         self.converged = True
         self.chi2_whitened = chi2
+        self._update_model_stats()
         self.metrics = fit_metrics(t_start, prep_s, iter_s, self.toas,
                                    self.model)
         return chi2
@@ -1046,6 +1083,7 @@ class WidebandTOAFitter(GLSFitter):
         self._attach_noise_resids()
         self.converged = True
         self.chi2_whitened = chi2
+        self._update_model_stats()
         # wideband re-prepares inside each iteration, so prepare time is
         # folded into iteration_s rather than reported separately
         self.metrics = fit_metrics(t_start, 0.0, iter_s, self.toas,
@@ -1113,6 +1151,7 @@ class WidebandDownhillFitter(WidebandTOAFitter):
         self._attach_noise_resids()
         self.converged = True
         self.chi2_whitened = best_chi2
+        self._update_model_stats()
         self.metrics = fit_metrics(t_start, 0.0, iter_s, self.toas,
                                    self.model)
         return best_chi2
@@ -1180,6 +1219,7 @@ class WidebandLMFitter(WidebandTOAFitter):
         self._attach_noise_resids()
         self.converged = True
         self.chi2_whitened = best_chi2
+        self._update_model_stats()
         self.metrics = fit_metrics(t_start, 0.0, iter_s, self.toas,
                                    self.model)
         return best_chi2

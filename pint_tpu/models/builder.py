@@ -42,8 +42,8 @@ _FDJUMP_RE = re.compile(r"^FD([1-9]\d*)JUMP$|^FDJUMP([1-9]\d*)$")
 
 TOP_LEVEL_STR = ("PSR", "EPHEM", "CLOCK", "UNITS", "TIMEEPH", "T2CMETHOD",
                  "TZRSITE", "INFO", "DCOVFILE", "TRACK", "MODE", "EPHVER",
-                 "CHI2", "CHI2R", "DMDATA", "NITS", "IBOOT", "DILATEFREQ")
-TOP_LEVEL_FLOAT = ("NTOA", "TRES", "TZRFRQ", "DMRES")
+                 "DMDATA", "NITS", "IBOOT", "DILATEFREQ")
+TOP_LEVEL_FLOAT = ("NTOA", "TRES", "TZRFRQ", "DMRES", "CHI2", "CHI2R")
 TOP_LEVEL_MJD = ("START", "FINISH", "TZRMJD")
 
 

@@ -273,3 +273,25 @@ def test_whitened_resids_and_lnlikelihood():
     m2 = get_model(par)
     m2.F0.value += 3e-9
     assert Residuals(t, m2).lnlikelihood() < r.lnlikelihood()
+
+
+def test_postfit_parfile_carries_fit_stats():
+    """Post-fit par files record START/FINISH/NTOA/TRES/CHI2
+    (reference: Fitter.update_model)."""
+    m = get_model(os.path.join(EXAMPLES, "NGC6440E.par"))
+    from pint_tpu.toa import get_TOAs
+
+    t = get_TOAs(os.path.join(EXAMPLES, "NGC6440E.tim"))
+    f = WLSFitter(t, m)
+    f.fit_toas()
+    par = f.model.as_parfile()
+    for key in ("START", "FINISH", "NTOA", "TRES", "CHI2"):
+        assert f"\n{key} " in par or par.startswith(f"{key} "), key
+    m2 = get_model(par)
+    assert m2.NTOA.value == 62
+    assert abs(m2.TRES.value - f.resids.rms_weighted() * 1e6) < 1e-6
+    assert abs(m2.START.value - t.get_mjds().min()) < 1e-6
+    # refit from the stats-carrying par: stats update, no duplication
+    f2 = WLSFitter(t, m2)
+    f2.fit_toas()
+    assert f2.model.as_parfile().count("NTOA") == 1
