@@ -180,8 +180,10 @@ class Fitter:
         set_top("TRES", floatParameter,
                 float(self.resids.rms_weighted() * 1e6))
         chi2 = getattr(self, "chi2_whitened", None)
-        set_top("CHI2", floatParameter,
-                float(chi2 if chi2 is not None else self.resids.chi2))
+        chi2 = float(chi2 if chi2 is not None else self.resids.chi2)
+        set_top("CHI2", floatParameter, chi2)
+        if self.resids.dof > 0:
+            set_top("CHI2R", floatParameter, chi2 / self.resids.dof)
 
     def get_designmatrix(self):
         """Labeled time-residual design matrix [s/param-unit]
