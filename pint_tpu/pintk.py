@@ -52,6 +52,15 @@ class InteractivePulsar:
         r = Residuals(self.toas, model or self.model)
         return np.asarray(r.calc_time_resids()) * 1e6
 
+    def whitened_resids(self) -> np.ndarray:
+        """Dimensionless whitened residuals of the last fit — with a
+        GLS fit, the fitted noise realizations are subtracted first
+        (reference: plk whitened plotting mode backed by
+        Residuals.calc_whitened_resids)."""
+        if self.last_fit is None:
+            raise ValueError("no fit yet — run fit() first")
+        return np.asarray(self.last_fit.resids.calc_whitened_resids())
+
     # -- selection (reference: plk click/drag selection) --
 
     def select(self, mask):

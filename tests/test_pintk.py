@@ -415,3 +415,32 @@ def test_xvals_modes():
     assert "orbital phase" in sb.x_axis_choices()
     ph = sb.xvals("orbital phase")
     assert ph.shape == (20,) and np.all((ph >= 0) & (ph < 1))
+
+
+def test_session_whitened_resids():
+    """Session.whitened_resids: unit-scatter after a GLS fit with red
+    noise (the realization is subtracted), and refuses before any fit."""
+    import numpy as np
+    import pytest
+
+    from pint_tpu.fitter import GLSFitter
+    from pint_tpu.models import get_model
+    from pint_tpu.pintk import InteractivePulsar
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    par = ("PSR TPKW\nRAJ 6:00:00\nDECJ 10:00:00\nF0 200.0 1\nF1 -1e-14 1\n"
+           "PEPOCH 55500\nDM 10.0\nTNREDAMP -12.5\nTNREDGAM 3.5\nTNREDC 12\n")
+    m = get_model(par)
+    t = make_fake_toas_fromMJDs(np.linspace(55000, 56000, 120), m,
+                                error_us=0.5, freq_mhz=1400.0, obs="gbt",
+                                add_noise=True, add_correlated_noise=True,
+                                seed=7)
+    s = InteractivePulsar(m, t, fitter_factory=GLSFitter)
+    with pytest.raises(ValueError, match="fit"):
+        s.whitened_resids()
+    s.fit(maxiter=3)
+    w = s.whitened_resids()
+    raw = s.resids_us() / (np.asarray(
+        s.last_fit.resids.prepared.scaled_sigma_us()))
+    assert w.std() < 1.4  # realization subtracted: ~unit variance
+    assert raw.std() > 1.5 * w.std()  # raw is red-noise inflated
