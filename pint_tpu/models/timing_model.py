@@ -322,8 +322,12 @@ class TimingModel:
         with open(path, "w") as f:
             f.write(self.as_parfile(format=format))
 
-    def compare(self, other: "TimingModel") -> str:
-        """Pre/post-fit comparison table (reference: TimingModel.compare)."""
+    def compare(self, other: "TimingModel", sigma=None) -> str:
+        """Pre/post-fit comparison table (reference: TimingModel.compare).
+
+        ``sigma``: only list parameters whose difference exceeds this
+        many combined uncertainties (parameters with no uncertainty on
+        either side always shown when their values differ)."""
         rows = [f"{'PARAM':<12} {'SELF':>20} {'OTHER':>20} {'DIFF/UNC':>10}"]
         for p in self.params:
             a = getattr(self, p)
@@ -335,6 +339,12 @@ class TimingModel:
             except (TypeError, ValueError):
                 continue
             unc = a.uncertainty or b.uncertainty
+            if sigma is not None:
+                if unc:
+                    if abs(diff) < sigma * unc:
+                        continue
+                elif diff == 0.0:
+                    continue
             rel = f"{diff / unc:.2f}" if unc else "-"
             rows.append(f"{p:<12} {float(a.value):>20.12g} {float(b.value):>20.12g} {rel:>10}")
         return "\n".join(rows)

@@ -131,6 +131,11 @@ def test_compare_parfiles_and_pintpublish(parfile, tmp_path, capsys):
     par2.write_text(PAR.replace("245.4261196", "245.4261197"))
     assert compare_parfiles.main([parfile, str(par2)]) == 0
     assert "F0" in capsys.readouterr().out
+    # --sigma filters sub-threshold rows; identical F1 disappears but
+    # the changed F0 (no uncertainties in these pars) stays
+    assert compare_parfiles.main([parfile, str(par2), "--sigma", "3"]) == 0
+    out = capsys.readouterr().out
+    assert "F0" in out and "\nF1 " not in out
     tex = tmp_path / "t.tex"
     assert pintpublish.main([parfile, "--outfile", str(tex)]) == 0
     text = tex.read_text()
