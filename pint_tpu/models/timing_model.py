@@ -338,7 +338,9 @@ class TimingModel:
                 diff = float(b.value) - float(a.value)
             except (TypeError, ValueError):
                 continue
-            unc = a.uncertainty or b.uncertainty
+            # combined (quadrature) uncertainty when both sides have one
+            ua, ub = a.uncertainty or 0.0, b.uncertainty or 0.0
+            unc = float(np.hypot(ua, ub)) or None
             if sigma is not None:
                 if unc:
                     if abs(diff) < sigma * unc:
