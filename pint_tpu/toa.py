@@ -305,6 +305,18 @@ class TOAs:
             return np.full(len(self), fill, dtype=object)
         return np.array([f.get(flag, fill) for f in self._flags], dtype=object)
 
+    def compute_pulse_numbers(self, model):
+        """Set each TOA's ``-pn`` flag to the nearest absolute pulse
+        number under ``model``, making phase tracking resumable — a
+        written tim file reloads with TRACK -2 semantics intact
+        (reference: toa.py::TOAs.compute_pulse_numbers)."""
+        ph = model.phase(self)
+        # frac is in [-0.5, 0.5), so int_ IS the nearest pulse number
+        pn = np.asarray(ph.int_, np.float64)
+        for f, v in zip(self.flags, pn):
+            f["pn"] = f"{v:.0f}"
+        return pn
+
     def get_pulse_numbers(self):
         pn = np.full(len(self), np.nan)
         if self._flags_raw is not None:

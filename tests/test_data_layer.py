@@ -355,3 +355,34 @@ def test_toas_select_unselect_stack(tmp_path):
     t.bipm_version = "BIPM2015"
     t.select(t.get_mjds() < 55010)
     assert t.include_site_clock is False and t.bipm_version == "BIPM2015"
+
+
+def test_compute_pulse_numbers_roundtrip(tmp_path):
+    """compute_pulse_numbers sets -pn flags that survive a tim write/
+    reload and drive use_pulse_numbers tracking (reference:
+    TOAs.compute_pulse_numbers + TRACK -2)."""
+    import numpy as np
+
+    from pint_tpu.models import get_model
+    from pint_tpu.residuals import Residuals
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+    from pint_tpu.toa import get_TOAs
+
+    m = get_model("PSR TPN\nRAJ 6:00:00\nDECJ 10:00:00\nF0 200.0 1\n"
+                  "PEPOCH 55000\nDM 10.0\n")
+    t = make_fake_toas_fromMJDs(np.linspace(54900, 55100, 25), m,
+                                error_us=1.0, freq_mhz=1400.0, obs="gbt",
+                                add_noise=True, seed=8)
+    pn = t.compute_pulse_numbers(m)
+    assert np.isfinite(pn).all() and (pn == np.round(pn)).all()
+    out = tmp_path / "pn.tim"
+    t.write_TOA_file(str(out))
+    t2 = get_TOAs(str(out), usepickle=False)
+    np.testing.assert_array_equal(t2.get_pulse_numbers(), pn)
+    # tracked residuals agree with nearest-integer residuals here (the
+    # model is the one that defined the pulse numbers)
+    r_track = np.asarray(Residuals(t2, m, track_mode="use_pulse_numbers",
+                                   subtract_mean=False).calc_time_resids())
+    r_near = np.asarray(Residuals(t2, m, track_mode="nearest",
+                                  subtract_mean=False).calc_time_resids())
+    np.testing.assert_allclose(r_track, r_near, atol=1e-12)
