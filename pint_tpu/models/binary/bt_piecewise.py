@@ -131,6 +131,11 @@ class BinaryBTPiecewise(BinaryBT):
             seg[(mjds >= lo) & (mjds < hi)] = k
         params0["T0X"] = t0x
         params0["A1X"] = a1x
+        # base pack published each member as a scalar leaf; the device
+        # reads only the packed vectors (same convention as FB members)
+        for i in ids:
+            for stem in ("T0X", "A1X", "XR1", "XR2"):
+                params0.pop(f"{stem}_{i:04d}", None)
         prep["btpw_seg"] = jnp.asarray(seg)
         prep["btpw_has_t0"] = jnp.asarray(has_t0)
         prep["btpw_has_a1"] = jnp.asarray(has_a1)

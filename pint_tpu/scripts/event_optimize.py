@@ -42,7 +42,7 @@ def main(argv=None) -> int:
     if args.template:
         tpl = np.loadtxt(args.template)
         template = tpl[:, 1] if tpl.ndim == 2 else tpl
-        template = template / template.mean()
+        # the fitter normalizes templates itself (_normalized_template)
     else:
         template = empirical_template(model, toas, weights, args.nbins)
     fit = MCMCFitterBinnedTemplate(toas, model, template, weights=weights,
