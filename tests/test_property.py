@@ -226,7 +226,9 @@ def test_random_model_configurations_fuzz():
     extras = ["", "GLEP_1 55350\nGLF0_1 1e-8 1\n",
               "DMX_0001 0.001 1\nDMXR1_0001 55200\nDMXR2_0001 55400\n",
               "FD1 1e-5 1\nCORRECT_TROPOSPHERE Y\n",
-              "NE_SW 6.0 1\nWAVE_OM 0.01\nWAVE1 1e-4 -5e-5\n"]
+              "NE_SW 6.0 1\nWAVE_OM 0.01\nWAVE1 1e-4 -5e-5\n",
+              "JUMP -f L-wide 1e-5 1\nSIFUNC 2\nIFUNC1 55100 0.0\n"
+              "IFUNC2 55300 1e-6\nIFUNC3 55500 0.0\n"]
     noises = ["", "EFAC -f L-wide 1.2\nEQUAD -f L-wide 0.4\n",
               "ECORR -f L-wide 0.6\nTNREDAMP -13.5\nTNREDGAM 3.5\nTNREDC 8\n"]
     configs = list(itertools.product(binaries, extras, noises))
