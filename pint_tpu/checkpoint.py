@@ -144,3 +144,47 @@ def checkpointed_fit(fitter, directory, tag="fit", every=1, maxiter=20,
                         "param_names": np.array(names),
                         "iter": done, "chi2": chi2})
     return chi2
+
+
+def checkpointed_pta_fit(pta, directory, tag="pta", every=1, maxiter=4,
+                         method="gls", **fit_kw):
+    """Batched analogue of checkpointed_fit: snapshot the (n_psr, k)
+    parameter vectors between fit chunks so an interrupted PTA refit
+    resumes where it stopped (SURVEY 2.2 elasticity — per-pulsar
+    divergence isolation already lives inside PTABatch; this adds the
+    between-iterations snapshot). Returns (x, chi2, cov); cov is None
+    when the snapshot already covered maxiter."""
+    if method not in ("gls", "wls"):
+        raise ValueError(f"method must be 'gls' or 'wls', got {method!r}")
+    ckpt = FitCheckpointer(directory)
+    names = [n for n, _, _ in pta.free_map()]
+    state = ckpt.restore(tag)
+    if state is not None and not all(
+            k in state for k in ("param_names", "x", "chi2", "iter")):
+        # partial/foreign snapshot (e.g. a single-pulsar checkpointed_fit
+        # tag, or a damaged sidecar): restart cleanly rather than crash
+        import warnings
+
+        warnings.warn(f"checkpoint {tag!r} is not a PTA snapshot "
+                      f"(keys {sorted(state)}); restarting the fit")
+        state = None
+    if state is not None:
+        saved = [str(n) for n in np.asarray(state["param_names"])]
+        if saved != names:
+            raise ValueError(
+                f"checkpoint {tag!r} was taken with params {saved}, "
+                f"batch has {names}; refusing positional restore")
+        pta.set_start_vector(np.asarray(state["x"], float))
+    done = int(state["iter"]) if state is not None else 0
+    fit = pta.gls_fit if method == "gls" else pta.wls_fit
+    x = np.asarray(state["x"], float) if state is not None else None
+    chi2 = np.asarray(state["chi2"], float) if state is not None else None
+    cov = None
+    while done < maxiter:
+        n = min(every, maxiter - done)
+        x, chi2, cov = fit(maxiter=n, **fit_kw)
+        done += n
+        pta.set_start_vector(x)
+        ckpt.save(tag, {"x": np.asarray(x), "chi2": np.asarray(chi2),
+                        "param_names": np.array(names), "iter": done})
+    return x, chi2, cov

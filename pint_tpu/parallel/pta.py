@@ -271,6 +271,20 @@ class PTABatch:
         """Free-parameter layout of the template (uniform across batch)."""
         return self.preps[0].free_param_map()
 
+    def set_start_vector(self, x):
+        """Override the starting parameter vectors for the next fit —
+        the checkpoint-resume hook (shape (n_psr, n_free), same layout
+        as the fit results)."""
+        import jax.numpy as jnp
+
+        x = jnp.asarray(x)
+        k = len(self.free_map())
+        if x.shape != (len(self.models), k):
+            raise ValueError(
+                f"start vector shape {x.shape} != "
+                f"({len(self.models)}, {k})")
+        self._x0_cache = x
+
     def _overlay(self, params, x):
         out = dict(params)
         for i, (_, key, idx) in enumerate(self.free_map()):

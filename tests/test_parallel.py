@@ -480,3 +480,31 @@ print("DIST-OK")
     out = subprocess.run([sys.executable, "-c", code], env=env,
                          capture_output=True, text=True, timeout=300)
     assert "DIST-OK" in out.stdout, out.stderr[-2000:]
+
+
+def test_checkpointed_pta_fit_resumes(tmp_path):
+    """A chunked, snapshotted PTA fit reproduces the direct fit, and a
+    fresh batch resumes from the snapshot instead of restarting."""
+    from pint_tpu.checkpoint import checkpointed_pta_fit
+
+    models, toas_list, _ = _batch(3)
+    direct = PTABatch([copy.deepcopy(m) for m in models], toas_list)
+    x_ref, chi2_ref, _ = direct.wls_fit(maxiter=3)
+
+    pta = PTABatch([copy.deepcopy(m) for m in models], toas_list)
+    x, chi2, cov = checkpointed_pta_fit(pta, str(tmp_path), every=1,
+                                        maxiter=3, method="wls")
+    np.testing.assert_allclose(np.asarray(x), np.asarray(x_ref),
+                               rtol=0, atol=1e-12)
+    assert cov is not None
+    # fresh batch + exhausted snapshot: returns saved state, no refit
+    pta2 = PTABatch([copy.deepcopy(m) for m in models], toas_list)
+    x2, chi2_2, cov2 = checkpointed_pta_fit(pta2, str(tmp_path), every=1,
+                                            maxiter=3, method="wls")
+    assert cov2 is None
+    np.testing.assert_allclose(np.asarray(x2), np.asarray(x), rtol=0,
+                               atol=0)
+    # and extending maxiter continues FROM the snapshot
+    x3, chi2_3, cov3 = checkpointed_pta_fit(pta2, str(tmp_path), every=1,
+                                            maxiter=4, method="wls")
+    assert cov3 is not None and np.isfinite(np.asarray(chi2_3)).all()
