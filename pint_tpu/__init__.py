@@ -16,9 +16,56 @@ Float64 is enabled globally at import: nanosecond timing over decade
 spans is meaningless in f32.
 """
 
+import os as _os
+
 import jax as _jax
 
 _jax.config.update("jax_enable_x64", True)
+
+
+def _init_compile_cache():
+    """Point jax at a persistent compilation cache so a fresh process
+    reuses XLA executables compiled by any earlier one (cold-process
+    flagship fits drop from ~minutes of compile to seconds).
+
+    Opt-in: set PINT_TPU_COMPILE_CACHE=1 (or point
+    PINT_TPU_COMPILE_CACHE_DIR at a directory). Not on by default
+    because on the CPU backend the cache was measured to save ~nothing
+    while spamming XLA:CPU AOT machine-feature errors on every reload;
+    on TPU it cuts ~160 s cold compiles to ~37 s (BASELINE.md), which
+    is why bench.py enables it explicitly. Callers that set
+    jax_compilation_cache_dir themselves simply win (we never
+    override). Cache entries are keyed by a fingerprint of
+    program + jaxlib + backend, so a stale dir can only miss, never
+    corrupt.
+    """
+    enabled = (_os.environ.get("PINT_TPU_COMPILE_CACHE") == "1"
+               or bool(_os.environ.get("PINT_TPU_COMPILE_CACHE_DIR")))
+    if not enabled or _os.environ.get("PINT_TPU_COMPILE_CACHE") == "0":
+        return
+    try:
+        if _jax.config.jax_compilation_cache_dir:
+            return  # caller (bench.py, dryrun child, env) already chose one
+    except AttributeError:
+        pass
+    cache_dir = _os.environ.get(
+        "PINT_TPU_COMPILE_CACHE_DIR",
+        _os.path.join(_os.path.expanduser("~"), ".cache", "pint_tpu",
+                      "jax_cache"))
+    try:
+        _os.makedirs(cache_dir, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:
+        # the user explicitly opted in — a silently dead cache would
+        # cost them the full cold-compile every process with no clue
+        import warnings as _warnings
+
+        _warnings.warn(f"persistent compile cache requested but could "
+                       f"not be enabled at {cache_dir!r}: {e}")
+
+
+_init_compile_cache()
 
 from .constants import DMconst, C_M_S, AU_LS, SECS_PER_DAY, TSUN_S  # noqa: E402,F401
 

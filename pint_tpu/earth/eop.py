@@ -62,3 +62,69 @@ class EOPTable:
         """(xp, yp) in radians."""
         return (self._interp(self.pm_x, t) * ARCSEC_TO_RAD,
                 self._interp(self.pm_y, t) * ARCSEC_TO_RAD)
+
+
+# --- global table: the transparent data-upgrade path -------------------
+# Drop a finals2000A.all into pint_tpu/data/ (or point $PINT_TPU_EOP_FILE
+# at one) and every site->GCRS conversion picks it up; no code changes.
+_GLOBAL: EOPTable | None = None
+_SEARCHED = False
+
+
+def set_eop_table(table: EOPTable | None) -> None:
+    """Install the process-wide EOP table; None DISABLES EOP (the
+    UT1=UTC / zero-polar-motion tier) until reset_eop_discovery() or a
+    new table. Disabling sticks — it does not re-trigger file
+    discovery, so "how much does EOP data contribute" comparisons are
+    expressible."""
+    global _GLOBAL, _SEARCHED
+    _GLOBAL = table
+    _SEARCHED = True
+
+
+def reset_eop_discovery() -> None:
+    """Forget any installed/disabled state and re-run the file
+    auto-discovery on next use (e.g. after changing
+    $PINT_TPU_EOP_FILE)."""
+    global _GLOBAL, _SEARCHED
+    _GLOBAL = None
+    _SEARCHED = False
+
+
+def get_eop_table() -> EOPTable | None:
+    """The process-wide EOP table, auto-discovered on first use from
+    $PINT_TPU_EOP_FILE or pint_tpu/data/finals2000A.all; None when no
+    data is available (rotation chain then runs UT1=UTC, zero polar
+    motion — the documented ~1.4 us fallback tier)."""
+    global _GLOBAL, _SEARCHED
+    if _SEARCHED:
+        return _GLOBAL
+    _SEARCHED = True
+    import os
+    import warnings
+
+    env_file = os.environ.get("PINT_TPU_EOP_FILE", "")
+    candidates = [
+        env_file,
+        os.path.join(os.path.dirname(__file__), "..", "data",
+                     "finals2000A.all"),
+    ]
+    for p in candidates:
+        if not p:
+            continue
+        try:
+            _GLOBAL = EOPTable.from_finals2000a(p)
+            break
+        except FileNotFoundError:
+            continue  # candidate simply absent — the normal case
+        except (OSError, ValueError) as e:
+            # a file that EXISTS but fails to load deserves a
+            # diagnostic — silently ignoring it would let the user
+            # believe their data is applied while the chain runs the
+            # degraded UT1=UTC tier
+            which = (f"PINT_TPU_EOP_FILE={p!r}" if p == env_file
+                     else f"bundled EOP file {p!r}")
+            warnings.warn(f"{which} could not be loaded ({e}); "
+                          "continuing without it")
+            continue
+    return _GLOBAL

@@ -27,7 +27,7 @@ import numpy as np
 from ..constants import ARCSEC_TO_RAD, SECS_PER_DAY
 from ..mjd import Epochs
 from .. import timescales as ts
-from .eop import EOPTable
+from .eop import EOPTable  # noqa: F401  (re-export: callers pass EOPTable in)
 
 TWO_PI = 2.0 * np.pi
 OMEGA_EARTH = 7.292115855306589e-5  # rad/s, Earth rotation rate (IERS)
@@ -209,10 +209,26 @@ def gast(ut1: Epochs, T_tt) -> np.ndarray:
     return np.mod(era(ut1) + poly + ee, TWO_PI)
 
 
-def _earth_rotation_inputs(utc: Epochs, eop: EOPTable | None):
+# default sentinel: "use the process-wide auto-discovered table".
+# Distinct from None, which explicitly selects the zero-EOP tier for
+# one call without touching global state.
+AUTO_EOP = object()
+
+
+def _earth_rotation_inputs(utc: Epochs, eop):
     """(tt, ut1, xp, yp) — the single home of the UTC->TT/UT1/EOP
-    precompute shared by the numpy and native paths."""
+    precompute shared by the numpy and native paths.
+
+    eop=AUTO_EOP (the default everywhere) consults the process-wide
+    auto-discovered table (earth/eop.py::get_eop_table) so dropping a
+    finals2000A.all into the data dir upgrades every site->GCRS
+    conversion transparently; eop=None forces UT1=UTC / zero polar
+    motion for this call only."""
+    from .eop import get_eop_table
+
     tt = ts.utc_to_tt(utc)
+    if eop is AUTO_EOP:
+        eop = get_eop_table()
     if eop is not None:
         dut1 = eop.ut1_minus_utc(utc)
         xp, yp = eop.polar_motion(utc)
@@ -223,7 +239,7 @@ def _earth_rotation_inputs(utc: Epochs, eop: EOPTable | None):
     return tt, ut1, xp, yp
 
 
-def itrf_to_gcrs_matrix(utc: Epochs, eop: EOPTable | None = None,
+def itrf_to_gcrs_matrix(utc: Epochs, eop=AUTO_EOP,
                         _inputs=None) -> np.ndarray:
     """Rotation matrices (n, 3, 3): r_GCRS = M @ r_ITRF.
 
@@ -239,7 +255,7 @@ def itrf_to_gcrs_matrix(utc: Epochs, eop: EOPTable | None = None,
     return np.swapaxes(c2t, -1, -2)  # transpose: ITRF->GCRS
 
 
-def gcrs_posvel_from_itrf(itrf_xyz_m, utc: Epochs, eop: EOPTable | None = None):
+def gcrs_posvel_from_itrf(itrf_xyz_m, utc: Epochs, eop=AUTO_EOP):
     """Observatory GCRS position [m] and velocity [m/s] at each epoch.
 
     (reference: src/pint/erfautils.py::gcrs_posvel_from_itrf)
