@@ -117,7 +117,7 @@ class EcorrNoise(NoiseComponent):
                          for i in self.ecorr_ids])
         params0["ECORR"] = vals
         mjds = toas.get_mjds()
-        cols = []
+        groups = []  # member-index arrays, one per epoch
         owner = []  # which ECORR param each epoch belongs to
         for k, i in enumerate(self.ecorr_ids):
             mask = getattr(self, f"ECORR{i}").resolve_mask(toas)
@@ -132,13 +132,44 @@ class EcorrNoise(NoiseComponent):
                 members = order[bucket == b]
                 if len(members) < 2:
                     continue  # singleton epochs carry no correlated info
-                col = np.zeros(len(toas))
-                col[members] = 1.0
-                cols.append(col)
+                groups.append(members)
                 owner.append(k)
-        U = np.stack(cols, axis=1) if cols else np.zeros((len(toas), 0))
-        prep["ecorr_U"] = jnp.asarray(U)
         prep["ecorr_owner"] = jnp.asarray(np.array(owner, dtype=np.int64))
+        counts = np.zeros(len(toas), dtype=np.int64)
+        for g in groups:
+            counts[g] += 1
+        if groups and counts.max() > 1:
+            # overlapping ECORR masks (a TOA in two epochs): only the
+            # dense basis can represent this; the GLS auto path falls
+            # back to the dense solve for such models anyway
+            U = np.zeros((len(toas), len(groups)))
+            for j, g in enumerate(groups):
+                U[g, j] = 1.0
+            prep["ecorr_U"] = jnp.asarray(U)
+        else:
+            # disjoint epochs — the universal real-data case: store the
+            # O(n) epoch index instead of the O(n*k) dense basis. At
+            # NANOGrav scale (30k TOAs, ~10^3 epochs/pulsar) the dense
+            # U is ~0.25 GB/pulsar of pure redundancy; the index packs
+            # the identical information in 120 kB and the marginalized
+            # GLS path (parallel/pta.py::one_step_marg) consumes it
+            # directly via segment sums.
+            eidx = np.full(len(toas), -1, dtype=np.int32)
+            for j, g in enumerate(groups):
+                eidx[g] = j
+            prep["ecorr_eidx"] = jnp.asarray(eidx)
+
+    @staticmethod
+    def dense_U(prep):
+        """The (n_toa, k) 0/1 quantization basis, reconstructed from
+        the epoch index when only the sparse form is packed."""
+        import jax.numpy as jnp
+
+        if "ecorr_U" in prep:
+            return prep["ecorr_U"]
+        k = prep["ecorr_owner"].shape[-1]
+        eidx = prep["ecorr_eidx"]
+        return (eidx[:, None] == jnp.arange(k)[None, :]).astype(jnp.float64)
 
     def basis_weight(self, params, prep):
         """(U, w): covariance contribution U diag(w) U^T, w in us^2.
@@ -149,12 +180,25 @@ class EcorrNoise(NoiseComponent):
         threshold instead of carrying pulsar-0's ECORR prior."""
         import jax.numpy as jnp
 
-        U = prep["ecorr_U"]
+        U = self.dense_U(prep)
         if not U.shape[1]:
             return U, jnp.zeros(0)
         owner = prep["ecorr_owner"]
         w = jnp.square(params["ECORR"])[jnp.clip(owner, 0, None)]
         return U, jnp.where(owner >= 0, w, 0.0)
+
+    def epoch_index_weight(self, params, prep):
+        """Sparse form for the analytically-marginalized GLS path:
+        (eidx (n_toa,) int, w_us2 (k,)) with eidx in [0,k) or any
+        out-of-range value (-1 / padded) meaning 'not in an epoch'.
+        None when only the overlapping dense form exists."""
+        import jax.numpy as jnp
+
+        if "ecorr_eidx" not in prep:
+            return None
+        owner = prep["ecorr_owner"]
+        w = jnp.square(params["ECORR"])[jnp.clip(owner, 0, None)]
+        return prep["ecorr_eidx"], jnp.where(owner >= 0, w, 0.0)
 
 
 def fourier_basis(toas, n_harm):

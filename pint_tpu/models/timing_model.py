@@ -96,7 +96,10 @@ def device_put_staged(tree):
 
     if _STAGING_DEPTH > 0:
         return tree
-    target = jax.devices()[0]
+    # local_devices, not devices: in a multi-process fleet
+    # (jax.distributed) devices()[0] belongs to process 0 and is
+    # non-addressable elsewhere
+    target = jax.local_devices()[0]
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     is_arr = [isinstance(x, jax.Array) for x in leaves]
     arrs = [x for x, a in zip(leaves, is_arr) if a]

@@ -71,6 +71,9 @@ def _pad_single(prepared, n_pad):
             continue
         if _is_static(k, v):
             static[k] = v
+        elif k == "ecorr_eidx":
+            arrays[k] = jnp.asarray(np.concatenate(
+                [np.asarray(v), np.full(n_pad - n, -1, dtype=np.int32)]))
         else:
             arrays[k] = jnp.asarray(_toa_dim_pad(v, n, n_pad))
     fields = {}
@@ -106,6 +109,20 @@ def stack_prepared(preps: list[PreparedTiming]):
     n_max = max(p.batch.n_toas for p in preps)
     n_toas = np.array([p.batch.n_toas for p in preps])
 
+    # ECORR representation must be uniform across the batch: pulsars
+    # with overlapping masks pack the dense U, disjoint ones pack the
+    # O(n) epoch index (models/noise.py::EcorrNoise.pack). A mixed
+    # batch densifies the sparse ones (rare: overlap means hand-built
+    # overlapping mask ranges).
+    if (any("ecorr_U" in p.prep for p in preps)
+            and any("ecorr_eidx" in p.prep for p in preps)):
+        from ..models.noise import EcorrNoise
+
+        for p in preps:
+            if "ecorr_eidx" in p.prep:
+                p.prep["ecorr_U"] = EcorrNoise.dense_U(p.prep)
+                del p.prep["ecorr_eidx"]
+
     # --- params: same keys; vector lengths padded to max
     keys = preps[0].params0.keys()
     params_stack = {}
@@ -128,6 +145,15 @@ def stack_prepared(preps: list[PreparedTiming]):
             assert all(np.all(v == vals[0]) for v in vals), \
                 f"prep[{k}] must be uniform across the PTA batch"
             static[k] = vals[0]
+            continue
+        if k == "ecorr_eidx":
+            # epoch indices: padded TOA rows must be OUTSIDE every
+            # epoch (-1), not joined to the last real epoch
+            arrs = [np.concatenate(
+                [np.asarray(v),
+                 np.full(n_max - p.batch.n_toas, -1, dtype=np.int32)])
+                for v, p in zip(vals, preps)]
+            prep_stack[k] = jnp.asarray(np.stack(arrs))
             continue
         arrs = [np.asarray(_toa_dim_pad(v, p.batch.n_toas, n_max))
                 for v, p in zip(vals, preps)]
@@ -267,9 +293,78 @@ class PTABatch:
 
         return resid_seconds
 
+    @property
+    def n_pulsars(self):
+        """Batch size from the packed arrays themselves — in a
+        multi-process fleet (assemble_global_batch) this is the GLOBAL
+        pulsar count while self.models holds only the local slice."""
+        import jax
+
+        return int(jax.tree_util.tree_leaves(self.params)[0].shape[0])
+
     def free_map(self):
         """Free-parameter layout of the template (uniform across batch)."""
+        if getattr(self, "_free_map", None) is not None:
+            return self._free_map
         return self.preps[0].free_param_map()
+
+    def pack_state(self):
+        """Host-side numpy snapshot of the packed batch. Together with
+        ``from_packed`` this lets a caller cache the expensive host
+        pack (TOA prep + stacking) across processes — the bench's
+        full-scale stage rebuilds a 670k-TOA fleet from disk in
+        seconds instead of minutes."""
+        import jax
+
+        def to_np(t):
+            return jax.tree_util.tree_map(lambda x: np.asarray(x), t)
+
+        from ..toa import TOABatch
+
+        return {"params": to_np(self.params), "prep": to_np(self.prep),
+                "batch": {f: np.asarray(getattr(self.batch, f))
+                          for f in TOABatch._fields},
+                "static": dict(self.static),
+                "n_toas": np.asarray(self.n_toas),
+                "free_map": list(self.free_map())}
+
+    @classmethod
+    def from_packed(cls, template_model, state, mesh=None):
+        """Rebuild a PTABatch from ``pack_state()`` output, skipping
+        host TOA prep entirely. template_model provides the component
+        structure (it must match the one that produced the state)."""
+        import jax.numpy as jnp
+
+        from ..models.timing_model import _cpu_staging, device_put_staged
+        from ..toa import TOABatch
+
+        self = cls.__new__(cls)
+        n_psr = int(len(state["n_toas"]))
+        self.models = [template_model] * n_psr  # divergence labels only
+        self.toas_list = None
+        self.preps = None
+        self._free_map = [tuple(x) for x in state["free_map"]]
+        with _cpu_staging():
+            params = {k: jnp.asarray(v) for k, v in state["params"].items()}
+            prep = {k: jnp.asarray(v) for k, v in state["prep"].items()}
+            batch = TOABatch(**{k: jnp.asarray(v)
+                                for k, v in state["batch"].items()})
+        self.params, self.prep, self.batch = device_put_staged(
+            (params, prep, batch))
+        self.static = dict(state["static"])
+        self.n_toas = np.asarray(state["n_toas"])
+        self.template = template_model
+        self.mesh = mesh
+        if mesh is not None:
+            from .mesh import shard_batch
+
+            n_max = int(self.batch.tdb_sec.shape[1])
+            self.params = shard_batch(self.params, mesh)
+            self.prep = shard_batch(self.prep, mesh, n_toa=n_max)
+            self.batch = shard_batch(self.batch, mesh, n_toa=n_max)
+        self._fns = {}
+        self._ecorr_marg_ok = None
+        return self
 
     def set_start_vector(self, x):
         """Override the starting parameter vectors for the next fit —
@@ -279,10 +374,10 @@ class PTABatch:
 
         x = jnp.asarray(x)
         k = len(self.free_map())
-        if x.shape != (len(self.models), k):
+        if x.shape != (self.n_pulsars, k):
             raise ValueError(
                 f"start vector shape {x.shape} != "
-                f"({len(self.models)}, {k})")
+                f"({self.n_pulsars}, {k})")
         self._x0_cache = x
 
     def _overlay(self, params, x):
@@ -314,6 +409,26 @@ class PTABatch:
         self._x0_cache = jax.vmap(pull_one)(self.params)
         return self._x0_cache
 
+    def _pull(self, tree):
+        """Device->host pull that also works on multi-process global
+        arrays (assemble_global_batch fleets): non-addressable leaves
+        are first replicated across the mesh — the all-gather IS the
+        fleet's DCN collective — then materialized as numpy."""
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(tree)
+        if any(isinstance(l, jax.Array) and not l.is_fully_addressable
+               for l in leaves):
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            if "pull_rep" not in self._fns:  # one compiled gather, reused
+                rep = NamedSharding(self.mesh, P())
+                self._fns["pull_rep"] = jax.jit(lambda t: t,
+                                                out_shardings=rep)
+            tree = self._fns["pull_rep"](tree)
+            return jax.tree_util.tree_map(np.asarray, tree)
+        return jax.device_get(tree)
+
     def _isolate_diverged(self, x0, x, chi2):
         """Per-pulsar fault isolation (SURVEY section 5 "failure
         detection"): a diverged lane (non-finite chi2 or params) must
@@ -333,13 +448,19 @@ class PTABatch:
         bad = ~np.isfinite(chi2) | ~np.isfinite(x).all(axis=1)
         self.diverged = np.flatnonzero(bad)
         if bad.any():
-            names = [getattr(m, "PSR", None) and m.PSR.value or f"#{i}"
+            # self.models holds only this process's slice in a
+            # distributed fleet (indices offset by _pulsar_offset);
+            # out-of-slice pulsars are labeled by global index
+            off = getattr(self, "_pulsar_offset", 0)
+            names = [getattr(m, "PSR", None) and m.PSR.value or f"#{off + i}"
                      for i, m in enumerate(self.models)]
+            labels = [names[i - off] if 0 <= i - off < len(names)
+                      else f"#{i}" for i in self.diverged]
             warnings.warn(
                 f"PTA batch: {bad.sum()}/{len(bad)} pulsars diverged "
-                f"({[names[i] for i in self.diverged]}); their parameter "
+                f"({labels}); their parameter "
                 "vectors were restored to the pre-fit values")
-            x[bad] = np.asarray(x0, np.float64)[bad]
+            x[bad] = np.asarray(self._pull(x0), np.float64)[bad]
         return x, chi2
 
     def wls_fit(self, maxiter=3, threshold=1e-12):
@@ -406,7 +527,7 @@ class PTABatch:
         # Physical-unit covariance then forms on host in IEEE f64:
         # variances like var(F1)~1e-38 leave the TPU emulated-f64
         # exponent range.
-        x, chi2, covn, norm = jax.device_get((x, chi2, covn, norm))
+        x, chi2, covn, norm = self._pull((x, chi2, covn, norm))
         cov = covn / (norm[:, :, None] * norm[:, None, :])
         x, chi2 = self._isolate_diverged(x0, x, chi2)
         self._record_metrics("wls", t0, maxiter, warm=compiled)
@@ -428,7 +549,7 @@ class PTABatch:
             "fit_wall_s": round(time.perf_counter() - t0, 4),
             "includes_compile": not warm,
             "maxiter": maxiter,
-            "n_pulsars": len(self.models),
+            "n_pulsars": self.n_pulsars,
             "n_toas_total": int(sum(self.n_toas)),
             "device_bytes_in_use": device_memory_stats(),
         }
@@ -517,16 +638,15 @@ class PTABatch:
             # (e.g. a flag mask plus an mjd-range mask) put a TOA in two
             # epochs. Zero epochs (all singletons) has nothing to
             # marginalize. Both fall back to the exact dense path.
-            # The check pulls the (n_psr, n_toa, n_epoch) U to host —
-            # tens of MB over a tunneled device — so it is cached:
-            # prep is immutable for the life of the batch (measured
-            # 0.30 s/refit saved on the 16x1000 profile).
+            # Disjointness is now explicit in the packed representation
+            # (models/noise.py::EcorrNoise.pack): the sparse epoch
+            # index exists iff the epochs are disjoint; overlapping
+            # masks pack the dense U instead. Cached: prep is immutable
+            # for the life of the batch.
             if self._ecorr_marg_ok is None:
-                U_host = np.asarray(self.prep.get("ecorr_U",
-                                                  np.zeros((1, 1, 0))))
                 self._ecorr_marg_ok = bool(
-                    U_host.shape[-1] > 0
-                    and not (U_host.sum(axis=-1) > 1).any())
+                    "ecorr_eidx" in self.prep
+                    and self.prep["ecorr_owner"].shape[-1] > 0)
             marginalize = self._ecorr_marg_ok
         noise_bw_nf = (self._noise_bw_fn(exclude_ecorr=True)
                        if marginalize else None)
@@ -575,11 +695,17 @@ class PTABatch:
             bw = (noise_bw_nf(p, prep) if noise_bw_nf is not None
                   else None) or (None, None)
             Mfull, sqrt_phi_inv, nparam = stack_noise_bases(M, bw)
-            U, w_us2 = ecorr_comp.basis_weight(p, {**prep, **self.static})
-            k = U.shape[1]
-            # per-TOA epoch id; rows outside every epoch go to bucket k
-            in_epoch = jnp.sum(U, axis=1) > 0
-            e_idx = jnp.where(in_epoch, jnp.argmax(U, axis=1), k)
+            # sparse quantization: the (n_toa, k) dense U never
+            # materializes anywhere on this path — epoch membership is
+            # one int per TOA and every epoch reduction is a segment
+            # sum, which is what lets a 30k-TOA NANOGrav-scale pulsar
+            # fit in HBM (dense U would be ~0.25 GB/pulsar)
+            eidx, w_us2 = ecorr_comp.epoch_index_weight(
+                p, {**prep, **self.static})
+            k = w_us2.shape[0]
+            # per-TOA epoch id; rows outside every epoch (-1 / padded)
+            # go to bucket k
+            e_idx = jnp.where((eidx >= 0) & (eidx < k), eidx, k)
             # everything below lives in WHITENED, COLUMN-NORMALIZED
             # space (fitter.gls_whiten — the one home of the prior-
             # folded convention): raw whitened column products overflow
@@ -624,7 +750,7 @@ class PTABatch:
         x, chi2, (covn, norm) = self._fns[key](x0, self.params,
                                                self.batch, self.prep)
         # one batched pull; see wls_fit
-        x, chi2, covn, norm = jax.device_get((x, chi2, covn, norm))
+        x, chi2, covn, norm = self._pull((x, chi2, covn, norm))
         cov = covn / (norm[:, :, None] * norm[:, None, :])
         x, chi2 = self._isolate_diverged(x0, x, chi2)
         self._record_metrics("gls", t0, maxiter, warm=compiled)
@@ -680,12 +806,28 @@ class PTAFleet:
     win while accepting arbitrary mixtures.
     """
 
-    def __init__(self, models, toas_list, mesh=None):
+    def __init__(self, models, toas_list, mesh=None, toa_bucket=None):
+        """toa_bucket=None: group by model structure only (each batch
+        pads to its own max TOA count). toa_bucket="pow2": additionally
+        bucket pulsars by next-power-of-two TOA count — on ragged real
+        datasets (NANOGrav spans 10^2..10^4.5 TOAs/pulsar) structure-
+        only grouping pads EVERY pulsar to the fleet max, a ~3x FLOP
+        and memory tax; pow2 bucketing caps padding waste at 2x per
+        pulsar while keeping the compiled-program count at
+        O(log(max/min)) (SURVEY.md section 7.3 item 4)."""
         self.buckets = {}
         self.order = []  # (bucket_key, index_within_bucket) per pulsar
+        if toa_bucket not in (None, "pow2"):
+            raise ValueError(f"toa_bucket must be None or 'pow2', "
+                             f"got {toa_bucket!r}")
         groups = {}
         for i, (m, t) in enumerate(zip(models, toas_list)):
             key = PTABatch.structure_key(m)
+            if toa_bucket == "pow2":
+                b = 256
+                while b < len(t):
+                    b *= 2
+                key = (key, b)
             groups.setdefault(key, []).append(i)
         self.group_indices = groups
         self.batches = {}
@@ -694,6 +836,10 @@ class PTAFleet:
                                          [toas_list[i] for i in idxs],
                                          mesh=mesh)
         self.n = len(models)
+        real = sum(len(t) for t in toas_list)
+        padded = sum(int(b.batch.tdb_sec.shape[0] * b.batch.tdb_sec.shape[1])
+                     for b in self.batches.values())
+        self.padding_ratio = padded / max(real, 1)
 
     def fit(self, method="auto", maxiter=3, **kw):
         """Fit every bucket; returns per-pulsar lists (x, chi2, cov)

@@ -150,15 +150,26 @@ class Residuals:
         mjd = self.toas.get_mjds()
         freq = self.toas.freq_mhz
         prep = self.prepared.prep
-        U = np.asarray(prep.get("ecorr_U", np.zeros((n, 0))))
-        groups = [np.flatnonzero(U[:, j]) for j in range(U.shape[1])]
-        w_us2 = np.zeros(U.shape[1])
-        if U.shape[1] and use_noise_model:
+        if "ecorr_eidx" in prep:  # sparse quantization (disjoint epochs)
+            eidx = np.asarray(prep["ecorr_eidx"])
+            n_ep = int(np.asarray(prep["ecorr_owner"]).shape[0])
+            groups = [np.flatnonzero(eidx == j) for j in range(n_ep)]
+            in_epoch = eidx >= 0
+        else:
+            U = np.asarray(prep.get("ecorr_U", np.zeros((n, 0))))
+            groups = [np.flatnonzero(U[:, j]) for j in range(U.shape[1])]
+            in_epoch = U.sum(axis=1) > 0
+        w_us2 = np.zeros(len(groups))
+        if groups and use_noise_model:
             comp = self.model.components.get("EcorrNoise")
             if comp is not None:
-                _, w = comp.basis_weight(self.prepared.params0, prep)
+                if "ecorr_eidx" in prep:
+                    # sparse path: weights without rebuilding dense U
+                    _, w = comp.epoch_index_weight(
+                        self.prepared.params0, prep)
+                else:
+                    _, w = comp.basis_weight(self.prepared.params0, prep)
                 w_us2 = np.asarray(w)
-        in_epoch = U.sum(axis=1) > 0
         groups += [np.array([i]) for i in np.flatnonzero(~in_epoch)]
         w_us2 = np.concatenate([w_us2, np.zeros(n - int(in_epoch.sum()))])
         order = np.argsort([mjd[g].mean() for g in groups])

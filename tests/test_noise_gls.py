@@ -62,10 +62,14 @@ def test_efac_equad_scaling():
 
 
 def test_ecorr_quantization():
+    from pint_tpu.models.noise import EcorrNoise
+
     m = get_model(PAR + "ECORR -f L-wide 0.8\n")
     t = _clustered_toas(m)
     prep = m.prepare(t)
-    U = np.asarray(prep.prep["ecorr_U"])
+    # disjoint epochs pack the sparse O(n) epoch index, not a dense U
+    assert "ecorr_U" not in prep.prep and "ecorr_eidx" in prep.prep
+    U = np.asarray(EcorrNoise.dense_U(prep.prep))
     # every L-wide epoch (25 epochs, 2 L-wide TOAs each) becomes a column
     assert U.shape[1] == 25
     assert set(U.sum(axis=0)) == {2.0}

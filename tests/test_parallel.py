@@ -280,7 +280,7 @@ def test_gls_marginalization_guards():
                                  error_us=1.0, freq_mhz=1400.0, obs="gbt",
                                  add_noise=True, seed=2)
     pta3 = PTABatch([m2], [t2])
-    assert np.asarray(pta3.prep["ecorr_U"]).shape[-1] == 0
+    assert pta3.prep["ecorr_owner"].shape[-1] == 0
     x3, c3, _ = pta3.gls_fit(maxiter=2)  # must not crash
 
     with pytest.raises(ValueError, match="ecorr_mode"):
@@ -376,7 +376,7 @@ def test_ptafleet_mixed_structure_integration():
         true_f0.append(true.F0.value)
     # the ECORR pulsar's epoch basis must be live (one epoch per pair)
     prep1 = models[1].prepare(toas_list[1]).prep
-    assert prep1["ecorr_U"].shape[1] == 60
+    assert prep1["ecorr_owner"].shape[0] == 60
     fleet = PTAFleet(models, toas_list)
     assert len(fleet.batches) == 3  # three distinct structures
     xs, chi2s, covs = fleet.fit(method="auto", maxiter=3)
@@ -482,6 +482,119 @@ print("DIST-OK")
     assert "DIST-OK" in out.stdout, out.stderr[-2000:]
 
 
+def _dist_fleet(n_psr=4, n_toa=40):
+    """Deterministic uniform-shape fleet every process can rebuild
+    identically (equal TOA counts: assemble_global_batch requires
+    identical padded shapes across processes)."""
+    import numpy as np
+
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    rng = np.random.default_rng(42)
+    models, toas_list = [], []
+    for i in range(n_psr):
+        par = (f"PSR DF{i}\nRAJ 0{2 * i}:30:00.0\nDECJ {10 + i}:00:00.0\n"
+               f"F0 {180 + 7 * i}.25 1\nF1 -{2 + i}e-16 1\nPEPOCH 55500\n"
+               f"DM {12 + i}.0 1\n")
+        m = get_model(par)
+        mjds = np.sort(rng.uniform(55000, 56000, n_toa))
+        freqs = np.where(np.arange(n_toa) % 2, 1400.0, 800.0)
+        t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=freqs,
+                                    obs="gbt", add_noise=True, seed=100 + i)
+        models.append(m)
+        toas_list.append(t)
+    return models, toas_list
+
+
+_DIST_WORKER = '''
+import os, sys
+pid, port, outdir = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import warnings; warnings.simplefilter("ignore")
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+from pint_tpu.parallel import PTABatch
+from pint_tpu.parallel.distributed import (assemble_global_batch,
+    initialize_distributed, process_pulsar_slice)
+pid_, nproc = initialize_distributed(
+    coordinator_address="127.0.0.1:" + port, num_processes=2,
+    process_id=pid)
+assert (pid_, nproc) == (pid, 2), (pid_, nproc)
+assert len(jax.local_devices()) == 2 and len(jax.devices()) == 4
+
+{builder_src}
+
+models, toas_list = _dist_fleet()
+sl = process_pulsar_slice(len(models))
+assert sl == slice(2 * pid, 2 * pid + 2), sl
+local = PTABatch(models[sl], toas_list[sl])
+pta = assemble_global_batch(local)
+x, chi2, cov = pta.wls_fit(maxiter=3)
+# _pull replicated the global result: every process sees all 4 pulsars
+assert np.asarray(x).shape[0] == 4, np.asarray(x).shape
+np.savez(os.path.join(outdir, f"proc{{pid}}.npz"), x=np.asarray(x),
+         chi2=np.asarray(chi2), cov=np.asarray(cov))
+print("DIST2-OK", pid)
+'''
+
+
+def test_distributed_two_process_fit(tmp_path):
+    """REAL multi-process DCN path (VERDICT r2 next-step 8): two CPU
+    processes, coordinator on localhost, each packs its
+    process_pulsar_slice and assembles the global batch with
+    assemble_global_batch; the jitted WLS fit runs as ONE SPMD program
+    over the 4-device global mesh, and the replicating result pull is
+    a genuine cross-process all-gather. Both processes' results must
+    agree with each other and with a single-process fit of the same
+    fleet."""
+    import inspect
+    import os
+    import socket
+    import subprocess
+    import sys
+    import textwrap
+
+    # single-process reference in THIS session (8-device CPU mesh)
+    models, toas_list = _dist_fleet()
+    ref = PTABatch([copy.deepcopy(m) for m in models], toas_list)
+    x_ref, chi2_ref, cov_ref = ref.wls_fit(maxiter=3)
+
+    with socket.socket() as s:  # free localhost port for the coordinator
+        s.bind(("127.0.0.1", 0))
+        port = str(s.getsockname()[1])
+
+    builder_src = textwrap.dedent(inspect.getsource(_dist_fleet))
+    code = _DIST_WORKER.replace("{builder_src}", builder_src) \
+                       .replace("{{pid}}", "{pid}")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", ""))
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", code, str(pid), port, str(tmp_path)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for pid in (0, 1)]
+    outs = [p.communicate(timeout=420) for p in procs]
+    for pid, (out, err) in enumerate(outs):
+        assert f"DIST2-OK {pid}" in out, (pid, out[-500:], err[-3000:])
+
+    r0 = np.load(tmp_path / "proc0.npz")
+    r1 = np.load(tmp_path / "proc1.npz")
+    # both processes hold the identical replicated global result
+    np.testing.assert_array_equal(r0["x"], r1["x"])
+    np.testing.assert_array_equal(r0["chi2"], r1["chi2"])
+    # and it matches the single-process fit bit-for-bit-ish (same
+    # program, different mesh layout -> tiny reduction-order noise)
+    np.testing.assert_allclose(r0["x"], np.asarray(x_ref),
+                               rtol=1e-10, atol=0)
+    np.testing.assert_allclose(r0["chi2"], np.asarray(chi2_ref), rtol=1e-8)
+    np.testing.assert_allclose(r0["cov"], np.asarray(cov_ref), rtol=1e-6,
+                               atol=1e-300)
+
+
 def test_checkpointed_pta_fit_resumes(tmp_path):
     """A chunked, snapshotted PTA fit reproduces the direct fit, and a
     fresh batch resumes from the snapshot instead of restarting."""
@@ -508,3 +621,53 @@ def test_checkpointed_pta_fit_resumes(tmp_path):
     x3, chi2_3, cov3 = checkpointed_pta_fit(pta2, str(tmp_path), every=1,
                                             maxiter=4, method="wls")
     assert cov3 is not None and np.isfinite(np.asarray(chi2_3)).all()
+
+
+def test_fleet_pow2_toa_bucketing():
+    """toa_bucket="pow2" splits a same-structure ragged fleet into
+    size buckets: less padding, identical per-pulsar results."""
+    from pint_tpu.parallel import PTAFleet
+
+    models, toas_list, _ = _batch(4, base_toas=30)
+    # make the raggedness span a pow2 boundary: pulsar 3 gets ~600 TOAs
+    big_m = copy.deepcopy(models[0])
+    rng = np.random.default_rng(9)
+    mjds = np.sort(rng.uniform(55000, 56000, 600))
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    big_t = make_fake_toas_fromMJDs(
+        mjds, big_m, error_us=1.0,
+        freq_mhz=np.where(np.arange(600) % 2, 1400.0, 800.0), obs="gbt",
+        add_noise=True, seed=77)
+    models = [copy.deepcopy(m) for m in models] + [big_m]
+    toas_list = toas_list + [big_t]
+
+    flat = PTAFleet([copy.deepcopy(m) for m in models], toas_list)
+    assert len(flat.batches) == 1  # same structure: one batch, max-padded
+    fleet = PTAFleet([copy.deepcopy(m) for m in models], toas_list,
+                     toa_bucket="pow2")
+    assert len(fleet.batches) == 2  # 256-bucket + 1024-bucket
+    assert fleet.padding_ratio < flat.padding_ratio
+    x_flat, chi2_flat, _ = flat.fit(method="wls", maxiter=3)
+    x_b, chi2_b, _ = fleet.fit(method="wls", maxiter=3)
+    for i in range(len(models)):
+        np.testing.assert_allclose(x_b[i], x_flat[i], rtol=1e-8)
+
+
+def test_pta_pack_state_roundtrip():
+    """from_packed(pack_state()) reproduces the fit bit-for-bit —
+    the packed-fleet cache the full-scale bench stage relies on."""
+    models, toas_list, _ = _batch(3)
+    pta = PTABatch([copy.deepcopy(m) for m in models], toas_list)
+    x_ref, chi2_ref, cov_ref = pta.wls_fit(maxiter=2)
+    state = pta.pack_state()
+    # simulate a disk round-trip
+    import pickle
+
+    state = pickle.loads(pickle.dumps(state))
+    pta2 = PTABatch.from_packed(models[0], state)
+    assert pta2.free_map() == pta.free_map()
+    x2, chi2_2, cov2 = pta2.wls_fit(maxiter=2)
+    np.testing.assert_array_equal(np.asarray(x2), np.asarray(x_ref))
+    np.testing.assert_array_equal(np.asarray(chi2_2), np.asarray(chi2_ref))
+    np.testing.assert_array_equal(np.asarray(cov2), np.asarray(cov_ref))
