@@ -74,6 +74,128 @@ def build_batch(n_psr, n_toa, noise=True, seed=0):
     return models, toas_list
 
 
+def _ragged_counts(n_psr=68, total=670_000, seed=7):
+    """Deterministic NANOGrav-15yr-like ragged TOA counts: lognormal
+    spread over ~600..30000, scaled to the target total."""
+    rng = np.random.default_rng(seed)
+    c = rng.lognormal(np.log(8000.0), 0.9, n_psr)
+    for _ in range(3):
+        c = np.clip(c * (total / c.sum()), 600, 30000)
+    return np.sort(c.astype(int))[::-1]
+
+
+def _full_scale_stage(meta):
+    """Measured (not projected) full-scale north star: 68 pulsars at
+    ragged realistic TOA counts totaling ~670k, PTAFleet pow2
+    bucketing, full GLS refit wall-clock. The expensive host pack is
+    cached in .bench_cache/ (pickle of PTABatch.pack_state per
+    bucket) so driver re-runs only pay device time."""
+    import pickle
+
+    import jax
+
+    from pint_tpu.models import get_model
+    from pint_tpu.parallel import PTABatch, PTAFleet
+
+    counts = _ragged_counts()
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".bench_cache")
+    cache_path = os.path.join(cache_dir, "full670k_v1.pkl")
+    states = None
+    if os.path.exists(cache_path):
+        try:
+            t0 = time.time()
+            with open(cache_path, "rb") as fh:
+                payload = pickle.load(fh)
+            if payload.get("counts") == counts.tolist():
+                states = payload["states"]
+                _stage(f"full-scale pack cache hit "
+                       f"({time.time() - t0:.1f}s load)")
+        except Exception as e:
+            _stage(f"full-scale pack cache unreadable ({e}); rebuilding")
+    if states is None:
+        _stage(f"full-scale host prep: 68 ragged pulsars, "
+               f"{counts.sum()} TOAs (~minutes, cached afterwards)")
+        t0 = time.time()
+        models, toas_list = [], []
+        rng = np.random.default_rng(1)
+        for i, n in enumerate(counts):
+            par = (f"PSR FS{i}\nRAJ {i % 24}:{(11 * i) % 60:02d}:00.0\n"
+                   f"DECJ {(i * 5) % 70 - 35}:15:00.0\n"
+                   f"F0 {170 + 3 * (i % 60)}.707 1\nF1 -{1 + i % 8}e-16 1\n"
+                   f"PEPOCH 55500\nDM {5 + (i % 50)}.17 1\n"
+                   "EFAC -f L-wide 1.1\nEQUAD -f L-wide 0.4\n"
+                   "ECORR -f L-wide 0.8\n"
+                   "RNAMP 1e-14\nRNIDX -3.1\nTNREDC 30\n")
+            m = get_model(par)
+            n_ep = max(1, int(n) // 4)
+            days = np.sort(rng.uniform(54000, 57000, n_ep))
+            mjds = np.concatenate(
+                [d + np.arange(4) * 0.5 / 86400.0 for d in days])[:int(n)]
+            freqs = np.where(np.arange(len(mjds)) % 2, 1400.0, 800.0)
+            from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+            t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0,
+                                        freq_mhz=freqs, obs="gbt",
+                                        add_noise=False, iterations=0)
+            for f in t.flags:
+                f["f"] = "L-wide"
+            models.append(m)
+            toas_list.append(t)
+        host_s = time.time() - t0
+        _stage(f"full-scale host prep done ({host_s:.0f}s); packing "
+               "pow2 buckets")
+        t0 = time.time()
+        fleet = PTAFleet(models, toas_list, toa_bucket="pow2")
+        pack_s = time.time() - t0
+        _stage(f"packed {len(fleet.batches)} buckets ({pack_s:.0f}s, "
+               f"padding x{fleet.padding_ratio:.2f}); caching pack")
+        states = [(models[idxs[0]].as_parfile(), b.pack_state())
+                  for (key, idxs), b in zip(fleet.group_indices.items(),
+                                            fleet.batches.values())]
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+            with open(cache_path + ".tmp", "wb") as fh:
+                pickle.dump({"counts": counts.tolist(), "states": states},
+                            fh, protocol=4)
+            os.replace(cache_path + ".tmp", cache_path)
+        except Exception as e:
+            _stage(f"full-scale pack cache write failed ({e}); continuing")
+        batches = list(fleet.batches.values())
+    else:
+        batches = [PTABatch.from_packed(get_model(par), st)
+                   for par, st in states]
+    # actually-packed count, not counts.sum(): epoch clustering floors
+    # each pulsar to a multiple of 4 TOAs
+    real_toas = int(sum(int(np.sum(b.n_toas)) for b in batches))
+    padded = sum(int(b.batch.tdb_sec.shape[0] * b.batch.tdb_sec.shape[1])
+                 for b in batches)
+    # compile all bucket programs (cold), then time warm refits
+    t0 = time.time()
+    for b in batches:
+        _, chi2, _ = b.gls_fit(maxiter=2)
+    compile_s = time.time() - t0
+    t0 = time.time()
+    chi2s = []
+    for b in batches:
+        _, chi2, _ = b.gls_fit(maxiter=2)
+        chi2s.append(np.asarray(chi2))
+    refit_s = time.time() - t0
+    finite = all(np.isfinite(c).all() for c in chi2s)
+    meta.update({
+        "measured_670k_gls_refit_s": round(refit_s, 3),
+        "measured_670k_total_toas": real_toas,
+        "measured_670k_buckets": len(batches),
+        "measured_670k_padding_ratio": round(padded / real_toas, 3),
+        "measured_670k_compile_s": round(compile_s, 2),
+        "measured_670k_all_finite": finite,
+        "measured_670k_platform": jax.devices()[0].platform,
+    })
+    _stage(f"full-scale measured: {refit_s:.2f}s GLS refit over "
+           f"{real_toas} TOAs in {len(batches)} buckets "
+           f"(compile+first {compile_s:.1f}s, finite={finite})")
+
+
 def _timed_refit(fit, arg):
     import jax
 
@@ -93,9 +215,20 @@ def _guard_wedged_device():
     """Probe the default jax backend in a subprocess; if no device
     materializes within 150 s (the axon relay can wedge for an hour
     after an interrupted claim), force the CPU backend so the driver
-    records a real measurement instead of a timeout."""
+    records a real measurement instead of a timeout.
+
+    PINT_TPU_BENCH_CPU=1 skips the probe and pins CPU directly —
+    setting JAX_PLATFORMS alone does NOT help here, because the axon
+    sitecustomize hooks the plugin in regardless and a wedged relay
+    still hangs the probe for its full 150 s."""
     import subprocess
     import sys
+
+    if os.environ.get("PINT_TPU_BENCH_CPU") == "1":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        return
 
     try:
         subprocess.run(
@@ -121,7 +254,10 @@ def main():
                              ".jax_cache")
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+        # 1.0 (not 5.0): the full-scale stage compiles ~6 per-bucket
+        # GLS programs of ~3 s each on CPU — persisting them cuts the
+        # driver's re-run by ~20 s
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass  # older jax without the knobs: just compile
 
@@ -151,7 +287,27 @@ def main():
            "); compiling+running WLS refit")
     wls_compile_s, wls_refit_s = _timed_refit(pta.wls_fit, 3)
     _stage(f"WLS done (compile {wls_compile_s:.1f}s, refit {wls_refit_s:.3f}s"
-           "); photon H-test throughput")
+           "); full-scale ragged stage")
+
+    # measured full-scale north star (68 ragged pulsars, ~670k TOAs).
+    # Guarded: a cold build takes minutes, so it only runs when the
+    # elapsed budget allows; with the pack cache + persistent compile
+    # cache warm (any prior run on this machine) it adds well under a
+    # minute. Failure or skip never endangers the headline JSON.
+    full_meta = {}
+    deadline = float(os.environ.get("PINT_TPU_BENCH_FULL_DEADLINE", "300"))
+    if os.environ.get("PINT_TPU_BENCH_SKIP_FULL") == "1":
+        _stage("full-scale stage skipped (PINT_TPU_BENCH_SKIP_FULL=1)")
+    elif time.time() - _T0 > deadline:
+        _stage(f"full-scale stage skipped (elapsed over {deadline:.0f}s "
+               "budget)")
+    else:
+        try:
+            _full_scale_stage(full_meta)
+        except Exception as e:
+            _stage(f"full-scale stage failed ({type(e).__name__}: {e}); "
+                   "headline JSON unaffected")
+    _stage("photon H-test throughput")
 
     # photon-domain side metric: H-test over 4M photon phases (the
     # pallas streaming kernel on TPU; SURVEY.md 3.5 photon workload).
@@ -208,7 +364,10 @@ def main():
     total_toas = n_psr * n_toa
     rate = total_toas / gls_refit_s  # TOAs GLS-refit per second
     projected_670k = gls_refit_s * (670_000 / total_toas)
-    vs_baseline = 60.0 / projected_670k
+    # the MEASURED full-scale refit, when it ran, supersedes the
+    # linear projection for the vs-baseline claim
+    measured = full_meta.get("measured_670k_gls_refit_s")
+    vs_baseline = 60.0 / (measured if measured else projected_670k)
 
     meta = {
         "n_pulsars": n_psr, "n_toas_per_pulsar": n_toa,
@@ -229,6 +388,7 @@ def main():
         "htest_includes_transfer": False,
         "platform": jax.devices()[0].platform,
     }
+    meta.update(full_meta)
     print(json.dumps({
         "metric": "pta_gls_refit_toas_per_sec",
         "value": round(rate, 1),
