@@ -8,7 +8,12 @@ meters / m/s, ICRS, wrt the solar-system barycenter.
 Provider resolution order:
 1. a real JPL kernel: ``<name>.bsp`` found in pint_tpu/data/ or in
    ``$PINT_TPU_EPHEM_DIR`` (read via io/spk.py — full DE accuracy);
-2. the analytic fallback (ephemeris/analytic.py) with documented
+2. the shipped numerically-integrated kernel ``numeph_v1.bsp``
+   (ephemeris/numeph.py: N-body + 1PN integration fit to the analytic
+   series — recovers the dynamics the series truncations drop; same
+   SPK evaluation path), when every requested epoch is in coverage;
+   disable with ``PINT_TPU_DISABLE_NUMEPH=1``;
+3. the analytic fallback (ephemeris/analytic.py) with documented
    reduced accuracy; the returned provider tag says which was used.
 """
 
@@ -61,32 +66,122 @@ _CHAIN_TO_SSB = {
 }
 
 
-def objPosVel_wrt_SSB(body: str, tdb: Epochs, ephem: str = "de440s") -> PosVel:
+_NUMEPH: list | None = None  # [kernel, et_lo, et_hi] or [None, 0, 0]
+
+
+def _numeph_kernel():
+    """The shipped numerically-integrated kernel, or None."""
+    global _NUMEPH
+    if os.environ.get("PINT_TPU_DISABLE_NUMEPH"):
+        return None, 0.0, 0.0
+    if _NUMEPH is None:
+        from ..io.spk import SPKKernel
+
+        path = os.path.join(os.path.dirname(__file__), "..", "data",
+                            "numeph_v1.bsp")
+        if os.path.exists(path):
+            k = SPKKernel(path)
+            seg = k.segment_for(3, 0)
+            _NUMEPH = [k, seg.start_et, seg.end_et]
+        else:
+            _NUMEPH = [None, 0.0, 0.0]
+    return tuple(_NUMEPH)
+
+
+def _kernel_posvel(kern, body: str, tdb: Epochs) -> PosVel:
+    from ..io.spk import tdb_epochs_to_et
+
+    et = tdb_epochs_to_et(tdb.day, tdb.sec)
+    chain = _CHAIN_TO_SSB.get(body)
+    if chain is None:
+        raise KeyError(f"unknown body {body!r}")
+    pos = np.zeros((len(tdb), 3))
+    vel = np.zeros((len(tdb), 3))
+    for target, center in chain:
+        p, v = kern.posvel(target, center, et)
+        pos += p * 1e3  # km -> m
+        vel += v * 1e3
+    return PosVel(pos, vel, origin="ssb", obj=body)
+
+
+def objPosVel_wrt_SSB(body: str, tdb: Epochs, ephem: str = "de440s",
+                      provider: str | None = None) -> PosVel:
     """ICRS PosVel [m, m/s] of ``body`` wrt SSB at TDB epochs.
 
+    ``provider`` pins the tier ('spk'/'numeph'/'analytic'): callers
+    that split one dataset into subsets (TOAs.compute_posvels goes
+    per-observatory) MUST resolve ``ephemeris_provider`` once on the
+    full epoch range and pass it down, otherwise subsets straddling
+    the numeph coverage edge would silently mix tiers (~600 km of
+    inter-observatory Earth-position inconsistency).
     (reference: solar_system_ephemerides.py::objPosVel_wrt_SSB — same
     role; units here are SI, not astropy quantities.)
     """
     body = body.lower()
-    kern = _find_kernel(ephem)
-    if kern is not None:
-        from ..io.spk import tdb_epochs_to_et
-
-        et = tdb_epochs_to_et(tdb.day, tdb.sec)
-        chain = _CHAIN_TO_SSB.get(body)
-        if chain is None:
-            raise KeyError(f"unknown body {body!r}")
-        pos = np.zeros((len(tdb), 3))
-        vel = np.zeros((len(tdb), 3))
-        for target, center in chain:
-            p, v = kern.posvel(target, center, et)
-            pos += p * 1e3  # km -> m
-            vel += v * 1e3
-        return PosVel(pos, vel, origin="ssb", obj=body)
+    if provider is None:
+        provider = ephemeris_provider(ephem, tdb)
+    if provider == "spk":
+        return _kernel_posvel(_find_kernel(ephem), body, tdb)
+    if provider == "numeph" and body in _CHAIN_TO_SSB:
+        nk, _, _ = _numeph_kernel()
+        if nk is not None:
+            return _kernel_posvel(nk, body, tdb)
     pos, vel = analytic.body_posvel_ssb(body, tdb.mjd_float())
     return PosVel(pos, vel, origin="ssb", obj=body)
 
 
-def ephemeris_provider(ephem: str = "de440s") -> str:
-    """'spk' if a real kernel backs this ephem name, else 'analytic'."""
-    return "spk" if _find_kernel(ephem) is not None else "analytic"
+def numeph_fingerprint():
+    """(coverage_et_lo, coverage_et_hi, content_hash) of the shipped
+    numeph kernel, or None. Goes into the TOA pickle-cache key: cached
+    posvels depend on the kernel's coverage AND its coefficient
+    values, so swapping the artifact must bust stale caches even when
+    no package version changes — including a same-span refit, which
+    keeps the byte SIZE identical (fixed segment layout) while every
+    Chebyshev coefficient changes. Hence a content hash, not a size."""
+    import hashlib
+
+    nk, et_lo, et_hi = _numeph_kernel()
+    if nk is None:
+        return None
+    if not hasattr(nk, "_content_hash"):
+        nk._content_hash = hashlib.sha256(nk._data.tobytes()).hexdigest()
+    return (et_lo, et_hi, nk._content_hash)
+
+
+def best_positions_icrs(mjd: np.ndarray) -> tuple[dict, str]:
+    """(dict body -> (T,3) ICRS position [m] wrt SSB, provider tag) at
+    TDB MJDs, from the best available tier. Used by the integrated
+    TDB-TT table (timescales._build_tdb_table), which needs every
+    body's position on a dense grid: with the numeph kernel present the
+    table's accuracy follows the kernel's (~100 km-class Earth) instead
+    of the analytic tier's (~600 km-class)."""
+    mjd = np.atleast_1d(np.asarray(mjd, dtype=np.float64))
+    nk, et_lo, et_hi = _numeph_kernel()
+    et = (mjd - 51544.5) * 86400.0
+    if nk is not None and len(et) and et.min() >= et_lo and et.max() <= et_hi:
+        day = np.floor(mjd).astype(np.int64)
+        t = Epochs(day, (mjd - day) * 86400.0, "tdb")
+        out = {b: _kernel_posvel(nk, b, t).pos for b in _CHAIN_TO_SSB}
+        for b in ("jupiter", "saturn", "uranus", "neptune"):
+            out[f"{b}_bary"] = out[b]
+        return out, "numeph"
+    T = (mjd - 51544.5) / 36525.0
+    return analytic._all_positions_icrs(T), "analytic"
+
+
+def ephemeris_provider(ephem: str = "de440s", tdb: Epochs | None = None) -> str:
+    """Which tier serves this request: 'spk' (a real kernel backs the
+    requested name), 'numeph' (the shipped integrated kernel, in
+    coverage for ``tdb`` if given), or 'analytic'."""
+    if _find_kernel(ephem) is not None:
+        return "spk"
+    nk, et_lo, et_hi = _numeph_kernel()
+    if nk is not None:
+        if tdb is None:
+            return "numeph"
+        from ..io.spk import tdb_epochs_to_et
+
+        et = tdb_epochs_to_et(tdb.day, tdb.sec)
+        if len(et) and et.min() >= et_lo and et.max() <= et_hi:
+            return "numeph"
+    return "analytic"

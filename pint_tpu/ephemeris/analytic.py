@@ -97,28 +97,149 @@ def _helio_ecliptic(body: str, T):
     return np.stack([x, y, z], axis=-1)
 
 
+# Lunar periodic terms, Meeus ch.47 truncation (ELP2000-82 derived).
+# Columns: D, M, M', F multipliers; sin-coefficient for longitude
+# [1e-6 deg]; cos-coefficient for distance [1e-3 km]. Terms with an M
+# multiplier are scaled by E^|mult(M)| (eccentricity secular factor).
+# Entered through rank ~50 in longitude / ~30 in distance; the dropped
+# tail is ~0.002-0.003 deg (~15-20 km) — the truncation tier recorded
+# in ERRORBUDGET.md. Distance coefficients below ~4000 (4 km) are set
+# to 0 where the source value is uncertain rather than risk a wrong
+# entry exceeding its own size.
+_MOON_LR = np.array([
+    # D  M  Mp  F      l_sin       r_cos
+    (0, 0, 1, 0, 6288774, -20905355),
+    (2, 0, -1, 0, 1274027, -3699111),
+    (2, 0, 0, 0, 658314, -2955968),
+    (0, 0, 2, 0, 213618, -569925),
+    (0, 1, 0, 0, -185116, 48888),
+    (0, 0, 0, 2, -114332, -3149),
+    (2, 0, -2, 0, 58793, 246158),
+    (2, -1, -1, 0, 57066, -152138),
+    (2, 0, 1, 0, 53322, -170733),
+    (2, -1, 0, 0, 45758, -204586),
+    (0, 1, -1, 0, -40923, -129620),
+    (1, 0, 0, 0, -34720, 108743),
+    (0, 1, 1, 0, -30383, 104755),
+    (2, 0, 0, -2, 15327, 10321),
+    (0, 0, 1, 2, -12528, 0),
+    (0, 0, 1, -2, 10980, 79661),
+    (4, 0, -1, 0, 10675, -34782),
+    (0, 0, 3, 0, 10034, -23210),
+    (4, 0, -2, 0, 8548, -21636),
+    (2, 1, -1, 0, -7888, 24208),
+    (2, 1, 0, 0, -6766, 30824),
+    (1, 0, -1, 0, -5163, -8379),
+    (1, 1, 0, 0, 4987, -16675),
+    (2, -1, 1, 0, 4036, -12831),
+    (2, 0, 2, 0, 3994, -10445),
+    (4, 0, 0, 0, 3861, -11650),
+    (2, 0, -3, 0, 3665, 14403),
+    (0, 1, -2, 0, -2689, -7003),
+    (2, 0, -1, 2, -2602, 0),
+    (2, -1, -2, 0, 2390, 10056),
+    (1, 0, 1, 0, -2348, 6322),
+    (2, -2, 0, 0, 2236, -9884),
+    (0, 1, 2, 0, -2120, 5751),
+    (0, 2, 0, 0, -2069, 0),
+    (2, -2, -1, 0, 2048, -4950),
+    (2, 0, 1, -2, -1773, 4130),
+    (2, 0, 0, 2, -1595, 0),
+    (4, -1, -1, 0, 1215, -3958),
+    (0, 0, 2, 2, -1110, 0),
+    (3, 0, -1, 0, -892, 0),
+    (2, 1, 1, 0, -810, 0),
+    (4, -1, -2, 0, 759, 0),
+    (0, 2, -1, 0, -713, 0),
+    (2, 2, -1, 0, -700, 0),
+    (2, 1, -2, 0, 691, 0),
+    (2, -1, 0, -2, 596, 0),
+    (4, 0, 1, 0, 549, -1897),
+    (0, 0, 4, 0, 537, -2117),
+    (4, -1, 0, 0, 520, -1423),
+    (1, 0, -2, 0, -487, -1117),
+], dtype=np.float64)
+
+# Latitude terms [1e-6 deg], same argument convention.
+_MOON_B = np.array([
+    (0, 0, 0, 1, 5128122),
+    (0, 0, 1, 1, 280602),
+    (0, 0, 1, -1, 277693),
+    (2, 0, 0, -1, 173237),
+    (2, 0, -1, 1, 55413),
+    (2, 0, -1, -1, 46271),
+    (2, 0, 0, 1, 32573),
+    (0, 0, 2, 1, 17198),
+    (2, 0, 1, -1, 9266),
+    (0, 0, 2, -1, 8822),
+    (2, -1, 0, -1, 8216),
+    (2, 0, -2, -1, 4324),
+    (2, 0, 1, 1, 4200),
+    (2, 1, 0, -1, -3359),
+    (2, -1, -1, 1, 2463),
+    (2, -1, 0, 1, 2211),
+    (2, -1, -1, -1, 2065),
+    (0, 1, -1, -1, -1870),
+    (4, 0, -1, -1, 1828),
+    (0, 1, 0, 1, -1794),
+    (0, 0, 0, 3, -1749),
+    (0, 1, -1, 1, -1565),
+    (1, 0, 0, 1, -1491),
+    (0, 1, 1, 1, -1475),
+    (0, 1, 1, -1, -1410),
+    (0, 1, 0, -1, -1344),
+    (1, 0, 0, -1, -1335),
+    (0, 0, 3, 1, 1107),
+    (4, 0, 0, -1, 1021),
+    (4, 0, -1, 1, 833),
+], dtype=np.float64)
+
+
 def _moon_geocentric_ecliptic(T):
-    """Geocentric ecliptic-of-date lunar position [m], truncated Meeus ch.47."""
-    Lp = (218.3164477 + 481267.88123421 * T) * _DEG
-    D = (297.8501921 + 445267.1114034 * T) * _DEG
-    M = (357.5291092 + 35999.0502909 * T) * _DEG
-    Mp = (134.9633964 + 477198.8675055 * T) * _DEG
-    F = (93.2720950 + 483202.0175233 * T) * _DEG
-    lon = Lp + _DEG * (
-        6.288774 * np.sin(Mp) + 1.274027 * np.sin(2 * D - Mp)
-        + 0.658314 * np.sin(2 * D) + 0.213618 * np.sin(2 * Mp)
-        - 0.185116 * np.sin(M) - 0.114332 * np.sin(2 * F)
-        + 0.058793 * np.sin(2 * D - 2 * Mp) + 0.057066 * np.sin(2 * D - M - Mp)
-        + 0.053322 * np.sin(2 * D + Mp) + 0.045758 * np.sin(2 * D - M))
-    lat = _DEG * (
-        5.128122 * np.sin(F) + 0.280602 * np.sin(Mp + F)
-        + 0.277693 * np.sin(Mp - F) + 0.173237 * np.sin(2 * D - F)
-        + 0.055413 * np.sin(2 * D - Mp + F) + 0.046271 * np.sin(2 * D - Mp - F))
-    dist_km = (385000.56 - 20905.355 * np.cos(Mp) - 3699.111 * np.cos(2 * D - Mp)
-               - 2955.968 * np.cos(2 * D) - 569.925 * np.cos(2 * Mp))
+    """Geocentric ecliptic-of-date lunar position [m], Meeus ch.47
+    truncation of ELP2000-82: ~50 longitude / 30 distance / 30 latitude
+    periodic terms + the A1/A2/A3 additive (Venus/Jupiter/flattening)
+    terms + E-factor eccentricity scaling. Documented truncation tier
+    ~15-30 km (dropped-tail sum), vs ~500-1000 km for the previous
+    10-term cut."""
+    Lp = (218.3164477 + 481267.88123421 * T - 0.0015786 * T**2
+          + T**3 / 538841.0 - T**4 / 65194000.0) * _DEG
+    D = (297.8501921 + 445267.1114034 * T - 0.0018819 * T**2
+         + T**3 / 545868.0 - T**4 / 113065000.0) * _DEG
+    M = (357.5291092 + 35999.0502909 * T - 0.0001536 * T**2
+         + T**3 / 24490000.0) * _DEG
+    Mp = (134.9633964 + 477198.8675055 * T + 0.0087414 * T**2
+          + T**3 / 69699.0 - T**4 / 14712000.0) * _DEG
+    F = (93.2720950 + 483202.0175233 * T - 0.0036539 * T**2
+         - T**3 / 3526000.0 + T**4 / 863310000.0) * _DEG
+    E = 1.0 - 0.002516 * T - 0.0000074 * T**2
+    A1 = (119.75 + 131.849 * T) * _DEG
+    A2 = (53.09 + 479264.290 * T) * _DEG
+    A3 = (313.45 + 481266.484 * T) * _DEG
+
+    d, m, mp, f = (_MOON_LR[:, 0, None], _MOON_LR[:, 1, None],
+                   _MOON_LR[:, 2, None], _MOON_LR[:, 3, None])
+    arg = d * D[None, :] + m * M[None, :] + mp * Mp[None, :] + f * F[None, :]
+    efac = E[None, :] ** np.abs(m)
+    lon_p = np.sum(_MOON_LR[:, 4, None] * efac * np.sin(arg), axis=0)
+    dist_p = np.sum(_MOON_LR[:, 5, None] * efac * np.cos(arg), axis=0)
+    lon_p += (3958 * np.sin(A1) + 1962 * np.sin(Lp - F) + 318 * np.sin(A2))
+
+    db, mb, mpb, fb = (_MOON_B[:, 0, None], _MOON_B[:, 1, None],
+                       _MOON_B[:, 2, None], _MOON_B[:, 3, None])
+    argb = (db * D[None, :] + mb * M[None, :] + mpb * Mp[None, :]
+            + fb * F[None, :])
+    efacb = E[None, :] ** np.abs(mb)
+    lat_p = np.sum(_MOON_B[:, 4, None] * efacb * np.sin(argb), axis=0)
+    lat_p += (-2235 * np.sin(Lp) + 382 * np.sin(A3) + 175 * np.sin(A1 - F)
+              + 175 * np.sin(A1 + F) + 127 * np.sin(Lp - Mp)
+              - 115 * np.sin(Lp + Mp))
+
+    lon = Lp + lon_p * 1e-6 * _DEG
+    lat = lat_p * 1e-6 * _DEG
+    r = (385000.56 + dist_p * 1e-3) * 1e3  # m
     cl, sl = np.cos(lon), np.sin(lon)
     cb, sb = np.cos(lat), np.sin(lat)
-    r = dist_km * 1e3
     return np.stack([r * cb * cl, r * cb * sl, r * sb], axis=-1)
 
 
@@ -129,7 +250,7 @@ def _ecl_to_icrs(v):
     return np.stack([x, ce * y - se * z, se * y + ce * z], axis=-1)
 
 
-def _all_positions_icrs(T):
+def _all_positions_icrs(T, earth_min_amp=0.0):
     """dict of ICRS positions [m] wrt SSB for sun/planets/earth/moon.
 
     Earth comes from the truncated VSOP87D series (ephemeris/vsop87.py,
@@ -138,6 +259,9 @@ def _all_positions_icrs(T):
     against VSOP87 over 2000-2026 — fine for planet Shapiro geometry,
     fatal for the Earth Roemer term. EMB/Moon are derived from the
     VSOP87 Earth + truncated lunar theory so the trio stays consistent.
+
+    ``earth_min_amp`` coarsens the Earth series (vsop87._series) for
+    the numeph restoration experiment only.
     """
     from .vsop87 import earth_heliocentric_icrs_m
 
@@ -148,7 +272,7 @@ def _all_positions_icrs(T):
     for b in _ELEMENTS:
         out[b if b != "emb" else "emb"] = _ecl_to_icrs(sun_ssb + helio[b])
     moon_geo = _ecl_to_icrs(_moon_geocentric_ecliptic(T))
-    earth = out["sun"] + earth_heliocentric_icrs_m(T)
+    earth = out["sun"] + earth_heliocentric_icrs_m(T, earth_min_amp)
     out["earth"] = earth
     out["moon"] = earth + moon_geo
     out["emb"] = earth + moon_geo / (1.0 + _EARTH_MOON_MASS_RATIO)

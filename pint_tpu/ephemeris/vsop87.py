@@ -269,29 +269,38 @@ _R4 = np.array([
 ], dtype=np.float64)
 
 
-def _series(terms_list, tau):
-    """Horner-in-tau sum of VSOP87 alpha-series: sum_k tau^k * S_k(tau)."""
+def _series(terms_list, tau, min_amp=0.0):
+    """Horner-in-tau sum of VSOP87 alpha-series: sum_k tau^k * S_k(tau).
+
+    ``min_amp`` drops terms below that amplitude (same 1e-8 units as
+    the tables) — used by the numeph restoration experiment
+    (ephemeris/numeph.py) to build a deliberately coarser series and
+    measure how much of the dropped physics an initial-condition-fitted
+    numerical integration recovers.
+    """
     tau = np.asarray(tau, dtype=np.float64)
     out = np.zeros_like(tau)
     for k in reversed(range(len(terms_list))):
         t = terms_list[k]
+        if min_amp > 0.0:
+            t = t[np.abs(t[:, 0]) >= min_amp]
         s = np.sum(t[:, 0, None] * np.cos(t[:, 1, None] + t[:, 2, None]
                                           * tau[None, :]), axis=0)
         out = out * tau + s
     return out
 
 
-def earth_heliocentric_lbr(tau):
+def earth_heliocentric_lbr(tau, min_amp=0.0):
     """(L [rad], B [rad], R [AU]) of Earth, mean ecliptic/equinox OF
     DATE, tau = Julian millennia TDB from J2000.0."""
     tau = np.atleast_1d(np.asarray(tau, dtype=np.float64))
-    L = _series([_L0, _L1, _L2, _L3, _L4, _L5], tau) * 1e-8
-    B = _series([_B0, _B1], tau) * 1e-8
-    R = _series([_R0, _R1, _R2, _R3, _R4], tau) * 1e-8
+    L = _series([_L0, _L1, _L2, _L3, _L4, _L5], tau, min_amp) * 1e-8
+    B = _series([_B0, _B1], tau, min_amp) * 1e-8
+    R = _series([_R0, _R1, _R2, _R3, _R4], tau, min_amp) * 1e-8
     return np.mod(L, 2 * np.pi), B, R
 
 
-def earth_heliocentric_icrs_m(T_centuries):
+def earth_heliocentric_icrs_m(T_centuries, min_amp=0.0):
     """Heliocentric Earth position [m] in the J2000 mean equatorial
     (ICRS-aligned) frame; T in Julian centuries TDB from J2000.
 
@@ -302,7 +311,7 @@ def earth_heliocentric_icrs_m(T_centuries):
     from ..earth.erfa_lite import mean_obliquity, precession_matrix
 
     T = np.atleast_1d(np.asarray(T_centuries, dtype=np.float64))
-    L, B, R = earth_heliocentric_lbr(T / 10.0)
+    L, B, R = earth_heliocentric_lbr(T / 10.0, min_amp)
     cb = np.cos(B)
     x = R * cb * np.cos(L)
     y = R * cb * np.sin(L)

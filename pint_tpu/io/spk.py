@@ -59,6 +59,7 @@ class SPKKernel:
         self._parse_file_record()
         self._parse_summaries()
         self._seg_cache: dict[tuple[int, int], Segment] = {}
+        self._rec_cache: dict[tuple[int, int], np.ndarray] = {}
 
     def _words(self, start_word: int, count: int) -> np.ndarray:
         """1-indexed 8-byte words -> float64 array."""
@@ -133,9 +134,15 @@ class SPKKernel:
                       0, seg.n_records - 1)
         rsize = seg.rsize
         ncoef = (rsize - 2) // 3 if seg.data_type == 2 else (rsize - 2) // 6
-        # gather records
-        all_rec = self._words(seg.start_word, seg.n_records * rsize)
-        all_rec = all_rec.reshape(seg.n_records, rsize)
+        # gather records (decoded once per segment — this sits on the
+        # per-TOA posvel path when a kernel is the active provider)
+        key = (target, center)
+        all_rec = self._rec_cache.get(key)
+        if all_rec is None:
+            all_rec = self._words(seg.start_word,
+                                  seg.n_records * rsize).reshape(
+                                      seg.n_records, rsize)
+            self._rec_cache[key] = all_rec
         rec = all_rec[idx]  # (n, rsize)
         from ..native import cheby_posvel as _native
 

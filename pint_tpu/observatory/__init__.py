@@ -47,7 +47,8 @@ class Observatory:
         """Seconds to ADD to raw topocentric UTC TOAs."""
         return np.zeros(len(utc))
 
-    def posvel_ssb(self, tdb: Epochs, utc: Epochs, ephem: str) -> PosVel:
+    def posvel_ssb(self, tdb: Epochs, utc: Epochs, ephem: str,
+                   provider: str | None = None) -> PosVel:
         raise NotImplementedError
 
     @property
@@ -105,8 +106,9 @@ class TopoObs(Observatory):
                 corr += bipm.evaluate(utc, limits=limits) - 32.184
         return corr
 
-    def posvel_ssb(self, tdb: Epochs, utc: Epochs, ephem: str) -> PosVel:
-        earth = objPosVel_wrt_SSB("earth", tdb, ephem)
+    def posvel_ssb(self, tdb: Epochs, utc: Epochs, ephem: str,
+                   provider: str | None = None) -> PosVel:
+        earth = objPosVel_wrt_SSB("earth", tdb, ephem, provider=provider)
         gpos, gvel = gcrs_posvel_from_itrf(self.itrf_xyz, utc)
         return PosVel(earth.pos + gpos, earth.vel + gvel, origin="ssb", obj=self.name)
 
@@ -118,7 +120,7 @@ class BarycenterObs(Observatory):
     def timescale(self):
         return "tdb"
 
-    def posvel_ssb(self, tdb, utc, ephem):
+    def posvel_ssb(self, tdb, utc, ephem, provider=None):
         z = np.zeros((len(tdb), 3))
         return PosVel(z, z, origin="ssb", obj="barycenter")
 
@@ -126,8 +128,8 @@ class BarycenterObs(Observatory):
 class GeocenterObs(Observatory):
     """geocenter / coe (reference: special_locations.py::GeocenterObs)."""
 
-    def posvel_ssb(self, tdb, utc, ephem):
-        e = objPosVel_wrt_SSB("earth", tdb, ephem)
+    def posvel_ssb(self, tdb, utc, ephem, provider=None):
+        e = objPosVel_wrt_SSB("earth", tdb, ephem, provider=provider)
         return PosVel(e.pos, e.vel, origin="ssb", obj="geocenter")
 
 

@@ -131,8 +131,9 @@ class SatelliteObs(Observatory):
                + (-6 * u**2 + 6 * u) * p1 + (3 * u**2 - 2 * u) * m1) / h[:, None]
         return pos, vel
 
-    def posvel_ssb(self, tdb: Epochs, utc: Epochs, ephem: str) -> PosVel:
-        earth = objPosVel_wrt_SSB("earth", tdb, ephem)
+    def posvel_ssb(self, tdb: Epochs, utc: Epochs, ephem: str,
+                   provider: str | None = None) -> PosVel:
+        earth = objPosVel_wrt_SSB("earth", tdb, ephem, provider=provider)
         tt = tdb_to_tt(tdb)
         met = ((tt.day - self.mjdref) * 86400.0 + tt.sec)
         pos, vel = self._interp(np.asarray(met, float))

@@ -161,12 +161,14 @@ _TDB_TABLE = None
 
 def _build_tdb_table():
     from .constants import C_M_S, GMSUN_M3_S2
-    from .ephemeris import analytic
+    from .ephemeris import analytic, best_positions_icrs
 
     mjd = np.arange(_TDB_GRID_LO, _TDB_GRID_HI + _TDB_GRID_STEP,
                     _TDB_GRID_STEP)
-    T = (mjd - 51544.5) / 36525.0
-    pos = analytic._all_positions_icrs(T)
+    # best available provider: with the shipped numeph kernel the rate
+    # integrand (v^2/2 + U)/c^2 tracks the integrated dynamics (~100
+    # km-class Earth) rather than the analytic series (~600 km-class)
+    pos, _provider = best_positions_icrs(mjd)
     earth = pos["earth"]
     dt_s = _TDB_GRID_STEP * SECS_PER_DAY
     vel = np.gradient(earth, dt_s, axis=0)
@@ -227,9 +229,11 @@ def tt_to_tdb(t: Epochs) -> Epochs:
 
 def tdb_to_tt(t: Epochs) -> Epochs:
     assert t.scale == "tdb"
-    # one fixed-point iteration is ample (d(TDB-TT)/dt ~ 1e-8)
-    approx_tt = Epochs(t.day, t.sec, "tt")
-    d = tdb_minus_tt(approx_tt)
+    # two fixed-point iterations: one leaves ~(TDB-TT)*d(TDB-TT)/dt
+    # ~ 1e-11 s of error (measured against the integrated table), two
+    # converge to ~1e-19 — below the roundtrip tests' 1e-12 bar
+    d = tdb_minus_tt(Epochs(t.day, t.sec, "tt"))
+    d = tdb_minus_tt(Epochs(t.day, t.sec - d, "tt").normalized())
     return Epochs(t.day, t.sec - d, "tt").normalized()
 
 

@@ -98,6 +98,9 @@ class TOAs:
         self.ssb_obs: PosVel | None = None
         self.obs_sun: PosVel | None = None
         self.planet_pos: dict[str, np.ndarray] = {}
+        # which ephemeris tier computed ssb_obs ('spk'/'numeph'/
+        # 'analytic'); None until compute_posvels runs
+        self.ephem_provider: str | None = None
         self._clock_applied = False
 
     def __len__(self):
@@ -201,10 +204,14 @@ class TOAs:
 
     def compute_posvels(self):
         from .observatory import get_observatory
-        from .ephemeris import objPosVel_wrt_SSB
+        from .ephemeris import ephemeris_provider, objPosVel_wrt_SSB
 
         if self.tdb is None:
             self.compute_TDBs()
+        # resolve the ephemeris tier ONCE on the full epoch range and
+        # pin it through every per-observatory subset below — subsets
+        # straddling the numeph coverage edge must not mix tiers
+        self.ephem_provider = ephemeris_provider(self.ephem, self.tdb)
         n = len(self)
         pos = np.zeros((n, 3))
         vel = np.zeros((n, 3))
@@ -216,13 +223,16 @@ class TOAs:
             mask = self.obs.astype(str) == obs_name
             tdb_sub = Epochs(self.tdb.day[mask], self.tdb.sec[mask], "tdb")
             utc_sub = Epochs(utc.day[mask], utc.sec[mask], "utc")
-            pv = ob.posvel_ssb(tdb_sub, utc_sub, self.ephem)
+            pv = ob.posvel_ssb(tdb_sub, utc_sub, self.ephem,
+                               provider=self.ephem_provider)
             pos[mask] = pv.pos
             vel[mask] = pv.vel
-            sun_pv = objPosVel_wrt_SSB("sun", tdb_sub, self.ephem)
+            sun_pv = objPosVel_wrt_SSB("sun", tdb_sub, self.ephem,
+                                       provider=self.ephem_provider)
             sun[mask] = sun_pv.pos - pv.pos
             for p in planet_pos:
-                ppv = objPosVel_wrt_SSB(p, tdb_sub, self.ephem)
+                ppv = objPosVel_wrt_SSB(p, tdb_sub, self.ephem,
+                                        provider=self.ephem_provider)
                 planet_pos[p][mask] = ppv.pos - pv.pos
         self.ssb_obs = PosVel(pos, vel, origin="ssb", obj="obs")
         self.obs_sun = PosVel(sun, np.zeros_like(sun), origin="obs", obj="sun")
@@ -254,6 +264,8 @@ class TOAs:
             out.obs_sun = PosVel(self.obs_sun.pos[condition],
                                  np.zeros((condition.sum(), 3)), origin="obs", obj="sun")
             out.planet_pos = {p: v[condition] for p, v in self.planet_pos.items()}
+            # the subset carries posvels computed under this tier
+            out.ephem_provider = self.ephem_provider
         out._clock_applied = self._clock_applied
         return out
 
@@ -645,12 +657,20 @@ def _pickle_settings_key(ephem, planets, include_gps, include_bipm,
     from . import __version__
     from .utils import compute_hash
 
-    # package version + physics revision in the key: cached pickles carry
-    # computed posvels, so any change to the earth-rotation/ephemeris chain
-    # must bust stale caches (e.g. the 0.2.0 ERA half-day fix).
+    from .ephemeris import ephemeris_provider, numeph_fingerprint
+
+    # package version + physics revision + active ephemeris tier + the
+    # numeph kernel's coverage/size fingerprint in the key: cached
+    # pickles carry computed posvels, so any change to the
+    # earth-rotation/ephemeris chain must bust stale caches (e.g. the
+    # 0.2.0 ERA half-day fix, a kernel that flips the provider tier, or
+    # a swapped numeph artifact whose coverage moves which tier serves
+    # a given dataset's epochs).
     return compute_hash(repr((ephem, planets, include_gps, include_bipm,
                               bipm_version, include_site_clock,
-                              __version__, _PHYSICS_REV)))
+                              __version__, _PHYSICS_REV,
+                              ephemeris_provider(ephem),
+                              numeph_fingerprint())))
 
 
 # Bump whenever the posvel/clock/TDB pipeline OR the tim parser's
@@ -818,6 +838,13 @@ def merge_TOAs(toas_list) -> TOAs:
         out.tdb = Epochs(np.concatenate([t.tdb.day for t in toas_list]),
                          np.concatenate([t.tdb.sec for t in toas_list]), "tdb")
     if all(t.ssb_obs is not None for t in toas_list):
+        providers = {t.ephem_provider for t in toas_list}
+        if len(providers) > 1:
+            warnings.warn(f"merging TOAs computed under different "
+                          f"ephemeris tiers {sorted(map(str, providers))}; "
+                          "recompute posvels for a consistent dataset")
+        out.ephem_provider = (providers.pop() if len(providers) == 1
+                              else None)
         out.ssb_obs = PosVel(np.concatenate([t.ssb_obs.pos for t in toas_list]),
                              np.concatenate([t.ssb_obs.vel for t in toas_list]),
                              origin="ssb", obj="obs")

@@ -1,0 +1,54 @@
+"""Regenerate the NGC6440E golden tensors (prefit resids + delay chain).
+
+Run after an INTENTIONAL physics change (e.g. a new default ephemeris
+provider tier), then update the frozen wrms constant in
+tests/test_golden.py from the printed value and justify the delta in
+the commit message:
+
+    python tests/golden/generate_ngc6440e.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import warnings
+
+import numpy as np
+
+warnings.simplefilter("ignore")
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+PAR = os.path.join(HERE, "..", "..", "pint_tpu", "data", "examples",
+                   "NGC6440E.par")
+TIM = os.path.join(HERE, "..", "..", "pint_tpu", "data", "examples",
+                   "NGC6440E.tim")
+
+
+def main():
+    from pint_tpu.models import get_model
+    from pint_tpu.residuals import Residuals
+    from pint_tpu.toa import get_TOAs
+
+    m = get_model(PAR)
+    t = get_TOAs(TIM, usepickle=False)
+    t.compute_posvels()
+    r = Residuals(t, m)
+    resid_us = np.asarray(r.calc_time_resids()) * 1e6
+    delays = np.asarray(m.delay(t))
+    np.save(os.path.join(HERE, "ngc6440e_prefit_resids_us.npy"), resid_us)
+    np.save(os.path.join(HERE, "ngc6440e_delays_s.npy"), delays)
+    print(f"ephem provider: {t.ephem_provider}")
+    print(f"wrms_us = {r.rms_weighted() * 1e6:.6f}  "
+          f"(update the frozen constant in test_golden.py)")
+
+
+if __name__ == "__main__":
+    main()

@@ -207,7 +207,11 @@ def test_ephemeris_provider_switches_with_kernel(tmp_path, monkeypatch):
     monkeypatch.setattr(eph, "_KERNELS", {})
 
     assert eph.ephemeris_provider("detest") == "spk"
+    # with the shipped numeph kernel out of the way, a missing name
+    # falls all the way back to the analytic tier
+    monkeypatch.setenv("PINT_TPU_DISABLE_NUMEPH", "1")
     assert eph.ephemeris_provider("detest_missing") == "analytic"
+    monkeypatch.delenv("PINT_TPU_DISABLE_NUMEPH")
 
     # TDB epochs inside the segment span (ET from J2000 epoch)
     day = np.array([51544, 51560], dtype=np.int64)

@@ -70,6 +70,46 @@ def test_earth_moon_emb_consistency():
     np.testing.assert_allclose(b, e + (m - e) / (1.0 + ratio), atol=1e-3)
 
 
+def test_nutation_published_anchor():
+    """Nutation truncation vs the published worked example (Meeus
+    ch.22, 1987 April 10.0 TD: dpsi = -3.788", deps = +9.443", full
+    1980 series). The 13-term IAU2000B truncation must land within
+    ~30 mas — its documented dropped-tail bound (~1 m at the Earth's
+    surface, ~3 ns of timing; see ERRORBUDGET.md). Measured at this
+    epoch: dpsi off by 20 mas, deps by 1 mas."""
+    from pint_tpu.earth.erfa_lite import nutation
+
+    T = (2446895.5 - 2451545.0) / 36525.0
+    dpsi, deps = nutation(np.array([T]))
+    dpsi_as = np.degrees(dpsi[0]) * 3600
+    deps_as = np.degrees(deps[0]) * 3600
+    assert abs(dpsi_as - (-3.788)) < 0.030
+    assert abs(deps_as - 9.443) < 0.010
+
+
+def test_moon_meeus_worked_example():
+    """Lunar series vs the published full-theory worked example
+    (Meeus, Astronomical Algorithms ch.47, 1992 April 12.0 TD:
+    lambda 133.162655 deg, beta -3.229126 deg, Delta 368409.7 km).
+    The truncation tail is ~3 arcsec / few km; a single mistyped
+    major coefficient would blow these bounds by 10-100x."""
+    from pint_tpu.ephemeris.analytic import _moon_geocentric_ecliptic
+
+    T = np.array([(2448724.5 - 2451545.0) / 36525.0])
+    xyz = _moon_geocentric_ecliptic(T)[0]
+    r = np.linalg.norm(xyz)
+    lon = np.degrees(np.arctan2(xyz[1], xyz[0])) % 360
+    lat = np.degrees(np.arcsin(xyz[2] / r))
+    assert abs(lon - 133.162655) * 3600 < 6.0   # arcsec
+    assert abs(lat + 3.229126) * 3600 < 4.0
+    assert abs(r / 1e3 - 368409.7) < 6.0        # km
+    full = 368409.7e3 * np.array([
+        np.cos(np.radians(-3.229126)) * np.cos(np.radians(133.162655)),
+        np.cos(np.radians(-3.229126)) * np.sin(np.radians(133.162655)),
+        np.sin(np.radians(-3.229126))])
+    assert np.linalg.norm(xyz - full) < 15e3    # ~15 km truncation tier
+
+
 def test_tdb_table_vs_series():
     """Integrated TDB-TT table: agrees with the FB1990 truncated series
     to within the series' own truncation (<10 us), and its annual term
