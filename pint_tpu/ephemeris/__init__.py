@@ -121,10 +121,25 @@ def objPosVel_wrt_SSB(body: str, tdb: Epochs, ephem: str = "de440s",
     if provider is None:
         provider = ephemeris_provider(ephem, tdb)
     if provider == "spk":
-        return _kernel_posvel(_find_kernel(ephem), body, tdb)
+        kern = _find_kernel(ephem)
+        if kern is None:
+            raise KeyError(f"provider pinned to 'spk' but no kernel "
+                           f"backs ephem {ephem!r}")
+        return _kernel_posvel(kern, body, tdb)
     if provider == "numeph" and body in _CHAIN_TO_SSB:
-        nk, _, _ = _numeph_kernel()
+        nk, et_lo, et_hi = _numeph_kernel()
         if nk is not None:
+            from ..io.spk import tdb_epochs_to_et
+
+            # a pinned tier must never silently extrapolate: the SPK
+            # evaluator clamps to the last record outside coverage and
+            # would return positions wrong by ~1e14 km
+            et = tdb_epochs_to_et(tdb.day, tdb.sec)
+            if len(et) and (et.min() < et_lo or et.max() > et_hi):
+                raise ValueError(
+                    "epochs outside the numeph kernel coverage with "
+                    "provider pinned to 'numeph'; re-resolve the tier "
+                    "for these epochs (pass provider=None)")
             return _kernel_posvel(nk, body, tdb)
     pos, vel = analytic.body_posvel_ssb(body, tdb.mjd_float())
     return PosVel(pos, vel, origin="ssb", obj=body)

@@ -24,19 +24,19 @@ standing in for observations, because nothing better is reachable
 offline. (reference: src/pint/solar_system_ephemerides.py simply loads
 the JPL product of that pipeline.)
 
-``restoration_experiment()`` validates the mechanism with a measurable
-truth proxy: coarsen the Earth series by a known factor, fit to the
-coarse targets, and measure the fitted trajectory against the FULL
-series. The measured recovery factor (coarse-series error vs
-fitted-trajectory error) is stored in the artifact metadata and
-asserted in tests — it is the evidence that the same mechanism bounds
-the real artifact's error well below the series truncation.
+``injection_experiment()`` validates the mechanism with fully known
+truth: inject synthetic longitude terms of known amplitude into the
+Earth target and measure how much leaks into the fitted trajectory vs
+a control fit. Measured (numeph_v1.json): short-period (synodic-band)
+injections are 98.5% rejected — the regime of the production target's
+truncation error — while a 628-yr term leaks ~50%, so the error budget
+carries the long-period truncation tail at face value.
 
 ``build()`` writes the production artifact as a real little-endian
 DAF/SPK type-2 kernel (io/spk_write.py) so the existing kernel path
 (io/spk.py, including its native C++ Chebyshev evaluator) serves it
 with zero new evaluation code, plus a JSON sidecar with fit residuals,
-Chebyshev compression errors, and the restoration evidence.
+Chebyshev compression errors, and the injection evidence.
 """
 
 from __future__ import annotations
@@ -336,7 +336,6 @@ def build(out_dir: str | None = None, span=SPAN_MJD, log=lambda s: None,
             f"{val[body]['max_pos_err_m']:.2e} m, vel err "
             f"{val[body]['max_vel_err_m_s']:.2e} m/s")
     meta["cheb_validation"] = val
-    json_path = os.path.join(out_dir, "numeph_v1.json")
     with open(json_path, "w") as fh:
         json.dump(meta, fh, indent=1)
     log(f"numeph build: done -> {bsp_path}, {json_path}")

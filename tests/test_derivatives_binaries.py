@@ -1,0 +1,129 @@
+"""Design-matrix cross-checks per binary family: jacfwd columns vs
+central finite differences of the phase for every free parameter, for
+each of the seven binary models (DD, DDS, DDK, DDGR, BT, ELL1H, ELL1k)
+including their post-Keplerian and Shapiro parameterizations.
+
+(reference pattern: SURVEY.md section 4 pattern 2 — upstream carries a
+per-family derivative test file (test_dd.py, test_ell1h.py, ...)
+checking analytic derivatives against d_delay_d_param_num; here jacfwd
+is the analytic side and central differences the independent check.
+tests/test_derivatives.py covers ELL1 + the non-binary components with
+the same machinery.)
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+warnings.simplefilter("ignore")
+
+import jax
+
+from pint_tpu.models import get_model
+from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+_COMMON = ("PSR TDBIN\nRAJ 07:51:09.2\nDECJ 18:07:38.5 1\n"
+           "F0 287.457853 1\nF1 -3.44e-15 1\nPEPOCH 55400\nDM 19.6 1\n")
+
+# (case id, par tail, {param: (rel_step, abs_floor[, tol])}).
+# Default tol 1e-4; looser per-param tolerances are the measured
+# central-difference noise/curvature floor: the phase is ~1e10
+# cycles so FD cancellation bottoms out at ~2e-6/h cycles, and
+# near-edge-on Shapiro (SINI~0.99) has O(h^2) curvature ~1e-2 —
+# the test targets sign/units/factor bugs, not that floor.
+_DEFAULT_STEP = (1e-6, 0.0)
+CASES = [
+    ("DD_full", _COMMON + (
+        "BINARY DD\nPB 0.3229 1\nA1 1.8599 1\nT0 55400.15 1\n"
+        "ECC 0.0878 1\nOM 73.8 1\nOMDOT 4.22 1\nPBDOT -2.4e-12 1\n"
+        "GAMMA 0.0044 1\nM2 1.25 1\nSINI 0.9874 1\nA1DOT 1e-14 1\n"
+        "EDOT 1e-15 1\n"),
+     {"PB": (1e-9, 0), "A1": (1e-8, 0), "T0": (3e-10, 0),
+      "ECC": (1e-6, 0), "OM": (1e-7, 0), "OMDOT": (1e-4, 0),
+      "PBDOT": (1e-3, 0), "GAMMA": (1e-3, 0), "M2": (0, 0.02, 1e-3),
+      "SINI": (0, 1e-3, 2e-2), "A1DOT": (1e-3, 0, 1e-3),
+      "EDOT": (1e-3, 0, 2e-3)}),
+    ("DDS_shapmax", _COMMON + (
+        "BINARY DDS\nPB 0.3229 1\nA1 1.8599 1\nT0 55400.15 1\n"
+        "ECC 0.0878 1\nOM 73.8 1\nM2 1.25 1\nSHAPMAX 2.25 1\n"),
+     {"PB": (1e-9, 0), "A1": (1e-8, 0), "T0": (3e-10, 0),
+      "ECC": (1e-6, 0), "OM": (1e-7, 0), "M2": (0, 0.02),
+      "SHAPMAX": (1e-4, 0)}),
+    ("DDK_kopeikin", _COMMON + (
+        "PMRA -2.66 1\nPMDEC -25.5 1\nPX 1.0 1\nPOSEPOCH 55400\n"
+        "BINARY DDK\nPB 0.3229 1\nA1 1.8599 1\nT0 55400.15 1\n"
+        "ECC 0.0878 1\nOM 73.8 1\nM2 1.25 1\nKIN 80.6 1\nKOM 45.0 1\n"),
+     {"PB": (1e-9, 0), "A1": (1e-8, 0), "T0": (3e-10, 0),
+      "ECC": (1e-6, 0), "OM": (1e-7, 0), "M2": (0, 0.02),
+      "KIN": (1e-6, 0, 1e-3), "KOM": (0, 0.05, 5e-3), "PX": (0, 0.1),
+      "PMRA": (1e-4, 0, 1e-3), "PMDEC": (1e-4, 0, 1e-3)}),
+    ("DDGR_masses", _COMMON + (
+        "BINARY DDGR\nPB 0.10225 1\nA1 1.4150 1\nT0 55400.05 1\n"
+        "ECC 0.0877775 1\nOM 87.03 1\nMTOT 2.58708 1\nM2 1.2489 1\n"),
+     {"PB": (1e-9, 0), "A1": (1e-8, 0), "T0": (3e-10, 0),
+      "ECC": (1e-6, 0), "OM": (1e-7, 0), "MTOT": (1e-6, 0),
+      "M2": (1e-4, 0, 5e-3)}),
+    ("BT_basic", _COMMON + (
+        "BINARY BT\nPB 117.349 1\nA1 64.809 1\nT0 55402.0 1\n"
+        "ECC 0.6584 1\nOM 226.9 1\nGAMMA 0.005 1\n"),
+     {"PB": (1e-9, 0), "A1": (1e-8, 0), "T0": (1e-9, 0),
+      "ECC": (1e-6, 0), "OM": (1e-7, 0), "GAMMA": (1e-3, 0)}),
+    ("ELL1H_ortho", _COMMON + (
+        "BINARY ELL1H\nPB 5.7410 1\nA1 3.3667 1\nTASC 55401.0 1\n"
+        "EPS1 1.9e-5 1\nEPS2 -8e-6 1\nH3 2.7e-7 1\nH4 2.0e-7 1\n"),
+     {"PB": (1e-9, 0), "A1": (1e-8, 0), "TASC": (1e-9, 0),
+      "EPS1": (1e-3, 0), "EPS2": (1e-3, 0), "H3": (1e-3, 0),
+      "H4": (1e-3, 0)}),
+    ("ELL1k_precessing", _COMMON + (
+        "BINARY ELL1k\nPB 0.0907 1\nA1 0.0362 1\nTASC 55400.02 1\n"
+        "EPS1 2e-5 1\nEPS2 -1e-5 1\nOMDOT 10.0 1\nLNEDOT 1e-10 1\n"),
+     {"PB": (1e-9, 0), "A1": (1e-8, 0, 5e-4), "TASC": (1e-10, 0),
+      "EPS1": (0, 2e-7, 5e-4), "EPS2": (0, 2e-7, 5e-4),
+      "OMDOT": (0, 0.1, 1e-3), "LNEDOT": (0, 1e-11, 2e-3)}),
+]
+
+
+@pytest.mark.parametrize("case_id,par,steps", CASES,
+                         ids=[c[0] for c in CASES])
+def test_binary_design_columns_match_fd(case_id, par, steps):
+    m = get_model(par)
+    n = 90
+    mjds = np.linspace(55300, 55700, n)
+    freqs = np.tile([800.0, 1400.0, 2100.0], n // 3)
+    t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=freqs,
+                                obs="gbt", add_noise=False)
+    prepared = m.prepare(t)
+    dm_fn, labels = prepared.designmatrix_fn()
+    off = 1 if labels[0] == "Offset" else 0
+    x0 = np.asarray(prepared.vector_from_params())
+    M = np.asarray(dm_fn(prepared.vector_from_params()))
+    phase_fn = jax.jit(
+        lambda x: prepared._phase_continuous(prepared.params_with_vector(x)))
+    names = [nm for nm, _, _ in prepared.free_param_map()]
+
+    # every binary parameter in the case must actually be free
+    for p in steps:
+        assert p in names, f"{case_id}: {p} not free in the packed model"
+
+    failures = []
+    for j, name in enumerate(names):
+        spec = steps.get(name, _DEFAULT_STEP)
+        rel, floor = spec[0], spec[1]
+        tol = spec[2] if len(spec) > 2 else 1e-4
+        h = max(abs(x0[j]) * rel if x0[j] != 0 else rel, floor)
+        if h == 0:
+            continue
+        xp, xm = x0.copy(), x0.copy()
+        xp[j] += h
+        xm[j] -= h
+        dnum = (np.asarray(phase_fn(xp)) - np.asarray(phase_fn(xm))) / (2 * h)
+        dana = M[:, off + j]
+        scale = max(np.abs(dnum).max(), np.abs(dana).max())
+        if scale == 0:
+            failures.append((name, "both zero"))
+            continue
+        err = np.abs(dana - dnum).max() / scale
+        if err > tol:
+            failures.append((name, float(err), tol))
+    assert not failures, f"{case_id} jacfwd vs numeric: {failures}"
