@@ -290,23 +290,44 @@ def main():
            "); full-scale ragged stage")
 
     # measured full-scale north star (68 ragged pulsars, ~670k TOAs).
-    # Guarded: a cold build takes minutes, so it only runs when the
-    # elapsed budget allows; with the pack cache + persistent compile
-    # cache warm (any prior run on this machine) it adds well under a
-    # minute. Failure or skip never endangers the headline JSON.
+    # Guarded three ways: elapsed-budget skip, exception containment,
+    # and a DAEMON THREAD with a hard join timeout — the 6 per-bucket
+    # TPU compiles have been observed to wedge the relay mid-compile
+    # (r03 session: UNAVAILABLE after 28 min); on a wedge the runtime
+    # blocks in C++ where exceptions never fire, and the headline JSON
+    # must not die with it. Failure, wedge, or skip never endangers
+    # the headline JSON.
+    import threading
+
     full_meta = {}
     deadline = float(os.environ.get("PINT_TPU_BENCH_FULL_DEADLINE", "300"))
+    full_timeout = float(os.environ.get("PINT_TPU_BENCH_FULL_TIMEOUT",
+                                        "1500"))
+    full_wedged = False
     if os.environ.get("PINT_TPU_BENCH_SKIP_FULL") == "1":
         _stage("full-scale stage skipped (PINT_TPU_BENCH_SKIP_FULL=1)")
     elif time.time() - _T0 > deadline:
         _stage(f"full-scale stage skipped (elapsed over {deadline:.0f}s "
                "budget)")
     else:
-        try:
-            _full_scale_stage(full_meta)
-        except Exception as e:
-            _stage(f"full-scale stage failed ({type(e).__name__}: {e}); "
-                   "headline JSON unaffected")
+        def _full_stage_guarded():
+            try:
+                _full_scale_stage(full_meta)
+            except Exception as e:
+                _stage(f"full-scale stage failed ({type(e).__name__}: {e})"
+                       "; headline JSON unaffected")
+
+        th_full = threading.Thread(target=_full_stage_guarded, daemon=True)
+        th_full.start()
+        th_full.join(timeout=full_timeout)
+        if th_full.is_alive():
+            full_wedged = True
+            # snapshot-safety: a late-finishing thread must not mutate
+            # the dict while json.dumps walks it
+            full_meta = dict(full_meta)
+            _stage(f"full-scale stage still running after "
+                   f"{full_timeout:.0f}s (wedged device?); continuing "
+                   "without it — will hard-exit after printing")
     _stage("photon H-test throughput")
 
     # photon-domain side metric: H-test over 4M photon phases (the
@@ -347,15 +368,19 @@ def main():
             _stage(f"H-test stage failed ({type(e).__name__}: {e}); "
                    "headline JSON unaffected")
 
-    import threading
-
-    th = threading.Thread(target=_htest_stage, daemon=True)
-    th.start()
-    th.join(timeout=300)
-    wedged = th.is_alive()
+    if full_wedged:
+        # the device is already stuck; don't burn 300 more seconds
+        # proving it again
+        _stage("H-test stage skipped (device wedged in full-scale stage)")
+        wedged = True
+    else:
+        th = threading.Thread(target=_htest_stage, daemon=True)
+        th.start()
+        th.join(timeout=300)
+        wedged = th.is_alive()
     # snapshot ONCE: a late-finishing thread must not race the JSON
     htest_done_s = None if wedged else htest_s
-    if wedged:
+    if wedged and not full_wedged:
         _stage("H-test stage timed out (wedged device?); headline JSON "
                "unaffected — will hard-exit after printing")
     elif htest_done_s is not None:

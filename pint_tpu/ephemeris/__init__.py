@@ -88,10 +88,12 @@ def _numeph_kernel():
     return tuple(_NUMEPH)
 
 
-def _kernel_posvel(kern, body: str, tdb: Epochs) -> PosVel:
+def _kernel_posvel(kern, body: str, tdb: Epochs,
+                   et: np.ndarray | None = None) -> PosVel:
     from ..io.spk import tdb_epochs_to_et
 
-    et = tdb_epochs_to_et(tdb.day, tdb.sec)
+    if et is None:
+        et = tdb_epochs_to_et(tdb.day, tdb.sec)
     chain = _CHAIN_TO_SSB.get(body)
     if chain is None:
         raise KeyError(f"unknown body {body!r}")
@@ -140,7 +142,7 @@ def objPosVel_wrt_SSB(body: str, tdb: Epochs, ephem: str = "de440s",
                     "epochs outside the numeph kernel coverage with "
                     "provider pinned to 'numeph'; re-resolve the tier "
                     "for these epochs (pass provider=None)")
-            return _kernel_posvel(nk, body, tdb)
+            return _kernel_posvel(nk, body, tdb, et=et)
     pos, vel = analytic.body_posvel_ssb(body, tdb.mjd_float())
     return PosVel(pos, vel, origin="ssb", obj=body)
 
