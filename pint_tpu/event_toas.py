@@ -59,16 +59,21 @@ def met_to_day_sec(met_s, mjdref_days):
 
 def load_event_TOAs(eventfile, mission, weights=None, weightcolumn=None,
                     minmjd=-np.inf, maxmjd=np.inf, extname="EVENTS",
-                    errors_us=1.0, ephem="de440s", planets=False):
+                    errors_us=1.0, ephem="de440s", planets=False,
+                    table=None):
     """FITS event list -> TOAs (reference: event_toas.py::load_event_TOAs).
 
     Returns a fully-populated TOAs object (clock/TDB/posvel computed
     downstream as usual). Weights (probability the photon is from the
-    pulsar) land in per-TOA flags as ``-weight``.
+    pulsar) land in per-TOA flags as ``-weight``. ``table`` supplies an
+    already-read (header, cols) pair so callers that pre-scan columns
+    (the Fermi CALC weight path) don't parse a multi-million-photon
+    file twice.
     """
     from .io.fits import get_table
 
-    header, cols = get_table(eventfile, extname)
+    header, cols = table if table is not None else get_table(eventfile,
+                                                             extname)
     tcol = next(k for k in cols if k.upper() == "TIME")
     met = np.asarray(cols[tcol], np.float64)
     mjdref = _mjdref_days(header, mission)
@@ -109,18 +114,74 @@ load_Swift_TOAs = _mission_loader("swift")
 load_IXPE_TOAs = _mission_loader("ixpe")
 
 
+def calc_lat_weights(energies_mev, angseps_deg, logeref=4.1,
+                     logesig=0.5):
+    """Heuristic Fermi-LAT photon weights from angular separation and
+    energy (reference: fermi_toas.py::calc_lat_weights — the Kerr 2011
+    'simple weights' convention): a Gaussian in angular offset with an
+    energy-dependent PSF scale, times a log-normal energy window
+    centered on log10(E/MeV)=logeref. No spacecraft pointing history
+    or IRF is used — these are aperture-photometry-grade weights; for
+    likelihood-grade weights run gtsrcprob and pass its column.
+
+    PSF scale: sigma(E) = sqrt(p0^2 (100 MeV/E)^(2 p1) + p2^2)/3 deg
+    with (p0, p1, p2) = (5.445, 0.848, 0.084), the front-converting
+    P7-era parameterization the reference convention uses.
+    """
+    e = np.asarray(energies_mev, np.float64)
+    th = np.asarray(angseps_deg, np.float64)
+    psfpar0, psfpar1, psfpar2, scalepsf = 5.445, 0.848, 0.084, 3.0
+    sigma = np.sqrt(psfpar0**2 * (100.0 / e) ** (2 * psfpar1)
+                    + psfpar2**2) / scalepsf
+    loge = np.log10(e)
+    return (np.exp(-0.5 * (th / sigma) ** 2)
+            * np.exp(-0.5 * ((loge - logeref) / logesig) ** 2))
+
+
+def _angsep_deg(ra1, dec1, ra2, dec2):
+    """Great-circle separation [deg] (Vincenty formula, stable at all
+    separations), inputs in degrees; ra2/dec2 may be arrays."""
+    l1, b1, l2, b2 = map(np.radians, (ra1, dec1, ra2, dec2))
+    dl = l2 - l1
+    num = np.hypot(np.cos(b2) * np.sin(dl),
+                   np.cos(b1) * np.sin(b2)
+                   - np.sin(b1) * np.cos(b2) * np.cos(dl))
+    den = (np.sin(b1) * np.sin(b2)
+           + np.cos(b1) * np.cos(b2) * np.cos(dl))
+    return np.degrees(np.arctan2(num, den))
+
+
 def load_Fermi_TOAs(ft1file, weightcolumn=None, targetcoord=None,
                     minmjd=-np.inf, maxmjd=np.inf, ephem="de440s",
-                    planets=False):
+                    planets=False, logeref=4.1, logesig=0.5):
     """Fermi-LAT FT1 photons (reference: fermi_toas.py::load_Fermi_TOAs).
 
-    weightcolumn: name of the photon-weight column (e.g. from gtsrcprob)
-    or "CALC" (not supported without the spacecraft pointing history —
-    pass precomputed weights via the column instead)."""
+    weightcolumn: name of a photon-weight column (e.g. from gtsrcprob),
+    or "CALC" to compute heuristic PSF weights on the fly from the FT1
+    RA/DEC/ENERGY columns and ``targetcoord`` (see calc_lat_weights).
+    targetcoord: (ra_deg, dec_deg) of the pulsar, required for CALC.
+    """
     if weightcolumn == "CALC":
-        raise NotImplementedError(
-            "on-the-fly weight computation needs the pointing history; "
-            "precompute weights into an FT1 column instead")
+        if targetcoord is None:
+            raise ValueError("weightcolumn='CALC' needs targetcoord="
+                             "(ra_deg, dec_deg)")
+        from .io.fits import get_table
+
+        table = get_table(ft1file, "EVENTS")
+        cols = table[1]
+
+        def col(name):
+            return np.asarray(
+                cols[next(k for k in cols if k.upper() == name)],
+                np.float64)
+
+        angsep = _angsep_deg(targetcoord[0], targetcoord[1],
+                             col("RA"), col("DEC"))
+        weights = calc_lat_weights(col("ENERGY"), angsep,
+                                   logeref=logeref, logesig=logesig)
+        return load_event_TOAs(ft1file, "fermi", weights=weights,
+                               minmjd=minmjd, maxmjd=maxmjd, ephem=ephem,
+                               planets=planets, table=table)
     return load_event_TOAs(ft1file, "fermi", weightcolumn=weightcolumn,
                            minmjd=minmjd, maxmjd=maxmjd, ephem=ephem,
                            planets=planets)

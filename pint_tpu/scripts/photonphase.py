@@ -48,14 +48,35 @@ def main(argv=None) -> int:
     mission = args.mission
     if mission is None:
         header, _ = get_table(args.eventfile, "EVENTS")
-        mission = str(header.get("TELESCOP", "generic")).strip().lower()
+        mission = str(header.get("TELESCOP", "generic")).strip()
+    mission = mission.lower()
+    if mission == "glast":  # Fermi FT1 files carry the old name
+        mission = "fermi"
     if args.orbfile:
         from ..observatory.satellite_obs import get_satellite_observatory
 
         get_satellite_observatory(mission, args.orbfile)
-    toas = load_event_TOAs(args.eventfile, mission,
-                           weightcolumn=args.weightcol,
-                           minmjd=args.minMJD, maxmjd=args.maxMJD)
+    if args.weightcol == "CALC" and mission == "fermi":
+        # heuristic PSF weights from the par-file position
+        # (reference: photonphase --weightcol CALC behavior); ecliptic
+        # par files are converted so ELONG/ELAT pulsars work too
+        from ..event_toas import load_Fermi_TOAs
+
+        if not hasattr(model, "RAJ"):
+            from ..modelutils import model_ecliptic_to_equatorial
+
+            model_eq = model_ecliptic_to_equatorial(model)
+        else:
+            model_eq = model
+        target = (np.degrees(model_eq.RAJ.value),
+                  np.degrees(model_eq.DECJ.value))
+        toas = load_Fermi_TOAs(args.eventfile, weightcolumn="CALC",
+                               targetcoord=target,
+                               minmjd=args.minMJD, maxmjd=args.maxMJD)
+    else:
+        toas = load_event_TOAs(args.eventfile, mission,
+                               weightcolumn=args.weightcol,
+                               minmjd=args.minMJD, maxmjd=args.maxMJD)
     print(f"Read {len(toas)} photons from {args.eventfile} ({mission})")
     if len(toas) == 0:
         print("no photons in the MJD window", file=sys.stderr)

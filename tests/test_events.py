@@ -155,3 +155,45 @@ def test_satellite_observatory(tmp_path):
     toas.compute_TDBs()
     toas.compute_posvels()
     assert np.abs(np.asarray(toas.ssb_obs.pos) - pv.pos).max() < 1.0
+
+
+def test_fermi_calc_weights(tmp_path):
+    """weightcolumn='CALC': heuristic PSF weights computed from the
+    FT1 RA/DEC/ENERGY columns and the target position (reference
+    convention: fermi_toas.py::calc_lat_weights). On-source high-energy
+    photons weigh ~1, off-source or soft photons are suppressed."""
+    from pint_tpu.event_toas import calc_lat_weights, load_Fermi_TOAs
+
+    path = tmp_path / "ft1.fits"
+    n = 40
+    rng = np.random.default_rng(5)
+    met = np.sort(rng.uniform(0, 1e5, n))
+    ra0, dec0 = 150.0, 15.0
+    # half the photons on-source, half offset by 0.5-3 deg
+    off = np.where(np.arange(n) % 2, 0.0, rng.uniform(0.5, 3.0, n))
+    ra = ra0 + off / np.cos(np.radians(dec0))
+    dec = np.full(n, dec0)
+    energy = np.where(np.arange(n) % 4 < 2, 10000.0, 150.0)  # MeV
+    write_fits_table(path, {"TIME": met, "RA": ra, "DEC": dec,
+                            "ENERGY": energy},
+                     {"MJDREFI": 51910, "MJDREFF": 7.428703703703703e-4,
+                      "TIMESYS": "TT", "TELESCOP": "GLAST"},
+                     extname="EVENTS")
+    t = load_Fermi_TOAs(str(path), weightcolumn="CALC",
+                        targetcoord=(ra0, dec0))
+    w = np.asarray(t.weights)
+    assert w.shape == (n,)
+    assert np.all((w >= 0) & (w <= 1))
+    on_hard = w[(off == 0) & (energy > 1000)]
+    off_soft = w[(off > 0) & (energy < 1000)]
+    assert on_hard.min() > 0.5
+    assert off_soft.max() < 0.1
+    assert on_hard.min() > 10 * off_soft.max()
+    # matches the exported formula directly
+    from pint_tpu.event_toas import _angsep_deg
+
+    w_direct = calc_lat_weights(energy, _angsep_deg(ra0, dec0, ra, dec))
+    np.testing.assert_allclose(w, w_direct, rtol=1e-12)
+    # CALC without a target position is a clear error
+    with pytest.raises(ValueError, match="targetcoord"):
+        load_Fermi_TOAs(str(path), weightcolumn="CALC")

@@ -485,7 +485,9 @@ print("DIST-OK")
 def _dist_fleet(n_psr=4, n_toa=40):
     """Deterministic uniform-shape fleet every process can rebuild
     identically (equal TOA counts: assemble_global_batch requires
-    identical padded shapes across processes)."""
+    identical padded shapes across processes). Carries the full noise
+    stack (EFAC+ECORR+red noise, clustered epochs) so the distributed
+    GLS exercises the real Woodbury path, not a degenerate one."""
     import numpy as np
 
     from pint_tpu.models import get_model
@@ -496,12 +498,16 @@ def _dist_fleet(n_psr=4, n_toa=40):
     for i in range(n_psr):
         par = (f"PSR DF{i}\nRAJ 0{2 * i}:30:00.0\nDECJ {10 + i}:00:00.0\n"
                f"F0 {180 + 7 * i}.25 1\nF1 -{2 + i}e-16 1\nPEPOCH 55500\n"
-               f"DM {12 + i}.0 1\n")
+               f"DM {12 + i}.0 1\n"
+               "EFAC -f L-wide 1.1\nECORR -f L-wide 0.7\n"
+               "RNAMP 1e-14\nRNIDX -3.0\nTNREDC 8\n")
         m = get_model(par)
-        mjds = np.sort(rng.uniform(55000, 56000, n_toa))
+        days = np.sort(rng.uniform(55000, 56000, n_toa // 2))
+        mjds = np.sort(np.concatenate([days, days + 30.0 / 86400.0]))
         freqs = np.where(np.arange(n_toa) % 2, 1400.0, 800.0)
         t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=freqs,
-                                    obs="gbt", add_noise=True, seed=100 + i)
+                                    obs="gbt", add_noise=True, seed=100 + i,
+                                    flags={"f": "L-wide"})
         models.append(m)
         toas_list.append(t)
     return models, toas_list
@@ -536,8 +542,14 @@ pta = assemble_global_batch(local)
 x, chi2, cov = pta.wls_fit(maxiter=3)
 # _pull replicated the global result: every process sees all 4 pulsars
 assert np.asarray(x).shape[0] == 4, np.asarray(x).shape
+# full-noise GLS over the same global mesh: the distributed Woodbury
+# (ECORR quantization + red-noise basis) as ONE cross-process program
+xg, chi2g, covg = pta.gls_fit(maxiter=2)
+assert np.asarray(xg).shape[0] == 4, np.asarray(xg).shape
 np.savez(os.path.join(outdir, f"proc{{pid}}.npz"), x=np.asarray(x),
-         chi2=np.asarray(chi2), cov=np.asarray(cov))
+         chi2=np.asarray(chi2), cov=np.asarray(cov),
+         xg=np.asarray(xg), chi2g=np.asarray(chi2g),
+         covg=np.asarray(covg))
 print("DIST2-OK", pid)
 '''
 
@@ -562,6 +574,7 @@ def test_distributed_two_process_fit(tmp_path):
     models, toas_list = _dist_fleet()
     ref = PTABatch([copy.deepcopy(m) for m in models], toas_list)
     x_ref, chi2_ref, cov_ref = ref.wls_fit(maxiter=3)
+    xg_ref, chi2g_ref, _ = ref.gls_fit(maxiter=2)
 
     builder_src = textwrap.dedent(inspect.getsource(_dist_fleet))
     code = _DIST_WORKER.replace("{builder_src}", builder_src) \
@@ -593,6 +606,7 @@ def test_distributed_two_process_fit(tmp_path):
     # both processes hold the identical replicated global result
     np.testing.assert_array_equal(r0["x"], r1["x"])
     np.testing.assert_array_equal(r0["chi2"], r1["chi2"])
+    np.testing.assert_array_equal(r0["xg"], r1["xg"])
     # and it matches the single-process fit bit-for-bit-ish (same
     # program, different mesh layout -> tiny reduction-order noise)
     np.testing.assert_allclose(r0["x"], np.asarray(x_ref),
@@ -600,6 +614,11 @@ def test_distributed_two_process_fit(tmp_path):
     np.testing.assert_allclose(r0["chi2"], np.asarray(chi2_ref), rtol=1e-8)
     np.testing.assert_allclose(r0["cov"], np.asarray(cov_ref), rtol=1e-6,
                                atol=1e-300)
+    # distributed full-noise GLS (Woodbury across processes) agrees too
+    np.testing.assert_allclose(r0["xg"], np.asarray(xg_ref),
+                               rtol=1e-8, atol=1e-12)
+    np.testing.assert_allclose(r0["chi2g"], np.asarray(chi2g_ref),
+                               rtol=1e-6)
 
 
 def test_checkpointed_pta_fit_resumes(tmp_path):

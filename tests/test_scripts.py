@@ -355,3 +355,77 @@ def test_photonphase_polycos_mode(parfile, tmp_path, capsys):
     tot_full = pn_full + np.asarray(c_full["PULSE_PHASE"], np.float64)
     tot_pc = pn_pc + np.asarray(c_pc["PULSE_PHASE"], np.float64)
     assert np.abs(tot_full - tot_pc).max() < 1e-4
+
+
+def test_photonphase_fermi_calc_weights(parfile, tmp_path, capsys):
+    """photonphase --weightcol CALC on a Fermi file: heuristic PSF
+    weights from the par-file position reach the weighted H-test."""
+    from pint_tpu.io.fits import write_fits_table, get_table
+    from pint_tpu.models import get_model
+    from pint_tpu.scripts import photonphase
+
+    m = get_model(PAR)
+    f0 = m.F0.value
+    ra0 = np.degrees(m.RAJ.value)
+    dec0 = np.degrees(m.DECJ.value)
+    rng = np.random.default_rng(3)
+    n = 900
+    phases = (rng.vonmises(0.0, 6.0, n) / (2 * np.pi)) % 1.0
+    pulse_n = rng.integers(0, int(1000 * f0), n)
+    mjds = 55000.0 + ((pulse_n + phases) / f0) / 86400.0
+    mjdref = 51910.0007428703703703
+    met = (np.asarray(mjds, np.longdouble) - mjdref) * 86400.0
+    evt = str(tmp_path / "ft1.fits")
+    write_fits_table(
+        evt, {"TIME": np.asarray(met, float),
+              "RA": np.full(n, ra0) + rng.normal(0, 0.05, n),
+              "DEC": np.full(n, dec0) + rng.normal(0, 0.05, n),
+              "ENERGY": rng.uniform(500.0, 20000.0, n)},
+        {"MJDREFI": 51910, "MJDREFF": mjdref - 51910,
+         "TIMESYS": "TDB", "TELESCOP": "GLAST"})
+    out = str(tmp_path / "phased.fits")
+    assert photonphase.main([evt, parfile, "--mission", "fermi",
+                             "--weightcol", "CALC",
+                             "--outfile", out]) == 0
+    cap = capsys.readouterr().out
+    assert "Htest" in cap
+    h = float(cap.split("Htest :")[1].split()[0])
+    assert h > 100.0
+    _, cols = get_table(out, "EVENTS")
+    assert "PULSE_PHASE" in cols
+
+
+def test_photonphase_calc_weights_ecliptic_par(parfile, tmp_path, capsys):
+    """CALC weights from an ELONG/ELAT par: the target position is
+    converted to ICRS instead of crashing on the missing RAJ."""
+    from pint_tpu.io.fits import write_fits_table
+    from pint_tpu.models import get_model
+    from pint_tpu.modelutils import model_equatorial_to_ecliptic
+    from pint_tpu.scripts import photonphase
+
+    m = get_model(PAR)
+    m_ecl = model_equatorial_to_ecliptic(m)
+    par_ecl = str(tmp_path / "ecl.par")
+    with open(par_ecl, "w") as fh:
+        fh.write(m_ecl.as_parfile())
+    f0 = m.F0.value
+    ra0, dec0 = np.degrees(m.RAJ.value), np.degrees(m.DECJ.value)
+    rng = np.random.default_rng(9)
+    n = 300
+    phases = (rng.vonmises(0.0, 6.0, n) / (2 * np.pi)) % 1.0
+    pulse_n = rng.integers(0, int(500 * f0), n)
+    mjds = 55000.0 + ((pulse_n + phases) / f0) / 86400.0
+    mjdref = 51910.0007428703703703
+    met = (np.asarray(mjds, np.longdouble) - mjdref) * 86400.0
+    evt = str(tmp_path / "ft1e.fits")
+    write_fits_table(
+        evt, {"TIME": np.asarray(met, float),
+              "RA": np.full(n, ra0), "DEC": np.full(n, dec0),
+              "ENERGY": np.full(n, 5000.0)},
+        {"MJDREFI": 51910, "MJDREFF": mjdref - 51910,
+         "TIMESYS": "TDB", "TELESCOP": "GLAST"})
+    assert photonphase.main([evt, par_ecl, "--weightcol", "CALC"]) == 0
+    cap = capsys.readouterr().out
+    assert "Htest" in cap
+    # on-source hard photons: weights near 1, so weighted H is large
+    assert float(cap.split("Htest :")[1].split()[0]) > 50.0
