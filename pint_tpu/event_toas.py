@@ -117,12 +117,14 @@ load_IXPE_TOAs = _mission_loader("ixpe")
 def calc_lat_weights(energies_mev, angseps_deg, logeref=4.1,
                      logesig=0.5):
     """Heuristic Fermi-LAT photon weights from angular separation and
-    energy (reference: fermi_toas.py::calc_lat_weights — the Kerr 2011
-    'simple weights' convention): a Gaussian in angular offset with an
-    energy-dependent PSF scale, times a log-normal energy window
-    centered on log10(E/MeV)=logeref. No spacecraft pointing history
-    or IRF is used — these are aperture-photometry-grade weights; for
-    likelihood-grade weights run gtsrcprob and pass its column.
+    energy (reference: fermi_toas.py::calc_lat_weights — Bruel's
+    SearchPulsation convention): a King-profile radial factor
+    fgeom = (1 + theta^2 / (2 gamma sigma^2))^(-gamma) with gamma = 2
+    and an energy-dependent PSF scale, times a log-normal energy
+    window centered on log10(E/MeV) = logeref. No spacecraft pointing
+    history or IRF is used — these are aperture-photometry-grade
+    weights; for likelihood-grade weights run gtsrcprob and pass its
+    column.
 
     PSF scale: sigma(E) = sqrt(p0^2 (100 MeV/E)^(2 p1) + p2^2)/3 deg
     with (p0, p1, p2) = (5.445, 0.848, 0.084), the front-converting
@@ -131,11 +133,12 @@ def calc_lat_weights(energies_mev, angseps_deg, logeref=4.1,
     e = np.asarray(energies_mev, np.float64)
     th = np.asarray(angseps_deg, np.float64)
     psfpar0, psfpar1, psfpar2, scalepsf = 5.445, 0.848, 0.084, 3.0
+    gamma = 2.0
     sigma = np.sqrt(psfpar0**2 * (100.0 / e) ** (2 * psfpar1)
                     + psfpar2**2) / scalepsf
+    fgeom = (1.0 + th**2 / (2.0 * gamma * sigma**2)) ** (-gamma)
     loge = np.log10(e)
-    return (np.exp(-0.5 * (th / sigma) ** 2)
-            * np.exp(-0.5 * ((loge - logeref) / logesig) ** 2))
+    return fgeom * np.exp(-0.5 * ((loge - logeref) / logesig) ** 2)
 
 
 def _angsep_deg(ra1, dec1, ra2, dec2):

@@ -48,7 +48,7 @@ class Observatory:
         return np.zeros(len(utc))
 
     def posvel_ssb(self, tdb: Epochs, utc: Epochs, ephem: str,
-                   provider: str | None = None) -> PosVel:
+                   provider: str | None = None, gcrs=None) -> PosVel:
         raise NotImplementedError
 
     @property
@@ -107,9 +107,12 @@ class TopoObs(Observatory):
         return corr
 
     def posvel_ssb(self, tdb: Epochs, utc: Epochs, ephem: str,
-                   provider: str | None = None) -> PosVel:
+                   provider: str | None = None, gcrs=None) -> PosVel:
         earth = objPosVel_wrt_SSB("earth", tdb, ephem, provider=provider)
-        gpos, gvel = gcrs_posvel_from_itrf(self.itrf_xyz, utc)
+        # gcrs: (pos, vel) precomputed by the topocentric-TDB step for
+        # the same epochs — skips a second precession/nutation chain
+        gpos, gvel = (gcrs if gcrs is not None
+                      else gcrs_posvel_from_itrf(self.itrf_xyz, utc))
         return PosVel(earth.pos + gpos, earth.vel + gvel, origin="ssb", obj=self.name)
 
 
@@ -120,7 +123,7 @@ class BarycenterObs(Observatory):
     def timescale(self):
         return "tdb"
 
-    def posvel_ssb(self, tdb, utc, ephem, provider=None):
+    def posvel_ssb(self, tdb, utc, ephem, provider=None, gcrs=None):
         z = np.zeros((len(tdb), 3))
         return PosVel(z, z, origin="ssb", obj="barycenter")
 
@@ -128,7 +131,7 @@ class BarycenterObs(Observatory):
 class GeocenterObs(Observatory):
     """geocenter / coe (reference: special_locations.py::GeocenterObs)."""
 
-    def posvel_ssb(self, tdb, utc, ephem, provider=None):
+    def posvel_ssb(self, tdb, utc, ephem, provider=None, gcrs=None):
         e = objPosVel_wrt_SSB("earth", tdb, ephem, provider=provider)
         return PosVel(e.pos, e.vel, origin="ssb", obj="geocenter")
 

@@ -132,7 +132,7 @@ class SatelliteObs(Observatory):
         return pos, vel
 
     def posvel_ssb(self, tdb: Epochs, utc: Epochs, ephem: str,
-                   provider: str | None = None) -> PosVel:
+                   provider: str | None = None, gcrs=None) -> PosVel:
         earth = objPosVel_wrt_SSB("earth", tdb, ephem, provider=provider)
         tt = tdb_to_tt(tdb)
         met = ((tt.day - self.mjdref) * 86400.0 + tt.sec)

@@ -56,6 +56,11 @@ def main(argv=None) -> int:
         from ..observatory.satellite_obs import get_satellite_observatory
 
         get_satellite_observatory(mission, args.orbfile)
+    if args.weightcol == "CALC" and mission != "fermi":
+        print("--weightcol CALC is only supported for Fermi FT1 files "
+              f"(mission here: {mission}); pass a real weight column",
+              file=sys.stderr)
+        return 1
     if args.weightcol == "CALC" and mission == "fermi":
         # heuristic PSF weights from the par-file position
         # (reference: photonphase --weightcol CALC behavior); ecliptic

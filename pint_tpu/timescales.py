@@ -201,9 +201,11 @@ def _build_tdb_table():
 
 
 def tdb_minus_tt(tt: Epochs) -> np.ndarray:
-    """TDB-TT [s] at TT epochs (geocentric; the topocentric ~2 us
-    diurnal term is omitted, matching the reference's default-method
-    geocentric TDB grid; reference: toa.py::TOAs.compute_TDBs).
+    """TDB-TT [s] at TT epochs (GEOCENTRIC: the topocentric ~2 us
+    diurnal term is observatory-dependent and is added by
+    TOAs._apply_topocentric_tdb in the TOA pipeline, where the
+    observatory is known; reference: toa.py::TOAs.compute_TDBs via
+    location-aware astropy Time).
 
     Integrated-table path (sub-us class, see _build_tdb_table) inside
     MJD [40000, 64000]; FB1990 truncated series (~5-10 us) outside.

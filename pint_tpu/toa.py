@@ -101,6 +101,10 @@ class TOAs:
         # which ephemeris tier computed ssb_obs ('spk'/'numeph'/
         # 'analytic'); None until compute_posvels runs
         self.ephem_provider: str | None = None
+        # per-observatory ITRF->GCRS products computed by the
+        # topocentric-TDB step, consumed (and cleared) by the next
+        # compute_posvels over the same epochs
+        self._gcrs_cache: dict = {}
         self._clock_applied = False
 
     def __len__(self):
@@ -201,6 +205,49 @@ class TOAs:
             out = sub if scale == "tdb" else ts.tt_to_tdb(sub)
             self.tdb.day[m] = out.day
             self.tdb.sec[m] = out.sec
+        self._apply_topocentric_tdb(corrected, obs_names, toa_scale)
+
+    def _apply_topocentric_tdb(self, corrected_utc, obs_names, toa_scale):
+        """Add the TOPOCENTRIC part of TDB-TT: v_earth . r_obs / c^2
+        (~2.1 us diurnal at the equator) for ground observatories.
+
+        The geocentric chain (timescales.tdb_minus_tt) deliberately
+        omits it — it depends on the observatory, not just the epoch.
+        The reference gets it through location-aware astropy Time.tdb
+        (reference: toa.py::TOAs.compute_TDBs passes the observatory
+        EarthLocation). Satellite/geocenter/barycenter TOAs keep the
+        geocentric convention (LEO term <1 us; documented in
+        ERRORBUDGET.md). The Earth velocity tier barely matters here
+        (a 1 m/s error shifts the term by 7e-17 s), so whichever
+        ephemeris tier is active is ample.
+        """
+        from .earth.erfa_lite import gcrs_posvel_from_itrf
+        from .ephemeris import objPosVel_wrt_SSB
+        from .observatory import get_observatory
+
+        for obs_name in np.unique(obs_names):
+            ob = get_observatory(obs_name)
+            itrf = getattr(ob, "itrf_xyz", None)
+            if itrf is None:
+                continue
+            mask = (obs_names == obs_name) & (toa_scale == "utc")
+            if not mask.any():
+                continue
+            utc_sub = Epochs(corrected_utc.day[mask],
+                             corrected_utc.sec[mask], "utc")
+            tdb_sub = Epochs(self.tdb.day[mask], self.tdb.sec[mask], "tdb")
+            r_gcrs, v_gcrs = gcrs_posvel_from_itrf(np.asarray(itrf, float),
+                                                   utc_sub)
+            # compute_posvels needs the identical ITRF->GCRS products
+            # (same observatory, same corrected-UTC epochs) — cache
+            # them so the precession/nutation chain runs once per load
+            if not hasattr(self, "_gcrs_cache"):
+                self._gcrs_cache = {}  # unpickled pre-cache objects
+            self._gcrs_cache[obs_name] = (r_gcrs, v_gcrs)
+            v_earth = objPosVel_wrt_SSB("earth", tdb_sub, self.ephem).vel
+            dtopo = np.sum(v_earth * r_gcrs, axis=-1) / C_M_S**2
+            self.tdb.sec[mask] += dtopo
+        self.tdb = self.tdb.normalized()
 
     def compute_posvels(self):
         from .observatory import get_observatory
@@ -223,8 +270,11 @@ class TOAs:
             mask = self.obs.astype(str) == obs_name
             tdb_sub = Epochs(self.tdb.day[mask], self.tdb.sec[mask], "tdb")
             utc_sub = Epochs(utc.day[mask], utc.sec[mask], "utc")
+            gcrs = getattr(self, "_gcrs_cache", {}).pop(obs_name, None)
+            if gcrs is not None and len(gcrs[0]) != int(mask.sum()):
+                gcrs = None  # epochs changed since compute_TDBs
             pv = ob.posvel_ssb(tdb_sub, utc_sub, self.ephem,
-                               provider=self.ephem_provider)
+                               provider=self.ephem_provider, gcrs=gcrs)
             pos[mask] = pv.pos
             vel[mask] = pv.vel
             sun_pv = objPosVel_wrt_SSB("sun", tdb_sub, self.ephem,
@@ -676,8 +726,9 @@ def _pickle_settings_key(ephem, planets, include_gps, include_bipm,
 # Bump whenever the posvel/clock/TDB pipeline OR the tim parser's
 # semantics change. 2: ERA half-day fix; 3: VSOP87 Earth + integrated
 # TDB-TT table; 4: INCLUDE shares command state + per-block tim_jump
-# indices + CLOCK-directive plumbing (cached parses differ).
-_PHYSICS_REV = 4
+# indices + CLOCK-directive plumbing (cached parses differ);
+# 5: topocentric TDB term for ground observatories.
+_PHYSICS_REV = 5
 
 
 def _tim_content_hash(path) -> str:

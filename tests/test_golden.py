@@ -38,7 +38,7 @@ def test_ngc6440e_prefit_residuals_frozen():
     # 1 ns bar: any real physics change shows up orders of magnitude
     # above this; pure refactors must stay below it
     np.testing.assert_allclose(resid_us, golden, rtol=0, atol=1e-3)
-    assert abs(r.rms_weighted() * 1e6 - 24.265478) < 1e-3
+    assert abs(r.rms_weighted() * 1e6 - 24.266879) < 1e-3
 
 
 def test_ngc6440e_delays_frozen():
@@ -78,4 +78,4 @@ def test_b1855sim_binary_noise_frozen():
     # noise path: ECORR quantization + red-noise Fourier basis)
     f = GLSFitter(t, m)
     f.fit_toas(maxiter=2)
-    assert abs(f.chi2_whitened - 207.511496) < 0.01
+    assert abs(f.chi2_whitened - 207.511488) < 0.01

@@ -155,3 +155,35 @@ def test_leap_seconds_vendored_file_loaded():
     # fallback and file agree everywhere both are defined
     for mjd, val in ts._LEAP_TABLE_FALLBACK:
         assert ts.tai_minus_utc(np.array([mjd]))[0] == val
+
+
+def test_topocentric_tdb_diurnal_term():
+    """Topocentric TDB-TT (v_earth . r_obs / c^2): comparing a ground
+    observatory against the geocenter at identical epochs isolates the
+    term — amplitude ~|v_E| R_earth cos(lat)/c^2 (~1.9 us at GBT's
+    latitude), period one sidereal day."""
+    from pint_tpu.toa import TOA, TOAs
+
+    mjds = 55000.0 + np.arange(0.0, 3.0, 1.0 / 24.0)  # hourly, 3 days
+    def build(obs):
+        lst = [TOA(int(m), (m - int(m)) * 86400.0, error_us=1.0,
+                   freq_mhz=1400.0, obs=obs) for m in mjds]
+        t = TOAs(lst)
+        t.apply_clock_corrections()
+        t.compute_TDBs()
+        return t
+
+    t_gbt = build("gbt")
+    t_geo = build("geocenter")
+    # clock chain is zero (no files shipped), so the TDB difference IS
+    # the topocentric term
+    d = ((t_gbt.tdb.day - t_geo.tdb.day) * 86400.0
+         + (t_gbt.tdb.sec - t_geo.tdb.sec))
+    amp = (d.max() - d.min()) / 2
+    assert 1.0e-6 < amp < 2.3e-6, amp
+    # diurnal: strong anticorrelation at half a day, correlation at 1 d
+    x = d - d.mean()
+    lag12 = np.corrcoef(x[:-12], x[12:])[0, 1]
+    lag24 = np.corrcoef(x[:-24], x[24:])[0, 1]
+    assert lag12 < -0.8, lag12
+    assert lag24 > 0.8, lag24
