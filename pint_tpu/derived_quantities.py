@@ -80,6 +80,10 @@ def mass_function(pb_days, a1_ls):
     return 4.0 * math.pi**2 * a1_ls**3 / (TSUN_S * pb_s**2)
 
 
+# upstream spelling (reference: derived_quantities.py::mass_funct)
+mass_funct = mass_function
+
+
 def mass_funct2(mp, mc, sini):
     """Mass function from component masses [Msun]
     (reference: derived_quantities.py::mass_funct2)."""

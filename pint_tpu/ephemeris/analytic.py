@@ -23,8 +23,8 @@ the docstring claim of 0.2-1 ms for it was wrong.) This fallback is
 for *self-consistent* operation (simulate -> fit round-trips are
 exact) plus sub-ms-scale absolute accuracy; for ns-level absolute work
 supply a real DE kernel (io/spk.py reads .bsp files directly). The
-active provider is recorded on every TOABatch so results are
-traceable.
+active provider is recorded on every TOAs (``TOAs.ephem_provider``)
+so results are traceable.
 """
 
 from __future__ import annotations

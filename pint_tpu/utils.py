@@ -110,6 +110,9 @@ class PosVel:
         return f"PosVel({self.origin}->{self.obj}, pos~{self.pos.ravel()[:3]})"
 
 
+# upstream spelling (reference: src/pint/utils.py::FTest)
+FTest = ftest
+
 def interesting_lines(lines, comments=("#", "C ")):
     """Strip blank/comment lines (reference: utils.py::interesting_lines)."""
     for line in lines:

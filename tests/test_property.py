@@ -222,18 +222,26 @@ def test_random_model_configurations_fuzz():
     binaries = [None,
                 "BINARY ELL1\nPB 5.7\nA1 3.36\nTASC 55301\n"
                 "EPS1 1e-5 1\nEPS2 -8e-6\n",
-                "BINARY DD\nPB 12.3\nA1 9.2\nT0 55300\nECC 0.17 1\nOM 70\n"]
+                "BINARY DD\nPB 12.3\nA1 9.2\nT0 55300\nECC 0.17 1\nOM 70\n",
+                "BINARY BT\nPB 44.1\nA1 31.0\nT0 55290\nECC 0.33\nOM 201\n",
+                "BINARY DDS\nPB 0.41\nA1 2.1\nT0 55300.1\nECC 0.09\n"
+                "OM 81\nM2 1.1\nSHAPMAX 2.0 1\n",
+                "BINARY ELL1H\nPB 3.2\nA1 2.8\nTASC 55300.5\n"
+                "EPS1 5e-6\nEPS2 2e-6\nH3 2e-7 1\n"]
     extras = ["", "GLEP_1 55350\nGLF0_1 1e-8 1\n",
               "DMX_0001 0.001 1\nDMXR1_0001 55200\nDMXR2_0001 55400\n",
               "FD1 1e-5 1\nCORRECT_TROPOSPHERE Y\n",
               "NE_SW 6.0 1\nWAVE_OM 0.01\nWAVE1 1e-4 -5e-5\n",
               "JUMP -f L-wide 1e-5 1\nSIFUNC 2\nIFUNC1 55100 0.0\n"
-              "IFUNC2 55300 1e-6\nIFUNC3 55500 0.0\n"]
+              "IFUNC2 55300 1e-6\nIFUNC3 55500 0.0\n",
+              "SWM 0\nNE_SW 4.0\nSWX_0001 5.0 1\nSWXR1_0001 55000\n"
+              "SWXR2_0001 55600\n",
+              "CM 0.02 1\nTNCHROMIDX 4\nPHOFF 0.01 1\n"]
     noises = ["", "EFAC -f L-wide 1.2\nEQUAD -f L-wide 0.4\n",
               "ECORR -f L-wide 0.6\nTNREDAMP -13.5\nTNREDGAM 3.5\nTNREDC 8\n"]
     configs = list(itertools.product(binaries, extras, noises))
     rng.shuffle(configs)
-    for k, (binary, extra, noise) in enumerate(configs[:18]):
+    for k, (binary, extra, noise) in enumerate(configs[:26]):
         par = (f"PSR FZ{k}\nRAJ {k % 23}:30:00\nDECJ {(k * 7) % 50 - 20}:10:00\n"
                f"F0 {120 + 13 * k}.25 1\nF1 -{1 + k % 5}e-15 1\nPEPOCH 55300\n"
                f"DM {4 + k}.5 1\n")
