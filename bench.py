@@ -86,10 +86,13 @@ def _ragged_counts(n_psr=68, total=670_000, seed=7):
 
 def _full_scale_stage(meta):
     """Measured (not projected) full-scale north star: 68 pulsars at
-    ragged realistic TOA counts totaling ~670k, PTAFleet pow2
-    bucketing, full GLS refit wall-clock. The expensive host pack is
-    cached in .bench_cache/ (pickle of PTABatch.pack_state per
-    bucket) so driver re-runs only pay device time."""
+    ragged realistic TOA counts totaling ~670k, full GLS refit
+    wall-clock. Bucketing is platform-dependent (pow2's 6 programs on
+    CPU, one padded program on TPU — see the bucket_mode comment
+    below). The expensive host pack is cached per mode in
+    .bench_cache/ (pickle of PTABatch.pack_state per bucket; both
+    modes' packs are pre-seeded by builder runs on this machine) so
+    driver re-runs only pay device time."""
     import pickle
 
     import jax
@@ -98,9 +101,28 @@ def _full_scale_stage(meta):
     from pint_tpu.parallel import PTABatch, PTAFleet
 
     counts = _ragged_counts()
+    # bucket mode: pow2 (6 compiled programs, padding x1.37) is right
+    # where compiles are cheap (CPU); on the tunneled TPU the 6-program
+    # compile marathon is what has wedged the relay, so default to ONE
+    # program padded to the fleet max (padding x3, but a single compile
+    # and far less wedge exposure). Override: PINT_TPU_BENCH_FULL_BUCKET
+    # = pow2 | none.
+    platform = jax.devices()[0].platform
+    default_mode = "none" if platform == "tpu" else "pow2"
+    bucket_mode = os.environ.get("PINT_TPU_BENCH_FULL_BUCKET",
+                                 default_mode).strip().lower()
+    if bucket_mode not in ("pow2", "none"):
+        # never die (or silently change modes) on an env typo — the
+        # stage must stay self-consistent with its recorded metadata
+        _stage(f"invalid PINT_TPU_BENCH_FULL_BUCKET={bucket_mode!r}; "
+               f"using platform default {default_mode!r}")
+        bucket_mode = default_mode
+    toa_bucket = None if bucket_mode == "none" else "pow2"
     cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              ".bench_cache")
-    cache_path = os.path.join(cache_dir, "full670k_v1.pkl")
+    cache_path = os.path.join(
+        cache_dir, "full670k_v1.pkl" if bucket_mode == "pow2"
+        else f"full670k_{bucket_mode}_v1.pkl")
     states = None
     if os.path.exists(cache_path):
         try:
@@ -144,9 +166,9 @@ def _full_scale_stage(meta):
             toas_list.append(t)
         host_s = time.time() - t0
         _stage(f"full-scale host prep done ({host_s:.0f}s); packing "
-               "pow2 buckets")
+               f"({bucket_mode} bucketing)")
         t0 = time.time()
-        fleet = PTAFleet(models, toas_list, toa_bucket="pow2")
+        fleet = PTAFleet(models, toas_list, toa_bucket=toa_bucket)
         pack_s = time.time() - t0
         _stage(f"packed {len(fleet.batches)} buckets ({pack_s:.0f}s, "
                f"padding x{fleet.padding_ratio:.2f}); caching pack")
@@ -186,6 +208,7 @@ def _full_scale_stage(meta):
         "measured_670k_gls_refit_s": round(refit_s, 3),
         "measured_670k_total_toas": real_toas,
         "measured_670k_buckets": len(batches),
+        "measured_670k_bucket_mode": bucket_mode,
         "measured_670k_padding_ratio": round(padded / real_toas, 3),
         "measured_670k_compile_s": round(compile_s, 2),
         "measured_670k_all_finite": finite,
