@@ -463,13 +463,9 @@ class PTABatch:
             x[bad] = np.asarray(self._pull(x0), np.float64)[bad]
         return x, chi2
 
-    def wls_fit(self, maxiter=3, threshold=1e-12):
-        """Vmapped, mesh-sharded multi-pulsar WLS fit.
-
-        Returns (x_fit (n_psr, n_free), chi2 (n_psr,), cov (n_psr, k, k)).
-        Diverged pulsars (non-finite results) are reported via
-        self.diverged and returned with their starting vectors.
-        """
+    def _build_wls(self, maxiter=3, threshold=1e-12):
+        """(cache key, per-pulsar fit_one) for the WLS program —
+        shared by :meth:`wls_fit` and :meth:`aot_compile`."""
         import jax
         import jax.numpy as jnp
 
@@ -511,10 +507,21 @@ class PTABatch:
                 x, chi2, cov = one_step(x, params, batch, prep)
             return x, chi2, cov
 
+        return ("wls", maxiter, threshold), fit_one
+
+    def wls_fit(self, maxiter=3, threshold=1e-12):
+        """Vmapped, mesh-sharded multi-pulsar WLS fit.
+
+        Returns (x_fit (n_psr, n_free), chi2 (n_psr,), cov (n_psr, k, k)).
+        Diverged pulsars (non-finite results) are reported via
+        self.diverged and returned with their starting vectors.
+        """
         import time
 
+        import jax
+
+        key, fit_one = self._build_wls(maxiter, threshold)
         t0 = time.perf_counter()
-        key = ("wls", maxiter, threshold)
         compiled = key in self._fns
         if not compiled:
             self._fns[key] = jax.jit(jax.vmap(fit_one))
@@ -587,10 +594,11 @@ class PTABatch:
 
         return noise_bw
 
-    def gls_fit(self, maxiter=2, threshold=1e-12, ecorr_mode="auto"):
-        """Vmapped, mesh-sharded multi-pulsar GLS fit — the
-        BASELINE.json north-star path (NANOGrav-15yr-style refit with
-        EFAC/EQUAD/ECORR/red-noise) as ONE jitted program.
+    def _build_gls(self, maxiter=2, threshold=1e-12, ecorr_mode="auto"):
+        """(cache key, per-pulsar fit_one) for the GLS program — the
+        single home of the program construction, shared by
+        :meth:`gls_fit` (JIT path) and :meth:`aot_compile` (explicit
+        lower/compile path with trace-vs-XLA timing).
 
         Two equivalent solves (Woodbury identities), chosen by
         ``ecorr_mode``:
@@ -739,10 +747,24 @@ class PTABatch:
                 x, chi2, cov = one_step(x, params, batch, prep)
             return x, chi2, cov
 
+        return ("gls", maxiter, threshold, marginalize), fit_one
+
+    def gls_fit(self, maxiter=2, threshold=1e-12, ecorr_mode="auto"):
+        """Vmapped, mesh-sharded multi-pulsar GLS fit — the
+        BASELINE.json north-star path (NANOGrav-15yr-style refit with
+        EFAC/EQUAD/ECORR/red-noise) as ONE jitted program. See
+        :meth:`_build_gls` for the two ECORR solve modes and the
+        whitening/normalization conventions.
+
+        Returns (x_fit, chi2_whitened, cov) like wls_fit; diverged
+        pulsars reported via self.diverged.
+        """
         import time
 
+        import jax
+
+        key, fit_one = self._build_gls(maxiter, threshold, ecorr_mode)
         t0 = time.perf_counter()
-        key = ("gls", maxiter, threshold, marginalize)
         compiled = key in self._fns
         if not compiled:
             self._fns[key] = jax.jit(jax.vmap(fit_one))
@@ -755,6 +777,60 @@ class PTABatch:
         x, chi2 = self._isolate_diverged(x0, x, chi2)
         self._record_metrics("gls", t0, maxiter, warm=compiled)
         return x, chi2, cov
+
+    def aot_compile(self, method="gls", maxiter=None, threshold=1e-12,
+                    ecorr_mode="auto"):
+        """Ahead-of-time compile one vmapped fit program, splitting
+        Python/JAX *trace* time from XLA *backend compile* time and
+        recording the compiled executable's own cost model.
+
+        The split answers "is the 100 s+ relay compile tracing or
+        XLA?" (the two need opposite fixes: tracing cost is this
+        package's graph size, backend cost is XLA/relay-side), and
+        the cost model gives an honest FLOP count for MFU accounting
+        instead of a hand-derived estimate (SURVEY section 5
+        tracing/profiling; the hand model lives in BASELINE.md as the
+        cross-check).
+
+        Returns {trace_s, backend_compile_s, flops, bytes_accessed}
+        (cost fields None when the backend doesn't report them). The
+        executable is installed in the fit cache, so the next
+        wls_fit/gls_fit call with the same options runs warm.
+        """
+        import time
+
+        import jax
+
+        if method == "gls":
+            maxiter = 2 if maxiter is None else maxiter
+            key, fit_one = self._build_gls(maxiter, threshold, ecorr_mode)
+        elif method == "wls":
+            maxiter = 3 if maxiter is None else maxiter
+            key, fit_one = self._build_wls(maxiter, threshold)
+        else:
+            raise ValueError(f"aot_compile: unknown method {method!r}")
+        args = (self._x0(), self.params, self.batch, self.prep)
+        t0 = time.perf_counter()
+        lowered = jax.jit(jax.vmap(fit_one)).lower(*args)
+        trace_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        compiled = lowered.compile()
+        backend_s = time.perf_counter() - t0
+        flops = bytes_ac = None
+        try:
+            cost = compiled.cost_analysis()
+            if isinstance(cost, (list, tuple)):  # older jax: per-device list
+                cost = cost[0] if cost else {}
+            f = cost.get("flops")
+            b = cost.get("bytes accessed")
+            flops = float(f) if f is not None else None
+            bytes_ac = float(b) if b is not None else None
+        except Exception:
+            pass  # cost analysis is best-effort; the timing split is not
+        self._fns[key] = compiled
+        return {"method": method, "trace_s": round(trace_s, 3),
+                "backend_compile_s": round(backend_s, 3),
+                "flops": flops, "bytes_accessed": bytes_ac}
 
     @staticmethod
     def structure_key(model):
