@@ -128,21 +128,38 @@ def objPosVel_wrt_SSB(body: str, tdb: Epochs, ephem: str = "de440s",
             raise KeyError(f"provider pinned to 'spk' but no kernel "
                            f"backs ephem {ephem!r}")
         return _kernel_posvel(kern, body, tdb)
-    if provider == "numeph" and body in _CHAIN_TO_SSB:
+    if provider == "numeph" and body not in _CHAIN_TO_SSB:
+        # mirror the pinned-'spk' KeyError above: a pinned tier must
+        # never silently degrade to the analytic series for a body the
+        # kernel doesn't integrate (caller pinned 'numeph' after
+        # resolving it on Earth/Sun epochs; asking for e.g.
+        # 'jupiter_bary' under that pin is a tier-mixing bug upstream
+        # of here, not a fallback situation)
+        raise KeyError(
+            f"provider pinned to 'numeph' but body {body!r} is not in "
+            f"the numeph kernel ({sorted(_CHAIN_TO_SSB)}); re-resolve "
+            f"the tier (pass provider=None) or request a kernel body")
+    if provider == "numeph":
         nk, et_lo, et_hi = _numeph_kernel()
-        if nk is not None:
-            from ..io.spk import tdb_epochs_to_et
+        if nk is None:
+            # kernel vanished between tier resolution and use (file
+            # removed / PINT_TPU_DISABLE_NUMEPH set mid-session):
+            # same no-silent-tier-mixing contract
+            raise KeyError(
+                "provider pinned to 'numeph' but the numeph kernel is "
+                "unavailable; re-resolve the tier (pass provider=None)")
+        from ..io.spk import tdb_epochs_to_et
 
-            # a pinned tier must never silently extrapolate: the SPK
-            # evaluator clamps to the last record outside coverage and
-            # would return positions wrong by ~1e14 km
-            et = tdb_epochs_to_et(tdb.day, tdb.sec)
-            if len(et) and (et.min() < et_lo or et.max() > et_hi):
-                raise ValueError(
-                    "epochs outside the numeph kernel coverage with "
-                    "provider pinned to 'numeph'; re-resolve the tier "
-                    "for these epochs (pass provider=None)")
-            return _kernel_posvel(nk, body, tdb, et=et)
+        # a pinned tier must never silently extrapolate: the SPK
+        # evaluator clamps to the last record outside coverage and
+        # would return positions wrong by ~1e14 km
+        et = tdb_epochs_to_et(tdb.day, tdb.sec)
+        if len(et) and (et.min() < et_lo or et.max() > et_hi):
+            raise ValueError(
+                "epochs outside the numeph kernel coverage with "
+                "provider pinned to 'numeph'; re-resolve the tier "
+                "for these epochs (pass provider=None)")
+        return _kernel_posvel(nk, body, tdb, et=et)
     pos, vel = analytic.body_posvel_ssb(body, tdb.mjd_float())
     return PosVel(pos, vel, origin="ssb", obj=body)
 

@@ -243,7 +243,13 @@ class TOAs:
             # them so the precession/nutation chain runs once per load
             if not hasattr(self, "_gcrs_cache"):
                 self._gcrs_cache = {}  # unpickled pre-cache objects
-            self._gcrs_cache[obs_name] = (r_gcrs, v_gcrs)
+            # the corrected-UTC epochs ride along as the validity key:
+            # compute_posvels must not reuse these products if epochs
+            # or clock corrections were mutated in between (a
+            # same-length in-place edit would pass a bare length check)
+            self._gcrs_cache[obs_name] = (r_gcrs, v_gcrs,
+                                          utc_sub.day.copy(),
+                                          utc_sub.sec.copy())
             v_earth = objPosVel_wrt_SSB("earth", tdb_sub, self.ephem).vel
             dtopo = np.sum(v_earth * r_gcrs, axis=-1) / C_M_S**2
             self.tdb.sec[mask] += dtopo
@@ -270,9 +276,18 @@ class TOAs:
             mask = self.obs.astype(str) == obs_name
             tdb_sub = Epochs(self.tdb.day[mask], self.tdb.sec[mask], "tdb")
             utc_sub = Epochs(utc.day[mask], utc.sec[mask], "utc")
-            gcrs = getattr(self, "_gcrs_cache", {}).pop(obs_name, None)
-            if gcrs is not None and len(gcrs[0]) != int(mask.sum()):
-                gcrs = None  # epochs changed since compute_TDBs
+            cached = getattr(self, "_gcrs_cache", {}).pop(obs_name, None)
+            gcrs = None
+            if cached is not None:
+                r_g, v_g, cday, csec = cached
+                # exact epoch match required: both sides build
+                # corrected UTC as Epochs(day, sec+clock_corr_s)
+                # .normalized(), so unchanged inputs are bitwise equal
+                # and ANY mutation (epochs, clock corrections) misses
+                if (len(cday) == int(mask.sum())
+                        and np.array_equal(cday, utc_sub.day)
+                        and np.array_equal(csec, utc_sub.sec)):
+                    gcrs = (r_g, v_g)
             pv = ob.posvel_ssb(tdb_sub, utc_sub, self.ephem,
                                provider=self.ephem_provider, gcrs=gcrs)
             pos[mask] = pv.pos
