@@ -150,7 +150,8 @@ class SolarWindDispersionX(SolarWindDispersion):
         from .parameter import MJDParameter, prefixParameter
 
         pdm = prefixParameter(f"SWXDM_{index:04d}", "SWXDM_", index,
-                              units="pc cm^-3")
+                              units="pc cm^-3",
+                              aliases=(f"SWX_{index:04d}",))
         pdm.value = dm
         self.add_param(pdm)
         pp = prefixParameter(f"SWXP_{index:04d}", "SWXP_", index, units="")

@@ -247,7 +247,12 @@ def test_random_model_configurations_fuzz():
                f"DM {4 + k}.5 1\n")
         par += (binary or "") + extra + noise
         m = get_model(par)
+        # every line the fuzz generates must BIND — a warning in a
+        # green test is a bug report (VERDICT r3: the SWX family fell
+        # through to `unrecognized` for a round while the suite passed)
+        assert not m.unrecognized, (par, m.unrecognized)
         m2 = get_model(m.as_parfile())  # round-trip
+        assert not m2.unrecognized, (m.as_parfile(), m2.unrecognized)
         assert sorted(m2.params) == sorted(m.params), par
         days = np.sort(rng.uniform(55000, 55600, 24))
         mjds = np.sort(np.concatenate([days, days + 1.5 / 86400.0]))
