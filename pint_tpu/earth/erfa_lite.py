@@ -9,15 +9,19 @@ directly:
 - Earth Rotation Angle (ERA, IAU 2000)
 - GMST/GAST via IAU 2006 polynomial + equation of the equinoxes
 - Frame bias + IAU 1976/2000-style precession angles
-- Truncated IAU 2000B nutation (dominant terms)
+- FULL 77-term IAU 2000B nutation (6-coefficient form + planetary
+  bias; reproduces the published SOFA nut00b test values to ~1e-19
+  rad — tests/test_precision_budget.py::test_nutation_sofa_nut00b_anchor)
 - Polar motion hook (EOP table optional; zero fallback)
 
-Accuracy budget (documented, honest): nutation truncation ~1 mas
-(~3 cm at Earth radius, ~0.1 ns Roemer); precession model drift
-~0.1 arcsec/century vs IAU2006 (~3 m, ~10 ns at 50 yr from J2000);
-UT1=UTC fallback when no EOP table is provided (up to ±0.9 s → up to
-~1.4 us Roemer; supply an IERS finals file to remove). All host-side
-numpy f64; results feed the device TOABatch.
+Accuracy budget (documented, honest): nutation = exact IAU2000B, so
+the remaining nutation tier is the 2000B-vs-2000A model difference
+~1 mas (~3 cm at Earth radius, ~0.1 ns Roemer); precession model
+drift ~0.1 arcsec/century vs IAU2006 (~3 m, ~10 ns at 50 yr from
+J2000); UT1=UTC fallback when no EOP table is provided (up to ±0.9 s
+→ up to ~1.4 us Roemer; supply an IERS finals file to remove). All
+host-side numpy f64; results feed the device TOABatch; the C++ mirror
+receives this module's tables at load (native/__init__.py::get_lib).
 """
 
 from __future__ import annotations
@@ -83,50 +87,139 @@ def era(ut1: Epochs) -> np.ndarray:
     return np.mod(theta, TWO_PI)
 
 
-# --- fundamental arguments (IERS 2003) [rad], T in Julian centuries TT ---
-def _fund_args(T):
-    # mean anomaly of Moon (l), Sun (l'), F, D, Omega
-    l = (485868.249036 + 1717915923.2178 * T + 31.8792 * T**2) * ARCSEC_TO_RAD
-    lp = (1287104.79305 + 129596581.0481 * T - 0.5532 * T**2) * ARCSEC_TO_RAD
-    F = (335779.526232 + 1739527262.8478 * T - 12.7512 * T**2) * ARCSEC_TO_RAD
-    D = (1072260.70369 + 1602961601.2090 * T - 6.3706 * T**2) * ARCSEC_TO_RAD
-    Om = (450160.398036 - 6962890.5431 * T + 7.4722 * T**2) * ARCSEC_TO_RAD
+# FULL IAU2000B luni-solar nutation series (McCarthy & Luzum 2003):
+# 77 rows of (l, lp, F, D, Om multipliers, ps, pst, pc, ec, ect, es)
+# with coefficients in 0.1 uas units —
+#   dpsi = sum (ps + pst*T) sin(arg) + pc cos(arg)
+#   deps = sum (ec + ect*T) cos(arg) + es sin(arg)
+# plus fixed planetary-bias offsets (below) in lieu of the 2000A
+# planetary terms. Validated against the published SOFA/ERFA nut00b
+# test values (tests/test_precision_budget.py) — any wrong
+# coefficient anywhere in the table shows at the 1e-13 rad level
+# there. (reference: erfa nut00b)
+_NUT_TERMS = np.array([
+    # l lp  F  D Om      ps        pst      pc        ec       ect    es
+    [0, 0, 0, 0, 1, -172064161.0, -174666.0, 33386.0, 92052331.0, 9086.0, 15377.0],
+    [0, 0, 2, -2, 2, -13170906.0, -1675.0, -13696.0, 5730336.0, -3015.0, -4587.0],
+    [0, 0, 2, 0, 2, -2276413.0, -234.0, 2796.0, 978459.0, -485.0, 1374.0],
+    [0, 0, 0, 0, 2, 2074554.0, 207.0, -698.0, -897492.0, 470.0, -291.0],
+    [0, 1, 0, 0, 0, 1475877.0, -3633.0, 11817.0, 73871.0, -184.0, -1924.0],
+    [0, 1, 2, -2, 2, -516821.0, 1226.0, -524.0, 224386.0, -677.0, -174.0],
+    [1, 0, 0, 0, 0, 711159.0, 73.0, -872.0, -6750.0, 0.0, 358.0],
+    [0, 0, 2, 0, 1, -387298.0, -367.0, 380.0, 200728.0, 18.0, 318.0],
+    [1, 0, 2, 0, 2, -301461.0, -36.0, 816.0, 129025.0, -63.0, 367.0],
+    [0, -1, 2, -2, 2, 215829.0, -494.0, 111.0, -95929.0, 299.0, 132.0],
+    [0, 0, 2, -2, 1, 128227.0, 137.0, 181.0, -68982.0, -9.0, 39.0],
+    [-1, 0, 2, 0, 2, 123457.0, 11.0, 19.0, -53311.0, 32.0, -4.0],
+    [-1, 0, 0, 2, 0, 156994.0, 10.0, -168.0, -1235.0, 0.0, 82.0],
+    [1, 0, 0, 0, 1, 63110.0, 63.0, 27.0, -33228.0, 0.0, -9.0],
+    [-1, 0, 0, 0, 1, -57976.0, -63.0, -189.0, 31429.0, 0.0, -75.0],
+    [-1, 0, 2, 2, 2, -59641.0, -11.0, 149.0, 25543.0, -11.0, 66.0],
+    [1, 0, 2, 0, 1, -51613.0, -42.0, 129.0, 26366.0, 0.0, 78.0],
+    [-2, 0, 2, 0, 1, 45893.0, 50.0, 31.0, -24236.0, -10.0, 20.0],
+    [0, 0, 0, 2, 0, 63384.0, 11.0, -150.0, -1220.0, 0.0, 29.0],
+    [0, 0, 2, 2, 2, -38571.0, -1.0, 158.0, 16452.0, -11.0, 68.0],
+    [0, -2, 2, -2, 2, 32481.0, 0.0, 0.0, -13870.0, 0.0, 0.0],
+    [-2, 0, 0, 2, 0, -47722.0, 0.0, -18.0, 477.0, 0.0, -25.0],
+    [2, 0, 2, 0, 2, -31046.0, -1.0, 131.0, 13238.0, -11.0, 59.0],
+    [1, 0, 2, -2, 2, 28593.0, 0.0, -1.0, -12338.0, 10.0, -3.0],
+    [-1, 0, 2, 0, 1, 20441.0, 21.0, 10.0, -10758.0, 0.0, -3.0],
+    [2, 0, 0, 0, 0, 29243.0, 0.0, -74.0, -609.0, 0.0, 13.0],
+    [0, 0, 2, 0, 0, 25887.0, 0.0, -66.0, -550.0, 0.0, 11.0],
+    [0, 1, 0, 0, 1, -14053.0, -25.0, 79.0, 8551.0, -2.0, -45.0],
+    [-1, 0, 0, 2, 1, 15164.0, 10.0, 11.0, -8001.0, 0.0, -1.0],
+    [0, 2, 2, -2, 2, -15794.0, 72.0, -16.0, 6850.0, -42.0, -5.0],
+    [0, 0, -2, 2, 0, 21783.0, 0.0, 13.0, -167.0, 0.0, 13.0],
+    [1, 0, 0, -2, 1, -12873.0, -10.0, -37.0, 6953.0, 0.0, -14.0],
+    [0, -1, 0, 0, 1, -12654.0, 11.0, 63.0, 6415.0, 0.0, 26.0],
+    [-1, 0, 2, 2, 1, -10204.0, 0.0, 25.0, 5222.0, 0.0, 15.0],
+    [0, 2, 0, 0, 0, 16707.0, -85.0, -10.0, 168.0, -1.0, 10.0],
+    [1, 0, 2, 2, 2, -7691.0, 0.0, 44.0, 3268.0, 0.0, 19.0],
+    [-2, 0, 2, 0, 0, -11024.0, 0.0, -14.0, 104.0, 0.0, 2.0],
+    [0, 1, 2, 0, 2, 7566.0, -21.0, -11.0, -3250.0, 0.0, -5.0],
+    [0, 0, 2, 2, 1, -6637.0, -11.0, 25.0, 3353.0, 0.0, 14.0],
+    [0, -1, 2, 0, 2, -7141.0, 21.0, 8.0, 3070.0, 0.0, 4.0],
+    [0, 0, 0, 2, 1, -6302.0, -11.0, 2.0, 3272.0, 0.0, 4.0],
+    [1, 0, 2, -2, 1, 5800.0, 10.0, 2.0, -3045.0, 0.0, -1.0],
+    [2, 0, 2, -2, 2, 6443.0, 0.0, -7.0, -2768.0, 0.0, -4.0],
+    [-2, 0, 0, 2, 1, -5774.0, -11.0, -15.0, 3041.0, 0.0, -5.0],
+    [2, 0, 2, 0, 1, -5350.0, 0.0, 21.0, 2695.0, 0.0, 12.0],
+    [0, -1, 2, -2, 1, -4752.0, -11.0, -3.0, 2719.0, 0.0, -3.0],
+    [0, 0, 0, -2, 1, -4940.0, -11.0, -21.0, 2720.0, 0.0, -9.0],
+    [-1, -1, 0, 2, 0, 7350.0, 0.0, -8.0, -51.0, 0.0, 4.0],
+    [2, 0, 0, -2, 1, 4065.0, 0.0, 6.0, -2206.0, 0.0, 1.0],
+    [1, 0, 0, 2, 0, 6579.0, 0.0, -24.0, -199.0, 0.0, 2.0],
+    [0, 1, 2, -2, 1, 3579.0, 0.0, 5.0, -1900.0, 0.0, 1.0],
+    [1, -1, 0, 0, 0, 4725.0, 0.0, -6.0, -41.0, 0.0, 3.0],
+    [-2, 0, 2, 0, 2, -3075.0, 0.0, -2.0, 1313.0, 0.0, -1.0],
+    [3, 0, 2, 0, 2, -2904.0, 0.0, 15.0, 1233.0, 0.0, 7.0],
+    [0, -1, 0, 2, 0, 4348.0, 0.0, -10.0, -81.0, 0.0, 2.0],
+    [1, -1, 2, 0, 2, -2878.0, 0.0, 8.0, 1232.0, 0.0, 4.0],
+    [0, 0, 0, 1, 0, -4230.0, 0.0, 5.0, -20.0, 0.0, -2.0],
+    [-1, -1, 2, 2, 2, -2819.0, 0.0, 7.0, 1207.0, 0.0, 3.0],
+    [-1, 0, 2, 0, 0, -4056.0, 0.0, 5.0, 40.0, 0.0, -2.0],
+    [0, -1, 2, 2, 2, -2647.0, 0.0, 11.0, 1129.0, 0.0, 5.0],
+    [-2, 0, 0, 0, 1, -2294.0, 0.0, -10.0, 1266.0, 0.0, -4.0],
+    [1, 1, 2, 0, 2, 2481.0, 0.0, -7.0, -1062.0, 0.0, -3.0],
+    [2, 0, 0, 0, 1, 2179.0, 0.0, -2.0, -1129.0, 0.0, -2.0],
+    [-1, 1, 0, 1, 0, 3276.0, 0.0, 1.0, -9.0, 0.0, 0.0],
+    [1, 1, 0, 0, 0, -3389.0, 0.0, 5.0, 35.0, 0.0, -2.0],
+    [1, 0, 2, 0, 0, 3339.0, 0.0, -13.0, -107.0, 0.0, 1.0],
+    [-1, 0, 2, -2, 1, -1987.0, 0.0, -6.0, 1073.0, 0.0, -2.0],
+    [1, 0, 0, 0, 2, -1981.0, 0.0, 0.0, 854.0, 0.0, 0.0],
+    [-1, 0, 0, 1, 0, 4026.0, 0.0, -353.0, -553.0, 0.0, -139.0],
+    [0, 0, 2, 1, 2, 1660.0, 0.0, -5.0, -710.0, 0.0, -2.0],
+    [-1, 0, 2, 4, 2, -1521.0, 0.0, 9.0, 647.0, 0.0, 4.0],
+    [-1, 1, 0, 1, 1, 1314.0, 0.0, 0.0, -700.0, 0.0, 0.0],
+    [0, -2, 2, -2, 1, -1283.0, 0.0, 0.0, 672.0, 0.0, 0.0],
+    [1, 0, 2, 2, 1, -1331.0, 0.0, 8.0, 663.0, 0.0, 4.0],
+    [-2, 0, 2, 2, 2, 1383.0, 0.0, -2.0, -594.0, 0.0, -2.0],
+    [-1, 0, 0, 0, 2, 1405.0, 0.0, 4.0, -610.0, 0.0, 2.0],
+    [1, 1, 2, -2, 2, 1290.0, 0.0, 0.0, -556.0, 0.0, 0.0],
+])
+
+# Fixed offsets in lieu of the IAU2000A planetary nutation terms
+# [arcsec] (nut00b's dpplan/deplan).
+_NUT_PLANETARY_BIAS_PSI = -0.135e-3
+_NUT_PLANETARY_BIAS_EPS = 0.388e-3
+
+
+def _fund_args_nut00b(T):
+    """Fundamental arguments [rad] as prescribed for the IAU2000B
+    series: LINEAR-only Delaunay expressions (nut00b truncates the
+    IERS 2003 polynomials; using the quadratic forms here would move
+    the series off the published model by ~10 uas at |T|~0.1)."""
+    l = (485868.249036 + 1717915923.2178 * T) * ARCSEC_TO_RAD
+    lp = (1287104.79305 + 129596581.0481 * T) * ARCSEC_TO_RAD
+    F = (335779.526232 + 1739527262.8478 * T) * ARCSEC_TO_RAD
+    D = (1072260.70369 + 1602961601.2090 * T) * ARCSEC_TO_RAD
+    Om = (450160.398036 - 6962890.5431 * T) * ARCSEC_TO_RAD
     return l, lp, F, D, Om
 
 
-# Truncated IAU2000B nutation: (l, lp, F, D, Om multipliers),
-# (psi_sin, psi_t_sin, eps_cos, eps_t_cos) in 0.1 uas units
-# Dominant 13 terms of the 77-term IAU2000B series.
-_NUT_TERMS = np.array([
-    # l lp F  D  Om    dpsi_sin    dpsi_t      deps_cos   deps_t
-    [0, 0, 0, 0, 1, -172064161.0, -174666.0, 92052331.0, 9086.0],
-    [0, 0, 2, -2, 2, -13170906.0, -1675.0, 5730336.0, -3015.0],
-    [0, 0, 2, 0, 2, -2276413.0, -234.0, 978459.0, -485.0],
-    [0, 0, 0, 0, 2, 2074554.0, 207.0, -897492.0, 470.0],
-    [0, 1, 0, 0, 0, 1475877.0, -3633.0, 73871.0, -184.0],
-    [0, 1, 2, -2, 2, -516821.0, 1226.0, 224386.0, -677.0],
-    [1, 0, 0, 0, 0, 711159.0, 73.0, -6750.0, 0.0],
-    [0, 0, 2, 0, 1, -387298.0, -367.0, 200728.0, 18.0],
-    [1, 0, 2, 0, 2, -301461.0, -36.0, 129025.0, -63.0],
-    [0, -1, 2, -2, 2, 215829.0, -494.0, -95929.0, 299.0],
-    [0, 0, 2, -2, 1, 128227.0, 137.0, -68982.0, -9.0],
-    [-1, 0, 2, 0, 2, 123457.0, 11.0, -53311.0, 32.0],
-    [-1, 0, 0, 2, 0, 156994.0, 10.0, -1235.0, 0.0],
-])
-
-
 def nutation(T):
-    """(dpsi, deps) [rad], truncated IAU2000B (reference: erfa nut00b)."""
-    l, lp, F, D, Om = _fund_args(T)
-    T = np.asarray(T)
-    dpsi = np.zeros_like(T)
-    deps = np.zeros_like(T)
-    for row in _NUT_TERMS:
-        arg = row[0] * l + row[1] * lp + row[2] * F + row[3] * D + row[4] * Om
-        dpsi = dpsi + (row[5] + row[6] * T) * np.sin(arg)
-        deps = deps + (row[7] + row[8] * T) * np.cos(arg)
+    """(dpsi, deps) [rad], full IAU2000B (reference: erfa nut00b).
+
+    Luni-solar series evaluated as one (N_epochs x 77) matrix product
+    against the multiplier table plus the fixed planetary bias —
+    ~1 mas of the full 2000A model, vs ~20 mas for the 13-term
+    truncation this replaces (ERRORBUDGET.md)."""
+    T = np.asarray(T, np.float64)
+    scalar = T.ndim == 0
+    Tv = np.atleast_1d(T)
+    fund = np.stack(_fund_args_nut00b(Tv), axis=0)       # (5, N)
+    arg = _NUT_TERMS[:, :5] @ fund                       # (77, N)
+    s, c = np.sin(arg), np.cos(arg)
+    ps, pst, pc = _NUT_TERMS[:, 5:6], _NUT_TERMS[:, 6:7], _NUT_TERMS[:, 7:8]
+    ec, ect, es = _NUT_TERMS[:, 8:9], _NUT_TERMS[:, 9:10], _NUT_TERMS[:, 10:11]
+    dpsi = np.sum((ps + pst * Tv) * s + pc * c, axis=0)
+    deps = np.sum((ec + ect * Tv) * c + es * s, axis=0)
     scale = 1e-7 * ARCSEC_TO_RAD  # tables are in 0.1 uas
-    return dpsi * scale, deps * scale
+    dpsi = dpsi * scale + _NUT_PLANETARY_BIAS_PSI * ARCSEC_TO_RAD
+    deps = deps * scale + _NUT_PLANETARY_BIAS_EPS * ARCSEC_TO_RAD
+    if scalar:
+        return float(dpsi[0]), float(deps[0])
+    return dpsi, deps
 
 
 def mean_obliquity(T):

@@ -71,20 +71,36 @@ def test_earth_moon_emb_consistency():
 
 
 def test_nutation_published_anchor():
-    """Nutation truncation vs the published worked example (Meeus
-    ch.22, 1987 April 10.0 TD: dpsi = -3.788", deps = +9.443", full
-    1980 series). The 13-term IAU2000B truncation must land within
-    ~30 mas — its documented dropped-tail bound (~1 m at the Earth's
-    surface, ~3 ns of timing; see ERRORBUDGET.md). Measured at this
-    epoch: dpsi off by 20 mas, deps by 1 mas."""
+    """Nutation vs the published worked example (Meeus ch.22, 1987
+    April 10.0 TD: dpsi = -3.788", deps = +9.443", full IAU 1980
+    series). With the full 77-term IAU2000B table (r4) the remaining
+    offset is the 1980-vs-2000 MODEL difference (~7 mas in dpsi from
+    the IAU2000 amplitude/precession-rate revisions, ~2.5 mas in
+    deps), not truncation — so the bounds are cross-model bounds, an
+    order of magnitude tighter than the r3 13-term-truncation ones."""
     from pint_tpu.earth.erfa_lite import nutation
 
     T = (2446895.5 - 2451545.0) / 36525.0
     dpsi, deps = nutation(np.array([T]))
     dpsi_as = np.degrees(dpsi[0]) * 3600
     deps_as = np.degrees(deps[0]) * 3600
-    assert abs(dpsi_as - (-3.788)) < 0.030
-    assert abs(deps_as - 9.443) < 0.010
+    assert abs(dpsi_as - (-3.788)) < 0.010
+    assert abs(deps_as - 9.443) < 0.005
+
+
+def test_nutation_sofa_nut00b_anchor():
+    """EXACT anchor: the published SOFA/ERFA t_sofa_c test values for
+    iauNut00b(2400000.5, 53736.0). This pins every one of the 77
+    luni-solar rows, all six coefficient columns, the linear-only
+    fundamental-argument convention, AND the planetary-bias offsets:
+    a single mistyped table entry of 1 unit (0.1 uas ~ 5e-13 rad)
+    would blow the 1e-13 tolerance. Measured residual ~1e-19 rad."""
+    from pint_tpu.earth.erfa_lite import nutation
+
+    T = (53736.0 - 51544.5) / 36525.0
+    dpsi, deps = nutation(T)
+    assert abs(dpsi - (-0.9632552291148362783e-5)) < 1e-13
+    assert abs(deps - 0.4063197106621159367e-4) < 1e-13
 
 
 def test_moon_meeus_worked_example():
@@ -111,15 +127,19 @@ def test_moon_meeus_worked_example():
 
 
 def test_tdb_table_vs_series():
-    """Integrated TDB-TT table: agrees with the FB1990 truncated series
-    to within the series' own truncation (<10 us), and its annual term
-    matches the IAU convention amplitude/phase at the us level."""
-    mjd = np.arange(48000.0, 61000.0, 3.0)
+    """Integrated TDB-TT table vs the harmonic series. With the r4
+    fit-derived extension (timescales._TDB_TERMS_EXT; VERDICT r3 item
+    4: 'TDB fallback <= 100 ns vs the table') the series must stay
+    within 100 ns of the table across the full coverage — two orders
+    under the r3 10-term truncation bound of ~10 us — so the
+    out-of-range fallback and the C++ mirror are interchangeable with
+    the primary path at the 0.1 us level."""
+    mjd = np.arange(40001.0, 63999.0, 1.0)
     tt = Epochs(mjd.astype(np.int64), (mjd % 1) * 86400.0, "tt")
     tab = ts.tdb_minus_tt(tt)
     ser = ts.tdb_minus_tt_series(tt)
     d = tab - ser
-    assert np.abs(d).max() < 1.2e-5  # series truncation scale
+    assert np.abs(d).max() < 100e-9, np.abs(d).max()
     # same estimator applied to table and series: the shared annual
     # term must agree at the ~1 us level (convention calibration)
     T = (mjd - 51544.5) / 36525.0
