@@ -22,7 +22,9 @@
 // C ABI, called from Python via ctypes (no pybind11 in the build env).
 
 #include <cmath>
+#include <cstddef>
 #include <cstdint>
+#include <vector>
 
 namespace {
 
@@ -65,45 +67,54 @@ inline Mat3 rz(double a) {
   return Mat3{{{c, s, 0}, {-s, c, 0}, {0, 0, 1}}};
 }
 
-// Truncated IAU2000B nutation — dominant 13 terms, 0.1 uas units
-// (same table as pint_tpu/earth/erfa_lite.py::_NUT_TERMS).
-constexpr double NUT[13][9] = {
-    {0, 0, 0, 0, 1, -172064161.0, -174666.0, 92052331.0, 9086.0},
-    {0, 0, 2, -2, 2, -13170906.0, -1675.0, 5730336.0, -3015.0},
-    {0, 0, 2, 0, 2, -2276413.0, -234.0, 978459.0, -485.0},
-    {0, 0, 0, 0, 2, 2074554.0, 207.0, -897492.0, 470.0},
-    {0, 1, 0, 0, 0, 1475877.0, -3633.0, 73871.0, -184.0},
-    {0, 1, 2, -2, 2, -516821.0, 1226.0, 224386.0, -677.0},
-    {1, 0, 0, 0, 0, 711159.0, 73.0, -6750.0, 0.0},
-    {0, 0, 2, 0, 1, -387298.0, -367.0, 200728.0, 18.0},
-    {1, 0, 2, 0, 2, -301461.0, -36.0, 129025.0, -63.0},
-    {0, -1, 2, -2, 2, 215829.0, -494.0, -95929.0, 299.0},
-    {0, 0, 2, -2, 1, 128227.0, 137.0, -68982.0, -9.0},
-    {-1, 0, 2, 0, 2, 123457.0, 11.0, -53311.0, 32.0},
-    {-1, 0, 0, 2, 0, 156994.0, 10.0, -1235.0, 0.0},
+// IAU2000B nutation, table INJECTED from Python at library load
+// (pt_set_nut_table below; pint_tpu/native/__init__.py::get_lib pushes
+// erfa_lite._NUT_TERMS so the 77x11 table has exactly one source of
+// truth). Row layout: l lp F D Om multipliers then ps pst pc ec ect es
+// in 0.1 uas; dpsi = (ps+pst*T) sin + pc cos, deps = (ec+ect*T) cos +
+// es sin, plus the fixed planetary-bias offsets [arcsec]. Built-in
+// default: the dominant 13 terms (pc/es zero), so a bare dlopen
+// without the setter still computes a ~20 mas-class nutation.
+std::vector<double> g_nut_table = {
+    0, 0, 0, 0, 1, -172064161.0, -174666.0, 0, 92052331.0, 9086.0, 0,
+    0, 0, 2, -2, 2, -13170906.0, -1675.0, 0, 5730336.0, -3015.0, 0,
+    0, 0, 2, 0, 2, -2276413.0, -234.0, 0, 978459.0, -485.0, 0,
+    0, 0, 0, 0, 2, 2074554.0, 207.0, 0, -897492.0, 470.0, 0,
+    0, 1, 0, 0, 0, 1475877.0, -3633.0, 0, 73871.0, -184.0, 0,
+    0, 1, 2, -2, 2, -516821.0, 1226.0, 0, 224386.0, -677.0, 0,
+    1, 0, 0, 0, 0, 711159.0, 73.0, 0, -6750.0, 0.0, 0,
+    0, 0, 2, 0, 1, -387298.0, -367.0, 0, 200728.0, 18.0, 0,
+    1, 0, 2, 0, 2, -301461.0, -36.0, 0, 129025.0, -63.0, 0,
+    0, -1, 2, -2, 2, 215829.0, -494.0, 0, -95929.0, 299.0, 0,
+    0, 0, 2, -2, 1, 128227.0, 137.0, 0, -68982.0, -9.0, 0,
+    -1, 0, 2, 0, 2, 123457.0, 11.0, 0, -53311.0, 32.0, 0,
+    -1, 0, 0, 2, 0, 156994.0, 10.0, 0, -1235.0, 0.0, 0,
 };
+double g_nut_bias_psi_as = 0.0;  // [arcsec]
+double g_nut_bias_eps_as = 0.0;
 
 void nutation(double T, double* dpsi, double* deps) {
-  const double l =
-      (485868.249036 + 1717915923.2178 * T + 31.8792 * T * T) * ARCSEC_TO_RAD;
-  const double lp =
-      (1287104.79305 + 129596581.0481 * T - 0.5532 * T * T) * ARCSEC_TO_RAD;
-  const double F =
-      (335779.526232 + 1739527262.8478 * T - 12.7512 * T * T) * ARCSEC_TO_RAD;
-  const double D =
-      (1072260.70369 + 1602961601.2090 * T - 6.3706 * T * T) * ARCSEC_TO_RAD;
-  const double Om =
-      (450160.398036 - 6962890.5431 * T + 7.4722 * T * T) * ARCSEC_TO_RAD;
+  // LINEAR-only Delaunay arguments, as the IAU2000B model prescribes
+  // (mirrors erfa_lite._fund_args_nut00b; quadratic terms would move
+  // the series ~10 uas off the published model at |T|~0.1)
+  const double l = (485868.249036 + 1717915923.2178 * T) * ARCSEC_TO_RAD;
+  const double lp = (1287104.79305 + 129596581.0481 * T) * ARCSEC_TO_RAD;
+  const double F = (335779.526232 + 1739527262.8478 * T) * ARCSEC_TO_RAD;
+  const double D = (1072260.70369 + 1602961601.2090 * T) * ARCSEC_TO_RAD;
+  const double Om = (450160.398036 - 6962890.5431 * T) * ARCSEC_TO_RAD;
   double dp = 0.0, de = 0.0;
-  for (const auto& row : NUT) {
+  const std::size_t n = g_nut_table.size() / 11;
+  for (std::size_t j = 0; j < n; ++j) {
+    const double* row = g_nut_table.data() + 11 * j;
     const double arg =
         row[0] * l + row[1] * lp + row[2] * F + row[3] * D + row[4] * Om;
-    dp += (row[5] + row[6] * T) * std::sin(arg);
-    de += (row[7] + row[8] * T) * std::cos(arg);
+    const double s = std::sin(arg), c = std::cos(arg);
+    dp += (row[5] + row[6] * T) * s + row[7] * c;
+    de += (row[8] + row[9] * T) * c + row[10] * s;
   }
   const double scale = 1e-7 * ARCSEC_TO_RAD;
-  *dpsi = dp * scale;
-  *deps = de * scale;
+  *dpsi = dp * scale + g_nut_bias_psi_as * ARCSEC_TO_RAD;
+  *deps = de * scale + g_nut_bias_eps_as * ARCSEC_TO_RAD;
 }
 
 inline double mean_obliquity(double T) {
@@ -150,26 +161,60 @@ inline double era(std::int64_t ut1_day, double ut1_sec) {
 
 extern "C" {
 
-// TDB-TT [s] (FB1990 truncated, same terms as timescales.py).
+// Replace the nutation table (rows of 11 doubles, see g_nut_table)
+// and planetary-bias offsets [arcsec]. Called once by the ctypes
+// loader with erfa_lite's full IAU2000B table.
+void pt_set_nut_table(std::int64_t n_rows, const double* rows,
+                      double bias_psi_as, double bias_eps_as) {
+  g_nut_table.assign(rows, rows + 11 * n_rows);
+  g_nut_bias_psi_as = bias_psi_as;
+  g_nut_bias_eps_as = bias_eps_as;
+}
+
+// TDB-TT [s] (FB1990-form harmonic series; terms injected from
+// timescales.py via pt_set_tdb_terms — single source of truth).
+// Built-in default: the 10 leading FB1990 terms + the largest
+// T-modulated term.
+std::vector<double> g_tdb_terms = {
+    0.001656675, 628.3075850, 6.2400580,
+    0.000022418, 575.3384885, 4.2969771,
+    0.000013840, 1256.6151700, 6.1968992,
+    0.000004770, 52.9690965, 0.4444038,
+    0.000004677, 606.9776754, 4.0211665,
+    0.000002257, 21.3299095, 5.5431320,
+    0.000001694, 0.3523118, 5.0251207,
+    0.000001556, 1203.6460735, 4.1698465,
+    0.000001276, 1414.3495242, 4.2781490,
+    0.000001193, 1097.7078770, 6.1798441,
+};
+std::vector<double> g_tdb_t_terms = {0.0000102, 628.3075850, 4.2490};
+double g_tdb_poly[3] = {0.0, 0.0, 0.0};
+
+void pt_set_tdb_terms(std::int64_t n, const double* terms,
+                      std::int64_t n_t, const double* t_terms,
+                      const double* poly3) {
+  g_tdb_terms.assign(terms, terms + 3 * n);
+  g_tdb_t_terms.assign(t_terms, t_terms + 3 * n_t);
+  g_tdb_poly[0] = poly3[0];
+  g_tdb_poly[1] = poly3[1];
+  g_tdb_poly[2] = poly3[2];
+}
+
 void pt_tdb_minus_tt(std::int64_t n, const std::int64_t* tt_day,
                      const double* tt_sec, double* out) {
-  static constexpr double TERMS[10][3] = {
-      {0.001656675, 628.3075850, 6.2400580},
-      {0.000022418, 575.3384885, 4.2969771},
-      {0.000013840, 1256.6151700, 6.1968992},
-      {0.000004770, 52.9690965, 0.4444038},
-      {0.000004677, 606.9776754, 4.0211665},
-      {0.000002257, 21.3299095, 5.5431320},
-      {0.000001694, 0.3523118, 5.0251207},
-      {0.000001556, 1203.6460735, 4.1698465},
-      {0.000001276, 1414.3495242, 4.2781490},
-      {0.000001193, 1097.7078770, 6.1798441},
-  };
+  const std::size_t n0 = g_tdb_terms.size() / 3;
+  const std::size_t n1 = g_tdb_t_terms.size() / 3;
   for (std::int64_t i = 0; i < n; ++i) {
     const double T = jc_from_epoch(tt_day[i], tt_sec[i]);
-    double s = 0.0;
-    for (const auto& t : TERMS) s += t[0] * std::sin(t[1] * T + t[2]);
-    s += 0.0000102 * T * std::sin(628.3075850 * T + 4.2490);
+    double s = g_tdb_poly[0] + g_tdb_poly[1] * T + g_tdb_poly[2] * T * T;
+    for (std::size_t j = 0; j < n0; ++j) {
+      const double* t = g_tdb_terms.data() + 3 * j;
+      s += t[0] * std::sin(t[1] * T + t[2]);
+    }
+    for (std::size_t j = 0; j < n1; ++j) {
+      const double* t = g_tdb_t_terms.data() + 3 * j;
+      s += t[0] * T * std::sin(t[1] * T + t[2]);
+    }
     out[i] = s;
   }
 }

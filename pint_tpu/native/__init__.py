@@ -48,6 +48,56 @@ def _build() -> bool:
         return False
 
 
+def _setup(lib: ctypes.CDLL) -> bool:
+    """Declare signatures and push the Python-side series tables
+    (single source of truth) into the library: the full IAU2000B
+    nutation table + planetary bias from erfa_lite, and the TDB-TT
+    harmonic terms from timescales. Returns False when the library
+    predates a required symbol — without the table push the .so would
+    fall back to its built-in truncations and the native/numpy
+    mirror-equality contract would break, so such a library must not
+    be used."""
+    try:
+        lib.pt_tdb_minus_tt.argtypes = [ctypes.c_int64, _i64p, _f64p, _f64p]
+        lib.pt_tdb_minus_tt.restype = None
+        lib.pt_itrf_to_gcrs.argtypes = [
+            ctypes.c_int64, _i64p, _f64p, _i64p,
+            _f64p, _f64p, _f64p, _f64p, _f64p, _f64p]
+        lib.pt_itrf_to_gcrs.restype = None
+        lib.pt_cheby_posvel.argtypes = [ctypes.c_int64, ctypes.c_int64,
+                                        ctypes.c_int64, ctypes.c_int64,
+                                        _f64p, _f64p, _f64p, _f64p]
+        lib.pt_cheby_posvel.restype = None
+        _u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        _i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        lib.pt_parse_tim_t2.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, _i64p, _f64p, _f64p, _f64p,
+            _i32p, _u8p, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+            _u8p, ctypes.c_int64, _i64p, ctypes.POINTER(ctypes.c_int64)]
+        lib.pt_parse_tim_t2.restype = ctypes.c_int64
+        lib.pt_set_nut_table.argtypes = [ctypes.c_int64, _f64p,
+                                         ctypes.c_double, ctypes.c_double]
+        lib.pt_set_nut_table.restype = None
+        lib.pt_set_tdb_terms.argtypes = [ctypes.c_int64, _f64p,
+                                         ctypes.c_int64, _f64p, _f64p]
+        lib.pt_set_tdb_terms.restype = None
+    except AttributeError:
+        return False
+    from .. import timescales as _ts
+    from ..earth import erfa_lite as _el
+
+    nut = np.ascontiguousarray(_el._NUT_TERMS, np.float64)
+    lib.pt_set_nut_table(nut.shape[0], nut,
+                         _el._NUT_PLANETARY_BIAS_PSI,
+                         _el._NUT_PLANETARY_BIAS_EPS)
+    terms = np.ascontiguousarray(_ts._TDB_TERMS_ALL, np.float64)
+    t_terms = np.ascontiguousarray(_ts._TDB_T_TERMS, np.float64)
+    poly = np.ascontiguousarray(_ts._TDB_POLY, np.float64)
+    lib.pt_set_tdb_terms(terms.shape[0], terms,
+                         t_terms.shape[0], t_terms, poly)
+    return True
+
+
 def get_lib() -> ctypes.CDLL | None:
     """The loaded native library, building it if needed; None if
     unavailable (callers then use their numpy paths)."""
@@ -70,22 +120,20 @@ def get_lib() -> ctypes.CDLL | None:
     except OSError:
         _LIB = False
         return None
-    lib.pt_tdb_minus_tt.argtypes = [ctypes.c_int64, _i64p, _f64p, _f64p]
-    lib.pt_tdb_minus_tt.restype = None
-    lib.pt_itrf_to_gcrs.argtypes = [ctypes.c_int64, _i64p, _f64p, _i64p,
-                                    _f64p, _f64p, _f64p, _f64p, _f64p, _f64p]
-    lib.pt_itrf_to_gcrs.restype = None
-    lib.pt_cheby_posvel.argtypes = [ctypes.c_int64, ctypes.c_int64,
-                                    ctypes.c_int64, ctypes.c_int64,
-                                    _f64p, _f64p, _f64p, _f64p]
-    lib.pt_cheby_posvel.restype = None
-    _u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
-    _i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
-    lib.pt_parse_tim_t2.argtypes = [
-        ctypes.c_char_p, ctypes.c_int64, _i64p, _f64p, _f64p, _f64p,
-        _i32p, _u8p, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
-        _u8p, ctypes.c_int64, _i64p, ctypes.POINTER(ctypes.c_int64)]
-    lib.pt_parse_tim_t2.restype = ctypes.c_int64
+    if not _setup(lib):
+        # symbols missing: a pre-table-injection .so slipped past the
+        # mtime check (copied artifact, clock skew). One forced
+        # rebuild from source, else the silent numpy fallback the
+        # module docstring promises.
+        lib = None
+        if _build():
+            try:
+                lib = ctypes.CDLL(_SO)
+            except OSError:
+                lib = None
+        if lib is None or not _setup(lib):
+            _LIB = False
+            return None
     _LIB = lib
     return lib
 
