@@ -453,3 +453,210 @@ def test_spk_writer_reader_roundtrip(tmp_path):
         seg = kern.segment_for(tgt, ctr)
         assert seg.start_et == init
         assert seg.end_et == init + coeffs.shape[0] * intlen
+
+
+# ---------------------------------------------------------------------------
+# ALL tiers at once: the upgrade story must COMPOSE (VERDICT r3 item 5)
+# ---------------------------------------------------------------------------
+
+def test_all_tiers_upgrade_end_to_end(tmp_path, monkeypatch):
+    """Synthesize reference-grade data for EVERY offline-degraded tier
+    at once — a DE-style SPK kernel with a known injected Earth-orbit
+    perturbation (io/spk_write.py), an IERS finals2000A file with a
+    known UT1-UTC, site+GPS+BIPM clock files with a known step — then
+    run the full par+tim -> TOAs -> residuals -> fit pipeline and
+    assert each injected signal is recovered END TO END, not just
+    parsed. (reference: SURVEY section 4 patterns 1+6 — upstream pins
+    this with real DE/IERS/clock data in tests/datafile/; offline, the
+    synthetic-injection equivalent is the strongest available form.)
+    """
+    import pint_tpu.ephemeris as eph
+    from pint_tpu.earth import eop as eop_mod
+    from pint_tpu.io.spk_write import write_spk_type2
+    from pint_tpu.models import get_model
+    from pint_tpu.observatory import clock_file as cfmod
+    from pint_tpu.observatory import get_observatory
+    from pint_tpu.residuals import Residuals
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+    from pint_tpu.toa import get_TOAs
+    from pint_tpu.constants import SECS_PER_DAY
+
+    C_KM_S = 299792.458
+    par = ("PSR COMPOSE1\nRAJ 06:30:00.0\nDECJ 15:30:00.0\n"
+           "F0 312.5 1\nF1 -2e-15 1\nPEPOCH 55050\nPOSEPOCH 55050\n"
+           "DM 21.3 1\nEPHEM compose\n")
+    m = get_model(par)
+
+    # --- synthesize the tim file (baseline physics: no data tiers) ---
+    rng = np.random.default_rng(3)
+    mjds = np.sort(rng.uniform(55001.0, 55099.0, 60))
+    t0 = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, obs="gbt",
+                                 add_noise=True, seed=3, iterations=2)
+    tim = tmp_path / "compose.tim"
+    lines = ["FORMAT 1"]
+    for i in range(len(t0)):
+        frac = int(round(t0.sec[i] / SECS_PER_DAY * 1e13))
+        lines.append(f" fake {t0.freq_mhz[i]:.6f} "
+                     f"{t0.day[i]}.{frac:013d} 1.000 gbt")
+    tim.write_text("\n".join(lines) + "\n")
+    parfile = tmp_path / "compose.par"
+    parfile.write_text(par)
+
+    def load():
+        return get_TOAs(str(tim), model=get_model(str(parfile)),
+                        usepickle=False)
+
+    def resids(t):
+        return np.asarray(Residuals(t, get_model(str(parfile))).time_resids)
+
+    # pulsar unit vector (RAJ 6:30 -> 97.5 deg, DECJ 15:30)
+    ra, dec = np.radians(97.5), np.radians(15.5)
+    nhat = np.array([np.cos(dec) * np.cos(ra), np.cos(dec) * np.sin(ra),
+                     np.sin(dec)])
+
+    # --- baseline: every tier in its no-data fallback state ---
+    monkeypatch.delenv("PINT_TPU_EPHEM_DIR", raising=False)
+    monkeypatch.delenv("PINT_TPU_EOP_FILE", raising=False)
+    monkeypatch.delenv("PINT_TPU_CLOCK_DIR", raising=False)
+    monkeypatch.setattr(eph, "_KERNELS", {})
+    monkeypatch.setattr(cfmod, "_cache", {})
+    eop_mod.reset_eop_discovery()
+    gbt = get_observatory("gbt")
+    monkeypatch.setattr(gbt, "_clock", None)
+    monkeypatch.setattr(gbt, "_warned", True)
+    t_base = load()
+    r_base = resids(t_base)
+    pos_base = t_base.ssb_obs.pos.copy()
+    clk_base = t_base.clock_corr_s.copy()
+
+    # --- tier 1: SPK kernel = package Earth/Sun + injected 30 km
+    # periodic Earth-orbit perturbation at 2pi/20d ---
+    span_lo, span_hi = 54995.0, 55105.0
+    from pint_tpu.io.spk import tdb_epochs_to_et
+    from pint_tpu.ephemeris import objPosVel_wrt_SSB
+
+    intlen_d, ncoef = 5.0, 13
+    n_rec = int(np.ceil((span_hi - span_lo) / intlen_d))
+    init_et = tdb_epochs_to_et(np.array([int(span_lo)]),
+                               np.array([(span_lo % 1) * SECS_PER_DAY]))[0]
+    nodes = np.cos(np.pi * (np.arange(ncoef) + 0.5) / ncoef)  # cheb pts
+    A_KM = 30.0
+    w_rad_per_day = 2 * np.pi / 20.0
+
+    def perturb(mjd_arr):
+        ph = w_rad_per_day * (mjd_arr - 55000.0)
+        return A_KM * np.stack([np.sin(ph), np.cos(ph),
+                                np.zeros_like(ph)], axis=-1)
+
+    segs = []
+    for tgt, ctr, body, pert in ((3, 0, "earth", True), (399, 3, None, False),
+                                 (10, 0, "sun", False)):
+        coeffs = np.zeros((n_rec, 3, ncoef))
+        for r in range(n_rec):
+            lo = span_lo + r * intlen_d
+            mjd_nodes = lo + (nodes + 1) / 2 * intlen_d
+            ep = Epochs(mjd_nodes.astype(np.int64),
+                        (mjd_nodes % 1.0) * SECS_PER_DAY, "tdb")
+            if body is None:
+                pos_km = np.zeros((ncoef, 3))
+            else:
+                pos_km = objPosVel_wrt_SSB(body, ep).pos / 1e3
+                if pert:
+                    pos_km = pos_km + perturb(mjd_nodes)
+            # Chebyshev fit on the nodes (exact interpolation)
+            V = np.polynomial.chebyshev.chebvander(nodes, ncoef - 1)
+            coeffs[r] = np.linalg.solve(V, pos_km).T
+        segs.append({"target": tgt, "center": ctr, "init_et": init_et,
+                     "intlen_s": intlen_d * SECS_PER_DAY, "coeffs": coeffs})
+    write_spk_type2(str(tmp_path / "compose.bsp"), segs)
+    monkeypatch.setenv("PINT_TPU_EPHEM_DIR", str(tmp_path))
+    monkeypatch.setattr(eph, "_KERNELS", {})
+
+    t_spk = load()
+    assert t_spk.ephem_provider == "spk"  # tier actually switched
+    # injected orbit perturbation appears in ssb_obs verbatim
+    dpos_km = (t_spk.ssb_obs.pos - pos_base) / 1e3
+    mjd_f = t_spk.day + t_spk.sec / SECS_PER_DAY
+    expect_km = perturb(mjd_f)
+    assert np.abs(dpos_km - expect_km).max() < 1.0  # cheb fit + tier delta
+    # ... and in the residuals as the predicted Roemer signature
+    r_spk = resids(t_spk)
+    dr = r_spk - r_base
+    delay_s = (expect_km @ nhat) / C_KM_S
+    w = 1.0 / np.asarray(t_spk.error_us) ** 2
+    for sign in (+1.0, -1.0):
+        pred = sign * (delay_s - np.sum(w * delay_s) / np.sum(w))
+        if np.abs(dr - pred).max() < 0.15e-6:
+            break
+    else:
+        raise AssertionError(
+            f"ephemeris signature not recovered: max dev "
+            f"{np.abs(dr - pred).max():.3g}s vs amplitude "
+            f"{np.abs(delay_s).max():.3g}s")
+
+    # --- tier 2: EOP (UT1-UTC = 0.4 s) on top of the kernel ---
+    dut1 = 0.4
+    eop_lines = [_finals_line(mjd, 0.0, 0.0, dut1)
+                 for mjd in range(54995, 55106)]
+    (tmp_path / "finals2000A.all").write_text("\n".join(eop_lines) + "\n")
+    monkeypatch.setenv("PINT_TPU_EOP_FILE",
+                       str(tmp_path / "finals2000A.all"))
+    eop_mod.reset_eop_discovery()
+    try:
+        t_eop = load()
+        # site rotated by ~omega * dut1 * r_equatorial through the FULL
+        # pipeline (not just the unit-level chain test)
+        from pint_tpu.earth.erfa_lite import OMEGA_EARTH
+
+        shift = np.linalg.norm(t_eop.ssb_obs.pos - t_spk.ssb_obs.pos, axis=1)
+        r_eq = np.linalg.norm(
+            np.array([882589.65, -4924872.32, 3943729.348])[:2])
+        expect_shift = OMEGA_EARTH * dut1 * r_eq
+        np.testing.assert_allclose(shift, expect_shift, rtol=2e-3)
+        # residual change follows the predicted per-TOA Roemer delta
+        r_eop = resids(t_eop)
+        delay2 = ((t_eop.ssb_obs.pos - t_spk.ssb_obs.pos) @ nhat) / 299792458.0
+        for sign in (+1.0, -1.0):
+            pred2 = sign * (delay2 - np.sum(w * delay2) / np.sum(w))
+            if np.abs((r_eop - r_spk) - pred2).max() < 30e-9:
+                break
+        else:
+            raise AssertionError("EOP residual signature not recovered")
+
+        # --- tier 3: clock chain (site step + GPS + BIPM) on top ---
+        (tmp_path / "time_gbt.dat").write_text(
+            "  54995.00  54995.50   0.00  0.00  gbt\n"
+            "  55050.00  55050.50   0.00  0.00  gbt\n"
+            "  55050.01  55050.51  10.00  0.00  gbt\n"
+            "  55106.00  55106.50  10.00  0.00  gbt\n")
+        (tmp_path / "gps2utc.clk").write_text(
+            "# GPS to UTC\n54995.0 2.0e-7\n55106.0 2.0e-7\n")
+        (tmp_path / "tai2tt_bipm2019.clk").write_text(
+            "# TAI to TT(BIPM2019)\n54995.0 32.1840276\n55106.0 32.1840276\n")
+        monkeypatch.setenv("PINT_TPU_CLOCK_DIR", str(tmp_path))
+        monkeypatch.setattr(cfmod, "_cache", {})
+        monkeypatch.setattr(gbt, "_clock", None)
+        t_clk = load()
+        dclk = t_clk.clock_corr_s - clk_base
+        late = mjd_f > 55050.5
+        # site step (10 us) + GPS (0.2 us) + BIPM (27.6 us) compose
+        np.testing.assert_allclose(dclk[~late], 27.6e-6 + 0.2e-6, atol=2e-8)
+        np.testing.assert_allclose(dclk[late], 37.6e-6 + 0.2e-6, atol=2e-8)
+        # the step (minus weighted mean) is what residuals can see:
+        # late-minus-early group offset recovers the 10 us injection
+        r_clk = resids(t_clk)
+        dr_clk = r_clk - r_eop
+        step = dr_clk[late].mean() - dr_clk[~late].mean()
+        assert abs(abs(step) - 10e-6) < 0.3e-6, step
+
+        # --- every tier on: the full pipeline still fits green ---
+        from pint_tpu.fitter import WLSFitter
+
+        f = WLSFitter(t_clk, get_model(str(parfile)))
+        f.fit_toas()
+        assert np.isfinite(float(f.resids.chi2))
+        for p in f.model.free_params:
+            assert np.isfinite(getattr(f.model, p).value)
+            assert np.isfinite(getattr(f.model, p).uncertainty or 1.0)
+    finally:
+        eop_mod.reset_eop_discovery()
