@@ -222,3 +222,74 @@ def test_j0437_shklovskii_kinematic_anchor():
     pb_s = 5.7410459 * 86400.0
     pbdot_shk = shklovskii_factor(140.914, 0.1563) * pb_s
     assert pbdot_shk == pytest.approx(3.73e-12, rel=0.02)
+
+
+def test_b1913_mass_function_anchor():
+    """B1913+16 mass function: f(m) = 0.13217 Msun from
+    Pb = 0.322997448911 d, a1 = 2.341782 ls (Weisberg, Nice & Taylor
+    2010, ApJ 722, 1030, table 2) — pins G, Msun, and the a^3/Pb^2
+    plumbing in one published number."""
+    from pint_tpu.derived_quantities import mass_function
+
+    f = mass_function(0.322997448911, 2.341782)
+    assert f == pytest.approx(0.13217, rel=1e-4)
+
+
+def test_crab_spin_derived_anchors():
+    """Crab pulsar (B0531+21) textbook values (Lyne & Graham-Smith;
+    P = 33.392 ms, Pdot = 4.21e-13 at the 1994-era epoch): the derived
+    spin quantities must land on the published
+    characteristic age ~1260 yr, surface field ~3.8e12 G, and
+    spin-down luminosity ~4.5e38 erg/s (I = 1e45 g cm^2)."""
+    from pint_tpu.derived_quantities import (pulsar_B, pulsar_age,
+                                             pulsar_edot)
+
+    p, pd = 33.392e-3, 4.21e-13
+    f0, f1 = 1.0 / p, -pd / p**2
+    age_yr = pulsar_age(f0, f1)  # returns years
+    assert age_yr == pytest.approx(p / (2 * pd) / 86400.0 / 365.25,
+                                   rel=1e-12)  # n=3 braking definition
+    assert age_yr == pytest.approx(1257.0, rel=0.02)
+    assert pulsar_B(f0, f1) == pytest.approx(3.8e12, rel=0.03)
+    # pulsar_edot returns SI watts: 4.5e38 erg/s = 4.5e31 W
+    assert pulsar_edot(f0, f1) == pytest.approx(4.5e31, rel=0.05)
+
+
+def test_j1614_shapiro_range_anchor():
+    """J1614-2230 (Demorest et al. 2010, Nature 467, 1081):
+    mc = 0.500 Msun, i = 89.17 deg. The Shapiro RANGE parameter is
+    r = T_sun * mc = 2.4628 us; the near-edge-on geometry amplifies
+    it to a peak-to-trough range 2r ln((1+s)/(1-s)) ~ 48.5 us (the
+    published 'two-solar-mass pulsar' detection signal), and the
+    packaged DD binary must reproduce that range from M2/SINI."""
+    import numpy as np
+
+    T_SUN_US = 4.925490947
+    mc, inc = 0.500, np.radians(89.17)
+    r_us = T_SUN_US * mc
+    assert r_us == pytest.approx(2.4627, rel=1e-3)
+    s = np.sin(inc)
+    peak_us = 2.0 * r_us * np.log((1.0 + s) / (1.0 - s))
+    assert peak_us == pytest.approx(48.5, rel=0.01)
+    # and the packaged binary model reproduces that peak: ELL1H-free
+    # DD with M2/SINI at superior conjunction
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    m = get_model(
+        "PSR J1614M\nRAJ 16:14:36.5\nDECJ -22:30:31\nF0 317.378 1\n"
+        "PEPOCH 55000\nDM 34.5\nBINARY DD\nPB 8.6866194196\n"
+        "A1 11.2911975\nT0 55000.0\nECC 1.3e-6\nOM 175.0\n"
+        f"M2 {mc}\nSINI {s}\n")
+    mjds = np.linspace(55000.0, 55000.0 + 8.6866194196, 4001)
+    t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, obs="gbt",
+                                iterations=0)
+    # Shapiro part = binary delay with (M2, SINI) minus the same
+    # orbit with M2 = 0 (delay_breakdown keeps the full-chain context)
+    d_with = m.delay_breakdown(t)["BinaryDD"]
+    m0 = get_model(m.as_parfile().replace(f"M2", "#M2")
+                   .replace("SINI", "#SINI"))
+    d_without = m0.delay_breakdown(t)["BinaryDD"]
+    shap = np.asarray(d_with) - np.asarray(d_without)
+    span_us = (shap.max() - shap.min()) * 1e6
+    assert span_us == pytest.approx(peak_us, rel=0.05)

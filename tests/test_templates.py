@@ -10,7 +10,9 @@ import pytest
 
 warnings.simplefilter("ignore")
 
-from pint_tpu.templates import LCFitter, LCGaussian, LCTemplate, LCVonMises
+from pint_tpu.templates import (LCFitter, LCGaussian, LCLorentzian,
+                                LCSkewGaussian, LCTemplate, LCTopHat,
+                                LCVonMises)
 from pint_tpu.profile import fftfit_basic, fftfit_full
 
 
@@ -346,3 +348,163 @@ def test_fftfit_cc_backend_agrees():
         d3 = (fftfit_cc(tmpl, noisy) - fftfit_full(tmpl, noisy).shift
               + 0.5) % 1.0 - 0.5
         assert abs(d3) < 5e-3
+
+
+# ---------------------------------------------------------------------------
+# r4 quantitative depth (VERDICT r3 item 8): calibration, asymmetry,
+# published anchors, per-family parameter recovery
+# ---------------------------------------------------------------------------
+
+def _sample_from_template(rng, t, n, fmax=None):
+    """Rejection-sample photon phases from an LCTemplate density."""
+    grid = np.linspace(0, 1, 2048, endpoint=False)
+    dens = np.asarray(t(grid))
+    fmax = fmax or dens.max() * 1.05
+    out = []
+    while sum(len(o) for o in out) < n:
+        ph = rng.uniform(0, 1, 4 * n)
+        keep = rng.uniform(0, fmax, 4 * n) < np.asarray(t(ph))
+        out.append(ph[keep])
+    return np.concatenate(out)[:n]
+
+
+def test_fftfit_uncertainty_statistically_calibrated():
+    """The reported shift uncertainty must MATCH the Monte-Carlo
+    scatter (ratio within [0.6, 1.6]), not merely bound it — the
+    wideband TOA pipeline consumes this number as a real sigma
+    (reference: fftfit Taylor 1992 appendix; upstream
+    tests/test_fftfit.py checks the same calibration)."""
+    rng = np.random.default_rng(42)
+    tmpl = _profile(512, 0.4, 0.02, amp=800.0, dc=0.0)
+    errs, sigs = [], []
+    for i in range(60):
+        prof = np.roll(tmpl, 23) + rng.standard_normal(512) * 30.0
+        r = fftfit_full(tmpl, prof)
+        errs.append(r.shift - 23 / 512)
+        sigs.append(r.uncertainty)
+    ratio = np.std(errs) / np.mean(sigs)
+    assert 0.6 < ratio < 1.6, ratio
+
+
+def test_fftfit_cc_vs_taylor_on_asymmetric_profile():
+    """Asymmetric (skew) pulse: the Taylor fftfit and the independent
+    cross-correlation backend must agree within their combined
+    uncertainty, and neither may show a bias beyond 3 sigma — the
+    asymmetry is exactly where a centroid-style estimator would
+    diverge from the template-matched one."""
+    from pint_tpu.profile.fftfit import fftfit_cc
+
+    rng = np.random.default_rng(7)
+    x = np.arange(512) / 512.0
+    d = (x - 0.35 + 0.5) % 1.0 - 0.5
+    sig = np.where(d < 0, 0.015, 0.06)  # sharp rise, slow decay
+    tmpl = 600.0 * np.exp(-0.5 * (d / sig) ** 2)
+    true = 41 / 512.0
+    biases_t, biases_c, sigs = [], [], []
+    for i in range(25):
+        prof = np.roll(tmpl, 41) + rng.standard_normal(512) * 25.0
+        rt = fftfit_full(tmpl, prof)
+        cc = fftfit_cc(tmpl, prof)
+        biases_t.append(rt.shift - true)
+        biases_c.append(((cc - true) + 0.5) % 1.0 - 0.5)
+        sigs.append(rt.uncertainty)
+    mt, mc, s = (np.mean(biases_t), np.mean(biases_c),
+                 np.mean(sigs) / np.sqrt(len(biases_t)))
+    assert abs(mt) < 3 * s, (mt, s)          # Taylor unbiased
+    assert abs(mt - mc) < 5 * s, (mt, mc, s)  # backends agree
+
+
+def test_sf_hm_published_anchor():
+    """H-test significance against the PUBLISHED de Jager & Busching
+    (2010, A&A 517, L9) calibration P = exp(-0.4 H), and the
+    documented sig2sigma example (2.866e-7 -> 5.0 sigma)."""
+    from pint_tpu.eventstats import sf_hm, sig2sigma
+
+    assert sf_hm(23.0) == pytest.approx(np.exp(-9.2), rel=1e-12)
+    assert sf_hm(50.0) == pytest.approx(2.0611536e-9, rel=1e-6)
+    assert sig2sigma(2.866515719235352e-07) == pytest.approx(5.0, abs=1e-6)
+
+
+def test_htest_false_alarm_rate_calibrated():
+    """Monte-Carlo false-alarm calibration of OUR hm implementation
+    against the published survival function: for uniform (no-signal)
+    phases, P(H > h) must track exp(-0.4 h) (within Poisson error x a
+    factor ~2 calibration band, as in the original paper's fig. 1)."""
+    from pint_tpu.eventstats import hm
+
+    rng = np.random.default_rng(11)
+    n_trials, n_ph = 800, 120
+    phases = rng.uniform(0, 1, (n_trials, n_ph))
+    hs = np.array([float(hm(phases[i])) for i in range(n_trials)])
+    for h0 in (5.0, 8.0):
+        emp = float(np.mean(hs > h0))
+        pred = np.exp(-0.4 * h0)
+        # Poisson band on the empirical rate, doubled for the
+        # calibration-formula tolerance
+        band = 2.0 * (np.sqrt(pred * n_trials) / n_trials + 2.0 / n_trials)
+        assert abs(emp - pred) < band, (h0, emp, pred, band)
+
+
+@pytest.mark.parametrize("prim,true_p,tol_loc,tol_w", [
+    (LCGaussian([0.03, 0.40]), [0.03, 0.40], 0.006, 0.010),
+    (LCLorentzian([0.02, 0.55]), [0.02, 0.55], 0.008, 0.012),
+    (LCVonMises([0.04, 0.30]), [0.04, 0.30], 0.008, 0.020),
+    (LCSkewGaussian([0.02, 0.05, 0.60]), [0.02, 0.05, 0.60], 0.012, 0.020),
+    (LCTopHat([0.20, 0.45]), [0.20, 0.45], 0.015, 0.030),
+])
+def test_primitive_family_parameter_recovery(prim, true_p, tol_loc, tol_w):
+    """Per-family QUANTITATIVE recovery (not smoke): photons drawn
+    from each primitive's own density, refit from a perturbed start,
+    parameters recovered within stated tolerances (reference:
+    upstream tests/test_lcprimitives.py per-class batteries)."""
+    from pint_tpu.templates import LCTemplate
+
+    rng = np.random.default_rng(hash(type(prim).__name__) % 2**31)
+    t_true = LCTemplate([type(prim)(list(true_p))], [0.65])
+    ph = _sample_from_template(rng, t_true, 25000)
+    start = list(true_p)
+    start[0] *= 1.4          # misstate the width
+    start[-1] = (start[-1] + 0.04) % 1.0  # and the location
+    t_fit = LCTemplate([type(prim)(start)], [0.5])
+    f = LCFitter(t_fit, ph)
+    f.fit(steps=600)
+    got = t_fit.primitives[0].p
+    assert got[-1] == pytest.approx(true_p[-1], abs=tol_loc)
+    assert got[0] == pytest.approx(true_p[0], abs=tol_w)
+    assert t_fit.norms[0] == pytest.approx(0.65, abs=0.06)
+
+
+def test_template_fit_error_propagation_at_scale():
+    """Error propagation through template fits at photon scale:
+    reported parameter uncertainties follow 1/sqrt(N) between N=5k
+    and N=20k, and the reported phase-shift uncertainty (the location
+    sigma the wideband/event pipelines consume) matches the
+    Monte-Carlo scatter of independent refits within a calibration
+    band (reference: lcfitters hessian errors; upstream
+    tests/test_lcfitters.py)."""
+    from pint_tpu.templates import LCTemplate
+
+    def fit_once(n, seed):
+        r = np.random.default_rng(seed)
+        t_true = LCTemplate([LCGaussian([0.03, 0.42])], [0.6])
+        ph = _sample_from_template(r, t_true, n)
+        t = LCTemplate([LCGaussian([0.035, 0.40])], [0.5])
+        f = LCFitter(t, ph)
+        f.fit(steps=500)
+        unc = f.param_uncertainties()
+        return t.primitives[0].loc, unc, f.phase_shift_uncertainty()
+
+    loc5, unc5, sig5 = fit_once(5000, 100)
+    loc20, unc20, sig20 = fit_once(20000, 101)
+    unc5 = np.asarray(unc5)
+    unc20 = np.asarray(unc20)
+    assert np.all(np.isfinite(unc5)) and np.all(unc5 > 0)
+    # 1/sqrt(N): factor 2 between 5k and 20k photons (30% slack)
+    np.testing.assert_allclose(unc5, 2.0 * unc20, rtol=0.35)
+    assert sig5 == pytest.approx(2.0 * sig20, rel=0.35)
+    # MC calibration: the scatter of independently refit locations
+    # must match the REPORTED location sigma within a factor 2.5 —
+    # a ~3x mis-scaled sigma fails this band
+    locs = [fit_once(5000, 200 + i)[0] for i in range(10)]
+    scatter = np.std(locs)
+    assert sig5 / 2.5 < scatter < sig5 * 2.5, (scatter, sig5)
