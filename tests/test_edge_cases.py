@@ -274,7 +274,7 @@ class TestDegenerateFits:
         f = WLSFitter(t, m)
         try:
             f.fit_toas(maxiter=1)
-            assert np.isfinite(getattr(m, "F0").value or 0.0)
+            assert np.isfinite(f.model.F0.value)  # fitted model, no NaN
         except (ValueError, RuntimeError):
             pass  # refusing is also acceptable; hanging/NaN is not
 

@@ -1,0 +1,325 @@
+"""Edge-case battery II (VERDICT r3 item 7, continued): par-file
+pathologies, astrometry sign traps, pulse-number tracking across gaps,
+mask-parameter range semantics, wideband flags, selection state.
+Each test names its upstream analog.
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu.models import get_model
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+PAR = ("PSR EC2\nRAJ 05:00:00\nDECJ 10:00:00\nF0 100.0 1\n"
+       "F1 -1e-15 1\nPEPOCH 55000\nDM 10.0 1\n")
+
+
+def _toas(m, n=24, span=(55000, 55300), seed=0, **kw):
+    rng = np.random.default_rng(seed)
+    mjds = np.sort(rng.uniform(*span, n))
+    return make_fake_toas_fromMJDs(mjds, m, error_us=1.0, obs="gbt",
+                                   add_noise=True, seed=seed,
+                                   iterations=1, **kw)
+
+
+# ---------------------------------------------------------------------------
+# par-file pathologies (reference: models/parameter.py parse;
+# upstream tests/test_parfile.py / test_model.py)
+# ---------------------------------------------------------------------------
+
+class TestParPathologies:
+    def test_fortran_d_exponent(self):
+        # tempo par files carry FORTRAN 'D' exponents
+        m = get_model(PAR.replace("F1 -1e-15 1", "F1 -1.5D-15 1"))
+        assert m.F1.value == -1.5e-15
+
+    def test_fit_flag_two_means_free(self):
+        # tempo uses fit flag 2 for some parameters; any nonzero = free
+        m = get_model(PAR.replace("F0 100.0 1", "F0 100.0 2"))
+        assert not m.F0.frozen
+
+    def test_value_flag_uncertainty_columns(self):
+        m = get_model(PAR.replace("F0 100.0 1", "F0 100.0 1 3e-12"))
+        assert not m.F0.frozen and m.F0.uncertainty == 3e-12
+
+    def test_negative_zero_degrees_decj(self):
+        # THE classic sign trap: -00:30:00 must be -0.5 deg, not +0.5
+        # (upstream fixed this in angle parsing years ago)
+        m = get_model(PAR.replace("DECJ 10:00:00", "DECJ -00:30:00"))
+        assert np.degrees(m.DECJ.value) == pytest.approx(-0.5, abs=1e-12)
+
+    def test_raj_uncertainty_in_seconds_of_time(self):
+        # RAJ uncertainty column is seconds of RA: 0.001 s = 2pi/86400e3
+        m = get_model(PAR.replace("RAJ 05:00:00", "RAJ 05:00:00 1 0.001"))
+        assert m.RAJ.uncertainty == pytest.approx(2 * np.pi / 86400e3,
+                                                  rel=1e-9)
+
+    def test_duplicate_parameter_last_wins_or_warns(self):
+        # a par with F0 twice must not silently produce a third value
+        import warnings as w
+
+        with w.catch_warnings():
+            w.simplefilter("ignore")
+            m = get_model(PAR + "F0 123.0 1\n")
+        assert m.F0.value in (100.0, 123.0)
+
+    def test_whitespace_and_tab_separated(self):
+        m = get_model(PAR.replace("F0 100.0 1", "F0\t100.0\t1"))
+        assert m.F0.value == 100.0 and not m.F0.frozen
+
+    def test_unknown_lines_reported_not_fatal(self):
+        import warnings as w
+
+        with w.catch_warnings():
+            w.simplefilter("ignore")
+            m = get_model(PAR + "NOTAPARAM 1.23\n")
+        assert "NOTAPARAM" in m.unrecognized
+
+
+# ---------------------------------------------------------------------------
+# astrometry traps (reference: models/astrometry.py; upstream
+# tests/test_astrometry.py)
+# ---------------------------------------------------------------------------
+
+class TestAstrometryTraps:
+    def test_proper_motion_moves_position(self):
+        # 100 mas/yr over ~2.7 yr from POSEPOCH ~ 274 mas of motion:
+        # the Roemer delay must shift measurably vs the no-PM model
+        m0 = get_model(PAR)
+        mpm = get_model(PAR + "PMRA 100.0\nPMDEC -50.0\nPOSEPOCH 54000\n")
+        t = _toas(m0)
+        d0 = m0.delay_breakdown(t)["AstrometryEquatorial"]
+        d1 = mpm.delay_breakdown(t)["AstrometryEquatorial"]
+        # annual-parallax-scale signature: > 100 ns somewhere
+        assert np.abs(np.asarray(d1) - np.asarray(d0)).max() > 1e-7
+
+    def test_negative_parallax_rejected_or_flagged(self):
+        # PX < 0 is unphysical; model must either raise at validate or
+        # carry it without NaN (upstream warns and carries)
+        m = get_model(PAR + "PX -1.0\n")
+        t = _toas(m)
+        r = Residuals(t, m)
+        assert np.isfinite(np.asarray(r.time_resids)).all()
+
+    def test_ecliptic_equatorial_same_sky_position(self):
+        # the SAME sky point expressed in both frames gives matching
+        # Roemer delays to sub-us (frame conversion correctness)
+        m_eq = get_model(PAR)
+        from pint_tpu.modelutils import model_equatorial_to_ecliptic
+
+        m_ecl = model_equatorial_to_ecliptic(m_eq)
+        t = _toas(m_eq)
+        r_eq = np.asarray(Residuals(t, m_eq).time_resids)
+        r_ecl = np.asarray(Residuals(t, m_ecl).time_resids)
+        assert np.abs(r_eq - r_ecl).max() < 1e-6
+
+    def test_posepoch_defaults_to_pepoch(self):
+        m = get_model(PAR + "PMRA 10.0\nPMDEC 0.0\n")
+        t = _toas(m)
+        assert np.isfinite(np.asarray(Residuals(t, m).time_resids)).all()
+
+
+# ---------------------------------------------------------------------------
+# pulse-number tracking (reference: toa.py::compute_pulse_numbers +
+# residuals track_mode; upstream tests/test_pulse_number.py)
+# ---------------------------------------------------------------------------
+
+class TestPulseNumberTracking:
+    def test_tracking_honors_manual_phase_wrap(self):
+        # pintk's wrap tool edits -pn flags: adding +1 to the second
+        # cluster must shift TRACKED residuals by exactly one turn
+        # (1/F0 = 10 ms) there, while nearest-pulse residuals ignore
+        # pn entirely — the deterministic TRACK -2 semantics
+        m = get_model("PSR TRK1\nRAJ 5:0:0\nDECJ 10:0:0\nF0 100.0 1\n"
+                      "PEPOCH 55000\nDM 10.0\n")
+        mjds = np.concatenate([np.linspace(55000, 55050, 10),
+                               np.linspace(55500, 55550, 10)])
+        t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, obs="gbt",
+                                    iterations=2)
+        t.compute_pulse_numbers(m)
+        assert len(t.get_pulse_numbers()) == 20
+        r0 = np.asarray(Residuals(t, m, track_mode="use_pulse_numbers",
+                                  subtract_mean=False).time_resids)
+        r_near0 = np.asarray(Residuals(t, m, track_mode="nearest",
+                                       subtract_mean=False).time_resids)
+        for f in t.flags[10:]:
+            f["pn"] = f"{float(f['pn']) + 1:.0f}"
+        r1 = np.asarray(Residuals(t, m, track_mode="use_pulse_numbers",
+                                  subtract_mean=False).time_resids)
+        r_near1 = np.asarray(Residuals(t, m, track_mode="nearest",
+                                       subtract_mean=False).time_resids)
+        d = r1 - r0
+        np.testing.assert_allclose(d[:10], 0.0, atol=1e-12)
+        np.testing.assert_allclose(np.abs(d[10:]), 1.0 / 100.0,
+                                   rtol=1e-9)
+        np.testing.assert_allclose(r_near1, r_near0, atol=1e-15)
+
+    def test_pn_flags_roundtrip_through_residuals(self):
+        m = get_model(PAR)
+        t = _toas(m)
+        t.compute_pulse_numbers(m)
+        r = Residuals(t, m, track_mode="use_pulse_numbers")
+        assert np.isfinite(np.asarray(r.time_resids)).all()
+
+
+# ---------------------------------------------------------------------------
+# mask parameter range semantics (reference: parameter.maskParameter;
+# upstream tests/test_jump.py / test_select.py)
+# ---------------------------------------------------------------------------
+
+class TestMaskSemantics:
+    def test_jump_mjd_range_hits_only_range(self):
+        # PhaseJump acts in phase (not the delay chain): observe it as
+        # the un-mean-subtracted residual difference vs the jump-free
+        # model — exactly -value inside the window, zero outside
+        m0 = get_model(PAR)
+        mj = get_model(PAR + "JUMP MJD 55100 55200 5e-4 1\n")
+        t = _toas(m0, n=40)
+        mjd = t.day + t.sec / 86400.0
+        in_range = (mjd >= 55100) & (mjd <= 55200)
+        assert in_range.any() and (~in_range).any()
+        r0 = np.asarray(Residuals(t, m0, subtract_mean=False).time_resids)
+        r1 = np.asarray(Residuals(t, mj, subtract_mean=False).time_resids)
+        d = r1 - r0
+        np.testing.assert_allclose(d[~in_range], 0.0, atol=1e-12)
+        np.testing.assert_allclose(d[in_range], -5e-4, rtol=1e-6)
+
+    def test_jump_freq_range(self):
+        m0 = get_model(PAR)
+        mj = get_model(PAR + "JUMP FREQ 1200 1500 3e-4 1\n")
+        rng = np.random.default_rng(0)
+        mjds = np.sort(rng.uniform(55000, 55300, 30))
+        t = make_fake_toas_fromMJDs(
+            mjds, m0, error_us=1.0, obs="gbt", add_noise=True, seed=0,
+            iterations=1,
+            freq_mhz=np.where(np.arange(30) % 2, 1400.0, 800.0))
+        hi = (t.freq_mhz >= 1200) & (t.freq_mhz <= 1500)
+        assert hi.any() and (~hi).any()
+        r0 = np.asarray(Residuals(t, m0, subtract_mean=False).time_resids)
+        r1 = np.asarray(Residuals(t, mj, subtract_mean=False).time_resids)
+        d = r1 - r0
+        np.testing.assert_allclose(d[~hi], 0.0, atol=1e-12)
+        np.testing.assert_allclose(d[hi], -3e-4, rtol=1e-6)
+
+    def test_efac_tel_mask(self):
+        # no dash: 'tel' selects on the observatory column
+        # (dashed keys select on tim FLAGS — simulated TOAs carry none)
+        m = get_model(PAR + "EFAC tel gbt 2.0\n")
+        t = _toas(m)
+        r = Residuals(t, m)
+        sig = np.asarray(r.prepared.scaled_sigma_us())
+        np.testing.assert_allclose(sig, 2.0, rtol=1e-12)
+
+    def test_overlapping_masks_compose(self):
+        # EFAC then EQUAD on the same TOAs: sigma = sqrt((e*f)^2+q^2)
+        m = get_model(PAR + "EFAC tel gbt 2.0\nEQUAD tel gbt 3.0\n")
+        t = _toas(m)
+        sig = np.asarray(Residuals(t, m).prepared.scaled_sigma_us())
+        want = np.sqrt((2.0 * 1.0) ** 2 + (2.0 * 3.0) ** 2)
+        # EQUAD convention: added in quadrature scaled by EFAC
+        # (tempo2/PINT 'EFAC scales EQUAD' convention; equality with
+        # either convention is accepted but must be one of them)
+        alt = np.sqrt((2.0 * 1.0) ** 2 + 3.0 ** 2)
+        ok = (np.allclose(sig, want, rtol=1e-9)
+              or np.allclose(sig, alt, rtol=1e-9))
+        assert ok, sig[:3]
+
+
+# ---------------------------------------------------------------------------
+# selection state (reference: toa.py select/unselect; upstream
+# tests/test_toa_selection.py)
+# ---------------------------------------------------------------------------
+
+class TestSelectionState:
+    def test_select_unselect_stack(self):
+        m = get_model(PAR)
+        t = _toas(m, n=30)
+        n0 = len(t)
+        mjd = t.day + t.sec / 86400.0
+        t.select(mjd > 55100)
+        n1 = len(t)
+        assert n1 < n0
+        t.select(t.freq_mhz > 1000)
+        assert len(t) <= n1
+        t.unselect()
+        assert len(t) == n1
+        t.unselect()
+        assert len(t) == n0
+
+    def test_mask_returns_independent_copy(self):
+        m = get_model(PAR)
+        t = _toas(m, n=10)
+        sub = t.mask(np.arange(10) < 4)
+        assert len(sub) == 4 and len(t) == 10
+        sub.sec[0] += 1.0
+        assert t.sec[0] != sub.sec[0]
+
+    def test_adjust_times_invalidates_derived(self):
+        m = get_model(PAR)
+        t = _toas(m, n=8)
+        pos0 = t.ssb_obs.pos.copy()
+        t.adjust_times(3600.0)  # +1 hour
+        # contract: derived columns are INVALIDATED (not silently kept)
+        assert t.ssb_obs is None
+        t.compute_posvels()
+        assert np.abs(t.ssb_obs.pos - pos0).max() > 1e4  # Earth moved
+
+
+# ---------------------------------------------------------------------------
+# wideband flags (reference: simulation wideband + residuals;
+# upstream tests/test_wideband_dm_data.py)
+# ---------------------------------------------------------------------------
+
+class TestWidebandFlags:
+    def test_wideband_simulation_sets_pp_flags(self):
+        m = get_model(PAR)
+        t = _toas(m, wideband=True)
+        for f in t.flags:
+            assert "pp_dm" in f and "pp_dme" in f
+            assert np.isfinite(float(f["pp_dm"]))
+
+    def test_wideband_fit_uses_dm_channel(self):
+        from pint_tpu.fitter import WidebandTOAFitter
+
+        m = get_model(PAR)
+        t = _toas(m, n=30, wideband=True)
+        f = WidebandTOAFitter(t, m)
+        f.fit_toas()
+        assert np.isfinite(float(f.resids.chi2))
+        # DM is constrained by the DM channel even with 2 params
+        assert f.model.DM.uncertainty is not None
+
+
+# ---------------------------------------------------------------------------
+# polycos boundary behavior (reference: polycos.py; upstream
+# tests/test_polycos.py)
+# ---------------------------------------------------------------------------
+
+class TestPolycosBoundary:
+    def test_eval_at_segment_edges_continuous(self):
+        from pint_tpu.polycos import Polycos
+
+        m = get_model(PAR)
+        p = Polycos.generate_polycos(m, 55000, 55002, "gbt", 60, 8,
+                                     1400.0)
+        # evaluate just inside both sides of an internal boundary
+        eps = 1e-7
+        t_edge = 55001.0
+        # eval_abs_phase returns (int turns, frac turns)
+        i_lo, f_lo = p.eval_abs_phase(np.array([t_edge - eps]))
+        i_hi, f_hi = p.eval_abs_phase(np.array([t_edge + eps]))
+        dphi = float((np.asarray(i_hi)[0] - np.asarray(i_lo)[0])
+                     + (np.asarray(f_hi)[0] - np.asarray(f_lo)[0]))
+        f0 = 100.0
+        # continuity: phase difference ~ f0 * 2*eps*86400, not a jump
+        assert dphi == pytest.approx(f0 * 2 * eps * 86400.0, rel=0.05)
+
+    def test_eval_outside_span_raises(self):
+        from pint_tpu.polycos import Polycos
+
+        m = get_model(PAR)
+        p = Polycos.generate_polycos(m, 55000, 55001, "gbt", 60, 8,
+                                     1400.0)
+        with pytest.raises((ValueError, IndexError)):
+            p.eval_abs_phase(np.array([56000.0]))
