@@ -159,12 +159,13 @@ def _reexec_cpu(reason):
 def _full_scale_stage(meta):
     """Measured (not projected) full-scale north star: 68 pulsars at
     ragged realistic TOA counts totaling ~670k, full GLS refit
-    wall-clock. Bucketing is platform-dependent (pow2's 6 programs on
-    CPU, one padded program on TPU — see the bucket_mode comment
-    below). The expensive host pack is cached per mode in
-    .bench_cache/ (pickle of PTABatch.pack_state per bucket; both
-    modes' packs are pre-seeded by builder runs on this machine) so
-    driver re-runs only pay device time."""
+    wall-clock. Bucketing is platform-dependent (pow2's 6 programs
+    where compiles are cheap (CPU); the DP-optimal 2-program split2 on
+    TPU — see the bucket_mode comment below). The expensive host pack
+    is cached per mode in .bench_cache/ (pickle of PTABatch.pack_state
+    per bucket; the pow2, none, and split2 packs are pre-seeded by
+    builder runs on this machine) so driver re-runs only pay device
+    time."""
     import pickle
 
     import jax
@@ -174,22 +175,25 @@ def _full_scale_stage(meta):
 
     counts = _ragged_counts()
     # bucket mode: pow2 (6 compiled programs, padding x1.37) is right
-    # where compiles are cheap (CPU); on the tunneled TPU the 6-program
-    # compile marathon is what has wedged the relay, so default to ONE
-    # program padded to the fleet max (padding x3, but a single compile
-    # and far less wedge exposure). Override: PINT_TPU_BENCH_FULL_BUCKET
-    # = pow2 | none.
+    # where compiles are cheap (CPU); on the tunneled TPU each compile
+    # is wedge exposure (the r03 6-program marathon wedged the relay),
+    # so default to the optimal TWO-program split (padding x1.61 vs
+    # the r03 one-program x3.05 — PTAFleet.optimal_split_bounds DP).
+    # Override: PINT_TPU_BENCH_FULL_BUCKET = pow2 | none | split<k>.
     platform = jax.devices()[0].platform
-    default_mode = "none" if platform == "tpu" else "pow2"
+    default_mode = "split2" if platform == "tpu" else "pow2"
     bucket_mode = os.environ.get("PINT_TPU_BENCH_FULL_BUCKET",
                                  default_mode).strip().lower()
-    if bucket_mode not in ("pow2", "none"):
+    valid = (bucket_mode in ("pow2", "none")
+             or (bucket_mode.startswith("split")
+                 and bucket_mode[5:].isdigit() and int(bucket_mode[5:]) > 0))
+    if not valid:
         # never die (or silently change modes) on an env typo — the
         # stage must stay self-consistent with its recorded metadata
         _stage(f"invalid PINT_TPU_BENCH_FULL_BUCKET={bucket_mode!r}; "
                f"using platform default {default_mode!r}")
         bucket_mode = default_mode
-    toa_bucket = None if bucket_mode == "none" else "pow2"
+    toa_bucket = None if bucket_mode == "none" else bucket_mode
     cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              ".bench_cache")
     cache_path = os.path.join(

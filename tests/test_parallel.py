@@ -697,3 +697,56 @@ def test_pta_pack_state_roundtrip():
     np.testing.assert_array_equal(np.asarray(x2), np.asarray(x_ref))
     np.testing.assert_array_equal(np.asarray(chi2_2), np.asarray(chi2_ref))
     np.testing.assert_array_equal(np.asarray(cov2), np.asarray(cov_ref))
+
+
+def test_fleet_splitk_optimal_bucketing():
+    """toa_bucket="split2": the DP threshold split gives <=2 programs
+    per structure, beats one-program padding, and returns per-pulsar
+    results identical to the unbucketed fleet. The DP itself is
+    checked against brute force on random count sets."""
+    from pint_tpu.parallel import PTAFleet
+
+    # DP vs brute force over all single thresholds (k=2)
+    rng = np.random.default_rng(5)
+    for _ in range(20):
+        counts = rng.integers(50, 5000, rng.integers(3, 12))
+        c = np.sort(counts)
+        n = len(c)
+        bounds = PTAFleet.optimal_split_bounds(counts, 2)
+        area = sum(len([x for x in c if (x <= bounds[0] if j == 0 else
+                                         bounds[0] < x <= bounds[-1])])
+                   * bounds[min(j, len(bounds) - 1)]
+                   for j in range(len(bounds)))
+        brute = min((int(np.sum(np.where(c <= c[k - 1], c[k - 1], c[-1])))
+                     if k else n * int(c[-1]))
+                    for k in range(n))
+        assert area == brute, (counts, bounds, area, brute)
+
+    models, toas_list, _ = _batch(4, base_toas=30)
+    big_m = copy.deepcopy(models[0])
+    mjds = np.sort(rng.uniform(55000, 56000, 600))
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    big_t = make_fake_toas_fromMJDs(
+        mjds, big_m, error_us=1.0,
+        freq_mhz=np.where(np.arange(600) % 2, 1400.0, 800.0), obs="gbt",
+        add_noise=True, seed=78)
+    models = [copy.deepcopy(m) for m in models] + [big_m]
+    toas_list = toas_list + [big_t]
+
+    flat = PTAFleet([copy.deepcopy(m) for m in models], toas_list)
+    fleet = PTAFleet([copy.deepcopy(m) for m in models], toas_list,
+                     toa_bucket="split2")
+    assert len(fleet.batches) == 2
+    assert fleet.padding_ratio < flat.padding_ratio
+    x_flat, chi2_flat, _ = flat.fit(method="wls", maxiter=3)
+    x_b, chi2_b, _ = fleet.fit(method="wls", maxiter=3)
+    for i in range(len(models)):
+        np.testing.assert_allclose(x_b[i], x_flat[i], rtol=1e-8)
+
+    import pytest
+
+    with pytest.raises(ValueError, match="split"):
+        PTAFleet(models, toas_list, toa_bucket="split0")
+    with pytest.raises(ValueError, match="toa_bucket"):
+        PTAFleet(models, toas_list, toa_bucket="banana")
