@@ -153,7 +153,9 @@ def _reexec_cpu(reason):
                        env=env, stdout=subprocess.PIPE, text=True)
     sys.stdout.write(r.stdout)
     sys.stdout.flush()
-    os._exit(r.returncode if r.stdout.strip() else 1)
+    # the headline JSON made it out -> success, whatever teardown did
+    # in the child (the driver keys ok off THIS process's rc)
+    os._exit(0 if r.stdout.strip() else (r.returncode or 1))
 
 
 def _full_scale_stage(meta):
@@ -404,6 +406,7 @@ def main():
     import threading
 
     full_meta = {}
+    full_alive = False
     full_timeout = float(os.environ.get("PINT_TPU_BENCH_FULL_TIMEOUT",
                                         "1500"))
     if os.environ.get("PINT_TPU_BENCH_SKIP_FULL") == "1":
@@ -426,7 +429,8 @@ def main():
                                    args=(full_meta,), daemon=True)
         th_full.start()
         th_full.join(timeout=full_timeout)
-        if th_full.is_alive():
+        full_alive = th_full.is_alive()
+        if full_alive:
             if os.environ.get("_PINT_TPU_BENCH_REEXEC"):
                 # already the CPU fallback child: abandon the worker's
                 # sink dict and flag that the still-running stage
@@ -567,9 +571,11 @@ def main():
         "vs_baseline": round(vs_baseline, 3),
         "detail": meta,
     }), flush=True)
-    if wedged:
-        # a daemon thread stuck in a C++ device wait can hang normal
-        # interpreter teardown; the JSON is out, leave now
+    if wedged or full_alive:
+        # a daemon thread stuck in a C++ device wait can hang (or a
+        # still-live dropped full-scale worker can crash) normal
+        # interpreter teardown — measured rc=250 from exactly that;
+        # the JSON is out, leave now
         os._exit(0)
 
 

@@ -22,7 +22,8 @@ from .lcprimitives import (LCGaussian, LCLorentzian, LCSkewGaussian,  # noqa: E4
 from .lcnorm import NormAngles, angles_from_norms, norms_from_angles  # noqa: E402,F401
 from .lctemplate import (LCTemplate, LCEmpiricalFourier,  # noqa: E402,F401
                          gauss_template_from_file, write_gauss_template)
-from .lcprimitives import LCHarmonic, LCTopHat  # noqa: E402,F401
+from .lcprimitives import (LCHarmonic, LCKernelDensity,  # noqa: E402,F401
+                           LCTopHat)
 from .lceprimitives import (LCEGaussian, LCELorentzian,  # noqa: E402,F401
                             LCEVonMises)
 from .lcfitters import LCFitter  # noqa: E402,F401
