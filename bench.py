@@ -153,9 +153,11 @@ def _reexec_cpu(reason):
                        env=env, stdout=subprocess.PIPE, text=True)
     sys.stdout.write(r.stdout)
     sys.stdout.flush()
-    # the headline JSON made it out -> success, whatever teardown did
-    # in the child (the driver keys ok off THIS process's rc)
-    os._exit(0 if r.stdout.strip() else (r.returncode or 1))
+    # success iff the headline METRIC actually made it out (not just
+    # any stdout bytes), whatever teardown did in the child — the
+    # driver keys ok off THIS process's rc
+    ok = '"pta_gls_refit_toas_per_sec"' in r.stdout
+    os._exit(0 if ok else (r.returncode or 1))
 
 
 def _full_scale_stage(meta):

@@ -112,6 +112,17 @@ def read_fits(path):
     BINTABLE HDUs, None otherwise (image data is skipped)."""
     hdus = []
     with open(path, "rb") as fh:
+        # reject non-FITS input up front: the primary header MUST begin
+        # with a SIMPLE card (FITS standard 3.0 section 4.4.1); without
+        # this check arbitrary bytes "parse" into an empty HDU list and
+        # the caller sees a confusing missing-extension error instead
+        # of the real problem
+        magic = fh.read(6)
+        fh.seek(0)
+        if magic != b"SIMPLE":
+            raise ValueError(
+                f"{path!r} is not a FITS file (primary header does not "
+                f"begin with SIMPLE)")
         while True:
             header = _read_header(fh)
             if header is None:

@@ -197,3 +197,116 @@ def test_fermi_calc_weights(tmp_path):
     # CALC without a target position is a clear error
     with pytest.raises(ValueError, match="targetcoord"):
         load_Fermi_TOAs(str(path), weightcolumn="CALC")
+
+
+# ---------------------------------------------------------------------------
+# r4 edge cases (upstream analogs: tests/test_event_toas.py pathologies,
+# tests/test_satobs.py span/unit handling, io robustness)
+# ---------------------------------------------------------------------------
+
+def test_event_minmjd_maxmjd_window(tmp_path):
+    mjds = 56700.0 + np.linspace(0, 10, 50)
+    p = tmp_path / "evt.fits"
+    _write_events(p, mjds, timesys="TDB")
+    t = load_event_TOAs(p, "nicer", minmjd=56702.0, maxmjd=56705.0)
+    f = t.day + t.sec / 86400.0
+    assert len(t) and (f >= 56702.0 - 1e-9).all() and (f <= 56705.0 + 1e-9).all()
+
+
+def test_event_weightcolumn_flags(tmp_path):
+    mjds = 56700.0 + np.linspace(0, 1, 20)
+    w = np.linspace(0.1, 0.9, 20)
+    p = tmp_path / "evtw.fits"
+    _write_events(p, mjds, timesys="TDB", weights=w)
+    t = load_event_TOAs(p, "nicer", weightcolumn="PSRPROB")
+    got = get_event_weights(t)
+    np.testing.assert_allclose(got, w, rtol=1e-7)
+
+
+def test_event_tdb_native_goes_barycentric(tmp_path):
+    # TIMESYS TDB photons are barycentric: obs must be barycenter and
+    # the posvel chain must produce ZERO observatory offset
+    mjds = 56700.0 + np.linspace(0, 1, 5)
+    p = tmp_path / "evtb.fits"
+    _write_events(p, mjds, timesys="TDB")
+    t = load_event_TOAs(p, "nicer")
+    assert set(t.obs.astype(str)) == {"barycenter"}
+    t.apply_clock_corrections()
+    t.compute_TDBs()
+    t.compute_posvels()
+    assert np.abs(np.asarray(t.ssb_obs.pos)).max() == 0.0
+
+
+def test_fits_reader_rejects_non_fits(tmp_path):
+    p = tmp_path / "not.fits"
+    p.write_bytes(b"definitely not a FITS file" * 100)
+    with pytest.raises((ValueError, KeyError, OSError)):
+        read_fits(str(p))
+
+
+def test_fits_reader_truncated_file(tmp_path):
+    # write a valid file then truncate mid-data: must raise, not hang
+    # or return garbage silently
+    mjds = 56700.0 + np.linspace(0, 1, 100)
+    p = tmp_path / "trunc.fits"
+    _write_events(p, mjds, timesys="TDB")
+    data = p.read_bytes()
+    p.write_bytes(data[:len(data) // 2])
+    with pytest.raises((ValueError, KeyError, OSError, EOFError)):
+        get_table(str(p), "EVENTS")
+
+
+def test_get_table_missing_extension(tmp_path):
+    mjds = 56700.0 + np.linspace(0, 1, 5)
+    p = tmp_path / "evt.fits"
+    _write_events(p, mjds, timesys="TDB")
+    with pytest.raises(KeyError):
+        get_table(str(p), "NOPE")
+
+
+def test_satellite_km_unit_orbit_normalized(tmp_path):
+    # FPorbit-style tables in km must be converted to m (radius check)
+    from pint_tpu.io.fits import write_fits_table
+    from pint_tpu.observatory.satellite_obs import SatelliteObs
+
+    mjdref = MISSION_MJDREF["nicer"]
+    met = np.arange(0, 86400, 60.0)
+    r_km = 6980.0
+    ang = 2 * np.pi * met / 5700.0
+    pos_km = np.stack([r_km * np.cos(ang), r_km * np.sin(ang),
+                       np.zeros_like(ang)], axis=-1)
+    p = tmp_path / "orb_km.fits"
+    write_fits_table(p, {"TIME": met, "POSITION": pos_km},
+                     {"MJDREFI": int(mjdref),
+                      "MJDREFF": mjdref - int(mjdref)}, extname="ORBIT")
+    ob = SatelliteObs.from_orbit_file("nicer", p)
+    r = np.linalg.norm(ob.pos_m[0])
+    assert r == pytest.approx(r_km * 1e3, rel=1e-12)
+
+
+def test_satellite_unsorted_orbit_sorted(tmp_path):
+    from pint_tpu.observatory.satellite_obs import SatelliteObs
+
+    met = np.array([300.0, 100.0, 200.0, 0.0])
+    pos = np.stack([met * 10, met * 0, met * 0], axis=-1) + 7e6
+    ob = SatelliteObs("nicer", met, pos)
+    assert (np.diff(ob.met_s) > 0).all()
+    assert ob.pos_m[0, 0] == pytest.approx(7e6)  # met=0 row first
+
+
+def test_satellite_out_of_span_raises():
+    from pint_tpu.observatory.satellite_obs import SatelliteObs
+    from pint_tpu.mjd import Epochs
+    from pint_tpu.timescales import tt_to_tdb
+
+    mjdref = MISSION_MJDREF["nicer"]
+    met = np.arange(0, 3600, 30.0)
+    ang = 2 * np.pi * met / 5700.0
+    pos = np.stack([6.98e6 * np.cos(ang), 6.98e6 * np.sin(ang),
+                    np.zeros_like(ang)], axis=-1)
+    ob = SatelliteObs("nicer", met, pos, mjdref=mjdref)
+    # an epoch ~1 day past the orbit span
+    day = int(mjdref) + 1
+    tt = Epochs(np.array([day], np.int64), np.array([40000.0]), "tt")
+    with pytest.raises(ValueError, match="orbit"):
+        ob.posvel_ssb(tt_to_tdb(tt), None, "de440s")
