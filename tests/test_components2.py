@@ -202,3 +202,116 @@ def test_piecewise_pwf2_and_validation():
 
     with pytest.raises(MissingParameter):
         get_model(BASE + "PWEP_0001 55100\nPWF0_0001 1e-8\n")
+
+
+def test_swm1_power_law_wind():
+    """SWM 1 (r^-SWP power-law wind, reference:
+    solar_wind_dispersion.py SWM 1): SWP=2 reproduces the SWM 0
+    spherical model exactly (the cos-power quadrature is exact for
+    p=2); SWP>2 concentrates DM toward conjunction; an injected
+    SWP is recovered by fitting it, proving differentiability through
+    the Gauss-Legendre geometry kernel."""
+    import numpy as np
+
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+    from pint_tpu.fitter import DownhillWLSFitter
+
+    base = ("PSR SWM1T\nRAJ 05:00:00\nDECJ 02:00:00\nF0 200.0 1\n"
+            "PEPOCH 55300\nDM 10.0 1\n")
+    m0 = get_model(base + "SWM 0\nNE_SW 8.0\n")
+    m1 = get_model(base + "SWM 1\nNE_SW 8.0\nSWP 2.0\n")
+    mjds = np.linspace(55000.0, 55365.0, 120)
+    t = make_fake_toas_fromMJDs(mjds, m0, error_us=1.0, obs="gbt",
+                                iterations=0)
+    d0 = np.asarray(m0.total_dm(t))
+    d1 = np.asarray(m1.total_dm(t))
+    np.testing.assert_allclose(d1, d0, rtol=0, atol=1e-12)
+
+    # p=2.5: bigger DM excess near conjunction, and par round-trip
+    m25 = get_model(base + "SWM 1\nNE_SW 8.0\nSWP 2.5\n")
+    d25 = np.asarray(m25.total_dm(t))
+    assert (d25 - 10.0).max() != (d0 - 10.0).max()
+    m25b = get_model(m25.as_parfile())
+    assert m25b.SWM.value == 1.0 and m25b.SWP.value == 2.5
+
+    # recover an injected SWP by fitting (NE_SW fixed, SWP free)
+    m_true = get_model(base + "SWM 1\nNE_SW 20.0\nSWP 2.6\n")
+    t_sim = make_fake_toas_fromMJDs(mjds, m_true, error_us=0.5, obs="gbt",
+                                    add_noise=True, seed=4, iterations=2)
+    m_fit = get_model(base.replace("DM 10.0 1", "DM 10.0")
+                      + "SWM 1\nNE_SW 20.0\nSWP 2.2 1\n")
+    f = DownhillWLSFitter(t_sim, m_fit)
+    f.fit_toas(maxiter=12)
+    assert f.model.SWP.value == pytest.approx(2.6, abs=0.15), \
+        f.model.SWP.value
+
+    # SWM 2 and divergent SWP rejected
+    with pytest.raises(ValueError, match="SWM"):
+        get_model(base + "SWM 2\nNE_SW 8.0\n")
+    with pytest.raises(ValueError, match="SWP"):
+        get_model(base + "SWM 1\nNE_SW 8.0\nSWP 0.9\n")
+
+
+def test_cospow_integral_accuracy_all_regimes():
+    """The solar-wind cos-power quadrature (tanh-sinh + closed-form
+    half-range) vs dense reference integration: <= 1e-10 absolute
+    across p in [1.2, 6] and the full elongation range (measured
+    2.4e-12 worst), and a finite p-gradient everywhere (SWP
+    fitting)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pint_tpu.models.solar_wind import _cospow_integral
+
+    def ref(phi_hi, p, n=400_001):
+        u = (np.arange(n) + 0.5) / n
+        psi = phi_hi * u
+        return phi_hi * np.mean(np.cos(psi) ** (p - 2.0))
+
+    for p in (1.2, 1.5, 1.9, 2.0, 2.5, 3.7, 5.0):
+        for phi in (-1.4, -0.7, 0.5, 1.2, 1.5):
+            got = float(_cospow_integral(jnp.array([phi]),
+                                         jnp.array([p]))[0])
+            want = ref(phi, p)
+            assert abs(got - want) < 1e-10, (p, phi, got, want)
+    for p0 in (1.5, 3.0):
+        g = jax.grad(lambda pp: jnp.sum(_cospow_integral(
+            jnp.array([0.7]), pp * jnp.ones(1))))(p0)
+        assert np.isfinite(float(g))
+
+
+def test_swp_free_under_swm0_rejected():
+    """Freeing SWP with SWM 0 would put an identically-zero column in
+    the design matrix; validate() must reject it (r4 review), and
+    SWP 0.0 under SWM 1 must not slip through a falsy-zero fallback."""
+    import pytest
+
+    from pint_tpu.models import get_model
+
+    base = ("PSR SWV2\nRAJ 05:00:00\nDECJ 02:00:00\nF0 200.0 1\n"
+            "PEPOCH 55300\nDM 10.0\n")
+    with pytest.raises(ValueError, match="SWP"):
+        get_model(base + "NE_SW 8.0\nSWP 2.5 1\n")
+    with pytest.raises(ValueError, match="SWP"):
+        get_model(base + "SWM 1\nNE_SW 8.0\nSWP 0.0\n")
+
+
+def test_swxp_window_divergence_guard():
+    """The per-window SWXP_#### gets the same SWP > 1 divergence guard
+    as the base parameter (r4 review: _cospow_half(1.0) is inf, so an
+    unguarded window would silently produce inf delays)."""
+    import pytest
+
+    from pint_tpu.models import get_model
+
+    base = ("PSR SWV3\nRAJ 05:00:00\nDECJ 02:00:00\nF0 200.0 1\n"
+            "PEPOCH 55300\nDM 10.0\nSWM 0\nNE_SW 4.0\n"
+            "SWX_0001 5.0 1\nSWXR1_0001 55000\nSWXR2_0001 55600\n")
+    with pytest.raises(ValueError, match="SWXP"):
+        get_model(base + "SWXP_0001 1.0\n")
+    with pytest.raises(ValueError, match="SWXP"):
+        get_model(base + "SWXP_0001 0.0\n")
+    m = get_model(base + "SWXP_0001 2.3\n")  # valid index loads fine
+    assert m.SWXP_0001.value == 2.3

@@ -236,6 +236,7 @@ def test_random_model_configurations_fuzz():
               "IFUNC2 55300 1e-6\nIFUNC3 55500 0.0\n",
               "SWM 0\nNE_SW 4.0\nSWX_0001 5.0 1\nSWXR1_0001 55000\n"
               "SWXR2_0001 55600\n",
+              "SWM 1\nNE_SW 5.0 1\nSWP 2.4 1\n",
               "CM 0.02 1\nTNCHROMIDX 4\nPHOFF 0.01 1\n"]
     noises = ["", "EFAC -f L-wide 1.2\nEQUAD -f L-wide 0.4\n",
               "ECORR -f L-wide 0.6\nTNREDAMP -13.5\nTNREDGAM 3.5\nTNREDC 8\n"]
