@@ -434,7 +434,17 @@ class PLSWNoise(_PLScaledNoise):
 
         n_hat = np.asarray(astrom.ssb_to_psb_xyz(params0, prep))
         sun_ls = toas.obs_sun.pos / C_M_S
-        geom_pc = np.asarray(solar_wind_geometry_p(sun_ls, n_hat, 2.0))
+        # the EFFECTIVE wind profile index, not hardcoded 2: under
+        # SWM 1 the deterministic d(delay)/d(NE_SW) is the r^-SWP
+        # geometry, and the GP basis must match it or conjunction
+        # epochs are mis-weighted relative to the wind being fit
+        sw = model.components.get("SolarWindDispersionX",
+                                  model.components.get(
+                                      "SolarWindDispersion"))
+        p_eff = 2.0
+        if int(sw.SWM.value or 0) == 1 and sw.SWP.value is not None:
+            p_eff = float(sw.SWP.value)
+        geom_pc = np.asarray(solar_wind_geometry_p(sun_ls, n_hat, p_eff))
         with np.errstate(divide="ignore"):
             per_f2 = np.where(np.isfinite(toas.freq_mhz),
                               1.0 / np.square(toas.freq_mhz), 0.0)

@@ -150,11 +150,17 @@ def _cospow_integral(phi_hi, p):
     integrated with the fixed 81-node tanh-sinh rule above — one
     static node set handles the t^(p-2) endpoint behavior for every
     p. Measured vs dense reference integration (pinned in
-    tests/test_components2.py): <= 2.4e-12 ABSOLUTE over
-    p in [1.2, 6] x the full elongation range, i.e. exact at the
-    f64 level for timing purposes. Differentiable in p (gammaln +
-    smooth quadrature; the truncated tail grows as
-    exp(-(p-1) pi sinh 4.5) toward p -> 1, ~1e-6 by p = 1.1).
+    tests/test_components2.py): <= 2.4e-12 ABSOLUTE for
+    p in [1.2, 6] over elongations away from exact anti-solar
+    alignment (phi_hi >= -1.5); in the last ~0.07 rad toward the
+    anti-solar pole the sinc^(p-2) factor develops a t=1 near-
+    singularity and small p degrades to ~3e-4 absolute (~6e-5
+    relative of |F|~5) at the clipped phi_hi = -(pi/2 - 1e-6)
+    extreme — sub-1e-7 pc cm^-3 of far-side DM, far below timing
+    relevance, and pinned by the same test. Exact for
+    p = 2. Differentiable in p (gammaln + smooth quadrature; the
+    truncated tail grows as exp(-(p-1) pi sinh 4.5) toward p -> 1,
+    ~1e-6 by p = 1.1).
     """
     import jax.numpy as jnp
 

@@ -231,7 +231,9 @@ def test_swm1_power_law_wind():
     # p=2.5: bigger DM excess near conjunction, and par round-trip
     m25 = get_model(base + "SWM 1\nNE_SW 8.0\nSWP 2.5\n")
     d25 = np.asarray(m25.total_dm(t))
-    assert (d25 - 10.0).max() != (d0 - 10.0).max()
+    # steeper profile -> MORE DM at the conjunction peak, not merely
+    # different (a garbage SWM 1 path could still satisfy !=)
+    assert (d25 - 10.0).max() > (d0 - 10.0).max()
     m25b = get_model(m25.as_parfile())
     assert m25b.SWM.value == 1.0 and m25b.SWP.value == 2.5
 
@@ -276,6 +278,14 @@ def test_cospow_integral_accuracy_all_regimes():
                                          jnp.array([p]))[0])
             want = ref(phi, p)
             assert abs(got - want) < 1e-10, (p, phi, got, want)
+        # anti-solar extreme (the sin_t=1e-6 clip's farthest reach):
+        # degraded but bounded — ~3e-4 absolute (|F|~5, so ~6e-5
+        # relative) at p=1.2; the midpoint reference is itself
+        # endpoint-singular here, so the band covers both
+        phi = -(np.pi / 2 - 1e-6)
+        got = float(_cospow_integral(jnp.array([phi]), jnp.array([p]))[0])
+        want = ref(phi, p, n=4_000_001)
+        assert abs(got - want) < 1e-3, (p, got, want)
     for p0 in (1.5, 3.0):
         g = jax.grad(lambda pp: jnp.sum(_cospow_integral(
             jnp.array([0.7]), pp * jnp.ones(1))))(p0)

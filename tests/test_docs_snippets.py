@@ -1,0 +1,36 @@
+"""Execute the python code blocks of every docs/*.md tutorial: the
+documentation must never drift from the actual API (upstream pins this
+with executed example notebooks in CI)."""
+
+import os
+import re
+
+import pytest
+
+DOCS = os.path.join(os.path.dirname(__file__), "..", "docs")
+
+# docs whose python blocks are fully self-contained (no user files):
+# these EXECUTE; all other docs' blocks are still compile-checked so
+# the syntax can't rot
+_EXECUTABLE = {"tutorial_wideband.md", "tutorial_noise.md"}
+
+
+def _blocks(name):
+    text = open(os.path.join(DOCS, name)).read()
+    return re.findall(r"```python\n(.*?)```", text, re.S)
+
+
+@pytest.mark.parametrize("doc", sorted(
+    f for f in os.listdir(DOCS) if f.endswith(".md")))
+def test_doc_python_blocks_execute(doc):
+    blocks = _blocks(doc)
+    if not blocks:
+        pytest.skip("no python blocks")
+    ns = {}
+    for i, src in enumerate(blocks):
+        try:
+            code = compile(src, f"{doc}[block {i}]", "exec")
+        except SyntaxError:
+            pytest.fail(f"{doc} block {i} does not parse")
+        if doc in _EXECUTABLE:
+            exec(code, ns)  # shared namespace: blocks build on earlier
