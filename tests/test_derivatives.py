@@ -221,3 +221,35 @@ def test_swx_dm_derivative_columns():
     scale = max(np.abs(dnum).max(), np.abs(dana).max())
     assert scale > 0
     assert np.abs(dana - dnum).max() / scale < 2e-5
+
+
+def test_swp_derivative_matches_finite_difference():
+    """d(phase)/d(SWP) under SWM 1 — the only parameter whose
+    derivative flows through the tanh-sinh cos-power quadrature —
+    matches central finite differences (autodiff-vs-numeric pattern,
+    upstream test_derivative_utils analog for SWM 1)."""
+    m = get_model("PSR DSWP\nRAJ 05:00:00\nDECJ 02:00:00\nF0 200.0 1\n"
+                  "PEPOCH 55300\nDM 10.0\nSWM 1\nNE_SW 12.0\nSWP 2.4 1\n")
+    t = make_fake_toas_fromMJDs(np.linspace(55000, 55365, 80), m,
+                                error_us=1.0, obs="gbt", iterations=0)
+    prepared = m.prepare(t)
+    dm_fn, labels = prepared.designmatrix_fn()
+    names = [n for n, _, _ in prepared.free_param_map()]
+    j = names.index("SWP")
+    off = 1 if labels[0] == "Offset" else 0
+    x0 = np.asarray(prepared.vector_from_params())
+    M = np.asarray(dm_fn(prepared.vector_from_params()))
+    phase_fn = jax.jit(
+        lambda x: prepared._phase_continuous(prepared.params_with_vector(x)))
+    # larger step than the generic battery: the SWP column is tiny
+    # (~1.6e-4 cycles per unit index), so differencing the ~cycles-
+    # scale phase at h=1e-5 is dominated by f64 cancellation noise
+    h = 3e-3
+    xp, xm = x0.copy(), x0.copy()
+    xp[j] += h
+    xm[j] -= h
+    dnum = (np.asarray(phase_fn(xp)) - np.asarray(phase_fn(xm))) / (2 * h)
+    dana = M[:, off + j]
+    scale = max(np.abs(dnum).max(), np.abs(dana).max())
+    assert scale > 0
+    assert np.abs(dana - dnum).max() / scale < 2e-4
