@@ -6,6 +6,29 @@
 from __future__ import annotations
 
 
+def choose_t2_model(keys: set) -> str:
+    """Pick the concrete binary model for a tempo2 "BINARY T2"
+    parameter set (T2 is a universal container; what's present decides):
+    KIN/KOM -> DDK, EPS1/EPS2 (+H3/H4/STIG) -> ELL1/ELL1H,
+    H3/STIG alone -> DDH, ECC/OM + M2/SINI -> DD, else BT.
+    Single home for the rule — scripts/t2binary2pint.py imports it.
+    Expects UPPERCASE par keys; only meaningful for PAR-FILE key sets
+    (the par loader applies it; add_binary_component deliberately
+    still rejects 'T2' so programmatic converts can't silently pick a
+    wrong model from non-par keys)."""
+    if "KIN" in keys or "KOM" in keys:
+        return "DDK"
+    if "EPS1" in keys or "EPS2" in keys:
+        if "H3" in keys or "H4" in keys or "STIGMA" in keys or "STIG" in keys:
+            return "ELL1H"
+        return "ELL1"
+    if "H3" in keys or "STIGMA" in keys or "STIG" in keys:
+        return "DDH"  # eccentric orbit with orthometric Shapiro
+    if "M2" in keys or "SINI" in keys or "SHAPMAX" in keys:
+        return "DD"
+    return "BT"
+
+
 def add_binary_component(model, binary_name: str, keys: dict):
     import importlib
 

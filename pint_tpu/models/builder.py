@@ -182,6 +182,19 @@ def get_model(parfile, allow_name_mixing=False, allow_tcb=False) -> TimingModel:
 
         model.add_component(DispersionJump())
     if "BINARY" in keys:
+        if keys["BINARY"][0].upper() == "T2":
+            # tempo2's universal container: pick the concrete model
+            # from the PAR keys present (valid only here, where keys
+            # really are par-file keys — programmatic convert_binary
+            # targets still reject 'T2')
+            from .binary import choose_t2_model
+
+            chosen = choose_t2_model(set(keys))
+            warnings.warn(
+                f"BINARY T2 is a tempo2 container model; selected "
+                f"BINARY {chosen} from the parameters present (persist "
+                f"the choice with scripts/t2binary2pint.py)")
+            keys["BINARY"] = [chosen]
         from .binary import add_binary_component
 
         add_binary_component(model, keys["BINARY"][0], keys)

@@ -20,19 +20,7 @@ import re
 import sys
 
 
-def choose_model(keys: set[str]) -> str:
-    """Pick the concrete binary model for a T2 parameter set."""
-    if "KIN" in keys or "KOM" in keys:
-        return "DDK"
-    if "EPS1" in keys or "EPS2" in keys:
-        if "H3" in keys or "H4" in keys or "STIGMA" in keys or "STIG" in keys:
-            return "ELL1H"
-        return "ELL1"
-    if "H3" in keys or "STIGMA" in keys or "STIG" in keys:
-        return "DDH"  # eccentric orbit with orthometric Shapiro
-    if "M2" in keys or "SINI" in keys or "SHAPMAX" in keys:
-        return "DD"
-    return "BT"
+from ..models.binary import choose_t2_model as choose_model  # single home
 
 
 def convert_t2_par(text: str) -> tuple[str, str]:

@@ -494,3 +494,45 @@ def test_ell1k_rotating_eccentricity_vector():
                     f"EPS1 {e1}\nEPS2 {e2}\n")
     d_0 = np.asarray(m_0.prepare(t).delay())
     assert np.abs(d_0 - d_k).max() > 1e-5
+
+
+def test_binary_t2_container_auto_selects():
+    """BINARY T2 (tempo2's universal container) auto-selects the
+    concrete model from the parameters present — same rules as
+    scripts/t2binary2pint.py — warns, loads, fits, and round-trips as
+    the chosen model (reference: upstream points users at the
+    conversion script; selecting on load is the conversion applied
+    in-memory)."""
+    import warnings as w
+
+    import numpy as np
+    import pytest
+
+    from pint_tpu.fitter import WLSFitter
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    base = ("PSR T2SEL\nRAJ 05:00:00\nDECJ 01:00:00\nF0 100.0 1\n"
+            "PEPOCH 55000\nDM 10.0\nBINARY T2\nPB 10.0\nA1 5.0\n")
+    cases = (
+        ("KOM 90\nKIN 60\nECC 0.01\nOM 30\nT0 55000\nM2 0.3\nPX 1.0\n",
+         "BinaryDDK"),
+        ("EPS1 1e-5 1\nEPS2 2e-5\nTASC 55000\n", "BinaryELL1"),
+        ("ECC 0.01 1\nOM 30\nT0 55000\nM2 0.3\nSINI 0.9\n", "BinaryDD"),
+        ("ECC 0.01 1\nOM 30\nT0 55000\n", "BinaryBT"),
+    )
+    for extra, want in cases:
+        with pytest.warns(UserWarning, match="T2"):
+            with w.catch_warnings():
+                w.simplefilter("always")
+                m = get_model(base + extra)
+        assert want in m.components, (want, list(m.components))
+        # round-trips as the CONCRETE model (conversion persisted)
+        m2 = get_model(m.as_parfile())
+        assert want in m2.components
+        t = make_fake_toas_fromMJDs(np.linspace(55000, 55200, 30), m,
+                                    error_us=1.0, obs="gbt",
+                                    add_noise=True, seed=1, iterations=1)
+        f = WLSFitter(t, m2)
+        f.fit_toas(maxiter=2)
+        assert np.isfinite(float(f.resids.chi2))
