@@ -10,7 +10,8 @@ def choose_t2_model(keys: set) -> str:
     """Pick the concrete binary model for a tempo2 "BINARY T2"
     parameter set (T2 is a universal container; what's present decides):
     KIN/KOM -> DDK, EPS1/EPS2 (+H3/H4/STIG) -> ELL1/ELL1H,
-    H3/STIG alone -> DDH, ECC/OM + M2/SINI -> DD, else BT.
+    H3/STIG alone -> DDH, SHAPMAX -> DDS, ECC/OM + M2/SINI -> DD,
+    else BT.
     Single home for the rule — scripts/t2binary2pint.py imports it.
     Expects UPPERCASE par keys; only meaningful for PAR-FILE key sets
     (the par loader applies it; add_binary_component deliberately
@@ -24,7 +25,10 @@ def choose_t2_model(keys: set) -> str:
         return "ELL1"
     if "H3" in keys or "STIGMA" in keys or "STIG" in keys:
         return "DDH"  # eccentric orbit with orthometric Shapiro
-    if "M2" in keys or "SINI" in keys or "SHAPMAX" in keys:
+    if "SHAPMAX" in keys:
+        return "DDS"  # SHAPMAX is DDS's defining parameter — mapping
+        # it to DD would silently drop the Shapiro shape (r4 review)
+    if "M2" in keys or "SINI" in keys:
         return "DD"
     return "BT"
 

@@ -503,8 +503,6 @@ def test_binary_t2_container_auto_selects():
     the chosen model (reference: upstream points users at the
     conversion script; selecting on load is the conversion applied
     in-memory)."""
-    import warnings as w
-
     import numpy as np
     import pytest
 
@@ -519,13 +517,13 @@ def test_binary_t2_container_auto_selects():
          "BinaryDDK"),
         ("EPS1 1e-5 1\nEPS2 2e-5\nTASC 55000\n", "BinaryELL1"),
         ("ECC 0.01 1\nOM 30\nT0 55000\nM2 0.3\nSINI 0.9\n", "BinaryDD"),
+        ("ECC 0.01 1\nOM 30\nT0 55000\nM2 1.1\nSHAPMAX 2.0\n",
+         "BinaryDDS"),
         ("ECC 0.01 1\nOM 30\nT0 55000\n", "BinaryBT"),
     )
     for extra, want in cases:
         with pytest.warns(UserWarning, match="T2"):
-            with w.catch_warnings():
-                w.simplefilter("always")
-                m = get_model(base + extra)
+            m = get_model(base + extra)
         assert want in m.components, (want, list(m.components))
         # round-trips as the CONCRETE model (conversion persisted)
         m2 = get_model(m.as_parfile())
