@@ -4,10 +4,19 @@ TPU-native replacement for the reference's longdouble MJD handling
 (reference: src/pint/pulsar_mjd.py — PulsarMJD Time format,
 mjds_to_jds/jds_to_mjds and the (jd1, jd2) split inside astropy Time).
 
-Design: an epoch is ``(day: int64, sec: float64)`` with 0 <= sec < 86400.
+Design: an epoch is ``(day: int64, sec: float64, lo: float64)`` with
+0 <= sec < 86400 and ``lo`` a compensation term (|lo| <= ulp(sec)/2;
+the represented instant is day*86400 + sec + lo seconds).
 - ``day`` is the integer MJD in the relevant timescale.
 - ``sec`` is seconds within the day; f64 resolution on 86400 is ~20 ps,
   well under the ~1 ns target.
+- ``lo`` exists because a *single* f64 sec cannot survive timescale
+  shifts exactly: adding TAI-UTC=37 s to a sec just below 2^16 lands
+  just above 2^16, where the representable grid is twice as coarse —
+  a pigeonhole argument shows no single-f64 scheme can round-trip
+  UTC<->TAI exactly. Carrying the two_sum rounding error in ``lo``
+  makes every scale conversion exactly invertible (test_property.py::
+  test_utc_tai_roundtrip) at the cost of one extra f64 per epoch.
 Differences between epochs are formed as double-double seconds
 (day difference * 86400 is exact in f64 for any realistic span), which
 is what the device-side phase computation consumes (see pint_tpu.dd).
@@ -27,50 +36,83 @@ from .constants import SECS_PER_DAY
 LD = np.longdouble  # x86 80-bit on the host; never on device
 
 
+def _two_sum(a, b):
+    """Knuth two-sum: (s, e) with s = fl(a+b) and s + e == a + b exactly."""
+    s = a + b
+    bb = s - a
+    e = (a - (s - bb)) + (b - bb)
+    return s, e
+
+
 @dataclass
 class Epochs:
-    """Array-of-epochs in some timescale: integer day + seconds-of-day."""
+    """Array-of-epochs in some timescale: integer day + seconds-of-day
+    (+ a tiny compensation ``lo``; see module docstring)."""
 
     day: np.ndarray  # int64 MJD
     sec: np.ndarray  # float64 seconds of day, [0, 86400)
     scale: str = "utc"
+    lo: np.ndarray | None = None  # f64 compensation; instant = sec + lo
 
     def __post_init__(self):
         self.day = np.atleast_1d(np.asarray(self.day, dtype=np.int64))
         self.sec = np.atleast_1d(np.asarray(self.sec, dtype=np.float64))
+        self.lo = (np.zeros_like(self.sec) if self.lo is None
+                   else np.atleast_1d(np.asarray(self.lo, dtype=np.float64)))
 
     def __len__(self):
         return len(self.day)
 
     def normalized(self) -> "Epochs":
-        """Carry sec into [0, 86400)."""
-        extra = np.floor(self.sec / SECS_PER_DAY).astype(np.int64)
-        day = self.day + extra
-        sec = self.sec - extra.astype(np.float64) * SECS_PER_DAY
-        # a tiny negative sec can round back up to exactly 86400.0 after the
-        # borrow; snap it to the next day so the [0, 86400) invariant (which
-        # leap-second lookup depends on) always holds
-        hit = sec >= SECS_PER_DAY
+        """Carry sec+lo into [0, 86400), compensated.
+
+        All shifts go through two_sum so no bit of the represented
+        instant is lost; the ``sec`` component equals what the old
+        uncompensated code produced (two_sum's high word IS the plain
+        float sum), so callers that ignore ``lo`` see identical values.
+        """
+        hi, lo = _two_sum(self.sec, self.lo)
+        day = self.day
+        # two passes: the first can leave hi within one ulp of a day
+        # boundary (when the exact remainder straddles it), the second
+        # settles it; vectorized equivalent of a tiny while-loop
+        for _ in range(2):
+            extra = np.floor(hi / SECS_PER_DAY).astype(np.int64)
+            day = day + extra
+            shift = extra.astype(np.float64) * SECS_PER_DAY  # exact
+            r, e = _two_sum(hi, -shift)
+            hi, lo = _two_sum(r, e + lo)
+        # residual boundary snaps (values within an ulp of the edge)
+        hit = hi >= SECS_PER_DAY
         day = np.where(hit, day + 1, day)
-        sec = np.where(hit, sec - SECS_PER_DAY, sec)
-        sec = np.where(sec < 0.0, 0.0, sec)
-        return Epochs(day, sec, self.scale)
+        hi = np.where(hit, hi - SECS_PER_DAY, hi)  # exact (Sterbenz)
+        neg = hi < 0.0
+        # clamp a sub-ulp negative to midnight, preserving it in lo
+        lo = np.where(neg, lo + hi, lo)
+        hi = np.where(neg, 0.0, hi)
+        return Epochs(day, hi, self.scale, lo)
 
     def mjd_longdouble(self) -> np.ndarray:
-        return LD(self.day) + LD(self.sec) / LD(SECS_PER_DAY)
+        return LD(self.day) + (LD(self.sec) + LD(self.lo)) / LD(SECS_PER_DAY)
 
     def mjd_float(self) -> np.ndarray:
         return np.asarray(self.day, dtype=np.float64) + self.sec / SECS_PER_DAY
 
     def add_seconds(self, s) -> "Epochs":
-        return Epochs(self.day, self.sec + np.asarray(s, np.float64), self.scale).normalized()
+        """Shift by s seconds, exactly (compensated)."""
+        hi, e = _two_sum(self.sec, np.asarray(s, np.float64))
+        return Epochs(self.day, hi, self.scale, self.lo + e).normalized()
+
+    def with_scale(self, scale: str) -> "Epochs":
+        """Same instant numbers, relabelled timescale (no conversion)."""
+        return Epochs(self.day, self.sec, scale, self.lo)
 
     def diff_seconds_dd(self, other: "Epochs"):
         """(self - other) in seconds as a (hi, lo) double-double pair."""
         dday = (self.day - other.day).astype(np.float64) * SECS_PER_DAY  # exact
         dsec = self.sec - other.sec  # exact-ish (both < 86400)
         hi = dday + dsec
-        lo = (dday - hi) + dsec
+        lo = (dday - hi) + dsec + (self.lo - other.lo)
         return hi, lo
 
 

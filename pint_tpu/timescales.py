@@ -76,8 +76,9 @@ def tai_minus_utc(mjd_utc_day) -> np.ndarray:
 def utc_to_tai(t: Epochs) -> Epochs:
     assert t.scale == "utc"
     dt = tai_minus_utc(t.day)
-    out = Epochs(t.day, t.sec + dt, "tai").normalized()
-    return out
+    # compensated shift: the rounding of sec+dt rides in .lo, so
+    # tai_to_utc(utc_to_tai(x)) is bit-exact (see mjd.Epochs docstring)
+    return t.with_scale("tai").add_seconds(dt)
 
 
 def tai_to_utc(t: Epochs) -> Epochs:
@@ -85,19 +86,19 @@ def tai_to_utc(t: Epochs) -> Epochs:
     # iterate: leap count at (tai - guess) may differ near boundaries
     dt = tai_minus_utc(t.day)
     for _ in range(2):
-        guess = Epochs(t.day, t.sec - dt, "utc").normalized()
+        guess = t.with_scale("utc").add_seconds(-dt)
         dt = tai_minus_utc(guess.day)
-    return Epochs(t.day, t.sec - dt, "utc").normalized()
+    return t.with_scale("utc").add_seconds(-dt)
 
 
 def tai_to_tt(t: Epochs) -> Epochs:
     assert t.scale == "tai"
-    return Epochs(t.day, t.sec + TT_MINUS_TAI_S, "tt").normalized()
+    return t.with_scale("tt").add_seconds(TT_MINUS_TAI_S)
 
 
 def tt_to_tai(t: Epochs) -> Epochs:
     assert t.scale == "tt"
-    return Epochs(t.day, t.sec - TT_MINUS_TAI_S, "tai").normalized()
+    return t.with_scale("tai").add_seconds(-TT_MINUS_TAI_S)
 
 
 def utc_to_tt(t: Epochs) -> Epochs:
@@ -260,6 +261,17 @@ _TDB_T_TERMS_EXT = np.array([
 # mirror (native/__init__.py::get_lib)
 _TDB_TERMS_ALL = np.vstack([_TDB_TERMS, _TDB_TERMS_EXT])
 _TDB_T_TERMS = np.vstack([_TDB_T_TERMS_FB, _TDB_T_TERMS_EXT])
+# Fit-window bounds (Julian centuries from J2000) of the extension fit,
+# MJD 40000..64000. The fit-derived SECULAR factors — the quadratic
+# _TDB_POLY and the T-amplitude of _TDB_T_TERMS_EXT — are clamped to
+# this window outside coverage: they are regression coefficients, not
+# physics, and the quadratic alone would otherwise add ~5 us of
+# spurious drift at |T| ~ 1 cy (ADVICE r4). Harmonic phases still use
+# the true T (phase extrapolation is what FB-form series are for), as
+# does the published FB T-modulated term (genuine secular physics).
+_TDB_T_CLAMP_LO = (40000.0 - 51544.5) / 36525.0
+_TDB_T_CLAMP_HI = (64000.0 - 51544.5) / 36525.0
+_N_T_TERMS_PUBLISHED = len(_TDB_T_TERMS_FB)
 
 
 def _tdb_fb10(tt: Epochs) -> np.ndarray:
@@ -295,14 +307,21 @@ def tdb_minus_tt_series(tt: Epochs) -> np.ndarray:
         return nat
     T = ((tt.day - 51544) - 0.5 + tt.sec / SECS_PER_DAY) / 36525.0
     Tv = np.atleast_1d(np.asarray(T, np.float64))
+    # fit-derived secular factors clamp to the fit window (see
+    # _TDB_T_CLAMP_LO provenance comment above)
+    Tc = np.clip(Tv, _TDB_T_CLAMP_LO, _TDB_T_CLAMP_HI)
     a, w, p = (_TDB_TERMS_ALL[:, 0:1], _TDB_TERMS_ALL[:, 1:2],
                _TDB_TERMS_ALL[:, 2:3])
     out = np.sum(a * np.sin(w * Tv[None, :] + p), axis=0)
-    a, w, p = (_TDB_T_TERMS[:, 0:1], _TDB_T_TERMS[:, 1:2],
-               _TDB_T_TERMS[:, 2:3])
+    npub = _N_T_TERMS_PUBLISHED
+    a, w, p = (_TDB_T_TERMS[:npub, 0:1], _TDB_T_TERMS[:npub, 1:2],
+               _TDB_T_TERMS[:npub, 2:3])
     out += Tv * np.sum(a * np.sin(w * Tv[None, :] + p), axis=0)
+    a, w, p = (_TDB_T_TERMS[npub:, 0:1], _TDB_T_TERMS[npub:, 1:2],
+               _TDB_T_TERMS[npub:, 2:3])
+    out += Tc * np.sum(a * np.sin(w * Tv[None, :] + p), axis=0)
     c0, c1, c2 = _TDB_POLY
-    out += c0 + c1 * Tv + c2 * Tv * Tv
+    out += c0 + c1 * Tc + c2 * Tc * Tc
     return out.reshape(np.shape(T))
 
 
@@ -390,7 +409,7 @@ def tdb_minus_tt(tt: Epochs) -> np.ndarray:
 
 def tt_to_tdb(t: Epochs) -> Epochs:
     assert t.scale == "tt"
-    return Epochs(t.day, t.sec + tdb_minus_tt(t), "tdb").normalized()
+    return t.with_scale("tdb").add_seconds(tdb_minus_tt(t))
 
 
 def tdb_to_tt(t: Epochs) -> Epochs:
@@ -398,9 +417,9 @@ def tdb_to_tt(t: Epochs) -> Epochs:
     # two fixed-point iterations: one leaves ~(TDB-TT)*d(TDB-TT)/dt
     # ~ 1e-11 s of error (measured against the integrated table), two
     # converge to ~1e-19 — below the roundtrip tests' 1e-12 bar
-    d = tdb_minus_tt(Epochs(t.day, t.sec, "tt"))
-    d = tdb_minus_tt(Epochs(t.day, t.sec - d, "tt").normalized())
-    return Epochs(t.day, t.sec - d, "tt").normalized()
+    d = tdb_minus_tt(t.with_scale("tt"))
+    d = tdb_minus_tt(t.with_scale("tt").add_seconds(-d))
+    return t.with_scale("tt").add_seconds(-d)
 
 
 def utc_to_tdb(t: Epochs) -> Epochs:

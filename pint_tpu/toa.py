@@ -742,8 +742,10 @@ def _pickle_settings_key(ephem, planets, include_gps, include_bipm,
 # semantics change. 2: ERA half-day fix; 3: VSOP87 Earth + integrated
 # TDB-TT table; 4: INCLUDE shares command state + per-block tim_jump
 # indices + CLOCK-directive plumbing (cached parses differ);
-# 5: topocentric TDB term for ground observatories.
-_PHYSICS_REV = 5
+# 5: topocentric TDB term for ground observatories; 6: Epochs grew a
+# compensation field (lo) — cached pickles of pre-6 Epochs would
+# deserialize without it.
+_PHYSICS_REV = 6
 
 
 def _tim_content_hash(path) -> str:
