@@ -189,31 +189,45 @@ std::vector<double> g_tdb_terms = {
 };
 std::vector<double> g_tdb_t_terms = {0.0000102, 628.3075850, 4.2490};
 double g_tdb_poly[3] = {0.0, 0.0, 0.0};
+// first g_tdb_n_t_published T-terms are published physics (secular
+// factor uses true T); the rest are fit-derived (secular factor clamps
+// to the fit window, like the polynomial). Mirrors timescales.py
+// _N_T_TERMS_PUBLISHED / _TDB_T_CLAMP_*.
+std::int64_t g_tdb_n_t_published = 1;
+double g_tdb_t_clamp_lo = -1e30;
+double g_tdb_t_clamp_hi = 1e30;
 
 void pt_set_tdb_terms(std::int64_t n, const double* terms,
                       std::int64_t n_t, const double* t_terms,
-                      const double* poly3) {
+                      const double* poly3, std::int64_t n_t_published,
+                      double t_clamp_lo, double t_clamp_hi) {
   g_tdb_terms.assign(terms, terms + 3 * n);
   g_tdb_t_terms.assign(t_terms, t_terms + 3 * n_t);
   g_tdb_poly[0] = poly3[0];
   g_tdb_poly[1] = poly3[1];
   g_tdb_poly[2] = poly3[2];
+  g_tdb_n_t_published = n_t_published;
+  g_tdb_t_clamp_lo = t_clamp_lo;
+  g_tdb_t_clamp_hi = t_clamp_hi;
 }
 
 void pt_tdb_minus_tt(std::int64_t n, const std::int64_t* tt_day,
                      const double* tt_sec, double* out) {
   const std::size_t n0 = g_tdb_terms.size() / 3;
   const std::size_t n1 = g_tdb_t_terms.size() / 3;
+  const std::size_t npub = static_cast<std::size_t>(g_tdb_n_t_published);
   for (std::int64_t i = 0; i < n; ++i) {
     const double T = jc_from_epoch(tt_day[i], tt_sec[i]);
-    double s = g_tdb_poly[0] + g_tdb_poly[1] * T + g_tdb_poly[2] * T * T;
+    const double Tc =
+        std::min(std::max(T, g_tdb_t_clamp_lo), g_tdb_t_clamp_hi);
+    double s = g_tdb_poly[0] + g_tdb_poly[1] * Tc + g_tdb_poly[2] * Tc * Tc;
     for (std::size_t j = 0; j < n0; ++j) {
       const double* t = g_tdb_terms.data() + 3 * j;
       s += t[0] * std::sin(t[1] * T + t[2]);
     }
     for (std::size_t j = 0; j < n1; ++j) {
       const double* t = g_tdb_t_terms.data() + 3 * j;
-      s += t[0] * T * std::sin(t[1] * T + t[2]);
+      s += t[0] * (j < npub ? T : Tc) * std::sin(t[1] * T + t[2]);
     }
     out[i] = s;
   }

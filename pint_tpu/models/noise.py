@@ -441,9 +441,26 @@ class PLSWNoise(_PLScaledNoise):
         sw = model.components.get("SolarWindDispersionX",
                                   model.components.get(
                                       "SolarWindDispersion"))
-        p_eff = 2.0
+        p_base = 2.0
         if int(sw.SWM.value or 0) == 1 and sw.SWP.value is not None:
-            p_eff = float(sw.SWP.value)
+            p_base = float(sw.SWP.value)
+        swx_ids = getattr(sw, "swx_ids", ())
+        if swx_ids:
+            # under SWX the wind index is per-window (SWXP_####): give
+            # each TOA the index of the window it falls in (base index
+            # outside all windows), else conjunction epochs inside a
+            # p != 2 window would be mis-weighted exactly as the
+            # comment above warns (ADVICE r4)
+            mjd = toas.get_mjds()
+            p_eff = np.full(len(toas), p_base, dtype=np.float64)
+            for i in swx_ids:
+                lo = getattr(sw, f"SWXR1_{i:04d}").value
+                hi = getattr(sw, f"SWXR2_{i:04d}").value
+                pv = getattr(sw, f"SWXP_{i:04d}").value
+                m = (mjd >= lo) & (mjd < hi)
+                p_eff[m] = 2.0 if pv is None else float(pv)
+        else:
+            p_eff = p_base
         geom_pc = np.asarray(solar_wind_geometry_p(sun_ls, n_hat, p_eff))
         with np.errstate(divide="ignore"):
             per_f2 = np.where(np.isfinite(toas.freq_mhz),

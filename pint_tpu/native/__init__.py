@@ -79,7 +79,9 @@ def _setup(lib: ctypes.CDLL) -> bool:
                                          ctypes.c_double, ctypes.c_double]
         lib.pt_set_nut_table.restype = None
         lib.pt_set_tdb_terms.argtypes = [ctypes.c_int64, _f64p,
-                                         ctypes.c_int64, _f64p, _f64p]
+                                         ctypes.c_int64, _f64p, _f64p,
+                                         ctypes.c_int64, ctypes.c_double,
+                                         ctypes.c_double]
         lib.pt_set_tdb_terms.restype = None
     except AttributeError:
         return False
@@ -94,7 +96,9 @@ def _setup(lib: ctypes.CDLL) -> bool:
     t_terms = np.ascontiguousarray(_ts._TDB_T_TERMS, np.float64)
     poly = np.ascontiguousarray(_ts._TDB_POLY, np.float64)
     lib.pt_set_tdb_terms(terms.shape[0], terms,
-                         t_terms.shape[0], t_terms, poly)
+                         t_terms.shape[0], t_terms, poly,
+                         _ts._N_T_TERMS_PUBLISHED,
+                         _ts._TDB_T_CLAMP_LO, _ts._TDB_T_CLAMP_HI)
     return True
 
 

@@ -82,8 +82,13 @@ class LCFitter:
                     "(w_i f + 1 - w_i) and cannot be expressed as a "
                     "histogram objective without changing the "
                     "convention; use unbinned=True")
+            # wrap into [0, 1): the unbinned path accepts any real phase
+            # (primitives wrap internally), so the binned mode must see
+            # the identical photon set — an unwrapped histogram would
+            # silently drop out-of-range phases from counts AND n_tot,
+            # biasing the Poisson objective (ADVICE r4)
             counts, _ = np.histogram(
-                self.phases, bins=nbins, range=(0.0, 1.0))
+                np.asarray(self.phases) % 1.0, bins=nbins, range=(0.0, 1.0))
             c = jnp.asarray(counts, jnp.float64)
             n_tot = float(counts.sum())
             centers = jnp.asarray(

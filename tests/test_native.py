@@ -27,7 +27,13 @@ def _numpy_only(monkeypatch):
 
 def test_tdb_minus_tt_equivalence(lib, monkeypatch):
     rng = np.random.default_rng(0)
-    day = rng.integers(44000, 61000, 500).astype(np.int64)
+    # in-coverage epochs plus far out-of-coverage ones (MJD 15000 /
+    # 90000, |T| ~ 1 cy): the fit-window clamp on the secular terms
+    # must match bit-for-bit between the C++ and numpy paths
+    day = np.concatenate([
+        rng.integers(44000, 61000, 400),
+        rng.integers(15000, 40000, 50),
+        rng.integers(64000, 90000, 50)]).astype(np.int64)
     sec = rng.uniform(0, 86400, 500)
     tt = Epochs(day, sec, "tt")
     got = native.tdb_minus_tt(tt.day, tt.sec)

@@ -361,3 +361,43 @@ def test_chi2_stays_marginal_not_realization_conditioned():
     assert abs(lhs - r.chi2) < 1e-6
     # whitened view is realization-subtracted, so strictly smaller
     assert float(np.sum(np.asarray(r.calc_whitened_resids())**2)) < r.chi2
+
+
+def test_plswnoise_row_scale_follows_swx_window_p():
+    """Under SolarWindDispersionX the GP basis row scale must use each
+    window's SWXP index for TOAs inside that window (ADVICE r4: the
+    basis previously fell back to p=2 under SWX even when
+    SWXP_#### != 2, mis-weighting conjunction epochs)."""
+    swx_extra = ("SWXDM_0001 2.0 1\nSWXR1_0001 55000\nSWXR2_0001 55300\n"
+                 "SWXP_0001 4.0\nTNSWAMP 0.0\nTNSWGAM 2.0\nTNSWC 6\n")
+    par4 = SW_PAR + swx_extra
+    par2 = SW_PAR + swx_extra.replace("SWXP_0001 4.0", "SWXP_0001 2.0")
+    m4 = get_model(par4)
+    assert "SolarWindDispersionX" in m4.components
+    rng = np.random.default_rng(11)
+    mjds = np.sort(rng.uniform(54900, 55600, 50))
+    freqs = np.full(50, 800.0)
+    t = make_fake_toas_fromMJDs(mjds, m4, error_us=0.5, freq_mhz=freqs,
+                                obs="gbt", add_noise=False, iterations=1)
+    in_win = (mjds >= 55000) & (mjds < 55300)
+    assert in_win.any() and (~in_win).any()
+    prep4 = m4.prepare(t)
+    comp4 = m4.components["PLSWNoise"]
+    s4 = comp4._row_scale(m4, t, prep4.prep, prep4.params0)
+    m2 = get_model(par2)
+    prep2 = m2.prepare(t)
+    s2 = m2.components["PLSWNoise"]._row_scale(m2, t, prep2.prep,
+                                               prep2.params0)
+    # outside the window both models agree (base p=2 wind)
+    np.testing.assert_allclose(s4[~in_win], s2[~in_win], rtol=1e-12)
+    # inside the p=4 window the geometry differs measurably from p=2
+    assert np.all(np.abs(s4[in_win] / s2[in_win] - 1.0) > 1e-3)
+    # and matches the SWM 1 base-wind geometry at the same p
+    from pint_tpu.models.solar_wind import solar_wind_geometry_p
+    from pint_tpu.models.noise import DMconst
+    n_hat = np.asarray(m4.components["AstrometryEquatorial"].ssb_to_psb_xyz(
+        prep4.params0, prep4.prep))
+    geom4 = np.asarray(solar_wind_geometry_p(
+        t.obs_sun.pos / 299792458.0, n_hat, 4.0))
+    expected_in = 1e6 * DMconst * geom4[in_win] / freqs[in_win] ** 2
+    np.testing.assert_allclose(s4[in_win], expected_in, rtol=1e-9)

@@ -109,3 +109,26 @@ def test_phase_split():
     q = p + phase.from_f64(jnp.float64(0.5))
     assert float(q.frac) == -0.25
     assert float(q.int_) == 1e11 + 1
+
+
+def test_tdb_series_secular_clamped_out_of_coverage():
+    """Outside the extension fit window (MJD 40000..64000) the
+    fit-derived secular factors (quadratic + T-modulated tail) freeze
+    at the window edge: they are regression coefficients, not physics,
+    and unclamped they added ~5 us/cy^2 of spurious drift (ADVICE r4).
+    The series must stay within the published-FB + harmonic-tail
+    envelope arbitrarily far out."""
+    for day in (15000, 20000, 80000, 90000):
+        tt = Epochs([day], [43200.0], "tt")
+        series = ts.tdb_minus_tt_series(tt)
+        fb10 = ts._tdb_fb10(tt)
+        # harmonic tail total amplitude is ~13 us; clamped secular adds
+        # a bounded ~5 us. Pre-fix, MJD 15000 (T ~ -1 cy) differed from
+        # fb10 by the unclamped quadratic alone (~5 us) PLUS linearly
+        # growing T-terms (~4 us/cy) on top of that envelope.
+        assert abs(float(series[0] - fb10[0])) < 2.5e-5
+    # continuity at the window edges: clamping must not introduce a jump
+    for edge in (40000.0, 64000.0):
+        lo = ts.tdb_minus_tt_series(Epochs([int(edge) - 1], [86000.0], "tt"))
+        hi = ts.tdb_minus_tt_series(Epochs([int(edge)], [500.0], "tt"))
+        assert abs(float(hi[0] - lo[0])) < 1e-6
