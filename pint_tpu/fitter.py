@@ -404,6 +404,76 @@ def gls_eigh_solve(A, b, threshold=1e-12):
     return dxn, covn
 
 
+def gls_gram(Mn, q, precision="f64"):
+    """Normal-equation matrix A = Mn^T Mn + diag(q^2) at the requested
+    Gram precision.
+
+    ``precision="mixed"``: the O(n k^2) Gram product — the FLOP-
+    dominant dense op of every GLS fit — runs in float32 and is
+    promoted back to f64. On TPU that moves the matmul from software-
+    emulated f64 (dozens of passes) onto the MXU's native f32 path;
+    the ~1e-6-relative Gram error is then removed by gls_eigh_refine's
+    f64-residual iterations (O(n k) per step). The prior fold keeps
+    diag(A) = 1, so the f32 rounding is a RELATIVE perturbation and
+    refinement contracts whenever the kept spectrum spans < ~1e6
+    (anchored by tests/test_gls_threshold.py::test_mixed_*).
+    """
+    import jax.numpy as jnp
+
+    if precision == "mixed":
+        M32 = Mn.astype(jnp.float32)
+        A = (M32.T @ M32).astype(jnp.float64)
+    else:
+        A = Mn.T @ Mn
+    return A + jnp.diag(q * q)
+
+
+def gls_eigh_refine(A_approx, b, matvec, threshold=1e-12, iters=2):
+    """Thresholded-eigh solve of A dxn = b where ``A_approx`` is an
+    approximate Gram (f32, from gls_gram(..., "mixed")) and ``matvec``
+    applies the EXACT f64 normal operator (via O(n k) products through
+    the design matrix — never forming the f64 Gram). ``iters``
+    iterative-refinement steps recover f64 solution accuracy:
+    dxn <- dxn + Ã^-1 (b - A dxn), contraction ||Ã^-1 (A - Ã)|| ~
+    κ_kept(A) * 1e-7 per step. The covariance comes from the
+    approximate factorization (~1e-6 relative — far below the
+    precision anyone quotes an uncertainty to).
+
+    The fixed point solves the exact system projected on Ã's kept
+    eigenspace; genuinely degenerate directions are dropped exactly as
+    in gls_eigh_solve.
+
+    Returns (dxn, covn, rel_resid): rel_resid is the final projected
+    relative residual ||P(b - A dxn)|| / ||P b|| — ~1e-14 when
+    refinement converged, O(1) when the kept spectrum was too wide for
+    an f32 preconditioner (κ_kept > ~1e7). Callers MUST check it and
+    fall back to precision="f64" when it exceeds ~1e-8: correctness
+    first, the speedup only where it is free.
+    """
+    import jax.numpy as jnp
+
+    evals, evecs = jnp.linalg.eigh(A_approx)
+    cut = max(threshold**2, GLS_EIG_FLOOR)
+    good = evals > cut * jnp.max(evals)
+    einv = jnp.where(good, 1.0 / jnp.where(good, evals, 1.0), 0.0)
+    keep = good.astype(b.dtype)
+
+    def apply_inv(v):
+        return evecs @ (einv * (evecs.T @ v))
+
+    def project(v):
+        return evecs @ (keep * (evecs.T @ v))
+
+    dxn = apply_inv(b)
+    for _ in range(iters):
+        dxn = dxn + apply_inv(b - matvec(dxn))
+    pb = project(b)
+    pr = project(b - matvec(dxn))
+    rel_resid = jnp.linalg.norm(pr) / (jnp.linalg.norm(pb) + 1e-300)
+    covn = evecs @ (einv[:, None] * evecs.T)
+    return dxn, covn, rel_resid
+
+
 def gls_normal(Mfull, r, sigma, sqrt_phi_inv):
     """(A, b, norm): whitened, prior-folded, column-normalized normal
     equations — jit-safe core shared by GLSFitter, the wideband
@@ -443,7 +513,8 @@ def gls_whiten(Mfull, sigma, sqrt_phi_inv):
     return Mn, norm, sqrt_phi_inv / norm
 
 
-def gls_solve(Mfull, r, sigma, sqrt_phi_inv, threshold=1e-12):
+def gls_solve(Mfull, r, sigma, sqrt_phi_inv, threshold=1e-12,
+              precision="f64"):
     """Whitened, column-normalized, prior-weighted normal-equation
     solve — the one GLS step shared by GLSFitter and the wideband
     fitters (reference: fitter.py::GLSFitter cholesky/Woodbury solve).
@@ -451,15 +522,32 @@ def gls_solve(Mfull, r, sigma, sqrt_phi_inv, threshold=1e-12):
     ``Mfull`` may carry noise-basis columns after the parameter
     columns; ``sqrt_phi_inv`` holds 0 for parameters (infinite prior
     variance) and 1/sqrt(prior variance) for basis amplitudes.
+    ``precision="mixed"`` runs the Gram product in f32 + f64
+    iterative refinement (see gls_gram / gls_eigh_refine) — the
+    MXU-native path on TPU.
     Returns (dx_all, (covn, norm), whitened_chi2) where whitened_chi2
     is r^T C^-1 r via the Woodbury identity (rw2 - b.dxn).
     """
     import jax.numpy as jnp
 
-    A, b, norm = gls_normal(Mfull, r, sigma, sqrt_phi_inv)
-    dxn, covn = gls_eigh_solve(A, b, threshold)
+    Mn, norm, q = gls_whiten(Mfull, sigma, sqrt_phi_inv)
+    z = r / sigma
+    b = Mn.T @ z
+    A = gls_gram(Mn, q, precision)
+    if precision == "mixed":
+        def matvec(v):
+            return Mn.T @ (Mn @ v) + (q * q) * v
+
+        dxn, covn, rel_resid = gls_eigh_refine(A, b, matvec, threshold)
+        if float(rel_resid) > 1e-8:
+            # f32 preconditioner couldn't contract (kept spectrum too
+            # wide, κ > ~1e7): redo in f64 — correctness first
+            A = gls_gram(Mn, q, "f64")
+            dxn, covn = gls_eigh_solve(A, b, threshold)
+    else:
+        dxn, covn = gls_eigh_solve(A, b, threshold)
     dx = dxn / norm
-    rw2 = jnp.sum(jnp.square(r / sigma))
+    rw2 = jnp.sum(jnp.square(z))
     chi2 = float(rw2 - b @ dxn)
     return dx, (covn, norm), chi2
 
@@ -798,11 +886,15 @@ class GLSFitter(Fitter):
             return jnp.concatenate(bases, axis=1), jnp.concatenate(weights)
         return None, None
 
-    def fit_toas(self, maxiter=2, threshold=1e-12, tol=0.0):
+    def fit_toas(self, maxiter=2, threshold=1e-12, tol=0.0,
+                 precision="f64"):
         import time
 
         _reject_free_dmjump(self.model)
         _warn_degraded_once()
+        if precision not in ("f64", "mixed"):
+            raise ValueError(
+                f"precision must be 'f64' or 'mixed', got {precision!r}")
         t_start = time.perf_counter()
         prepared = self.model.prepare(self.toas)
         prep_s = time.perf_counter() - t_start
@@ -840,7 +932,7 @@ class GLSFitter(Fitter):
             # gls_solve; threshold semantics anchored by
             # tests/test_gls_threshold.py)
             dx, cov, _ = gls_solve(Mfull, r, sigma_s, sqrt_phi_inv,
-                                   threshold)
+                                   threshold, precision=precision)
             noise_ampls = (np.asarray(dx[nparam:])
                            if bases[0] is not None else None)
             if first_cov is None:

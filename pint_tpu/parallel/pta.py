@@ -594,7 +594,8 @@ class PTABatch:
 
         return noise_bw
 
-    def _build_gls(self, maxiter=2, threshold=1e-12, ecorr_mode="auto"):
+    def _build_gls(self, maxiter=2, threshold=1e-12, ecorr_mode="auto",
+                   precision="f64"):
         """(cache key, per-pulsar fit_one) for the GLS program — the
         single home of the program construction, shared by
         :meth:`gls_fit` (JIT path) and :meth:`aot_compile` (explicit
@@ -627,14 +628,18 @@ class PTABatch:
         import jax
         import jax.numpy as jnp
 
-        from ..fitter import (_warn_degraded_once, gls_eigh_solve, gls_normal,
-                              gls_whiten, stack_noise_bases)
+        from ..fitter import (_warn_degraded_once, gls_eigh_refine,
+                              gls_eigh_solve, gls_gram, gls_whiten,
+                              stack_noise_bases)
 
         _warn_degraded_once()
 
         if ecorr_mode not in ("auto", "dense"):
             raise ValueError(
                 f"ecorr_mode must be 'auto' or 'dense', got {ecorr_mode!r}")
+        if precision not in ("f64", "mixed"):
+            raise ValueError(
+                f"precision must be 'f64' or 'mixed', got {precision!r}")
         resid_fn = self._resid_fn()
         phase_fn = self._phase_fn()
         noise_bw = self._noise_bw_fn()
@@ -682,13 +687,22 @@ class PTABatch:
             bw = (noise_bw(p, prep) if noise_bw is not None
                   else None) or (None, None)
             Mfull, sqrt_phi_inv, nparam = stack_noise_bases(M, bw)
-            A, b, norm = gls_normal(Mfull, r, sigma_s, sqrt_phi_inv)
-            dxn, covn = gls_eigh_solve(A, b, threshold)
+            Mn, norm, q = gls_whiten(Mfull, sigma_s, sqrt_phi_inv)
+            z = r / sigma_s
+            b = Mn.T @ z
+            A = gls_gram(Mn, q, precision)
+            if precision == "mixed":
+                dxn, covn, relres = gls_eigh_refine(
+                    A, b, lambda v: Mn.T @ (Mn @ v) + (q * q) * v,
+                    threshold)
+            else:
+                dxn, covn = gls_eigh_solve(A, b, threshold)
+                relres = jnp.zeros(())
             dx_all = dxn / norm
             # whitened marginalized chi2: r^T C^-1 r = |rw|^2 - b.dxn
             chi2 = jnp.sum(jnp.square(r / sigma_s)) - b @ dxn
             return (x - dx_all[1:nparam], chi2,
-                    (covn[1:nparam, 1:nparam], norm[1:nparam]))
+                    (covn[1:nparam, 1:nparam], norm[1:nparam], relres))
 
         def one_step_marg(x, params, batch, prep):
             # ECORR epochs eliminated by per-epoch Sherman-Morrison:
@@ -721,7 +735,6 @@ class PTABatch:
             Mn, norm, q = gls_whiten(Mfull, sigma_s, sqrt_phi_inv)
             z = r / sigma_s
             a = 1.0 / sigma_s
-            A0 = Mn.T @ Mn
             b0 = Mn.T @ z
             rNr = jnp.sum(jnp.square(z))
             s = jax.ops.segment_sum(a * a, e_idx, num_segments=k + 1)[:k]
@@ -730,14 +743,29 @@ class PTABatch:
             t = jax.ops.segment_sum(z * a, e_idx, num_segments=k + 1)[:k]
             w_s2 = w_us2 * 1e-12
             c = w_s2 / (1.0 + w_s2 * s)  # w=0 (padding) -> c=0 exactly
-            An = A0 - G.T @ (c[:, None] * G) + jnp.diag(q * q)
+            # sqrt(c)-scaled epoch matrix: the Sherman-Morrison
+            # downdate becomes a symmetric PSD Gram, so the mixed-
+            # precision path can run BOTH big products in f32
+            Gc = jnp.sqrt(c)[:, None] * G
             bn = b0 - G.T @ (c * t)
             rCr = rNr - jnp.sum(c * jnp.square(t))
-            dxn, covn = gls_eigh_solve(An, bn, threshold)
+            if precision == "mixed":
+                Gc32 = Gc.astype(jnp.float32)
+                An = (gls_gram(Mn, q, "mixed")
+                      - (Gc32.T @ Gc32).astype(jnp.float64))
+                dxn, covn, relres = gls_eigh_refine(
+                    An, bn,
+                    lambda v: (Mn.T @ (Mn @ v) - Gc.T @ (Gc @ v)
+                               + (q * q) * v),
+                    threshold)
+            else:
+                An = (Mn.T @ Mn - Gc.T @ Gc + jnp.diag(q * q))
+                dxn, covn = gls_eigh_solve(An, bn, threshold)
+                relres = jnp.zeros(())
             dx_all = dxn / norm
             chi2 = rCr - bn @ dxn
             return (x - dx_all[1:nparam], chi2,
-                    (covn[1:nparam, 1:nparam], norm[1:nparam]))
+                    (covn[1:nparam, 1:nparam], norm[1:nparam], relres))
 
         one_step = one_step_marg if marginalize else one_step_dense
 
@@ -747,14 +775,23 @@ class PTABatch:
                 x, chi2, cov = one_step(x, params, batch, prep)
             return x, chi2, cov
 
-        return ("gls", maxiter, threshold, marginalize), fit_one
+        return ("gls", maxiter, threshold, marginalize, precision), fit_one
 
-    def gls_fit(self, maxiter=2, threshold=1e-12, ecorr_mode="auto"):
+    def gls_fit(self, maxiter=2, threshold=1e-12, ecorr_mode="auto",
+                precision="f64"):
         """Vmapped, mesh-sharded multi-pulsar GLS fit — the
         BASELINE.json north-star path (NANOGrav-15yr-style refit with
         EFAC/EQUAD/ECORR/red-noise) as ONE jitted program. See
         :meth:`_build_gls` for the two ECORR solve modes and the
         whitening/normalization conventions.
+
+        ``precision="mixed"`` runs the FLOP-dominant Gram products in
+        f32 (MXU-native on TPU, where f64 matmuls are software-
+        emulated) and recovers f64 parameter accuracy by iterative
+        refinement with exact f64 residuals (fitter.gls_eigh_refine).
+        A per-pulsar convergence diagnostic guards the mode: if any
+        pulsar's refinement failed to contract the whole batch is
+        automatically refit in f64 with a warning.
 
         Returns (x_fit, chi2_whitened, cov) like wls_fit; diverged
         pulsars reported via self.diverged.
@@ -763,23 +800,37 @@ class PTABatch:
 
         import jax
 
-        key, fit_one = self._build_gls(maxiter, threshold, ecorr_mode)
+        key, fit_one = self._build_gls(maxiter, threshold, ecorr_mode,
+                                       precision)
         t0 = time.perf_counter()
         compiled = key in self._fns
         if not compiled:
             self._fns[key] = jax.jit(jax.vmap(fit_one))
         x0 = self._x0()
-        x, chi2, (covn, norm) = self._fns[key](x0, self.params,
-                                               self.batch, self.prep)
+        x, chi2, (covn, norm, relres) = self._fns[key](
+            x0, self.params, self.batch, self.prep)
         # one batched pull; see wls_fit
-        x, chi2, covn, norm = self._pull((x, chi2, covn, norm))
+        x, chi2, covn, norm, relres = self._pull(
+            (x, chi2, covn, norm, relres))
+        if precision == "mixed" and np.max(relres) > 1e-8:
+            # the f32 preconditioner failed to contract for >= 1 pulsar
+            # (kept spectrum wider than ~1e7): redo the batch in f64 —
+            # correctness is non-negotiable, the speedup opt-in
+            import warnings
+
+            warnings.warn(
+                f"mixed-precision GLS refinement did not converge "
+                f"(max rel resid {float(np.max(relres)):.2e}); "
+                "refitting in f64")
+            return self.gls_fit(maxiter=maxiter, threshold=threshold,
+                                ecorr_mode=ecorr_mode, precision="f64")
         cov = covn / (norm[:, :, None] * norm[:, None, :])
         x, chi2 = self._isolate_diverged(x0, x, chi2)
         self._record_metrics("gls", t0, maxiter, warm=compiled)
         return x, chi2, cov
 
     def aot_compile(self, method="gls", maxiter=None, threshold=1e-12,
-                    ecorr_mode="auto"):
+                    ecorr_mode="auto", precision="f64"):
         """Ahead-of-time compile one vmapped fit program, splitting
         Python/JAX *trace* time from XLA *backend compile* time and
         recording the compiled executable's own cost model.
@@ -803,8 +854,13 @@ class PTABatch:
 
         if method == "gls":
             maxiter = 2 if maxiter is None else maxiter
-            key, fit_one = self._build_gls(maxiter, threshold, ecorr_mode)
+            key, fit_one = self._build_gls(maxiter, threshold, ecorr_mode,
+                                           precision)
         elif method == "wls":
+            if precision != "f64":
+                raise ValueError(
+                    "precision applies to the GLS path only; WLS has "
+                    "no mixed-precision mode")
             maxiter = 3 if maxiter is None else maxiter
             key, fit_one = self._build_wls(maxiter, threshold)
         else:
