@@ -17,6 +17,8 @@ def photon_loglike(f, weights=None):
     return jnp.sum(jnp.log(jnp.maximum(weights * f + (1.0 - weights), 1e-300)))
 
 
+from .lcprimitives import (LCGaussian2, LCLorentzian2,  # noqa: E402,F401
+                           )
 from .lcprimitives import (LCGaussian, LCLorentzian, LCSkewGaussian,  # noqa: E402,F401
                            LCVonMises)
 from .lcnorm import NormAngles, angles_from_norms, norms_from_angles  # noqa: E402,F401

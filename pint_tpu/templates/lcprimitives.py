@@ -110,6 +110,58 @@ class LCSkewGaussian(LCPrimitive):
             math.sqrt(math.pi / 2.0) * (s1 + s2))
 
 
+class LCLorentzian2(LCPrimitive):
+    """Two-sided wrapped Lorentzian (reference: lcprimitives.py::
+    LCLorentzian2 — the asymmetric-peak workhorse alongside
+    LCGaussian2): p = [gamma1, gamma2, loc], HWHM gamma1 leading
+    (phi < loc), gamma2 trailing, continuous at the peak.
+
+    The wrap sum is truncated at ±K turns, but the normalization is
+    EXACT for the truncated kernel: integrating the k-sum over one
+    cycle telescopes to F(K+1-loc) - F(-K-loc) with F the two-sided
+    Lorentzian CDF (closed form in arctan), so the density integrates
+    to exactly 1 on [0,1) and stays differentiable in all params —
+    no slowly-converging tail approximation.
+    """
+
+    n_params = 3
+    _K = 5  # wrap truncation (normalization exact regardless; see above)
+
+    @staticmethod
+    def _cdf(d, g1, g2):
+        import jax.numpy as jnp
+
+        w1 = g1 / (g1 + g2)
+        w2 = 1.0 - w1
+        lead = w1 * (1.0 + (2.0 / jnp.pi) * jnp.arctan(d / g1))
+        trail = w1 + w2 * (2.0 / jnp.pi) * jnp.arctan(d / g2)
+        return jnp.where(d < 0, lead, trail)
+
+    def __call__(self, phases, p=None):
+        import jax.numpy as jnp
+
+        p = self.p if p is None else p
+        g1, g2, loc = p[0], p[1], p[2]
+        ph = jnp.asarray(phases)
+        K = self._K
+        k = jnp.arange(-K, K + 1, dtype=jnp.float64)
+        d = (ph - loc)[..., None] + k
+        g = jnp.where(d < 0, jnp.asarray(g1)[..., None],
+                      jnp.asarray(g2)[..., None])
+        # unnormalized two-sided kernel: 1/(1+(d/g)^2), continuous at 0
+        dens = jnp.sum(1.0 / (1.0 + (d / g) ** 2), axis=-1)
+        # peak height of the unit kernel is 1; line-integral of the
+        # kernel is (pi/2)(g1+g2) * (covered mass fraction)
+        mass = self._cdf(K + 1.0 - loc, g1, g2) - self._cdf(-K - loc, g1, g2)
+        return dens / ((jnp.pi / 2.0) * (g1 + g2) * mass)
+
+
+# Upstream-parity alias: LCSkewGaussian's p = [sigma1, sigma2, loc]
+# (leading/trailing widths, continuous peak) IS the reference
+# LCGaussian2 parameterization (reference: lcprimitives.py::LCGaussian2).
+LCGaussian2 = LCSkewGaussian
+
+
 class LCVonMises(LCPrimitive):
     """von Mises peak (reference: lcprimitives.py::LCVonMises):
     p = [kappa_inv, loc]; density ~ exp(kappa cos(2pi(phi-loc)))."""

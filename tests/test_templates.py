@@ -581,3 +581,111 @@ def test_binned_fit_matches_unbinned():
     with pytest.raises(ValueError, match="weights"):
         LCFitter(t_b, ph, weights=np.full(len(ph), 0.7)).fit(
             steps=1, unbinned=False)
+
+
+def test_binned_fit_wraps_out_of_range_phases():
+    """Binned mode histograms phases mod 1 (ADVICE r4: out-of-[0,1)
+    phases — accepted by the unbinned path, which wraps inside the
+    primitives — were silently dropped from the histogram, biasing the
+    Poisson objective). Shifting every photon by an integer number of
+    turns must leave the binned fit unchanged."""
+    rng = np.random.default_rng(77)
+    ph = _draw_phases(rng, 8000, loc=0.4, sigma=0.04, frac=0.7)
+    shifted = ph + np.where(np.arange(len(ph)) % 3 == 0, 1.0,
+                            np.where(np.arange(len(ph)) % 3 == 1, -2.0, 0.0))
+    t_a = LCTemplate([LCGaussian([0.06, 0.35])], [0.5])
+    ll_a = LCFitter(t_a, ph).fit(steps=300, unbinned=False, nbins=128)
+    t_b = LCTemplate([LCGaussian([0.06, 0.35])], [0.5])
+    ll_b = LCFitter(t_b, shifted).fit(steps=300, unbinned=False, nbins=128)
+    assert t_b.primitives[0].loc == pytest.approx(t_a.primitives[0].loc,
+                                                  abs=1e-9)
+    assert t_b.primitives[0].p[0] == pytest.approx(t_a.primitives[0].p[0],
+                                                   rel=1e-9)
+    assert ll_b == pytest.approx(ll_a, abs=1e-6)
+
+
+# ---- two-sided (asymmetric) primitives: LCGaussian2 / LCLorentzian2
+# (reference: lcprimitives.py::LCGaussian2/LCLorentzian2 — VERDICT r4
+# item 5) ----
+
+
+def _draw_two_sided(rng, n, loc, w1, w2, frac, kind="gauss"):
+    """Photon sample: frac from a two-sided peak (leading width w1,
+    trailing w2) + (1-frac) uniform background."""
+    n_sig = int(n * frac)
+    lead = rng.random(n_sig) < w1 / (w1 + w2)
+    if kind == "gauss":
+        mag = np.abs(rng.standard_normal(n_sig))
+    else:  # half-Cauchy
+        mag = np.abs(np.tan(np.pi * (rng.random(n_sig) - 0.5)))
+        mag = np.minimum(mag, 50.0)  # truncate monster tails
+    d = np.where(lead, -mag * w1, mag * w2)
+    sig = (loc + d) % 1.0
+    return np.concatenate([sig, rng.random(n - n_sig)])
+
+
+def test_lclorentzian2_density_normalized_and_asymmetric():
+    from pint_tpu.templates import LCLorentzian2
+
+    import jax.numpy as jnp
+
+    prim = LCLorentzian2([0.01, 0.04, 0.3])
+    x = jnp.linspace(0.0, 1.0, 20001)
+    dens = prim(x)
+    # exact truncated-kernel normalization: unit mass on [0,1)
+    assert float(jnp.trapezoid(dens, x)) == pytest.approx(1.0, abs=2e-4)
+    assert float(dens.min()) >= 0.0
+    # peak at loc; leading side falls off 4x faster than trailing
+    assert abs(float(x[int(jnp.argmax(dens))]) - 0.3) < 1e-3
+    lead = prim(jnp.asarray([0.3 - 0.02]))[0]
+    trail = prim(jnp.asarray([0.3 + 0.02]))[0]
+    assert float(trail) > 2.0 * float(lead)
+    # HWHM semantics per side: density at loc -/+ gamma_i is half peak
+    peak = float(prim(jnp.asarray([0.3]))[0])
+    assert float(prim(jnp.asarray([0.3 - 0.01]))[0]) == pytest.approx(
+        peak / 2, rel=0.02)
+    assert float(prim(jnp.asarray([0.3 + 0.04]))[0]) == pytest.approx(
+        peak / 2, rel=0.02)
+
+
+def test_lcgaussian2_alias_is_skew_gaussian():
+    from pint_tpu.templates import LCGaussian2, LCSkewGaussian
+
+    assert LCGaussian2 is LCSkewGaussian
+
+
+def test_two_sided_gaussian_fit_recovers_asymmetry():
+    """Unbinned AND binned fits of an asymmetric peak recover distinct
+    leading/trailing widths (the upstream LCGaussian2 use case)."""
+    from pint_tpu.templates import LCGaussian2, LCTemplate
+    from pint_tpu.templates.lcfitters import LCFitter
+
+    rng = np.random.default_rng(42)
+    ph = _draw_two_sided(rng, 40000, loc=0.55, w1=0.015, w2=0.06,
+                         frac=0.65, kind="gauss")
+    for unbinned in (True, False):
+        t = LCTemplate([LCGaussian2([0.03, 0.03, 0.5])], [0.6])
+        f = LCFitter(t, ph)
+        f.fit(steps=600, unbinned=unbinned, nbins=256)
+        s1, s2, loc = (float(v) for v in t.primitives[0].p)
+        assert loc == pytest.approx(0.55, abs=0.005), unbinned
+        assert s1 == pytest.approx(0.015, rel=0.25), unbinned
+        assert s2 == pytest.approx(0.06, rel=0.25), unbinned
+        assert s2 > 2.5 * s1  # the asymmetry itself is detected
+
+
+def test_two_sided_lorentzian_fit_recovers_asymmetry():
+    from pint_tpu.templates import LCLorentzian2, LCTemplate
+    from pint_tpu.templates.lcfitters import LCFitter
+
+    rng = np.random.default_rng(7)
+    ph = _draw_two_sided(rng, 40000, loc=0.4, w1=0.01, w2=0.035,
+                         frac=0.7, kind="lorentz")
+    t = LCTemplate([LCLorentzian2([0.02, 0.02, 0.45])], [0.6])
+    f = LCFitter(t, ph)
+    f.fit(steps=600)
+    g1, g2, loc = (float(v) for v in t.primitives[0].p)
+    assert loc == pytest.approx(0.4, abs=0.005)
+    assert g1 == pytest.approx(0.01, rel=0.35)
+    assert g2 == pytest.approx(0.035, rel=0.35)
+    assert g2 > 1.8 * g1
