@@ -36,8 +36,10 @@ print('probe ok', ds[0].platform, round(time.time()-t0,1),'s', flush=True)
     if [ $brc -eq 0 ] && grep -q '"platform": "tpu"' "benchmarks/${PREFIX}_bench.json"; then
       echo "[watcher] TPU bench captured; exiting"; exit 0
     fi
-    # failed mid-window (relay died?): keep the log, clear the json, retry later
-    [ $brc -ne 0 ] && mv -f "benchmarks/${PREFIX}_bench.json" \
+    # failed mid-window OR fell back to a non-TPU backend (relay died
+    # between probe and bench): keep the log, clear the json so the
+    # existence check cannot mistake it for success, retry later
+    mv -f "benchmarks/${PREFIX}_bench.json" \
       "benchmarks/${PREFIX}_bench.failed.$(date +%s).json" 2>/dev/null
   else
     echo "[watcher] $(date -u +%H:%M:%S) relay wedged (probe rc=$rc)"
