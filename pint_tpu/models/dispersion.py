@@ -123,6 +123,7 @@ class DispersionDMX(DelayComponent):
         # a missing DMXR1/DMXR2 pair parses as the empty window [0, 0]
         # -> identically-zero design column, silently degenerate fit
         # (reference behavior: MissingParameter)
+        windows = []
         for i in self.dmx_ids:
             r1 = getattr(self, f"DMXR1_{i:04d}").value
             r2 = getattr(self, f"DMXR2_{i:04d}").value
@@ -131,6 +132,21 @@ class DispersionDMX(DelayComponent):
                     "DispersionDMX", f"DMXR1_{i:04d}/DMXR2_{i:04d}",
                     f"DMX_{i:04d} needs a non-empty MJD window "
                     f"(got [{r1}, {r2}])")
+            windows.append((r1, r2, i))
+        # overlapping windows apply ADDITIVELY to shared TOAs (the
+        # delay sums the per-window offsets) — usually a par-file
+        # mistake (upstream tempo convention is disjoint bins), so say
+        # so once instead of fitting a silently-degenerate pair
+        windows.sort()
+        for (a1, a2, ia), (b1, b2, ib) in zip(windows, windows[1:]):
+            if b1 < a2:
+                import warnings
+
+                warnings.warn(
+                    f"DMX windows DMX_{ia:04d} [{a1}, {a2}] and "
+                    f"DMX_{ib:04d} [{b1}, {b2}] overlap; both offsets "
+                    "apply additively to TOAs in the overlap")
+                break
 
     def add_dmx_range(self, index, mjd_start, mjd_end, value=0.0, frozen=True):
         from .parameter import floatParameter

@@ -83,6 +83,18 @@ class ScaleToaError(NoiseComponent):
         equad = params["EQUAD"] @ prep["equad_masks"]
         return jnp.sqrt(jnp.square(efac * sigma_us) + jnp.square(equad))
 
+    def scale_dm_sigma(self, params, prep, sigma_dm):
+        """Scaled wideband DM uncertainties [pc cm^-3]:
+        sqrt((DMEFAC * sigma)^2 + DMEQUAD^2) per mask (reference:
+        noise_model.py::ScaleDmError.scale_dm_sigma — the DM-domain
+        twin of scale_sigma, consumed by WidebandDMResiduals and the
+        wideband fitters)."""
+        import jax.numpy as jnp
+
+        dmefac = 1.0 + (params["DMEFAC"] - 1.0) @ prep["dmefac_masks"]
+        dmequad = params["DMEQUAD"] @ prep["dmequad_masks"]
+        return jnp.sqrt(jnp.square(dmefac * sigma_dm) + jnp.square(dmequad))
+
 
 class EcorrNoise(NoiseComponent):
     """Epoch-correlated white noise (reference: noise_model.py::EcorrNoise).

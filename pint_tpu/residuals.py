@@ -246,9 +246,37 @@ class WidebandDMResiduals:
         dmerr = toas.get_flag_value("pp_dme", fill="nan")
         self.dm_observed = np.array([float(v) if v not in ("", "nan") else np.nan
                                      for v in dmvals])
-        self.dm_error = np.array([float(v) if v not in ("", "nan") else np.nan
-                                  for v in dmerr])
-        self.valid = ~np.isnan(self.dm_observed)
+        raw_err = np.array([float(v) if v not in ("", "nan") else np.nan
+                            for v in dmerr])
+        # a zero/negative pp_dme would give that TOA infinite weight in
+        # every wideband chi2/fit, and a missing one makes the weight
+        # undefined — treat both as no DM measurement, named separately
+        # so the warning points at the actual problem
+        has_dm = ~np.isnan(self.dm_observed)
+        bad_err = ~(raw_err > 0)
+        n_missing = int((np.isnan(raw_err) & has_dm).sum())
+        n_nonpos = int((bad_err & ~np.isnan(raw_err) & has_dm).sum())
+        if n_missing or n_nonpos:
+            import warnings
+
+            parts = []
+            if n_nonpos:
+                parts.append(f"{n_nonpos} with non-positive -pp_dme")
+            if n_missing:
+                parts.append(f"{n_missing} with -pp_dm but no -pp_dme")
+            warnings.warn("wideband TOA(s) excluded from the DM "
+                          "residuals: " + "; ".join(parts))
+        self.valid = ~np.isnan(self.dm_observed) & ~bad_err
+        # DMEFAC/DMEQUAD scaling (reference: ScaleDmError) — applied at
+        # the start-of-fit parameter values, like the basis spans
+        scale = model.components.get("ScaleToaError")
+        if scale is not None and (scale.dmefac_ids or scale.dmequad_ids):
+            safe = np.where(np.isnan(raw_err), 0.0, raw_err)
+            scaled = np.asarray(scale.scale_dm_sigma(
+                self.prepared.params0, self.prepared.prep, safe))
+            self.dm_error = np.where(np.isnan(raw_err), np.nan, scaled)
+        else:
+            self.dm_error = raw_err
 
     def calc_dm_resids(self, params=None):
         p = self.prepared.params0 if params is None else params
