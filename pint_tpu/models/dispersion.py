@@ -180,6 +180,18 @@ class DispersionDMX(DelayComponent):
             lo = getattr(self, f"DMXR1_{i:04d}").value
             hi = getattr(self, f"DMXR2_{i:04d}").value
             masks[k] = (mjds >= lo) & (mjds <= hi)
+        # windows are inclusive on BOTH ends (upstream convention), so
+        # a TOA at the exact shared boundary of abutting bins lands in
+        # two masks and gets both offsets — validate()'s strict-overlap
+        # warning can't see that (it has no TOAs); report it exactly here
+        multi = masks.sum(axis=0) > 1
+        if multi.any():
+            import warnings
+
+            warnings.warn(
+                f"{int(multi.sum())} TOA(s) fall inside more than one "
+                "DMX window (inclusive boundaries); the window offsets "
+                "apply additively to them")
         prep["dmx_masks"] = jnp.asarray(masks)
 
     def delay(self, params, batch, prep, delay_accum):

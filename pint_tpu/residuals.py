@@ -266,7 +266,7 @@ class WidebandDMResiduals:
                 parts.append(f"{n_missing} with -pp_dm but no -pp_dme")
             warnings.warn("wideband TOA(s) excluded from the DM "
                           "residuals: " + "; ".join(parts))
-        self.valid = ~np.isnan(self.dm_observed) & ~bad_err
+        self.valid = has_dm & ~bad_err
         # DMEFAC/DMEQUAD scaling (reference: ScaleDmError) — applied at
         # the start-of-fit parameter values, like the basis spans
         scale = model.components.get("ScaleToaError")

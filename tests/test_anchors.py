@@ -293,3 +293,32 @@ def test_j1614_shapiro_range_anchor():
     shap = np.asarray(d_with) - np.asarray(d_without)
     span_us = (shap.max() - shap.min()) * 1e6
     assert span_us == pytest.approx(peak_us, rel=0.05)
+
+
+def test_solar_limb_shapiro_published_magnitude():
+    """Published worked example (Lorimer & Kramer handbook ch. 2 /
+    Backer & Hellings 1986): the solar Shapiro delay for a ray grazing
+    the solar limb is ~113 us larger than at quadrature —
+    Delta = -2 T_sun ln(1 - cos theta), theta_limb = R_sun/AU =
+    4.652e-3 rad -> 112.6 us (commonly quoted as "~120 us at the
+    limb"). Also pins the published constant T_sun = GM_sun/c^3 =
+    4.925490947 us (tempo/tempo2/PINT convention)."""
+    import jax.numpy as jnp
+
+    from pint_tpu.constants import AU_LS, TSUN_S
+    from pint_tpu.models.solar_system_shapiro import SolarSystemShapiro
+
+    assert TSUN_S == pytest.approx(4.925490947e-6, rel=1e-9)
+    theta_limb = 696_000.0 / 149_597_870.7  # R_sun / AU [rad]
+    n = jnp.asarray([0.0, 0.0, 1.0])  # pulsar direction
+    # Sun 1 AU from observer, at limb elongation vs at quadrature
+    def sun_at(theta):
+        return AU_LS * jnp.asarray(
+            [jnp.sin(theta), 0.0, jnp.cos(theta)])[None, :]
+
+    d_limb = float(SolarSystemShapiro._body_delay(
+        sun_at(theta_limb), n, TSUN_S)[0])
+    d_quad = float(SolarSystemShapiro._body_delay(
+        sun_at(jnp.pi / 2), n, TSUN_S)[0])
+    delta_us = (d_limb - d_quad) * 1e6
+    assert delta_us == pytest.approx(112.6, abs=1.5)

@@ -298,3 +298,21 @@ class TestSWXWindows:
         # and those maxima move when the window range changes — so
         # compare against single-window models with the same ranges.
         assert dm_both == pytest.approx(d1 + d2, rel=1e-6)
+
+
+def test_abutting_windows_boundary_toa_warns_at_pack():
+    """Inclusive-inclusive windows: a TOA at the exact shared boundary
+    of abutting DMX bins is in BOTH masks; pack() reports it (validate
+    cannot — it has no TOAs, and abutting bins alone are legal)."""
+    par = PAR + ("DMX_0001 1e-3 1\nDMXR1_0001 55000\nDMXR2_0001 55400\n"
+                 "DMX_0002 4e-4 1\nDMXR1_0002 55400\nDMXR2_0002 56000\n")
+    m = get_model(par)  # abutting, not overlapping: no validate warning
+    # the simulation's internal prepare() is the first pack — the
+    # warning fires there
+    with pytest.warns(UserWarning, match="more than one DMX window"):
+        t = make_fake_toas_fromMJDs(np.array([55200.0, 55400.0]), m,
+                                    error_us=1.0, freq_mhz=1400.0,
+                                    obs="gbt", add_noise=False)
+    dm = m.total_dm(t) - 15.99
+    # boundary TOA gets both offsets (the behavior the warning names)
+    np.testing.assert_allclose(dm, [1e-3, 1.4e-3], rtol=1e-9)

@@ -207,3 +207,52 @@ def test_topocentric_tdb_diurnal_term():
     lag24 = np.corrcoef(x[:-24], x[24:])[0, 1]
     assert lag12 < -0.8, lag12
     assert lag24 > 0.8, lag24
+
+
+def test_sofa_cookbook_celestial_pole_anchor():
+    """Published worked example: the SOFA 'Tools for Earth Attitude'
+    cookbook (2007 April 5, 12h UTC) gives the celestial pole
+    coordinates X = +0.000712264729599, Y = +0.000044385250426 for
+    IAU 2000A. The bottom row of our equinox-based NPB matrix IS
+    (X, Y, ~1) — the pole position is decomposition-independent, so
+    this anchors the full bias+precession+nutation chain against an
+    external published number. Tolerance 1e-7 rad (~20 mas) covers the
+    IAU1976+2000B-vs-2000A model difference (measured ~4e-8 rad =
+    8 mas) with margin; a sign/order/units mistake anywhere in the
+    chain is orders of magnitude larger."""
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    from pint_tpu.earth import erfa_lite as el
+    from pint_tpu import timescales as ts
+    from pint_tpu.mjd import Epochs
+
+    tt = ts.utc_to_tt(Epochs([54195], [43200.0], "utc"))
+    T = float((tt.day[0] - 51544) - 0.5 + tt.sec[0] / 86400.0) / 36525.0
+    NPB = (el.nutation_matrix(np.array([T]))[0]
+           @ el.precession_matrix(np.array([T]))[0]
+           @ el._bias_matrix())
+    assert abs(NPB[2, 0] - 0.000712264729599) < 1e-7
+    assert abs(NPB[2, 1] - 0.000044385250426) < 1e-7
+    # and the pole column consistency (matrix is a rotation)
+    assert abs(np.linalg.det(NPB) - 1.0) < 1e-12
+
+
+def test_sofa_era00_anchor():
+    """EXACT anchor: published SOFA t_sofa_c test value
+    iauEra00(2400000.5, 54388.0) = 0.4022837240028158102 rad."""
+    from pint_tpu.earth.erfa_lite import era
+    from pint_tpu.mjd import Epochs
+
+    got = float(era(Epochs([54388], [0.0], "ut1"))[0])
+    assert abs(got - 0.4022837240028158102) < 1e-12
+
+
+def test_sofa_obl06_anchor():
+    """EXACT anchor: published SOFA t_sofa_c test value
+    iauObl06(2400000.5, 54388.0) = 0.4090749229387258204 rad pins the
+    IAU2006 mean-obliquity polynomial."""
+    from pint_tpu.earth.erfa_lite import mean_obliquity
+
+    T = (54388.0 - 51544.5) / 36525.0
+    got = float(mean_obliquity(np.array([T]))[0])
+    assert abs(got - 0.4090749229387258204) < 1e-13
