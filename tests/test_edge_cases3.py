@@ -309,10 +309,14 @@ def test_abutting_windows_boundary_toa_warns_at_pack():
     m = get_model(par)  # abutting, not overlapping: no validate warning
     # the simulation's internal prepare() is the first pack — the
     # warning fires there
+    # iterations=0 keeps the nominal MJDs exact — the zero-residual
+    # iteration would nudge the boundary TOA off 55400.0 (and with 0
+    # iterations nothing prepares/packs until total_dm below)
+    t = make_fake_toas_fromMJDs(np.array([55200.0, 55400.0]), m,
+                                error_us=1.0, freq_mhz=1400.0,
+                                obs="gbt", add_noise=False,
+                                iterations=0)
     with pytest.warns(UserWarning, match="more than one DMX window"):
-        t = make_fake_toas_fromMJDs(np.array([55200.0, 55400.0]), m,
-                                    error_us=1.0, freq_mhz=1400.0,
-                                    obs="gbt", add_noise=False)
-    dm = m.total_dm(t) - 15.99
+        dm = m.total_dm(t) - 15.99
     # boundary TOA gets both offsets (the behavior the warning names)
     np.testing.assert_allclose(dm, [1e-3, 1.4e-3], rtol=1e-9)
