@@ -12,7 +12,9 @@ DOCS = os.path.join(os.path.dirname(__file__), "..", "docs")
 # docs whose python blocks are fully self-contained (no user files):
 # these EXECUTE; all other docs' blocks are still compile-checked so
 # the syntax can't rot
-_EXECUTABLE = {"tutorial_wideband.md", "tutorial_noise.md"}
+_EXECUTABLE = {"tutorial_wideband.md", "tutorial_noise.md",
+               "tutorial_polycos.md", "tutorial_templates.md",
+               "tutorial_distributed.md"}
 
 
 def _blocks(name):
