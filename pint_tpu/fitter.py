@@ -404,6 +404,14 @@ def gls_eigh_solve(A, b, threshold=1e-12):
     return dxn, covn
 
 
+def check_precision(precision):
+    """Validate the GLS precision-mode argument (single home for the
+    accepted set; shared by GLSFitter, PTABatch, and sharded_gls_fit)."""
+    if precision not in ("f64", "mixed"):
+        raise ValueError(
+            f"precision must be 'f64' or 'mixed', got {precision!r}")
+
+
 def gls_gram(Mn, q, precision="f64"):
     """Normal-equation matrix A = Mn^T Mn + diag(q^2) at the requested
     Gram precision.
@@ -892,9 +900,7 @@ class GLSFitter(Fitter):
 
         _reject_free_dmjump(self.model)
         _warn_degraded_once()
-        if precision not in ("f64", "mixed"):
-            raise ValueError(
-                f"precision must be 'f64' or 'mixed', got {precision!r}")
+        check_precision(precision)
         t_start = time.perf_counter()
         prepared = self.model.prepare(self.toas)
         prep_s = time.perf_counter() - t_start

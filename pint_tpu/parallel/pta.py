@@ -628,18 +628,16 @@ class PTABatch:
         import jax
         import jax.numpy as jnp
 
-        from ..fitter import (_warn_degraded_once, gls_eigh_refine,
-                              gls_eigh_solve, gls_gram, gls_whiten,
-                              stack_noise_bases)
+        from ..fitter import (_warn_degraded_once, check_precision,
+                              gls_eigh_refine, gls_eigh_solve, gls_gram,
+                              gls_whiten, stack_noise_bases)
 
         _warn_degraded_once()
 
         if ecorr_mode not in ("auto", "dense"):
             raise ValueError(
                 f"ecorr_mode must be 'auto' or 'dense', got {ecorr_mode!r}")
-        if precision not in ("f64", "mixed"):
-            raise ValueError(
-                f"precision must be 'f64' or 'mixed', got {precision!r}")
+        check_precision(precision)
         resid_fn = self._resid_fn()
         phase_fn = self._phase_fn()
         noise_bw = self._noise_bw_fn()
@@ -771,9 +769,15 @@ class PTABatch:
 
         def fit_one(x0, params, batch, prep):
             x = x0
+            # track the WORST refinement residual over the Gauss-Newton
+            # iterations: an early-iteration non-contraction corrupts x
+            # even if the final (off-optimum) solve happens to converge
+            worst = jnp.zeros(())
             for _ in range(maxiter):
-                x, chi2, cov = one_step(x, params, batch, prep)
-            return x, chi2, cov
+                x, chi2, (covn, norm, relres) = one_step(
+                    x, params, batch, prep)
+                worst = jnp.maximum(worst, relres)
+            return x, chi2, (covn, norm, worst)
 
         return ("gls", maxiter, threshold, marginalize, precision), fit_one
 

@@ -95,7 +95,7 @@ def sharded_chi2(template_model, static, mesh, params, batch, prep, axis="toa"):
 
 
 def sharded_gls_fit(model, toas, mesh: Mesh, maxiter=2, threshold=1e-12,
-                    axis="toa"):
+                    axis="toa", precision="f64"):
     """Single-pulsar GLS fit with the TOA axis sharded over ``mesh`` —
     the sequence-parallel path for a pulsar whose TOA/photon count
     outgrows one chip (SURVEY section 5 "long-context").
@@ -111,13 +111,22 @@ def sharded_gls_fit(model, toas, mesh: Mesh, maxiter=2, threshold=1e-12,
     batched path's analytic Sherman-Morrison marginalization needs
     epoch locality.
 
+    ``precision="mixed"`` forms each shard's Gram block in f32 (the
+    MXU-native path) and recovers f64 accuracy by iterative refinement
+    whose exact-residual matvec is two O(n_local k) products plus one
+    psum per step — the distributed twin of PTABatch's mixed mode,
+    with the same non-contraction fallback to f64.
+
     Returns (x, whitened_chi2, cov) as numpy, matching
     fitter.GLSFitter on the same data (pinned by test_parallel.py).
     """
     import numpy as np
 
-    from ..fitter import (_reject_free_dmjump, cov_from_normalized,
+    from ..fitter import (_reject_free_dmjump, check_precision,
+                          cov_from_normalized, gls_eigh_refine,
                           gls_eigh_solve)
+
+    check_precision(precision)
     from .pta import _pad_single, pure_phase_fn, pure_sigma_fn
 
     _reject_free_dmjump(model)
@@ -178,23 +187,50 @@ def sharded_gls_fit(model, toas, mesh: Mesh, maxiter=2, threshold=1e-12,
         Mn = Mw / norm
         q = sqrt_phi_inv / norm
         z = r / sig
-        A = jax.lax.psum(Mn.T @ Mn, axis) + jnp.diag(q * q)
         b = jax.lax.psum(Mn.T @ z, axis)
         rw2 = jax.lax.psum(jnp.sum(jnp.square(z)), axis)
-        dxn, covn = gls_eigh_solve(A, b, threshold)
+        if precision == "mixed":
+            # per-shard Gram in f32 (the compute win), accumulated in
+            # f64 so the psum adds no further rounding
+            M32 = Mn.astype(jnp.float32)
+            A = (jax.lax.psum((M32.T @ M32).astype(jnp.float64), axis)
+                 + jnp.diag(q * q))
+
+            def matvec(v):
+                return jax.lax.psum(Mn.T @ (Mn @ v), axis) + (q * q) * v
+
+            dxn, covn, relres = gls_eigh_refine(A, b, matvec, threshold)
+        else:
+            A = jax.lax.psum(Mn.T @ Mn, axis) + jnp.diag(q * q)
+            dxn, covn = gls_eigh_solve(A, b, threshold)
+            relres = jnp.zeros(())
         chi2 = rw2 - b @ dxn
         dx = dxn / norm
-        return x - dx[1:nparam], chi2, covn[1:nparam, 1:nparam], norm[1:nparam]
+        return (x - dx[1:nparam], chi2, covn[1:nparam, 1:nparam],
+                norm[1:nparam], relres)
 
     step = jax.jit(jax.shard_map(
         local, mesh=mesh,
         in_specs=(P(), batch_specs, prep_specs),
-        out_specs=(P(), P(), P(), P())))
+        out_specs=(P(), P(), P(), P(), P())))
 
     # x must live replicated on the SAME mesh as the sharded data
     x = jax.device_put(x0, NamedSharding(mesh, P()))
+    worst_relres = 0.0
     for _ in range(maxiter):
-        x, chi2, covn, norm = step(x, batch, arrays)
+        x, chi2, covn, norm, relres = step(x, batch, arrays)
+        # worst over iterations: an early non-contraction corrupts x
+        # even when the final off-optimum solve happens to converge
+        worst_relres = max(worst_relres, float(relres))
     x, chi2, covn, norm = jax.device_get((x, chi2, covn, norm))
+    if precision == "mixed" and worst_relres > 1e-8:
+        import warnings
+
+        warnings.warn(
+            f"mixed-precision sharded GLS refinement did not converge "
+            f"(worst rel resid {worst_relres:.2e}); refitting in f64")
+        return sharded_gls_fit(model, toas, mesh, maxiter=maxiter,
+                               threshold=threshold, axis=axis,
+                               precision="f64")
     cov = cov_from_normalized(covn, norm)
     return x, float(chi2), cov

@@ -333,6 +333,14 @@ def test_sharded_single_pulsar_gls_matches_fitter():
     # covariance diagonal agrees with the fitter's uncertainties
     unc_ref = np.array([getattr(ref.model, n).uncertainty for n in names])
     np.testing.assert_allclose(np.sqrt(np.diag(cov_sh)), unc_ref, rtol=1e-6)
+    # mixed precision on the sharded path: per-shard f32 Gram + psum'd
+    # f64 refinement reproduces the f64 parameters to <= 1e-9
+    x_mx, chi2_mx, cov_mx = sharded_gls_fit(m, t, mesh, maxiter=2,
+                                            precision="mixed")
+    np.testing.assert_allclose(x_mx, x_sh, rtol=1e-9, atol=1e-18)
+    assert abs(chi2_mx - chi2_sh) <= 1e-9 * abs(chi2_sh)
+    np.testing.assert_allclose(np.sqrt(np.diag(cov_mx)),
+                               np.sqrt(np.diag(cov_sh)), rtol=1e-4)
 
 
 def test_ptafleet_mixed_structure_integration():
