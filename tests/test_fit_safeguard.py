@@ -76,8 +76,9 @@ def test_gls_poisoned_step_reverts(monkeypatch):
     real_solve = F.gls_solve
     calls = {"n": 0}
 
-    def poisoned(Mfull, r, sigma, sqrt_phi_inv, threshold=1e-12):
-        dx, cov, chi2 = real_solve(Mfull, r, sigma, sqrt_phi_inv, threshold)
+    def poisoned(Mfull, r, sigma, sqrt_phi_inv, threshold=1e-12, **kw):
+        dx, cov, chi2 = real_solve(Mfull, r, sigma, sqrt_phi_inv, threshold,
+                                   **kw)
         calls["n"] += 1
         if calls["n"] == 2:
             dx = dx + 1e-5
