@@ -292,12 +292,53 @@ def _full_scale_stage(meta):
     compile_s = time.time() - t0
     t0 = time.time()
     chi2s = []
+    x64s = []
     for b in batches:
-        _, chi2, _ = b.gls_fit(maxiter=2)
+        x64, chi2, _ = b.gls_fit(maxiter=2)
+        x64s.append(np.asarray(x64))
         chi2s.append(np.asarray(chi2))
     refit_s = time.time() - t0
     finite = all(np.isfinite(c).all() for c in chi2s)
     platform = jax.devices()[0].platform
+    # full-scale MIXED precision: measured only where it can win (TPU
+    # MXU; on CPU the f32 Gram is a wash — BASELINE.md r5) unless
+    # explicitly forced; costs len(batches) extra compiles, which
+    # split2 keeps to 2 on TPU
+    mixed_refit_s = mixed_max_rel = mixed_fell_back = None
+    want_mixed = os.environ.get("PINT_TPU_BENCH_FULL_MIXED",
+                                "1" if platform == "tpu" else "0") == "1"
+    if want_mixed:
+        try:
+            import warnings as _warnings
+
+            _stage("full-scale mixed-precision pass (compile + refit)")
+            # the compare loop doubles as compile+warm-up; the f64
+            # reference parameters come from the timed loop above
+            rels = []
+            for b, x64 in zip(batches, x64s):
+                xmx, _, _ = b.gls_fit(maxiter=2, precision="mixed")
+                rels.append(np.max(np.abs(np.asarray(xmx) - x64)
+                                   / (np.abs(x64) + 1e-30)))
+            # timed pass — and DETECT the silent f64 fallback: gls_fit
+            # transparently refits in f64 when refinement fails to
+            # contract, which would otherwise record a mixed+f64
+            # double-fit as the "mixed" wall time
+            with _warnings.catch_warnings(record=True) as wlist:
+                _warnings.simplefilter("always")
+                t0 = time.time()
+                for b in batches:
+                    _, cmx, _ = b.gls_fit(maxiter=2, precision="mixed")
+                    jax.block_until_ready(cmx)
+                mixed_refit_s = time.time() - t0
+            mixed_fell_back = any("refitting in f64" in str(w.message)
+                                  for w in wlist)
+            mixed_max_rel = float(np.max(rels))
+            _stage(f"full-scale mixed refit {mixed_refit_s:.2f}s "
+                   f"(max param rel diff {mixed_max_rel:.2e}, "
+                   f"fell_back={mixed_fell_back})")
+        except Exception as e:
+            _stage(f"full-scale mixed pass failed ({type(e).__name__}: "
+                   f"{e}); f64 numbers unaffected")
     model_fl = gls_model_flops(
         np.concatenate([np.asarray(b.n_toas) for b in batches]))
     meta.update({
@@ -316,6 +357,11 @@ def _full_scale_stage(meta):
         "measured_670k_mfu_model_pct": _mfu(model_fl, refit_s, platform),
         "measured_670k_all_finite": finite,
         "measured_670k_platform": platform,
+        "measured_670k_mixed_refit_s": (round(mixed_refit_s, 3)
+                                        if mixed_refit_s is not None
+                                        else None),
+        "measured_670k_mixed_max_param_rel_diff": mixed_max_rel,
+        "measured_670k_mixed_fell_back_f64": mixed_fell_back,
     })
     _stage(f"full-scale measured: {refit_s:.2f}s GLS refit over "
            f"{real_toas} TOAs in {len(batches)} buckets "
