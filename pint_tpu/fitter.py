@@ -549,7 +549,14 @@ def gls_solve(Mfull, r, sigma, sqrt_phi_inv, threshold=1e-12,
         dxn, covn, rel_resid = gls_eigh_refine(A, b, matvec, threshold)
         if float(rel_resid) > 1e-8:
             # f32 preconditioner couldn't contract (kept spectrum too
-            # wide, κ > ~1e7): redo in f64 — correctness first
+            # wide, κ > ~1e7): redo in f64 — correctness first. Warn
+            # like the PTABatch path does: a silent fallback makes
+            # "mixed" strictly slower than f64 with no signal
+            import warnings
+
+            warnings.warn(
+                f"mixed-precision GLS refinement did not converge "
+                f"(rel resid {float(rel_resid):.2e}); refitting in f64")
             A = gls_gram(Mn, q, "f64")
             dxn, covn = gls_eigh_solve(A, b, threshold)
     else:
@@ -989,8 +996,10 @@ class DownhillGLSFitter(GLSFitter):
     convergence tolerance rather than re-preparing per outer step.
     """
 
-    def fit_toas(self, maxiter=10, threshold=1e-12, tol=1e-8):
-        return super().fit_toas(maxiter=maxiter, threshold=threshold, tol=tol)
+    def fit_toas(self, maxiter=10, threshold=1e-12, tol=1e-8,
+                 precision="f64"):
+        return super().fit_toas(maxiter=maxiter, threshold=threshold,
+                                tol=tol, precision=precision)
 
 
 class WidebandTOAFitter(GLSFitter):
@@ -1127,10 +1136,11 @@ class WidebandTOAFitter(GLSFitter):
         fn = self._wideband_chi2_fn(prepared, bases, threshold)
         return float(fn(prepared.vector_from_params()))
 
-    def fit_toas(self, maxiter=2, threshold=1e-12):
+    def fit_toas(self, maxiter=2, threshold=1e-12, precision="f64"):
         import time
 
         _warn_degraded_once()
+        check_precision(precision)
         t_start = time.perf_counter()
         iter_s = []
         chi2 = None
@@ -1145,7 +1155,7 @@ class WidebandTOAFitter(GLSFitter):
             Mfull, sqrt_phi_inv, nparam = stack_noise_bases(
                 combined.matrix, bases)
             dx_all, cov, chi2 = gls_solve(Mfull, r, sigma, sqrt_phi_inv,
-                                          threshold)
+                                          threshold, precision=precision)
             self._sync_model_from_vector(prepared, x0 - dx_all[noff:nparam])
             self.noise_ampls = (np.asarray(dx_all[nparam:])
                                 if bases[0] is not None else None)
@@ -1199,9 +1209,10 @@ class WidebandDownhillFitter(WidebandTOAFitter):
     (reference: fitter.py::WidebandDownhillFitter)."""
 
     def fit_toas(self, maxiter=15, threshold=1e-12, min_lambda=1e-3,
-                 tol=1e-9, raise_maxiter=False):
+                 tol=1e-9, raise_maxiter=False, precision="f64"):
         import time
 
+        check_precision(precision)
         t_start = time.perf_counter()
         iter_s = []
         best_chi2 = None
@@ -1218,7 +1229,7 @@ class WidebandDownhillFitter(WidebandTOAFitter):
             Mfull, sqrt_phi_inv, nparam = stack_noise_bases(
                 combined.matrix, bases)
             dx_all, cov, _ = gls_solve(Mfull, r, sigma, sqrt_phi_inv,
-                                       threshold)
+                                       threshold, precision=precision)
             self.noise_ampls = (np.asarray(dx_all[nparam:])
                                 if bases[0] is not None else None)
             dx = dx_all[noff:nparam]
@@ -1264,11 +1275,12 @@ class WidebandLMFitter(WidebandTOAFitter):
     chi2 acceptance/rejection."""
 
     def fit_toas(self, maxiter=20, threshold=1e-12, lm_lambda0=1e-3,
-                 tol=1e-9):
+                 tol=1e-9, precision="f64"):
         import time
 
         import jax.numpy as jnp
 
+        check_precision(precision)
         t_start = time.perf_counter()
         iter_s = []
         lm = lm_lambda0
@@ -1279,9 +1291,22 @@ class WidebandLMFitter(WidebandTOAFitter):
                 self._wideband_system()
             Mfull, sqrt_phi_inv, nparam = stack_noise_bases(
                 combined.matrix, bases)
-            A, b, norm = gls_normal(Mfull, r, sigma, sqrt_phi_inv)
-            A_damped = A + lm * jnp.diag(jnp.diag(A))
-            dxn = jnp.linalg.solve(A_damped, b)
+            if precision == "mixed":
+                # f32 Gram + refinement against the DAMPED f64 operator
+                Mn, norm, q = gls_whiten(Mfull, sigma, sqrt_phi_inv)
+                b = Mn.T @ (r / sigma)
+                A = gls_gram(Mn, q, "mixed")
+                dA = jnp.diag(A)
+                A_damped = A + lm * jnp.diag(dA)
+                dxn = jnp.linalg.solve(A_damped, b)
+                for _r in range(2):
+                    resid = b - (Mn.T @ (Mn @ dxn) + (q * q) * dxn
+                                 + lm * dA * dxn)
+                    dxn = dxn + jnp.linalg.solve(A_damped, resid)
+            else:
+                A, b, norm = gls_normal(Mfull, r, sigma, sqrt_phi_inv)
+                A_damped = A + lm * jnp.diag(jnp.diag(A))
+                dxn = jnp.linalg.solve(A_damped, b)
             dx = (dxn / norm)[noff:nparam]
             self._sync_model_from_vector(prepared, x0 - dx)
             chi2 = self._wideband_chi2(threshold)

@@ -246,3 +246,36 @@ def test_mixed_precision_fallback_warns():
     np.testing.assert_allclose(np.asarray(dxmx), np.asarray(dx64),
                                rtol=1e-9, atol=1e-12)
     assert chimx == pytest.approx(chi64, rel=1e-9)
+
+
+def test_mixed_precision_via_downhill_and_wideband():
+    """precision='mixed' flows through DownhillGLSFitter and
+    WidebandTOAFitter identically to f64 (the passthrough plumbing)."""
+    from pint_tpu.fitter import DownhillGLSFitter, WidebandTOAFitter
+
+    par = PAR + "RNAMP 1e-14\nRNIDX -3.0\nTNREDC 6\n"
+    m = get_model(par)
+    t = _toas(m, n=40, seed=2)
+    for fl in t.flags:
+        fl["pp_dm"] = "12.0"
+        fl["pp_dme"] = "1e-4"
+    c1 = DownhillGLSFitter(t, get_model(par)).fit_toas(maxiter=4)
+    c2 = DownhillGLSFitter(t, get_model(par)).fit_toas(
+        maxiter=4, precision="mixed")
+    assert c2 == pytest.approx(c1, rel=1e-9)
+    w1 = WidebandTOAFitter(t, get_model(par)).fit_toas(maxiter=2)
+    w2 = WidebandTOAFitter(t, get_model(par)).fit_toas(
+        maxiter=2, precision="mixed")
+    assert w2 == pytest.approx(w1, rel=1e-9)
+    from pint_tpu.fitter import WidebandDownhillFitter, WidebandLMFitter
+
+    d1 = WidebandDownhillFitter(t, get_model(par)).fit_toas(maxiter=6)
+    d2 = WidebandDownhillFitter(t, get_model(par)).fit_toas(
+        maxiter=6, precision="mixed")
+    assert d2 == pytest.approx(d1, rel=1e-8)
+    l1 = WidebandLMFitter(t, get_model(par)).fit_toas(maxiter=8)
+    l2 = WidebandLMFitter(t, get_model(par)).fit_toas(
+        maxiter=8, precision="mixed")
+    assert l2 == pytest.approx(l1, rel=1e-8)
+    with pytest.raises(ValueError, match="precision"):
+        WidebandTOAFitter(t, get_model(par)).fit_toas(precision="bf16")
