@@ -387,6 +387,38 @@ class TestPickleCacheInvalidation:
                                           "BIPM2019")
         assert k1 != k2
 
+    def test_corrupted_pickle_reparses_gracefully(self, tmp_path):
+        """A truncated/garbage cache file must be treated as a miss (a
+        crash here would make the cache a liability on any unclean
+        shutdown)."""
+        tim, m = self._setup(tmp_path)
+        t1 = get_TOAs(tim, model=m, usepickle=True)
+        cache = tim + ".pickle.gz"
+        import os
+        assert os.path.exists(cache)
+        with open(cache, "wb") as fh:
+            fh.write(b"\x1f\x8b garbage not a pickle")
+        t2 = get_TOAs(tim, model=m, usepickle=True)  # silent re-parse
+        np.testing.assert_array_equal(t1.day, t2.day)
+        np.testing.assert_array_equal(t1.sec, t2.sec)
+
+    def test_explicit_picklefile_without_filename(self, tmp_path):
+        """TOAs built from arrays (no source file) cache only via an
+        explicit picklefile, and load back unvalidated."""
+        from pint_tpu.toa import TOAs, load_pickle, save_pickle
+
+        t = TOAs.from_arrays(np.array([55000], dtype=np.int64),
+                             np.array([43200.0]), error_us=np.array([1.0]),
+                             freq_mhz=np.array([1400.0]),
+                             obs=np.array(["gbt"], dtype=object))
+        with pytest.raises(ValueError, match="picklefile"):
+            save_pickle(t)
+        pf = str(tmp_path / "arr.pickle.gz")
+        save_pickle(t, picklefile=pf)
+        back = load_pickle(None, picklefile=pf)
+        assert back is not None and len(back) == 1
+        assert back.day[0] == 55000
+
     def test_bipm_setting_in_cache_key(self, tmp_path):
         import pint_tpu.toa as toa_mod
 
