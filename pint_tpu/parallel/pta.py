@@ -663,6 +663,26 @@ class PTABatch:
                        if marginalize else None)
         ecorr_comp = (self.template.components.get("EcorrNoise")
                       if marginalize else None)
+        # HOIST the x-independent dense blocks out of the Gauss-Newton
+        # iteration: with every noise/white-noise parameter frozen (the
+        # universal case — LS fits can't constrain them anyway), the
+        # whitened noise-basis columns Bn, their Gram Bn^T Bn (~88% of
+        # the normal-equation FLOPs at 60-of-64 columns), the epoch
+        # sums, and the Sherman-Morrison weights are all constants of
+        # the fit; only the tiny parameter block changes per iteration.
+        # A free noise parameter disables the hoist (falls back to the
+        # full per-iteration recompute).
+        free_names = {n for n, _, _ in self.free_map()}
+        noise_param_names = set()
+        for c in self.template.components.values():
+            # duck-typed like pure_sigma_fn / _noise_bw_fn: any
+            # component that can scale sigma or contribute a basis
+            # feeds the hoisted constants
+            if (getattr(c, "basis_weight", None) is not None
+                    or getattr(c, "scale_sigma", None) is not None):
+                noise_param_names.update(c.params)
+        hoist = (marginalize and precision == "f64"
+                 and not (free_names & noise_param_names))
 
         def design(x, params, batch, prep, p):
             def phase_of(xv):
@@ -765,6 +785,77 @@ class PTABatch:
             return (x - dx_all[1:nparam], chi2,
                     (covn[1:nparam, 1:nparam], norm[1:nparam], relres))
 
+        def precompute_marg(params, batch, prep):
+            """x-independent pieces of one_step_marg (see the hoist
+            comment above): whitened noise basis, its Gram, epoch sums,
+            Sherman-Morrison weights. Evaluated at the packed params —
+            valid because the hoist guard proved none of these read a
+            free parameter."""
+            _, sig = resid_fn(params, batch, prep)
+            sigma_s = sig * 1e-6
+            a = 1.0 / sigma_s
+            bw = (noise_bw_nf(params, prep) if noise_bw_nf is not None
+                  else None) or (None, None)
+            # single-home conventions: stack_noise_bases owns the
+            # us^2 -> prior-sqrt formula, gls_whiten the prior-folded
+            # whitening/normalization (a zero-column params block makes
+            # them operate on the basis alone)
+            B, spi_B, _ = stack_noise_bases(
+                jnp.zeros((sigma_s.shape[0], 0)), bw)
+            Bn, normB, qB = gls_whiten(B, sigma_s, spi_B)
+            FtF = Bn.T @ Bn
+            eidx, w_ec = ecorr_comp.epoch_index_weight(
+                params, {**prep, **self.static})
+            k = w_ec.shape[0]
+            e_idx = jnp.where((eidx >= 0) & (eidx < k), eidx, k)
+            s = jax.ops.segment_sum(a * a, e_idx, num_segments=k + 1)[:k]
+            GB = jax.ops.segment_sum(Bn * a[:, None], e_idx,
+                                     num_segments=k + 1)[:k]
+            w_s2 = w_ec * 1e-12
+            c = w_s2 / (1.0 + w_s2 * s)
+            sc = jnp.sqrt(c)
+            GcB = sc[:, None] * GB
+            return dict(sigma_s=sigma_s, a=a, Bn=Bn, qB=qB, normB=normB,
+                        FtF=FtF, e_idx=e_idx, c=c, sc=sc, GcB=GcB,
+                        GcBtGcB=GcB.T @ GcB, k=k)
+
+        def one_step_marg_hoisted(x, params, batch, prep, pre):
+            # identical math to one_step_marg with the constant blocks
+            # read from ``pre`` — only the (1 + n_free)-column parameter
+            # block is recomputed per iteration
+            p = self._overlay(params, x)
+            r, _ = resid_fn(p, batch, prep)
+            sigma_s, a, k = pre["sigma_s"], pre["a"], pre["k"]
+            M = design(x, params, batch, prep, p)
+            nparam = M.shape[1]
+            Mn_p, normM, _ = gls_whiten(M, sigma_s, jnp.zeros(nparam))
+            z = r / sigma_s
+            b0 = jnp.concatenate([Mn_p.T @ z, pre["Bn"].T @ z])
+            rNr = jnp.sum(jnp.square(z))
+            G_p = jax.ops.segment_sum(Mn_p * a[:, None], pre["e_idx"],
+                                      num_segments=k + 1)[:k]
+            Gc_p = pre["sc"][:, None] * G_p
+            t = jax.ops.segment_sum(z * a, pre["e_idx"],
+                                    num_segments=k + 1)[:k]
+            ApB = Mn_p.T @ pre["Bn"]
+            A0 = jnp.block([[Mn_p.T @ Mn_p, ApB],
+                            [ApB.T, pre["FtF"]]])
+            GcX = Gc_p.T @ pre["GcB"]
+            Gct = jnp.block([[Gc_p.T @ Gc_p, GcX],
+                             [GcX.T, pre["GcBtGcB"]]])
+            q = jnp.concatenate([jnp.zeros(nparam), pre["qB"]])
+            norm = jnp.concatenate([normM, pre["normB"]])
+            sct = pre["sc"] * t
+            bn = b0 - jnp.concatenate([Gc_p.T @ sct, pre["GcB"].T @ sct])
+            rCr = rNr - jnp.sum(pre["c"] * jnp.square(t))
+            An = A0 - Gct + jnp.diag(q * q)
+            dxn, covn = gls_eigh_solve(An, bn, threshold)
+            dx_all = dxn / norm
+            chi2 = rCr - bn @ dxn
+            return (x - dx_all[1:nparam], chi2,
+                    (covn[1:nparam, 1:nparam], norm[1:nparam],
+                     jnp.zeros(())))
+
         one_step = one_step_marg if marginalize else one_step_dense
 
         def fit_one(x0, params, batch, prep):
@@ -773,13 +864,19 @@ class PTABatch:
             # iterations: an early-iteration non-contraction corrupts x
             # even if the final (off-optimum) solve happens to converge
             worst = jnp.zeros(())
+            pre = precompute_marg(params, batch, prep) if hoist else None
             for _ in range(maxiter):
-                x, chi2, (covn, norm, relres) = one_step(
-                    x, params, batch, prep)
+                if hoist:
+                    x, chi2, (covn, norm, relres) = one_step_marg_hoisted(
+                        x, params, batch, prep, pre)
+                else:
+                    x, chi2, (covn, norm, relres) = one_step(
+                        x, params, batch, prep)
                 worst = jnp.maximum(worst, relres)
             return x, chi2, (covn, norm, worst)
 
-        return ("gls", maxiter, threshold, marginalize, precision), fit_one
+        return (("gls", maxiter, threshold, marginalize, precision, hoist),
+                fit_one)
 
     def gls_fit(self, maxiter=2, threshold=1e-12, ecorr_mode="auto",
                 precision="f64"):
