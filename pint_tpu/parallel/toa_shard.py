@@ -142,6 +142,20 @@ def sharded_gls_fit(model, toas, mesh: Mesh, maxiter=2, threshold=1e-12,
     free = prepared.free_param_map()
     nparam = len(free) + 1  # + offset column
     x0 = jnp.asarray(prepared.vector_from_params())
+    # hoist guard, mirroring PTABatch._build_gls: with every noise /
+    # sigma-scaling parameter frozen, the whitened basis columns, their
+    # psum'd Gram (the bulk of the normal-equation FLOPs), the norms,
+    # and sigma itself are constants of the fit — precompute them in
+    # ONE sharded pass and rebuild only the parameter block per
+    # Gauss-Newton iteration
+    free_names = {n for n, _, _ in free}
+    noise_param_names = set()
+    for c in model.components.values():
+        if (getattr(c, "basis_weight", None) is not None
+                or getattr(c, "scale_sigma", None) is not None):
+            noise_param_names.update(c.params)
+    hoist = (precision == "f64" and bool(noise_comps)
+             and not (free_names & noise_param_names))
 
     batch_specs = jax.tree_util.tree_map(
         lambda a: _data_spec(a, n_pad, axis), batch)
@@ -149,6 +163,14 @@ def sharded_gls_fit(model, toas, mesh: Mesh, maxiter=2, threshold=1e-12,
         lambda a: _data_spec(a, n_pad, axis), arrays)
     batch = _place(mesh, batch, batch_specs)
     arrays = _place(mesh, arrays, prep_specs)
+
+    def _global_colnorms(Mw):
+        # exponent-safe global column norms (see fitter.column_norms):
+        # peak-scale via pmax, then a psum'd sum of squares
+        amax = jax.lax.pmax(jnp.max(jnp.abs(Mw), axis=0), axis)
+        amax = jnp.where(amax == 0, 1.0, amax)
+        ss = jax.lax.psum(jnp.sum(jnp.square(Mw / amax), axis=0), axis)
+        return amax * jnp.where(ss == 0, 1.0, jnp.sqrt(ss))
 
     def local(x, batch, prep):
         def resid_of(xv):
@@ -176,14 +198,8 @@ def sharded_gls_fit(model, toas, mesh: Mesh, maxiter=2, threshold=1e-12,
                     1.0 / (jnp.sqrt(jnp.where(w_us2 > 0, w_us2, 1.0))
                            * 1e-6), 0.0)
                 sqrt_phi_inv = jnp.concatenate([sqrt_phi_inv, spi])
-        # exponent-safe global column norms (see fitter.column_norms):
-        # peak-scale via pmax, then a psum'd sum of squares
         Mw = M / sig[:, None]
-        amax = jax.lax.pmax(jnp.max(jnp.abs(Mw), axis=0), axis)
-        amax = jnp.where(amax == 0, 1.0, amax)
-        ss = jax.lax.psum(jnp.sum(jnp.square(Mw / amax), axis=0), axis)
-        cn = amax * jnp.where(ss == 0, 1.0, jnp.sqrt(ss))
-        norm = jnp.hypot(cn, sqrt_phi_inv)
+        norm = jnp.hypot(_global_colnorms(Mw), sqrt_phi_inv)
         Mn = Mw / norm
         q = sqrt_phi_inv / norm
         z = r / sig
@@ -209,6 +225,62 @@ def sharded_gls_fit(model, toas, mesh: Mesh, maxiter=2, threshold=1e-12,
         return (x - dx[1:nparam], chi2, covn[1:nparam, 1:nparam],
                 norm[1:nparam], relres)
 
+    def pre_local(batch, prep):
+        """One sharded pass for the x-independent pieces (hoist)."""
+        p = prepared.params_with_vector(x0)
+        sig = sigma_fn(p, batch, prep) * 1e-6
+        full = {**prep, **static}
+        from ..fitter import stack_noise_bases
+
+        Bs, ws = [], []
+        for c in noise_comps:
+            Bc, w_us2 = c.basis_weight(p, full)
+            if Bc.shape[1]:
+                Bs.append(Bc)
+                ws.append(w_us2)
+        bw = ((jnp.concatenate(Bs, axis=1), jnp.concatenate(ws))
+              if Bs else None)
+        # single home of the us^2 -> prior-sqrt convention
+        B, spi, _ = stack_noise_bases(
+            jnp.zeros((sig.shape[0], 0)), bw or (None, None))
+        normB = jnp.hypot(_global_colnorms(B / sig[:, None]), spi)
+        Bn = (B / sig[:, None]) / normB
+        qB = spi / normB
+        FtF = jax.lax.psum(Bn.T @ Bn, axis)
+        return Bn, sig, FtF, normB, qB
+
+    def local_hoisted(x, batch, prep, Bn, sig, FtF, normB, qB):
+        # identical math to ``local`` with the basis block constant
+        def resid_of(xv):
+            p = prepared.params_with_vector(xv)
+            ph = phase(p, batch, prep)
+            frac = ph - jnp.floor(ph + 0.5)
+            w = 1.0 / jnp.square(sig)
+            sw = jax.lax.psum(jnp.sum(frac * w), axis)
+            tw = jax.lax.psum(jnp.sum(w), axis)
+            return (frac - sw / tw) / p["F"][0]
+
+        r = resid_of(x)
+        M = jax.jacfwd(resid_of)(x)
+        M = jnp.concatenate([jnp.ones((M.shape[0], 1)), M], axis=1)
+        Mw = M / sig[:, None]
+        normM = _global_colnorms(Mw)
+        Mn_p = Mw / normM
+        z = r / sig
+        b = jnp.concatenate([jax.lax.psum(Mn_p.T @ z, axis),
+                             jax.lax.psum(Bn.T @ z, axis)])
+        rw2 = jax.lax.psum(jnp.sum(jnp.square(z)), axis)
+        App = jax.lax.psum(Mn_p.T @ Mn_p, axis)
+        ApB = jax.lax.psum(Mn_p.T @ Bn, axis)
+        q = jnp.concatenate([jnp.zeros(nparam), qB])
+        A = jnp.block([[App, ApB], [ApB.T, FtF]]) + jnp.diag(q * q)
+        dxn, covn = gls_eigh_solve(A, b, threshold)
+        chi2 = rw2 - b @ dxn
+        norm = jnp.concatenate([normM, normB])
+        dx = dxn / norm
+        return (x - dx[1:nparam], chi2, covn[1:nparam, 1:nparam],
+                norm[1:nparam], jnp.zeros(()))
+
     step = jax.jit(jax.shard_map(
         local, mesh=mesh,
         in_specs=(P(), batch_specs, prep_specs),
@@ -217,6 +289,21 @@ def sharded_gls_fit(model, toas, mesh: Mesh, maxiter=2, threshold=1e-12,
     # x must live replicated on the SAME mesh as the sharded data
     x = jax.device_put(x0, NamedSharding(mesh, P()))
     worst_relres = 0.0
+    if hoist:
+        pre_step = jax.jit(jax.shard_map(
+            pre_local, mesh=mesh, in_specs=(batch_specs, prep_specs),
+            out_specs=(P(axis), P(axis), P(), P(), P())))
+        pre = pre_step(batch, arrays)
+        step_h = jax.jit(jax.shard_map(
+            local_hoisted, mesh=mesh,
+            in_specs=(P(), batch_specs, prep_specs,
+                      P(axis), P(axis), P(), P(), P()),
+            out_specs=(P(), P(), P(), P(), P())))
+        for _ in range(maxiter):
+            x, chi2, covn, norm, relres = step_h(x, batch, arrays, *pre)
+        x, chi2, covn, norm = jax.device_get((x, chi2, covn, norm))
+        cov = cov_from_normalized(covn, norm)
+        return x, float(chi2), cov
     for _ in range(maxiter):
         x, chi2, covn, norm, relres = step(x, batch, arrays)
         # worst over iterations: an early non-contraction corrupts x
