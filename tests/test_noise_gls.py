@@ -401,3 +401,42 @@ def test_plswnoise_row_scale_follows_swx_window_p():
         t.obs_sun.pos / 299792458.0, n_hat, 4.0))
     expected_in = 1e6 * DMconst * geom4[in_win] / freqs[in_win] ** 2
     np.testing.assert_allclose(s4[in_win], expected_in, rtol=1e-9)
+
+
+def test_gls_hoist_guard_falls_back_with_free_noise_param():
+    """The Gauss-Newton hoist (constant noise-basis blocks) is only
+    valid with frozen noise parameters; a free EFAC must disable it
+    and the fit must still run (and agree with the dense cross-check
+    path). The free EFAC's design column is identically zero, so the
+    threshold drops it — the point here is the guard, not the EFAC."""
+    from pint_tpu.parallel import PTABatch
+
+    par_free = ("PSR TH0\nRAJ 10:00:00\nDECJ 05:00:00\nF0 200.5 1\n"
+                "F1 -2e-16 1\nPEPOCH 55500\nDM 10.5 1\n"
+                "EFAC -f L 1.1 1\nECORR -f L 0.6\n"
+                "RNAMP 1e-14\nRNIDX -3\nTNREDC 4\n")
+    m = get_model(par_free)
+    assert "EFAC1" in m.free_params
+    rng = np.random.default_rng(2)
+    days = np.sort(rng.uniform(55000, 55800, 15))
+    # 1 s pairs: inside the 2 s ECORR quantization window, so real
+    # epochs exist and the marginalized (hoistable) path is reachable
+    mjds = np.sort(np.concatenate([days, days + 1.0 / 86400]))
+    t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=1400.0,
+                                obs="gbt", add_noise=True, seed=2,
+                                iterations=1)
+    for fl in t.flags:
+        fl["f"] = "L"
+    pta = PTABatch([m], [t])
+    key, _ = pta._build_gls()
+    assert key[-1] is False  # hoist disabled by the free EFAC
+    x_a, chi2_a, _ = pta.gls_fit(maxiter=2)
+    x_d, chi2_d, _ = pta.gls_fit(maxiter=2, ecorr_mode="dense")
+    assert np.isfinite(np.asarray(chi2_a)).all()
+    np.testing.assert_allclose(np.asarray(x_a), np.asarray(x_d),
+                               rtol=1e-8, atol=1e-20)
+    # frozen-noise control: same structure, EFAC frozen -> hoisted
+    m2 = get_model(par_free.replace("EFAC -f L 1.1 1", "EFAC -f L 1.1"))
+    pta2 = PTABatch([m2], [t])
+    key2, _ = pta2._build_gls()
+    assert key2[-1] is True
