@@ -681,7 +681,10 @@ class PTABatch:
             if (getattr(c, "basis_weight", None) is not None
                     or getattr(c, "scale_sigma", None) is not None):
                 noise_param_names.update(c.params)
-        hoist = (marginalize and precision == "f64"
+        # (mixed precision composes: the hoisted constant Gram runs in
+        # f32 and the per-iteration solve is refined against exact f64
+        # matvecs through the factored blocks)
+        hoist = (marginalize
                  and not (free_names & noise_param_names))
 
         def design(x, params, batch, prep, p):
@@ -803,7 +806,9 @@ class PTABatch:
             B, spi_B, _ = stack_noise_bases(
                 jnp.zeros((sigma_s.shape[0], 0)), bw)
             Bn, normB, qB = gls_whiten(B, sigma_s, spi_B)
-            FtF = Bn.T @ Bn
+            # the one remaining big Gram: f32 (MXU) under "mixed", with
+            # the per-iteration refinement recovering f64 accuracy
+            FtF = gls_gram(Bn, jnp.zeros_like(qB), precision)
             eidx, w_ec = ecorr_comp.epoch_index_weight(
                 params, {**prep, **self.static})
             k = w_ec.shape[0]
@@ -849,12 +854,28 @@ class PTABatch:
             bn = b0 - jnp.concatenate([Gc_p.T @ sct, pre["GcB"].T @ sct])
             rCr = rNr - jnp.sum(pre["c"] * jnp.square(t))
             An = A0 - Gct + jnp.diag(q * q)
-            dxn, covn = gls_eigh_solve(An, bn, threshold)
+            if precision == "mixed":
+                # exact f64 operator through the factored blocks: every
+                # product is O(n k) or O(epochs k) — the f64 Gram never
+                # forms, yet refinement converges to f64 accuracy
+                def matvec(v):
+                    vp, vB = v[:nparam], v[nparam:]
+                    u = Mn_p @ vp + pre["Bn"] @ vB
+                    A0v = jnp.concatenate([Mn_p.T @ u, pre["Bn"].T @ u])
+                    gv = Gc_p @ vp + pre["GcB"] @ vB
+                    Gcv = jnp.concatenate([Gc_p.T @ gv,
+                                           pre["GcB"].T @ gv])
+                    return A0v - Gcv + (q * q) * v
+
+                dxn, covn, relres = gls_eigh_refine(An, bn, matvec,
+                                                    threshold)
+            else:
+                dxn, covn = gls_eigh_solve(An, bn, threshold)
+                relres = jnp.zeros(())
             dx_all = dxn / norm
             chi2 = rCr - bn @ dxn
             return (x - dx_all[1:nparam], chi2,
-                    (covn[1:nparam, 1:nparam], norm[1:nparam],
-                     jnp.zeros(())))
+                    (covn[1:nparam, 1:nparam], norm[1:nparam], relres))
 
         one_step = one_step_marg if marginalize else one_step_dense
 

@@ -142,12 +142,16 @@ def sharded_gls_fit(model, toas, mesh: Mesh, maxiter=2, threshold=1e-12,
     free = prepared.free_param_map()
     nparam = len(free) + 1  # + offset column
     x0 = jnp.asarray(prepared.vector_from_params())
-    # hoist guard, mirroring PTABatch._build_gls: with every noise /
+    # hoist guard, analogous to PTABatch._build_gls: with every noise /
     # sigma-scaling parameter frozen, the whitened basis columns, their
     # psum'd Gram (the bulk of the normal-equation FLOPs), the norms,
     # and sigma itself are constants of the fit — precompute them in
     # ONE sharded pass and rebuild only the parameter block per
-    # Gauss-Newton iteration
+    # Gauss-Newton iteration. INTENTIONAL divergence from the batched
+    # path: there hoist composes with precision="mixed"; here mixed
+    # keeps the unhoisted step (composing them needs the refinement
+    # matvec factored across shards — deferred until it can be
+    # validated on real multi-chip hardware)
     free_names = {n for n, _, _ in free}
     noise_param_names = set()
     for c in model.components.values():
