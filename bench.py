@@ -647,6 +647,45 @@ def main():
     elif htest_done_s is not None:
         _stage(f"H-test 4M photons: {htest_done_s:.3f}s (H={htest_h:.0f})")
 
+    # online-serving side metric: stream 216 mixed-shape fit requests
+    # (3 model structures x 3 TOA buckets) through pint_tpu.serve with
+    # the PTAFleet cross-check. Same resilience posture as the H-test
+    # stage: OPTIONAL for the headline, daemon thread + join timeout so
+    # a wedge cannot cost the JSON line. Skip with
+    # PINT_TPU_BENCH_SKIP_SERVE=1.
+    serve_report = None
+
+    def _serve_stage():
+        nonlocal serve_report
+        try:
+            from pint_tpu.scripts.pint_serve_bench import run_serve_stream
+
+            rep = run_serve_stream(n_requests=216, bucket_floor=64,
+                                   compare_offline=True)
+            serve_report = rep  # set LAST: completion marker
+        except Exception as e:
+            _stage(f"serve stage failed ({type(e).__name__}: {e}); "
+                   "headline JSON unaffected")
+
+    serve_wedged = False
+    if os.environ.get("PINT_TPU_BENCH_SKIP_SERVE") == "1":
+        _stage("serve stage skipped (PINT_TPU_BENCH_SKIP_SERVE=1)")
+    else:
+        _stage("serve: streaming 216 requests (3 structures x 3 buckets)")
+        ts = threading.Thread(target=_serve_stage, daemon=True)
+        ts.start()
+        ts.join(timeout=600)
+        serve_wedged = ts.is_alive()
+        if serve_wedged:
+            serve_report = None  # snapshot: late finish must not race
+            _stage("serve stage timed out; headline JSON unaffected")
+        elif serve_report is not None:
+            _stage(f"serve: p50 {serve_report['serve_p50_latency_s'] * 1e3:.1f}ms "
+                   f"p99 {serve_report['serve_p99_latency_s'] * 1e3:.1f}ms, "
+                   f"hit rate {serve_report['cache']['hit_rate']:.3f}, "
+                   f"{serve_report['recompiles_after_warmup']} recompiles "
+                   "after warmup")
+
     total_toas = n_psr * n_toa
     rate = total_toas / gls_refit_s  # TOAs GLS-refit per second
     projected_670k = gls_refit_s * (670_000 / total_toas)
@@ -696,6 +735,24 @@ def main():
         "htest_photons_per_sec": (round(n_ph / htest_done_s, 0)
                                   if htest_done_s else None),
         "htest_includes_transfer": False,
+        "serve_p50_latency_ms": (round(serve_report["serve_p50_latency_s"]
+                                       * 1e3, 2) if serve_report else None),
+        "serve_p99_latency_ms": (round(serve_report["serve_p99_latency_s"]
+                                       * 1e3, 2) if serve_report else None),
+        "serve_cache_hit_rate": (serve_report["cache"]["hit_rate"]
+                                 if serve_report else None),
+        "serve_cache_counters": (serve_report["cache"]
+                                 if serve_report else None),
+        "serve_recompiles_after_warmup": (
+            serve_report["recompiles_after_warmup"]
+            if serve_report else None),
+        "serve_warmup_executables": (serve_report["warmup_executables"]
+                                     if serve_report else None),
+        "serve_n_requests": (serve_report["n_requests"]
+                             if serve_report else None),
+        "serve_max_param_rel_diff": (
+            serve_report.get("max_param_rel_diff_vs_offline")
+            if serve_report else None),
         "platform": platform,
     }
     meta.update(full_meta)
@@ -706,7 +763,7 @@ def main():
         "vs_baseline": round(vs_baseline, 3),
         "detail": meta,
     }), flush=True)
-    if wedged or full_alive or _MIXED_THREAD_ALIVE:
+    if wedged or serve_wedged or full_alive or _MIXED_THREAD_ALIVE:
         # a daemon thread stuck in a C++ device wait can hang (or a
         # still-live dropped full-scale worker can crash) normal
         # interpreter teardown — measured rc=250 from exactly that;

@@ -54,16 +54,53 @@ def _pta_workload(n_psr, n_toas):
     return lambda: pta.wls_fit(maxiter=3)[1]
 
 
+def _serve_workload(n_requests, hit_threshold):
+    """Mixed-shape request stream through pint_tpu.serve: asserts the
+    zero-retrace property (no executable compiles after warmup, cache
+    hit rate >= threshold) that the serving layer exists to provide.
+    Returns the report dict; raises AssertionError on a retrace."""
+    import warnings
+
+    warnings.simplefilter("ignore")
+    from pint_tpu.scripts.pint_serve_bench import run_serve_stream
+
+    report = run_serve_stream(n_requests=n_requests, max_batch=4,
+                              bucket_floor=32, sizes=(24, 48, 90),
+                              per_combo=2, compare_offline=False)
+    assert report["recompiles_after_warmup"] == 0, \
+        f"serve stream retraced: {report['recompiles_after_warmup']} " \
+        f"executables compiled after warmup"
+    hit_rate = report["cache"]["hit_rate"] or 0.0
+    assert hit_rate >= hit_threshold, \
+        f"cache hit rate {hit_rate:.3f} < threshold {hit_threshold}"
+    return report
+
+
 def main(argv=None):
     import jax
 
     p = argparse.ArgumentParser()
-    p.add_argument("--workload", choices=("wls", "pta"), default="wls")
+    p.add_argument("--workload", choices=("wls", "pta", "serve"),
+                   default="wls")
     p.add_argument("--n-toas", type=int, default=5000)
     p.add_argument("--n-psr", type=int, default=8)
     p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--requests", type=int, default=120,
+                   help="stream length for --workload serve")
+    p.add_argument("--hit-threshold", type=float, default=0.9,
+                   help="min post-warmup cache hit rate (serve)")
     p.add_argument("--trace", help="jax.profiler trace output dir")
     args = p.parse_args(argv)
+
+    if args.workload == "serve":
+        t0 = time.perf_counter()
+        report = _serve_workload(args.requests, args.hit_threshold)
+        report.update({"workload": "serve",
+                       "platform": jax.default_backend(),
+                       "wall_s": round(time.perf_counter() - t0, 3),
+                       "hit_threshold": args.hit_threshold})
+        print(json.dumps(report, default=float))
+        return 0
 
     step = (_wls_workload(args.n_toas) if args.workload == "wls"
             else _pta_workload(args.n_psr, args.n_toas))

@@ -436,6 +436,21 @@ def gls_gram(Mn, q, precision="f64"):
     return A + jnp.diag(q * q)
 
 
+def relres_failed(rel_resid, tol=1e-8):
+    """NaN-aware check of gls_eigh_refine's convergence diagnostic
+    (single home for every mixed-precision guard: gls_solve, PTABatch,
+    sharded_gls_fit, WidebandLMFitter).
+
+    A NaN rel_resid — f32 Gram overflow or an eigh failure propagating
+    NaN through the refinement — means the refinement did NOT converge,
+    but ``nan > tol`` is False and Python's ``max(0.0, nan)`` is 0.0,
+    so naive guards silently accept garbage parameters. Accept only
+    when every entry is finite and <= tol.
+    """
+    r = np.asarray(rel_resid, dtype=np.float64)
+    return not bool(np.all(r <= tol))
+
+
 def gls_eigh_refine(A_approx, b, matvec, threshold=1e-12, iters=2):
     """Thresholded-eigh solve of A dxn = b where ``A_approx`` is an
     approximate Gram (f32, from gls_gram(..., "mixed")) and ``matvec``
@@ -547,7 +562,7 @@ def gls_solve(Mfull, r, sigma, sqrt_phi_inv, threshold=1e-12,
             return Mn.T @ (Mn @ v) + (q * q) * v
 
         dxn, covn, rel_resid = gls_eigh_refine(A, b, matvec, threshold)
-        if float(rel_resid) > 1e-8:
+        if relres_failed(rel_resid):
             # f32 preconditioner couldn't contract (kept spectrum too
             # wide, κ > ~1e7): redo in f64 — correctness first. Warn
             # like the PTABatch path does: a silent fallback makes
@@ -718,6 +733,24 @@ def _reject_free_dmjump(model):
         raise ValueError(
             f"free DMJUMP parameters {free} affect only wideband DM "
             "measurements; use a wideband fitter or freeze them")
+
+
+def _reject_free_dm_noise(model):
+    """Wideband fitters must refuse free DMEFAC/DMEQUAD: the DM-error
+    scaling is applied ONCE at the start-of-fit parameter values
+    (residuals.py::WidebandDMResiduals.__init__), so a 'fitted' value
+    would never feed back into the weights it is supposed to control —
+    the fit silently reports the input value. Mirrors
+    _reject_free_dmjump."""
+    from .residuals import free_dm_noise_params
+
+    free = free_dm_noise_params(model)
+    if free:
+        raise ValueError(
+            f"free DMEFAC/DMEQUAD parameters {free} scale wideband DM "
+            "uncertainties, which are fixed at their start-of-fit "
+            "values (WidebandDMResiduals applies the scaling once); "
+            "freeze them, or refit with updated values between fits")
 
 
 class WLSFitter(Fitter):
@@ -1141,6 +1174,7 @@ class WidebandTOAFitter(GLSFitter):
 
         _warn_degraded_once()
         check_precision(precision)
+        _reject_free_dm_noise(self.model)
         t_start = time.perf_counter()
         iter_s = []
         chi2 = None
@@ -1213,6 +1247,7 @@ class WidebandDownhillFitter(WidebandTOAFitter):
         import time
 
         check_precision(precision)
+        _reject_free_dm_noise(self.model)
         t_start = time.perf_counter()
         iter_s = []
         best_chi2 = None
@@ -1281,6 +1316,7 @@ class WidebandLMFitter(WidebandTOAFitter):
         import jax.numpy as jnp
 
         check_precision(precision)
+        _reject_free_dm_noise(self.model)
         t_start = time.perf_counter()
         iter_s = []
         lm = lm_lambda0
@@ -1298,11 +1334,31 @@ class WidebandLMFitter(WidebandTOAFitter):
                 A = gls_gram(Mn, q, "mixed")
                 dA = jnp.diag(A)
                 A_damped = A + lm * jnp.diag(dA)
+
+                def damped_mv(v, _Mn=Mn, _q=q, _dA=dA, _lm=lm):
+                    return (_Mn.T @ (_Mn @ v) + (_q * _q) * v
+                            + _lm * _dA * v)
+
                 dxn = jnp.linalg.solve(A_damped, b)
                 for _r in range(2):
-                    resid = b - (Mn.T @ (Mn @ dxn) + (q * q) * dxn
-                                 + lm * dA * dxn)
-                    dxn = dxn + jnp.linalg.solve(A_damped, resid)
+                    dxn = dxn + jnp.linalg.solve(A_damped,
+                                                 b - damped_mv(dxn))
+                relres = (jnp.linalg.norm(b - damped_mv(dxn))
+                          / (jnp.linalg.norm(b) + 1e-300))
+                if relres_failed(relres):
+                    # gls_eigh_refine's contract, applied to the damped
+                    # system: the f32 Gram failed to precondition this
+                    # step — redo it with the f64 Gram (A also feeds
+                    # the covariance via self._lm_cov below)
+                    import warnings
+
+                    warnings.warn(
+                        f"mixed-precision LM refinement did not "
+                        f"converge (rel resid {float(relres):.2e}); "
+                        "solving this step with the f64 Gram")
+                    A = gls_gram(Mn, q, "f64")
+                    A_damped = A + lm * jnp.diag(jnp.diag(A))
+                    dxn = jnp.linalg.solve(A_damped, b)
             else:
                 A, b, norm = gls_normal(Mfull, r, sigma, sqrt_phi_inv)
                 A_damped = A + lm * jnp.diag(jnp.diag(A))

@@ -99,14 +99,25 @@ def _pad_to(a, shape):
     return out
 
 
-def stack_prepared(preps: list[PreparedTiming]):
+def stack_prepared(preps: list[PreparedTiming], pad_toas=None):
     """Stack same-structure PreparedTimings into batched pytrees.
+
+    ``pad_toas`` forces the padded TOA axis to exactly that length
+    (must be >= the batch max count). The offline path pads to the
+    batch's own max; the serve path pads to the pow2 bucket BOUNDARY
+    so every flush of a bucket presents identical shapes to jax.jit
+    and the executable cache gets a dispatch hit instead of a retrace.
 
     Returns (params_stack, prep_stack, batch_stack, static, n_toas).
     """
     import jax.numpy as jnp
 
     n_max = max(p.batch.n_toas for p in preps)
+    if pad_toas is not None:
+        if int(pad_toas) < n_max:
+            raise ValueError(f"pad_toas={pad_toas} is below the batch "
+                             f"max TOA count {n_max}")
+        n_max = int(pad_toas)
     n_toas = np.array([p.batch.n_toas for p in preps])
 
     # ECORR representation must be uniform across the batch: pulsars
@@ -243,18 +254,19 @@ class PTABatch:
     All models must share component structure; see stack_prepared.
     """
 
-    def __init__(self, models, toas_list, mesh=None):
+    def __init__(self, models, toas_list, mesh=None, pad_toas=None):
         from ..models.timing_model import _cpu_staging, device_put_staged
 
         self.models = models
         self.toas_list = toas_list
+        self.pad_toas = pad_toas
         # stage per-pulsar packing + stacking on the CPU backend, then
         # one batched transfer of the stacked trees (behind a tunnel,
         # per-array transfers dominate the pack otherwise)
         with _cpu_staging():
             self.preps = [m.prepare(t) for m, t in zip(models, toas_list)]
             (self.params, self.prep, self.batch, self.static,
-             self.n_toas) = stack_prepared(self.preps)
+             self.n_toas) = stack_prepared(self.preps, pad_toas=pad_toas)
         self.params, self.prep, self.batch = device_put_staged(
             (self.params, self.prep, self.batch))
         self.template = models[0]
@@ -934,10 +946,13 @@ class PTABatch:
         # one batched pull; see wls_fit
         x, chi2, covn, norm, relres = self._pull(
             (x, chi2, covn, norm, relres))
-        if precision == "mixed" and np.max(relres) > 1e-8:
+        from ..fitter import relres_failed
+
+        if precision == "mixed" and relres_failed(relres):
             # the f32 preconditioner failed to contract for >= 1 pulsar
-            # (kept spectrum wider than ~1e7): redo the batch in f64 —
-            # correctness is non-negotiable, the speedup opt-in
+            # (kept spectrum wider than ~1e7, or NaN from an f32
+            # overflow): redo the batch in f64 — correctness is
+            # non-negotiable, the speedup opt-in
             import warnings
 
             warnings.warn(
@@ -1032,19 +1047,56 @@ class PTABatch:
         return (comps, free, tuple(static_cfg))
 
     def time_residuals(self):
-        """(n_psr, n_toa_max) residual seconds + validity mask."""
+        """(n_psr, n_toa_max) residual seconds + validity mask. The
+        jitted program is cached in self._fns like the fit programs,
+        so repeated calls (and serve-layer executable-cache sharing)
+        dispatch warm."""
         import jax
-        import jax.numpy as jnp
 
-        resid_fn = self._resid_fn()
+        key = ("resid",)
+        if key not in self._fns:
+            resid_fn = self._resid_fn()
 
-        def one(params, batch, prep):
-            r, sig = resid_fn(params, batch, prep)
-            return r
+            def one(params, batch, prep):
+                r, sig = resid_fn(params, batch, prep)
+                return r
 
-        r = jax.jit(jax.vmap(one))(self.params, self.batch, self.prep)
+            self._fns[key] = jax.jit(jax.vmap(one))
+        r = self._fns[key](self.params, self.batch, self.prep)
         mask = np.arange(r.shape[1])[None, :] < self.n_toas[:, None]
         return r, mask
+
+    def phases(self):
+        """(n_psr, n_toa_max) continuous pulse phase + validity mask —
+        the phase-predict surface of the serve engine (polyco-style
+        evaluation at the request's TOAs, computed exactly instead of
+        through a polynomial expansion). Cached in self._fns like
+        time_residuals."""
+        import jax
+
+        key = ("phase",)
+        if key not in self._fns:
+            self._fns[key] = jax.jit(jax.vmap(self._phase_fn()))
+        ph = self._fns[key](self.params, self.batch, self.prep)
+        mask = np.arange(ph.shape[1])[None, :] < self.n_toas[:, None]
+        return ph, mask
+
+    def shape_signature(self):
+        """Hashable fingerprint of every traced array's (shape, dtype)
+        across (params, prep, batch). Two PTABatches with equal
+        structure_key AND equal shape_signature dispatch the same
+        compiled executables when they share a ``_fns`` table — the
+        serve-layer cache keys on both, so residual shape variance the
+        structure key cannot see (e.g. ECORR epoch counts, param
+        vector lengths) becomes a visible cache miss instead of a
+        silent retrace."""
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(
+            (self.params, self.prep, self.batch))
+        return tuple((tuple(getattr(leaf, "shape", np.shape(leaf))),
+                      str(getattr(leaf, "dtype", type(leaf).__name__)))
+                     for leaf in leaves)
 
 
 class PTAFleet:

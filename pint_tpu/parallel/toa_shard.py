@@ -292,7 +292,7 @@ def sharded_gls_fit(model, toas, mesh: Mesh, maxiter=2, threshold=1e-12,
 
     # x must live replicated on the SAME mesh as the sharded data
     x = jax.device_put(x0, NamedSharding(mesh, P()))
-    worst_relres = 0.0
+    relres_hist = []
     if hoist:
         pre_step = jax.jit(jax.shard_map(
             pre_local, mesh=mesh, in_specs=(batch_specs, prep_specs),
@@ -310,16 +310,21 @@ def sharded_gls_fit(model, toas, mesh: Mesh, maxiter=2, threshold=1e-12,
         return x, float(chi2), cov
     for _ in range(maxiter):
         x, chi2, covn, norm, relres = step(x, batch, arrays)
-        # worst over iterations: an early non-contraction corrupts x
-        # even when the final off-optimum solve happens to converge
-        worst_relres = max(worst_relres, float(relres))
+        # every iteration's residual is checked: an early
+        # non-contraction corrupts x even when the final off-optimum
+        # solve happens to converge (a Python max() would also swallow
+        # a NaN — fitter.relres_failed is the nan-aware guard)
+        relres_hist.append(float(relres))
     x, chi2, covn, norm = jax.device_get((x, chi2, covn, norm))
-    if precision == "mixed" and worst_relres > 1e-8:
+    from ..fitter import relres_failed
+
+    if precision == "mixed" and relres_failed(relres_hist):
         import warnings
 
         warnings.warn(
             f"mixed-precision sharded GLS refinement did not converge "
-            f"(worst rel resid {worst_relres:.2e}); refitting in f64")
+            f"(worst rel resid {np.max(relres_hist):.2e}); "
+            "refitting in f64")
         return sharded_gls_fit(model, toas, mesh, maxiter=maxiter,
                                threshold=threshold, axis=axis,
                                precision="f64")

@@ -231,6 +231,22 @@ def wideband_dm_model(model, params, prep, batch=None, include_jumps=True):
     return dm
 
 
+def free_dm_noise_params(model):
+    """Names of user-freed DMEFAC/DMEQUAD parameters. The wideband DM
+    uncertainty scaling (WidebandDMResiduals.__init__) is evaluated
+    once at the start-of-fit params, so these cannot be fit
+    parameters — the wideband fitters call this to reject them up
+    front (fitter._reject_free_dm_noise) instead of silently
+    reporting the input value back with zero feedback into the
+    weights."""
+    comp = model.components.get("ScaleToaError")
+    if comp is None:
+        return []
+    return [p for p in comp.params
+            if p.startswith(("DMEFAC", "DMEQUAD"))
+            and not getattr(comp, p).frozen]
+
+
 class WidebandDMResiduals:
     """DM residuals from wideband TOA flags (reference: residuals.py::WidebandDMResiduals).
 
@@ -268,7 +284,10 @@ class WidebandDMResiduals:
                           "residuals: " + "; ".join(parts))
         self.valid = has_dm & ~bad_err
         # DMEFAC/DMEQUAD scaling (reference: ScaleDmError) — applied at
-        # the start-of-fit parameter values, like the basis spans
+        # the start-of-fit parameter values, like the basis spans. This
+        # is why the wideband fitters reject FREE DMEFAC/DMEQUAD
+        # (free_dm_noise_params above): a fitted value would never
+        # re-enter these weights
         scale = model.components.get("ScaleToaError")
         if scale is not None and (scale.dmefac_ids or scale.dmequad_ids):
             safe = np.where(np.isnan(raw_err), 0.0, raw_err)

@@ -1,0 +1,175 @@
+"""End-to-end driver for the online timing service (pint_tpu.serve):
+build a mixed fleet (several model structures x several TOA bucket
+sizes), prewarm the executable cache, stream a few hundred requests
+through ServeEngine, and report latency percentiles + cache counters,
+optionally cross-checking every fit against the offline PTAFleet
+path.
+
+This is the serving acceptance harness: a mixed-shape stream must
+complete with ZERO executable compiles after warmup (cache hit rate
+~100%) and parameters matching the offline path to ~1e-12 —
+bench.py's serve stage and benchmarks/profile_harness.py --workload
+serve both run through run_serve_stream below.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def build_serve_fleet(sizes=(48, 96, 180), per_combo=3, seed=0):
+    """(models, toas_list) spanning 3 model structures x len(sizes)
+    TOA counts, per_combo pulsars each:
+
+    - spin-only (F0/F1/DM free)            -> WLS route
+    - + EFAC/EQUAD (ScaleToaError)         -> WLS route, new structure
+    - + power-law red noise (TNREDC 10)    -> GLS route
+
+    Red noise only (no ECORR) in the GLS structure: ECORR's epoch
+    count varies with TOA clustering, which would key extra
+    executables per dataset; the red-noise basis column count is fixed
+    by TNREDC, so every request in a bucket shares one shape.
+    """
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    rng = np.random.default_rng(seed)
+    structures = (
+        "",
+        "EFAC -f L-wide 1.1\nEQUAD -f L-wide 0.4\n",
+        "EFAC -f L-wide 1.1\nEQUAD -f L-wide 0.4\n"
+        "RNAMP 1e-14\nRNIDX -3.1\nTNREDC 10\n",
+    )
+    models, toas_list = [], []
+    i = 0
+    for extra in structures:
+        for n_toa in sizes:
+            for _ in range(per_combo):
+                par = (f"PSR SRV{i}\nRAJ {i % 24}:{(11 * i) % 60:02d}:00.0\n"
+                       f"DECJ {(i * 5) % 60 - 30}:15:00.0\n"
+                       f"F0 {200 + 3 * (i % 50)}.271 1\n"
+                       f"F1 -{1 + i % 8}e-16 1\n"
+                       f"PEPOCH 55500\nDM {6 + i}.37 1\n" + extra)
+                m = get_model(par)
+                mjds = np.sort(rng.uniform(54200, 56800, n_toa))
+                t = make_fake_toas_fromMJDs(
+                    mjds, m, error_us=1.0, freq_mhz=1400.0, obs="gbt",
+                    add_noise=True, seed=100 + i, iterations=0)
+                if extra:
+                    for f in t.flags:
+                        f["f"] = "L-wide"
+                models.append(m)
+                toas_list.append(t)
+                i += 1
+    return models, toas_list
+
+
+def run_serve_stream(n_requests=216, max_batch=8, max_latency_s=0.05,
+                     bucket_floor=64, cache_capacity=32,
+                     sizes=(48, 96, 180), per_combo=3, maxiter=3,
+                     precision="f64", compare_offline=True, mesh=None,
+                     seed=0):
+    """Prewarm + stream n_requests fit requests round-robin over the
+    mixed fleet; returns a JSON-safe report with the engine snapshot,
+    recompile count after warmup, and (optionally) the max relative
+    parameter difference vs the offline PTAFleet fit of the same
+    pulsars."""
+    from pint_tpu.serve import FitRequest, ServeEngine
+
+    models, toas_list = build_serve_fleet(sizes=sizes,
+                                          per_combo=per_combo,
+                                          seed=seed)
+    n_pulsars = len(models)
+    eng = ServeEngine(max_batch=max_batch, max_latency_s=max_latency_s,
+                      bucket_floor=bucket_floor,
+                      cache_capacity=cache_capacity, mesh=mesh)
+
+    def req(i):
+        return FitRequest(models[i % n_pulsars],
+                          toas_list[i % n_pulsars],
+                          maxiter=maxiter, precision=precision)
+
+    # one request per pulsar covers every (structure, bucket) slot
+    warm_compiles = eng.prewarm([req(i) for i in range(n_pulsars)])
+    results = eng.run_stream([req(i) for i in range(n_requests)])
+    snap = eng.snapshot()
+    statuses = {}
+    for r in results:
+        statuses[r.status] = statuses.get(r.status, 0) + 1
+    report = {
+        "n_requests": n_requests,
+        "n_pulsars": n_pulsars,
+        "n_structures": 3,
+        "toa_buckets": sorted({r.telemetry.get("bucket")
+                               for r in results if r.telemetry}),
+        "statuses": statuses,
+        "warmup_executables": warm_compiles,
+        "recompiles_after_warmup": (snap["executables_compiled"]
+                                    - warm_compiles),
+        "cache": snap["cache"],
+        "serve_p50_latency_s": snap["total_s"]["p50"],
+        "serve_p99_latency_s": snap["total_s"]["p99"],
+        "queue_wait_p50_s": snap["queue_wait_s"]["p50"],
+        "execute_p50_s": snap["execute_s"]["p50"],
+        "counters": snap["counters"],
+    }
+    if compare_offline:
+        from pint_tpu.parallel import PTAFleet
+
+        fleet = PTAFleet(models, toas_list, mesh=mesh)
+        xs, _, _ = fleet.fit(method="auto", maxiter=maxiter)
+        worst = 0.0
+        for i, r in enumerate(results):
+            if r.status != "ok":
+                continue
+            off = np.asarray(xs[i % n_pulsars])
+            mine = np.asarray(r.value["x"])
+            rel = np.max(np.abs(mine - off)
+                         / np.maximum(np.abs(off), 1e-30))
+            worst = max(worst, float(rel))
+        report["max_param_rel_diff_vs_offline"] = worst
+    return report
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="pint_serve_bench",
+        description="Stream fit requests through pint_tpu.serve and "
+                    "report latency/cache telemetry")
+    p.add_argument("--requests", type=int, default=216)
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--max-latency", type=float, default=0.05)
+    p.add_argument("--bucket-floor", type=int, default=64)
+    p.add_argument("--maxiter", type=int, default=3)
+    p.add_argument("--precision", default="f64",
+                   choices=("f64", "mixed"))
+    p.add_argument("--no-offline-check", action="store_true",
+                   help="skip the PTAFleet cross-check")
+    p.add_argument("--hit-threshold", type=float, default=0.9,
+                   help="fail (rc 1) when the post-warmup cache hit "
+                        "rate drops below this")
+    args = p.parse_args(argv)
+
+    report = run_serve_stream(
+        n_requests=args.requests, max_batch=args.max_batch,
+        max_latency_s=args.max_latency, bucket_floor=args.bucket_floor,
+        maxiter=args.maxiter, precision=args.precision,
+        compare_offline=not args.no_offline_check)
+    print(json.dumps(report, default=float))
+    hit_rate = report["cache"]["hit_rate"] or 0.0
+    ok = (report["recompiles_after_warmup"] == 0
+          and hit_rate >= args.hit_threshold)
+    if not ok:
+        print(f"FAIL: recompiles_after_warmup="
+              f"{report['recompiles_after_warmup']}, "
+              f"hit_rate={hit_rate:.3f} "
+              f"(threshold {args.hit_threshold})", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

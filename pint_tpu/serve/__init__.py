@@ -1,0 +1,33 @@
+"""pint_tpu.serve — online timing service layer.
+
+Turns the offline batched fitting core (parallel/pta.py) into an
+in-process serving engine: typed requests (fit / residuals / phase
+predict) are admitted into pow2-bucketed micro-batch slots, flushed
+onto warm compiled executables held in an LRU cache, degraded
+gracefully under pressure (mixed->f64 fallback, oversize spill,
+queue/deadline shedding), and accounted per-request in telemetry
+snapshots. The routing/batching/caching engine is the part of an
+inference serving stack this workload needs; no network layer is
+included or required.
+
+    from pint_tpu.serve import ServeEngine, FitRequest
+
+    eng = ServeEngine(max_batch=8, max_latency_s=0.02)
+    res = eng.submit(FitRequest(model, toas))
+    eng.drain()                      # or poll() from a serving loop
+    res.value["x"], res.telemetry    # results + per-request latencies
+    eng.snapshot()                   # p50/p99 + cache/shed counters
+"""
+
+from .batcher import MicroBatcher, pow2_bucket
+from .engine import ServeEngine
+from .excache import ExecutableCache
+from .metrics import ServeTelemetry, percentile
+from .request import (FitRequest, PhasePredictRequest, ResidualRequest,
+                      ServeResult, TimingRequest)
+
+__all__ = [
+    "ServeEngine", "MicroBatcher", "ExecutableCache", "ServeTelemetry",
+    "percentile", "pow2_bucket", "TimingRequest", "FitRequest",
+    "ResidualRequest", "PhasePredictRequest", "ServeResult",
+]

@@ -1,0 +1,75 @@
+"""Dynamic micro-batcher: admit requests into pow2-bucketed slots,
+flush on batch-full or a max-latency timer — the prompt-batching
+pattern of inference serving applied to timing requests.
+
+A slot key is everything that must match for two requests to share
+one compiled executable: the PTABatch structure signature, the pow2
+TOA bucket the request pads into, and the resolved routing
+(kind, method, maxiter, precision). The pow2 convention is
+PTAFleet.toa_bucket="pow2" (parallel/pta.py) with a configurable
+floor; unlike PTAFleet — which pads each offline batch to its own max
+count — the serve path pads to the bucket BOUNDARY
+(PTABatch(pad_toas=...)), so every flush of a slot presents identical
+shapes and the executable cache can do its job.
+
+The batcher holds no clock of its own: the engine passes timestamps
+in, which keeps flush-on-timer deterministic under test clocks.
+"""
+
+from __future__ import annotations
+
+
+def pow2_bucket(n, floor=256):
+    """Smallest power-of-two >= n, starting at ``floor`` (PTAFleet's
+    pow2 convention; the floor is configurable so CPU tests and
+    benches can keep padding cheap)."""
+    b = int(floor)
+    while b < n:
+        b *= 2
+    return b
+
+
+class MicroBatcher:
+    def __init__(self, max_batch=8, max_latency_s=0.05,
+                 bucket_floor=256):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.max_batch = int(max_batch)
+        self.max_latency_s = float(max_latency_s)
+        self.bucket_floor = int(bucket_floor)
+        self._slots = {}  # key -> list[(request, result, t_submit)]
+
+    def slot_key(self, request, routing):
+        """(structure_key, toa_bucket, kind, method, maxiter,
+        precision) — requests with equal keys can share one
+        executable."""
+        from ..parallel.pta import PTABatch
+
+        kind, method, maxiter, precision = routing
+        return (PTABatch.structure_key(request.model),
+                pow2_bucket(len(request.toas), self.bucket_floor),
+                kind, method, maxiter, precision)
+
+    def depth(self):
+        """Total queued requests across all slots."""
+        return sum(len(v) for v in self._slots.values())
+
+    def admit(self, key, request, result, now):
+        """Queue one request; True when the slot just reached
+        max_batch and must flush."""
+        entries = self._slots.setdefault(key, [])
+        entries.append((request, result, now))
+        return len(entries) >= self.max_batch
+
+    def due(self, now):
+        """Slot keys whose OLDEST entry has waited >= max_latency_s
+        (the latency timer fires per slot, oldest-first semantics)."""
+        return [k for k, v in self._slots.items()
+                if v and now - v[0][2] >= self.max_latency_s]
+
+    def take(self, key):
+        """Remove and return a slot's queued entries."""
+        return self._slots.pop(key, [])
+
+    def pending_keys(self):
+        return [k for k, v in self._slots.items() if v]

@@ -1,0 +1,338 @@
+"""Synchronous in-process serving engine: submit -> micro-batch ->
+warm executable -> result, with degradation and per-request
+telemetry. scripts/pint_serve_bench.py drives it end-to-end; there is
+deliberately no network layer — the batching/caching/degradation
+engine is the part that transfers to a real serving stack.
+
+Shape stability is the whole game. A flush pads the TOA axis to the
+slot's pow2 bucket (PTABatch(pad_toas=...)) and the pulsar/lane axis
+to max_batch by replicating the last request's (model, toas), so
+every flush of a slot presents the executable cache with identical
+shapes and jax.jit dispatch (or an AOT executable) runs with zero
+retracing. Replicated lanes cost padded FLOPs, not correctness: lanes
+are independent under vmap and extra-lane results are discarded;
+padded TOA rows carry the 1e30-sigma sentinel (stack_prepared) so
+they vanish from every whitened reduction.
+"""
+
+from __future__ import annotations
+
+import time
+import warnings
+
+import numpy as np
+
+from . import policy
+from .batcher import MicroBatcher
+from .excache import ExecutableCache
+from .metrics import ServeTelemetry
+from .request import ServeResult
+
+
+class ServeEngine:
+    """In-process online timing service over PTABatch executables.
+
+    clock: injectable monotonic-seconds callable (tests drive the
+    flush timer deterministically with a fake clock).
+    """
+
+    def __init__(self, max_batch=8, max_latency_s=0.05, max_queue=256,
+                 cache_capacity=32, bucket_floor=256,
+                 oversize_toas=policy.DEFAULT_OVERSIZE_TOAS,
+                 mesh=None, clock=time.monotonic):
+        self.batcher = MicroBatcher(max_batch=max_batch,
+                                    max_latency_s=max_latency_s,
+                                    bucket_floor=bucket_floor)
+        self.max_queue = int(max_queue)
+        self.cache = ExecutableCache(cache_capacity)
+        self.telemetry = ServeTelemetry()
+        self.oversize_toas = oversize_toas
+        self.mesh = mesh
+        self.clock = clock
+        self.executables_compiled = 0
+
+    # -- intake ------------------------------------------------------
+
+    def submit(self, request):
+        """Route one request. Returns a ServeResult handle, filled in
+        when its slot flushes; a submit that fills a slot flushes it
+        inline, and shed/spilled requests complete immediately."""
+        res = ServeResult(request=request)
+        now = self.clock()
+        try:
+            routing = policy.resolve(request)
+        except ValueError as e:
+            res.status = "error"
+            res.reason = str(e)
+            self.telemetry.incr("errors")
+            self.telemetry.record(request_id=request.request_id,
+                                  kind=request.kind, status="error",
+                                  reason=res.reason)
+            return res
+        if policy.is_oversize(len(request.toas), self.oversize_toas):
+            self.telemetry.incr("spilled_oversize")
+            self._execute_solo(request, res, routing, now)
+            return res
+        if self.batcher.depth() >= self.max_queue:
+            res.status = "shed"
+            res.reason = "queue_full"
+            res.telemetry = policy.rejection(
+                "queue_full", queue_depth=self.batcher.depth(),
+                max_queue=self.max_queue,
+                request_id=request.request_id)
+            self.telemetry.incr("shed_queue_full")
+            self.telemetry.record(request_id=request.request_id,
+                                  kind=routing[0], status="shed",
+                                  reason="queue_full")
+            return res
+        key = self.batcher.slot_key(request, routing)
+        if self.batcher.admit(key, request, res, now):
+            self._flush(key)
+        return res
+
+    def poll(self, now=None):
+        """Flush every slot whose oldest request has aged past the
+        max-latency timer; call between submits from a serving loop.
+        Returns the flushed slot keys."""
+        now = self.clock() if now is None else now
+        due = self.batcher.due(now)
+        for key in due:
+            self._flush(key)
+        return due
+
+    def drain(self):
+        """Flush everything queued regardless of age (end of
+        stream)."""
+        for key in self.batcher.pending_keys():
+            self._flush(key)
+
+    def run_stream(self, requests, poll_every=1):
+        """Convenience driver: submit each request, run the latency
+        timer between submits, drain at the end. Returns the
+        ServeResults in request order."""
+        results = []
+        for i, req in enumerate(requests):
+            results.append(self.submit(req))
+            if poll_every and (i + 1) % poll_every == 0:
+                self.poll()
+        self.drain()
+        return results
+
+    def prewarm(self, requests):
+        """Warm-start prefill: run representative requests of the
+        most common shapes through the normal flush path (compiling
+        their executables into the cache), then reset latency records
+        and cache counters so steady-state telemetry starts clean.
+        Returns the number of executables compiled."""
+        before = self.executables_compiled
+        for res in self.run_stream(requests):
+            if res.status == "error":
+                raise RuntimeError(f"prewarm request "
+                                   f"{res.request.request_id} failed: "
+                                   f"{res.reason}")
+        self.telemetry.reset()
+        self.cache.reset_counters()
+        return self.executables_compiled - before
+
+    def snapshot(self):
+        """JSON-safe service snapshot: telemetry aggregate + cache
+        counters + compile/queue state."""
+        snap = self.telemetry.snapshot(cache=self.cache)
+        snap["executables_compiled"] = self.executables_compiled
+        snap["queue_depth"] = self.batcher.depth()
+        return snap
+
+    # -- execution ---------------------------------------------------
+
+    def _flush(self, key):
+        entries = self.batcher.take(key)
+        if not entries:
+            return
+        self.telemetry.incr("flushes")
+        now = self.clock()
+        live = []
+        for req, res, t_sub in entries:
+            if policy.expired(req, t_sub, now):
+                res.status = "shed"
+                res.reason = "deadline"
+                res.telemetry = policy.rejection(
+                    "deadline", waited_s=now - t_sub,
+                    deadline_s=req.deadline_s,
+                    request_id=req.request_id)
+                self.telemetry.incr("shed_deadline")
+                self.telemetry.record(request_id=req.request_id,
+                                      status="shed", reason="deadline",
+                                      queue_wait_s=now - t_sub)
+            else:
+                live.append((req, res, t_sub))
+        if live:
+            self._execute(key, live, flush_start=now)
+
+    def _fail(self, live, kind, exc):
+        reason = f"{type(exc).__name__}: {exc}"
+        self.telemetry.incr("errors", len(live))
+        for req, res, _ in live:
+            res.status = "error"
+            res.reason = reason
+            self.telemetry.record(request_id=req.request_id, kind=kind,
+                                  status="error", reason=reason)
+
+    def _execute(self, slot_key, live, flush_start):
+        from ..parallel.pta import PTABatch
+
+        _, bucket, kind, method, maxiter, precision = slot_key
+        models = [req.model for req, _, _ in live]
+        toas_list = [req.toas for req, _, _ in live]
+        n_live = len(live)
+        # lane padding: replicate the last request up to max_batch so
+        # every flush of this slot presents identical shapes
+        lanes = self.batcher.max_batch
+        models += [models[-1]] * (lanes - n_live)
+        toas_list += [toas_list[-1]] * (lanes - n_live)
+        t0 = self.clock()
+        try:
+            pta = PTABatch(models, toas_list, mesh=self.mesh,
+                           pad_toas=bucket)
+        except Exception as e:
+            self._fail(live, kind, e)
+            return
+        pack_s = self.clock() - t0
+        exec_key = (slot_key, lanes, pta.shape_signature())
+        fns = self.cache.lookup(exec_key)
+        cold = fns is None
+        compile_s = 0.0
+        if cold:
+            if kind == "fit":
+                # AOT-compile so the compile cost is attributed to this
+                # (cold) flush explicitly instead of smeared into its
+                # execute time
+                t0 = self.clock()
+                try:
+                    pta.aot_compile(method, maxiter=maxiter,
+                                    precision=precision)
+                except Exception as e:
+                    self._fail(live, kind, e)
+                    return
+                compile_s = self.clock() - t0
+            self.executables_compiled += 1
+            self.cache.insert(exec_key, pta._fns)
+        else:
+            pta._fns = fns
+
+        degraded = False
+        diverged = set()
+        t0 = self.clock()
+        try:
+            if kind == "fit":
+                with warnings.catch_warnings(record=True) as caught:
+                    warnings.simplefilter("always")
+                    if method == "gls":
+                        x, chi2, cov = pta.gls_fit(maxiter=maxiter,
+                                                   precision=precision)
+                    else:
+                        x, chi2, cov = pta.wls_fit(maxiter=maxiter)
+                degraded = policy.mixed_fell_back(caught)
+                # the fallback is accounted as degradation; everything
+                # else (divergence reports etc.) is re-emitted
+                for w in caught:
+                    if policy.MIXED_FALLBACK_MARK not in str(w.message):
+                        warnings.warn_explicit(w.message, w.category,
+                                               w.filename, w.lineno)
+                x, chi2, cov = (np.asarray(x), np.asarray(chi2),
+                                np.asarray(cov))
+                names = [n for n, _, _ in pta.free_map()]
+                diverged = set(pta.diverged)
+
+                def value_of(i):
+                    return {"x": x[i], "chi2": float(chi2[i]),
+                            "cov": cov[i], "free_names": names}
+            elif kind == "resid":
+                r, _ = pta.time_residuals()
+                r = np.asarray(r)
+
+                def value_of(i):
+                    return {"resid_s": r[i, :len(live[i][0].toas)]}
+            else:  # "phase" (policy.resolve rejected everything else)
+                ph, _ = pta.phases()
+                ph = np.asarray(ph)
+
+                def value_of(i):
+                    return {"phase": ph[i, :len(live[i][0].toas)]}
+        except Exception as e:
+            self._fail(live, kind, e)
+            return
+        execute_s = self.clock() - t0
+        if degraded:
+            self.telemetry.incr("degraded_mixed", n_live)
+        done = self.clock()
+        for i, (req, res, t_sub) in enumerate(live):
+            if i in diverged:
+                res.status = "error"
+                res.reason = "diverged"
+                self.telemetry.incr("diverged")
+            else:
+                res.status = "ok"
+                res.value = value_of(i)
+            rec = {"request_id": req.request_id, "kind": kind,
+                   "status": res.status, "reason": res.reason,
+                   "queue_wait_s": flush_start - t_sub,
+                   "pack_s": pack_s, "compile_s": compile_s,
+                   "execute_s": execute_s, "total_s": done - t_sub,
+                   "lanes": lanes, "bucket": bucket, "cold": cold,
+                   "degraded": degraded, "spilled": False}
+            res.telemetry = rec
+            self.telemetry.record(**rec)
+
+    def _execute_solo(self, request, res, routing, submitted_at):
+        """Oversize spill: run unbatched, padded to the request's own
+        TOA count (no bucket), so one monster request can't force a
+        huge shared executable shape. Compiles per unique shape —
+        acceptable because spills are the rare tail by
+        construction."""
+        from ..parallel.pta import PTABatch
+
+        kind, method, maxiter, precision = routing
+        live = [(request, res, submitted_at)]
+        t0 = self.clock()
+        try:
+            pta = PTABatch([request.model], [request.toas],
+                           mesh=self.mesh)
+            pack_s = self.clock() - t0
+            degraded = False
+            t0 = self.clock()
+            if kind == "fit":
+                with warnings.catch_warnings(record=True) as caught:
+                    warnings.simplefilter("always")
+                    if method == "gls":
+                        x, chi2, cov = pta.gls_fit(maxiter=maxiter,
+                                                   precision=precision)
+                    else:
+                        x, chi2, cov = pta.wls_fit(maxiter=maxiter)
+                degraded = policy.mixed_fell_back(caught)
+                value = {"x": np.asarray(x)[0],
+                         "chi2": float(np.asarray(chi2)[0]),
+                         "cov": np.asarray(cov)[0],
+                         "free_names": [n for n, _, _ in pta.free_map()]}
+            elif kind == "resid":
+                r, _ = pta.time_residuals()
+                value = {"resid_s": np.asarray(r)[0, :len(request.toas)]}
+            else:
+                ph, _ = pta.phases()
+                value = {"phase": np.asarray(ph)[0, :len(request.toas)]}
+        except Exception as e:
+            self._fail(live, kind, e)
+            return
+        execute_s = self.clock() - t0
+        if degraded:
+            self.telemetry.incr("degraded_mixed")
+        res.status = "ok"
+        res.value = value
+        rec = {"request_id": request.request_id, "kind": kind,
+               "status": "ok", "reason": None, "queue_wait_s": 0.0,
+               "pack_s": pack_s, "compile_s": None,
+               "execute_s": execute_s,
+               "total_s": self.clock() - submitted_at,
+               "lanes": 1, "bucket": None, "cold": True,
+               "degraded": degraded, "spilled": True}
+        res.telemetry = rec
+        self.telemetry.record(**rec)

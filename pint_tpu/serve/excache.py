@@ -1,0 +1,74 @@
+"""Executable cache: LRU of warm compiled-program tables keyed by the
+full executable signature (slot key + lane count + shape fingerprint).
+
+PTABatch keeps its compiled programs in a per-instance ``_fns`` dict;
+serving builds a fresh PTABatch per flush, which would recompile
+everything. A cache entry IS a shared ``_fns`` table: on a hit the new
+batch adopts the cached table, so jax.jit's dispatch sees the same
+callable with the same shapes/dtypes and reuses the XLA executable
+with zero retracing (AOT-compiled executables are plain callables in
+the same table). On a miss the new batch's own table is inserted and
+whatever it compiles becomes warm for the next same-signature flush —
+including programs compiled later through the same table, e.g. the
+f64 fallback a degraded mixed fit adds.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+
+class ExecutableCache:
+    def __init__(self, capacity=32):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = int(capacity)
+        self._entries = OrderedDict()  # key -> shared _fns table
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self):
+        return len(self._entries)
+
+    def keys(self):
+        return list(self._entries)
+
+    def lookup(self, key):
+        """The fns table for key (LRU-refreshed) or None; counts
+        hit/miss."""
+        fns = self._entries.get(key)
+        if fns is None:
+            self.misses += 1
+            return None
+        self._entries.move_to_end(key)
+        self.hits += 1
+        return fns
+
+    def insert(self, key, fns):
+        """Insert (or refresh) an executable table, evicting
+        least-recently-used entries over capacity. Dropping an entry
+        drops the only strong reference to its compiled programs, so
+        evicted XLA executables are actually freed, not just
+        forgotten."""
+        self._entries[key] = fns
+        self._entries.move_to_end(key)
+        while len(self._entries) > self.capacity:
+            self._entries.popitem(last=False)
+            self.evictions += 1
+
+    def prefill(self, entries):
+        """Warm-start bulk insert of (key, fns) pairs —
+        ServeEngine.prewarm drives real compiles through this for the
+        N most common shapes before traffic arrives."""
+        for key, fns in entries:
+            self.insert(key, fns)
+
+    def reset_counters(self):
+        self.hits = self.misses = self.evictions = 0
+
+    def counters(self):
+        total = self.hits + self.misses
+        return {"hits": self.hits, "misses": self.misses,
+                "evictions": self.evictions, "size": len(self._entries),
+                "hit_rate": (self.hits / total) if total else None}

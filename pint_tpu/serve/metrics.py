@@ -1,0 +1,72 @@
+"""Request telemetry: per-request latency phases + service counters,
+exported as JSON-ready snapshots — the observability half of the
+serve layer (bench.py's serve_* metrics come from these snapshots).
+
+Latency is recorded per phase so a slow request is attributable:
+queue_wait (submit -> flush), pack (host prep + stacking), compile
+(cold-executable AOT, zero on warm flushes), execute (device run,
+shared by the whole flush), total (submit -> result).
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def percentile(values, q):
+    """Nearest-rank percentile (q in [0, 100]); None on empty input.
+    Nearest-rank, not interpolated: at serving sample counts the p99
+    should be an actually-observed latency, not an average of two."""
+    if not values:
+        return None
+    v = sorted(float(x) for x in values)
+    idx = min(len(v) - 1, max(0, -(-int(q) * len(v) // 100) - 1))
+    return v[idx]
+
+
+class ServeTelemetry:
+    PHASES = ("queue_wait_s", "pack_s", "compile_s", "execute_s",
+              "total_s")
+
+    def __init__(self):
+        self.counters = {}
+        self.records = []
+
+    def incr(self, name, n=1):
+        self.counters[name] = self.counters.get(name, 0) + n
+
+    def record(self, **fields):
+        """Append one per-request record (same dict the request's
+        ServeResult.telemetry carries)."""
+        self.records.append(fields)
+
+    def latencies(self, phase="total_s", status="ok"):
+        return [r[phase] for r in self.records
+                if r.get("status") == status
+                and r.get(phase) is not None]
+
+    def snapshot(self, cache=None):
+        """JSON-safe aggregate: request counts, per-phase p50/p99/max
+        over completed requests, counters, and (optionally) the
+        executable cache's hit/miss/evict counters."""
+        snap = {
+            "requests": len(self.records),
+            "requests_ok": sum(1 for r in self.records
+                               if r.get("status") == "ok"),
+            "counters": dict(sorted(self.counters.items())),
+        }
+        for phase in self.PHASES:
+            vals = self.latencies(phase)
+            snap[phase] = {"p50": percentile(vals, 50),
+                           "p99": percentile(vals, 99),
+                           "max": max(vals) if vals else None}
+        if cache is not None:
+            snap["cache"] = cache.counters()
+        return snap
+
+    def to_json(self, cache=None, **dump_kw):
+        return json.dumps(self.snapshot(cache=cache), **dump_kw)
+
+    def reset(self):
+        self.counters = {}
+        self.records = []

@@ -1,0 +1,97 @@
+"""Typed requests + result handles for the online timing service.
+
+The serve layer speaks in small dataclasses so the engine, batcher,
+and policy modules agree on one vocabulary: what work is asked for
+(fit / residuals / phase predict), under what latency contract
+(deadline_s), and at what precision. A request carries the same
+(model, toas) pair the offline fitters take — the serving win is in
+how requests are routed onto warm executables, not in a new math
+path.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+
+_ids = itertools.count()
+
+
+def _next_id():
+    return f"req-{next(_ids)}"
+
+
+@dataclass
+class TimingRequest:
+    """Base request: a (model, toas) pair plus the service contract.
+
+    deadline_s: max seconds between submit and execution start; a
+        request still queued past its deadline is shed at flush time
+        rather than executed late (serve.policy).
+    precision: "f64" or "mixed" — GLS fits only (fitter.gls_gram);
+        non-fit kinds and WLS always run f64.
+    """
+
+    model: object
+    toas: object
+    deadline_s: float | None = None
+    precision: str = "f64"
+    request_id: str = field(default_factory=_next_id)
+
+    kind = "fit"
+
+
+@dataclass
+class FitRequest(TimingRequest):
+    """WLS/GLS parameter fit. method="auto" picks GLS when the model
+    carries correlated-noise (basis_weight) components, mirroring
+    PTAFleet.fit; maxiter=None takes the method default (GLS 2,
+    WLS 3)."""
+
+    method: str = "auto"
+    maxiter: int | None = None
+
+    kind = "fit"
+
+
+@dataclass
+class ResidualRequest(TimingRequest):
+    """Time residuals (seconds) at the model's current parameter
+    values."""
+
+    kind = "resid"
+
+
+@dataclass
+class PhasePredictRequest(TimingRequest):
+    """Continuous pulse phase at the request's TOAs — the polyco-style
+    predict surface, evaluated through the full timing model instead
+    of a polynomial expansion."""
+
+    kind = "phase"
+
+
+@dataclass
+class ServeResult:
+    """Mutable handle returned by ServeEngine.submit; filled in when
+    the request's slot flushes (or immediately on shed/spill/error).
+
+    status: "pending" -> "ok" | "shed" | "error".
+    reason: shed/error cause ("queue_full", "deadline", "diverged",
+        or an exception summary).
+    value: kind-dependent payload (fit: x/chi2/cov/free_names;
+        resid: resid_s; phase: phase).
+    telemetry: the per-request record metrics.ServeTelemetry
+        aggregates (latency phases, routing flags) or a structured
+        rejection (policy.rejection) when shed.
+    """
+
+    request: TimingRequest
+    status: str = "pending"
+    reason: str | None = None
+    value: dict | None = None
+    telemetry: dict = field(default_factory=dict)
+
+    @property
+    def done(self):
+        return self.status != "pending"

@@ -1,0 +1,299 @@
+"""Online serving engine (pint_tpu.serve): micro-batcher flush
+semantics, executable-cache accounting, degradation policy
+(mixed->f64, oversize spill, queue/deadline shedding), and
+equivalence of served results with the offline PTABatch path — plus
+regression tests for the NaN-aware mixed-precision guards the serve
+degradation path relies on."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+warnings.simplefilter("ignore")
+
+from pint_tpu import fitter
+from pint_tpu.models import get_model
+from pint_tpu.parallel import PTABatch
+from pint_tpu.serve import (ExecutableCache, FitRequest,
+                            PhasePredictRequest, ResidualRequest,
+                            ServeEngine)
+from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+PAR = """
+PSR SRVT{i}
+RAJ 12:0{i}:00.0
+DECJ 10:00:00.0
+F0 3{i}1.25 1
+F1 -4e-16 1
+PEPOCH 55500
+DM 12.{i} 1
+"""
+
+NOISE = "RNAMP 1e-14\nRNIDX -3.2\nTNREDC 4\n"
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _pulsar(i=0, n_toa=24, noise=False, seed=0):
+    m = get_model(PAR.format(i=i) + (NOISE if noise else ""))
+    rng = np.random.default_rng(seed + i)
+    mjds = np.sort(rng.uniform(54500, 56500, n_toa))
+    t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=1400.0,
+                                obs="gbt", add_noise=True, seed=seed + i,
+                                iterations=0)
+    return m, t
+
+
+@pytest.fixture(scope="module")
+def two_pulsars():
+    return [_pulsar(0, 24), _pulsar(1, 24)]
+
+
+# -- batcher flush semantics -----------------------------------------
+
+
+def test_flush_on_full(two_pulsars):
+    (m0, t0), (m1, t1) = two_pulsars
+    eng = ServeEngine(max_batch=2, max_latency_s=1e9, bucket_floor=32)
+    r0 = eng.submit(ResidualRequest(m0, t0))
+    assert not r0.done  # slot not full, timer never fires
+    r1 = eng.submit(ResidualRequest(m1, t1))
+    assert r0.done and r1.done  # second submit filled + flushed
+    assert r0.status == "ok" and r1.status == "ok"
+    assert eng.telemetry.counters["flushes"] == 1
+
+
+def test_flush_on_timer(two_pulsars):
+    (m0, t0), _ = two_pulsars
+    clock = FakeClock()
+    eng = ServeEngine(max_batch=8, max_latency_s=0.05,
+                      bucket_floor=32, clock=clock)
+    res = eng.submit(ResidualRequest(m0, t0))
+    assert eng.poll() == [] and not res.done  # younger than the timer
+    clock.advance(0.051)
+    assert len(eng.poll()) == 1
+    assert res.status == "ok"
+    assert res.telemetry["queue_wait_s"] == pytest.approx(0.051)
+
+
+# -- executable cache ------------------------------------------------
+
+
+def test_cache_hit_miss_evict_counters(two_pulsars):
+    (m0, t0), _ = two_pulsars
+    (m1, t1) = _pulsar(1, 40)  # pads to bucket 64 != 32: second shape
+    eng = ServeEngine(max_batch=1, max_latency_s=1e9, bucket_floor=32,
+                      cache_capacity=1)
+    eng.submit(ResidualRequest(m0, t0))  # miss, insert A
+    assert (eng.cache.misses, eng.cache.hits) == (1, 0)
+    eng.submit(ResidualRequest(m0, t0))  # hit A
+    assert (eng.cache.misses, eng.cache.hits) == (1, 1)
+    eng.submit(ResidualRequest(m1, t1))  # miss, evicts A
+    assert eng.cache.evictions == 1
+    eng.submit(ResidualRequest(m0, t0))  # miss again: A was evicted
+    assert eng.cache.misses == 3
+    assert len(eng.cache) == 1
+    counters = eng.cache.counters()
+    assert counters["hit_rate"] == pytest.approx(0.25)
+    # warm flushes reuse the cached program table: only cold flushes
+    # compiled (one per miss)
+    assert eng.executables_compiled == 3
+
+
+def test_cache_prefill():
+    cache = ExecutableCache(capacity=4)
+    cache.prefill([(("k", i), {"fns": i}) for i in range(3)])
+    assert len(cache) == 3 and cache.misses == 0
+    assert cache.lookup(("k", 1)) == {"fns": 1}
+    assert cache.hits == 1
+
+
+# -- served results match the offline path ---------------------------
+
+
+def test_fit_resid_phase_match_offline(two_pulsars):
+    (m0, t0), (m1, t1) = two_pulsars
+    eng = ServeEngine(max_batch=2, max_latency_s=1e9, bucket_floor=32)
+    fit0 = eng.submit(FitRequest(m0, t0, maxiter=3))
+    fit1 = eng.submit(FitRequest(m1, t1, maxiter=3))
+    rr = eng.submit(ResidualRequest(m0, t0))
+    pp = eng.submit(PhasePredictRequest(m0, t0))
+    eng.drain()
+    assert all(r.status == "ok" for r in (fit0, fit1, rr, pp))
+
+    off = PTABatch([m0, m1], [t0, t1])
+    x_off, chi2_off, _ = off.wls_fit(maxiter=3)
+    for lane, res in enumerate((fit0, fit1)):
+        rel = np.max(np.abs(res.value["x"] - np.asarray(x_off)[lane])
+                     / np.maximum(np.abs(np.asarray(x_off)[lane]), 1e-30))
+        assert rel <= 1e-12
+        assert res.value["chi2"] == pytest.approx(
+            float(np.asarray(chi2_off)[lane]), rel=1e-9)
+        assert res.value["free_names"] == [n for n, _, _ in off.free_map()]
+    r_off, mask = off.time_residuals()
+    np.testing.assert_allclose(rr.value["resid_s"],
+                               np.asarray(r_off)[0][mask[0]],
+                               rtol=0, atol=1e-12)
+    ph_off, _ = off.phases()
+    np.testing.assert_allclose(pp.value["phase"],
+                               np.asarray(ph_off)[0][mask[0]],
+                               rtol=0, atol=1e-9)
+
+
+# -- degradation policy ----------------------------------------------
+
+
+def test_mixed_degrades_to_f64(monkeypatch):
+    """A mixed-precision GLS whose refinement reports failure (here: a
+    NaN rel_resid, the shape of the original NaN-swallowing bug) must
+    fall back to f64 inside PTABatch and be counted as degraded by the
+    engine — with a correct result."""
+    m, t = _pulsar(3, 20, noise=True)
+    real_refine = fitter.gls_eigh_refine
+
+    def nan_refine(A, b, matvec, threshold=1e-12, iters=2):
+        import jax.numpy as jnp
+
+        dxn, covn, rel = real_refine(A, b, matvec, threshold, iters)
+        return dxn, covn, jnp.full_like(rel, jnp.nan)
+
+    monkeypatch.setattr(fitter, "gls_eigh_refine", nan_refine)
+    eng = ServeEngine(max_batch=1, max_latency_s=1e9, bucket_floor=32)
+    res = eng.submit(FitRequest(m, t, method="gls", maxiter=2,
+                                precision="mixed"))
+    assert res.status == "ok"
+    assert res.telemetry["degraded"] is True
+    assert eng.telemetry.counters["degraded_mixed"] == 1
+
+    monkeypatch.setattr(fitter, "gls_eigh_refine", real_refine)
+    off = PTABatch([m], [t])
+    x_off, _, _ = off.gls_fit(maxiter=2, precision="f64")
+    np.testing.assert_allclose(res.value["x"], np.asarray(x_off)[0],
+                               rtol=1e-10, atol=0)
+
+
+def test_oversize_spill(two_pulsars):
+    (m0, t0), _ = two_pulsars
+    eng = ServeEngine(max_batch=4, max_latency_s=1e9, bucket_floor=32,
+                      oversize_toas=len(t0) - 1)
+    res = eng.submit(ResidualRequest(m0, t0))
+    assert res.done and res.status == "ok"  # executed solo, immediately
+    assert res.telemetry["spilled"] is True
+    assert eng.telemetry.counters["spilled_oversize"] == 1
+    assert eng.batcher.depth() == 0
+    off = PTABatch([m0], [t0])
+    r_off, mask = off.time_residuals()
+    np.testing.assert_allclose(res.value["resid_s"],
+                               np.asarray(r_off)[0][mask[0]],
+                               rtol=0, atol=1e-12)
+
+
+def test_deadline_shed(two_pulsars):
+    (m0, t0), _ = two_pulsars
+    clock = FakeClock()
+    eng = ServeEngine(max_batch=8, max_latency_s=0.2, bucket_floor=32,
+                      clock=clock)
+    res = eng.submit(ResidualRequest(m0, t0, deadline_s=0.1))
+    clock.advance(0.3)  # past the deadline by the time the timer fires
+    eng.poll()
+    assert res.status == "shed"
+    assert res.reason == "deadline"
+    assert res.telemetry["rejected"] is True
+    assert res.telemetry["detail"]["deadline_s"] == 0.1
+    assert eng.telemetry.counters["shed_deadline"] == 1
+    # nothing was executed for an all-shed flush
+    assert eng.executables_compiled == 0
+
+
+def test_queue_full_shed(two_pulsars):
+    (m0, t0), (m1, t1) = two_pulsars
+    eng = ServeEngine(max_batch=8, max_latency_s=1e9, bucket_floor=32,
+                      max_queue=1)
+    first = eng.submit(ResidualRequest(m0, t0))
+    assert not first.done  # queued
+    second = eng.submit(ResidualRequest(m1, t1))
+    assert second.status == "shed"
+    assert second.reason == "queue_full"
+    assert second.telemetry["detail"]["max_queue"] == 1
+    assert eng.telemetry.counters["shed_queue_full"] == 1
+    eng.drain()
+    assert first.status == "ok"  # queued work unaffected by the shed
+
+
+# -- NaN-relres regression (satellite guard fixes) -------------------
+
+
+def test_relres_failed_is_nan_aware():
+    nan = float("nan")
+    assert fitter.relres_failed(nan)
+    assert fitter.relres_failed([0.0, nan])
+    assert fitter.relres_failed(np.array([1e-12, nan]))
+    assert fitter.relres_failed(1.0)
+    assert not fitter.relres_failed(1e-9)
+    assert not fitter.relres_failed(np.array([1e-12, 1e-9]))
+    # the two bugs the helper replaces: comparison and Python max()
+    # both silently swallow NaN
+    assert not (nan > 1e-8)
+    assert max(0.0, nan) == 0.0
+
+
+def test_gls_solve_falls_back_on_nan_relres(monkeypatch):
+    """gls_solve(precision='mixed') must warn + redo in f64 when the
+    refinement residual is NaN (it previously compared nan > 1e-8 =
+    False and returned the unverified mixed solution)."""
+    import jax.numpy as jnp
+
+    real_refine = fitter.gls_eigh_refine
+
+    def nan_refine(A, b, matvec, threshold=1e-12, iters=2):
+        dxn, covn, rel = real_refine(A, b, matvec, threshold, iters)
+        return dxn, covn, jnp.full_like(rel, jnp.nan)
+
+    monkeypatch.setattr(fitter, "gls_eigh_refine", nan_refine)
+    rng = np.random.default_rng(0)
+    M = jnp.asarray(rng.standard_normal((12, 3)))
+    r = jnp.asarray(rng.standard_normal(12))
+    sigma = jnp.ones(12)
+    with pytest.warns(UserWarning, match="refitting in f64"):
+        dx, _, chi2 = fitter.gls_solve(M, r, sigma, jnp.zeros(3),
+                                       precision="mixed")
+    assert np.all(np.isfinite(np.asarray(dx))) and np.isfinite(chi2)
+    monkeypatch.setattr(fitter, "gls_eigh_refine", real_refine)
+    dx_f64, _, chi2_f64 = fitter.gls_solve(M, r, sigma, jnp.zeros(3),
+                                           precision="f64")
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(dx_f64),
+                               rtol=1e-12)
+
+
+# -- wideband DMEFAC/DMEQUAD rejection (satellite) -------------------
+
+
+def _wb_pulsar():
+    m = get_model(PAR.format(i=5) + "DMEFAC -all 1 2.0 1\n")
+    mjds = np.linspace(55000, 55600, 30)
+    t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=1400.0,
+                                obs="gbt", add_noise=True, seed=5)
+    for f in t.flags:
+        f["pp_dm"] = "12.5001"
+        f["pp_dme"] = "1e-4"
+    return m, t
+
+
+@pytest.mark.parametrize("cls", [fitter.WidebandTOAFitter,
+                                 fitter.WidebandDownhillFitter,
+                                 fitter.WidebandLMFitter])
+def test_wideband_rejects_free_dmefac(cls):
+    m, t = _wb_pulsar()
+    f = cls(t, m)
+    with pytest.raises(ValueError, match="DMEFAC"):
+        f.fit_toas(maxiter=2)
