@@ -686,6 +686,50 @@ def main():
                    f"{serve_report['recompiles_after_warmup']} recompiles "
                    "after warmup")
 
+    # chaos side metric: the same serve stream with a 5% toa_nan fault
+    # schedule vs a fault-free reference — the trajectory tracks
+    # robustness (zero healthy-request failures, healthy end state,
+    # shed/retry/breaker counters), not just speed. Same posture as the
+    # serve stage: optional, daemon thread + join timeout, skip with
+    # PINT_TPU_BENCH_SKIP_CHAOS=1.
+    chaos_report = None
+
+    def _chaos_stage():
+        nonlocal chaos_report
+        try:
+            from pint_tpu.scripts.pint_serve_bench import run_chaos_stream
+
+            rep = run_chaos_stream(n_requests=216, fault_rate=0.05,
+                                   bucket_floor=64)
+            chaos_report = rep  # set LAST: completion marker
+        except Exception as e:
+            _stage(f"chaos stage failed ({type(e).__name__}: {e}); "
+                   "headline JSON unaffected")
+
+    chaos_wedged = False
+    if os.environ.get("PINT_TPU_BENCH_SKIP_CHAOS") == "1":
+        _stage("chaos stage skipped (PINT_TPU_BENCH_SKIP_CHAOS=1)")
+    else:
+        _stage("chaos: serve stream with 5% toa_nan injection vs "
+               "fault-free reference")
+        tc = threading.Thread(target=_chaos_stage, daemon=True)
+        tc.start()
+        tc.join(timeout=900)
+        chaos_wedged = tc.is_alive()
+        if chaos_wedged:
+            chaos_report = None  # snapshot: late finish must not race
+            _stage("chaos stage timed out; headline JSON unaffected")
+        elif chaos_report is not None:
+            _stage(f"chaos: ok={chaos_report['ok']} "
+                   f"({chaos_report['injected']} injected, "
+                   f"{chaos_report['healthy_failures']} healthy "
+                   f"failures, health={chaos_report['health_state']}, "
+                   f"{chaos_report['unexpected_recompiles']} "
+                   "unexpected recompiles)")
+            if not chaos_report["ok"]:
+                _stage("chaos: CONTRACT VIOLATED — healthy requests "
+                       "must not fail under injected faults")
+
     total_toas = n_psr * n_toa
     rate = total_toas / gls_refit_s  # TOAs GLS-refit per second
     projected_670k = gls_refit_s * (670_000 / total_toas)
@@ -753,6 +797,26 @@ def main():
         "serve_max_param_rel_diff": (
             serve_report.get("max_param_rel_diff_vs_offline")
             if serve_report else None),
+        "chaos_ok": chaos_report["ok"] if chaos_report else None,
+        "chaos_injected": (chaos_report["injected"]
+                           if chaos_report else None),
+        "chaos_healthy_failures": (chaos_report["healthy_failures"]
+                                   if chaos_report else None),
+        "chaos_max_rel_diff_vs_clean": (
+            chaos_report["max_rel_diff_vs_clean"]
+            if chaos_report else None),
+        "chaos_health_state": (chaos_report["health_state"]
+                               if chaos_report else None),
+        "chaos_unexpected_recompiles": (
+            chaos_report["unexpected_recompiles"]
+            if chaos_report else None),
+        "chaos_shed": chaos_report["shed"] if chaos_report else None,
+        "chaos_retries": (chaos_report["retries"]
+                          if chaos_report else None),
+        "chaos_quarantined": (chaos_report["quarantined"]
+                              if chaos_report else None),
+        "chaos_breaker": (chaos_report["breaker"]
+                          if chaos_report else None),
         "platform": platform,
     }
     meta.update(full_meta)
@@ -763,7 +827,8 @@ def main():
         "vs_baseline": round(vs_baseline, 3),
         "detail": meta,
     }), flush=True)
-    if wedged or serve_wedged or full_alive or _MIXED_THREAD_ALIVE:
+    if wedged or serve_wedged or chaos_wedged or full_alive \
+            or _MIXED_THREAD_ALIVE:
         # a daemon thread stuck in a C++ device wait can hang (or a
         # still-live dropped full-scale worker can crash) normal
         # interpreter teardown — measured rc=250 from exactly that;

@@ -76,11 +76,34 @@ def _serve_workload(n_requests, hit_threshold):
     return report
 
 
+def _chaos_workload(n_requests, fault_rate):
+    """Chaos slice of the serve stream: low-rate toa_nan injection vs
+    a fault-free reference. Asserts the resilience contract — zero
+    healthy-request failures, healthy end state, zero unexpected
+    recompiles."""
+    import warnings
+
+    warnings.simplefilter("ignore")
+    from pint_tpu.scripts.pint_serve_bench import run_chaos_stream
+
+    report = run_chaos_stream(n_requests=n_requests,
+                              fault_rate=fault_rate, max_batch=4,
+                              bucket_floor=32, sizes=(24, 48, 90),
+                              per_combo=2)
+    assert report["ok"], \
+        f"chaos contract violated: " \
+        f"healthy_failures={report['healthy_failures']}, " \
+        f"health={report['health_state']}, " \
+        f"unexpected_recompiles={report['unexpected_recompiles']}"
+    return report
+
+
 def main(argv=None):
     import jax
 
     p = argparse.ArgumentParser()
-    p.add_argument("--workload", choices=("wls", "pta", "serve"),
+    p.add_argument("--workload", choices=("wls", "pta", "serve",
+                                          "chaos"),
                    default="wls")
     p.add_argument("--n-toas", type=int, default=5000)
     p.add_argument("--n-psr", type=int, default=8)
@@ -89,8 +112,19 @@ def main(argv=None):
                    help="stream length for --workload serve")
     p.add_argument("--hit-threshold", type=float, default=0.9,
                    help="min post-warmup cache hit rate (serve)")
+    p.add_argument("--fault-rate", type=float, default=0.05,
+                   help="injection rate for --workload chaos")
     p.add_argument("--trace", help="jax.profiler trace output dir")
     args = p.parse_args(argv)
+
+    if args.workload == "chaos":
+        t0 = time.perf_counter()
+        report = _chaos_workload(args.requests, args.fault_rate)
+        report.update({"workload": "chaos",
+                       "platform": jax.default_backend(),
+                       "wall_s": round(time.perf_counter() - t0, 3)})
+        print(json.dumps(report, default=float))
+        return 0
 
     if args.workload == "serve":
         t0 = time.perf_counter()

@@ -6,13 +6,45 @@ plus the TOA pickle cache. For TPU batch fits this module adds an
 orbax-backed snapshot of the numeric fit state between outer
 iterations, with a plain-npz fallback, so a preempted multi-hour PTA
 run resumes instead of restarting.)
+
+Integrity: every save records a CRC32 over the packed numeric arrays
+(key + dtype + shape + raw bytes, keys sorted) in the JSON sidecar,
+and every save first rotates the existing snapshot to ``<tag>.prev``.
+restore() verifies the checksum and, when the latest snapshot is
+unreadable or fails verification, falls back to the rotated previous
+one — a torn write at preemption time costs one checkpoint interval,
+not the whole run. Snapshots written before this scheme (no checksum
+record) restore as before.
 """
 
 from __future__ import annotations
 
 import os
+import shutil
+import warnings
+import zlib
 
 import numpy as np
+
+from .resilience import faultinject
+
+# reserved sidecar key carrying the snapshot checksum (never a state
+# key: save() would have stringified it)
+INTEGRITY_KEY = "__integrity__"
+
+
+def _integrity_crc(numeric: dict) -> int:
+    """CRC32 over the packed arrays, order-independent via sorted
+    keys; dtype and shape are folded in so a reinterpreted buffer
+    (same bytes, different view) fails verification too."""
+    crc = 0
+    for k in sorted(numeric):
+        v = np.ascontiguousarray(np.asarray(numeric[k]))
+        crc = zlib.crc32(str(k).encode(), crc)
+        crc = zlib.crc32(str(v.dtype).encode(), crc)
+        crc = zlib.crc32(repr(v.shape).encode(), crc)
+        crc = zlib.crc32(v.tobytes(), crc)
+    return int(crc)
 
 
 class FitCheckpointer:
@@ -36,10 +68,35 @@ class FitCheckpointer:
     def _path(self, tag):
         return os.path.join(self.directory, str(tag))
 
+    def has_snapshot(self, tag) -> bool:
+        """Any on-disk trace of ``tag`` (valid or not)?"""
+        return (os.path.isdir(self._path(tag))
+                or os.path.exists(self._path(tag) + ".npz")
+                or os.path.exists(self._path(tag) + ".meta.json"))
+
+    def _rotate(self, tag):
+        """Move the current snapshot of ``tag`` (all backends' files)
+        to ``<tag>.prev``, replacing any older .prev — the fallback
+        restore() reaches for when the latest snapshot is damaged."""
+        prev = f"{tag}.prev"
+        for suffix in ("", ".npz", ".meta.json"):
+            src = self._path(tag) + suffix
+            dst = self._path(prev) + suffix
+            present = (os.path.isdir(src) if suffix == ""
+                       else os.path.exists(src))
+            if not present:
+                continue
+            if os.path.isdir(dst):
+                shutil.rmtree(dst)
+            elif os.path.exists(dst):
+                os.remove(dst)
+            os.replace(src, dst)
+
     def save(self, tag, state: dict):
         """state: dict of arrays/scalars (e.g. {"x": ..., "iter": i,
         "chi2": ...}). String-valued entries (parameter names) go to a
-        JSON sidecar — orbax/tensorstore has no string dtype."""
+        JSON sidecar — orbax/tensorstore has no string dtype. The
+        sidecar also records the CRC32 of the numeric arrays."""
         import json
 
         state = {k: np.asarray(v) for k, v in state.items()}
@@ -47,6 +104,8 @@ class FitCheckpointer:
                 if np.asarray(v).dtype.kind in "US"}
         numeric = {k: v for k, v in state.items()
                    if np.asarray(v).dtype.kind not in "US"}
+        self._rotate(tag)
+        meta[INTEGRITY_KEY] = _integrity_crc(numeric)
         meta_path = self._path(tag) + ".meta.json"
         tmp = meta_path + ".tmp"
         with open(tmp, "w") as f:
@@ -59,18 +118,44 @@ class FitCheckpointer:
             ckptr = self._ocp.PyTreeCheckpointer()
             ckptr.save(path, jax.tree_util.tree_map(np.asarray, numeric),
                        force=True)
-            return path
-        path = self._path(tag) + ".npz"
-        tmp = path + ".tmp.npz"
-        np.savez(tmp, **numeric)
-        os.replace(tmp, path)
+        else:
+            path = self._path(tag) + ".npz"
+            tmp = path + ".tmp.npz"
+            np.savez(tmp, **numeric)
+            os.replace(tmp, path)
+        fault = faultinject.fire("checkpoint_corrupt", tag=str(tag))
+        if fault:
+            self._corrupt_snapshot(tag)
         return path
 
-    def restore(self, tag) -> dict | None:
-        """Load a snapshot regardless of which backend WROTE it: save()
-        picked the format at write time, so an .npz written where orbax
-        was absent must still restore once orbax becomes importable
-        (and vice versa) instead of silently restarting the fit."""
+    def _corrupt_snapshot(self, tag):
+        """checkpoint_corrupt fault effect: flip one byte mid-file in
+        the snapshot just written, modeling a torn/bit-rotted write
+        that the integrity check must catch."""
+        npz = self._path(tag) + ".npz"
+        if os.path.exists(npz):
+            targets = [npz]
+        else:
+            # directory backend (orbax/ocdbt): metadata files shrug
+            # off a flipped byte, so hit every sizable file — the data
+            # chunks among them carry the array bytes the CRC covers
+            targets = []
+            for root, _, files in os.walk(self._path(tag)):
+                targets += [p for p in
+                            (os.path.join(root, f) for f in sorted(files))
+                            if os.path.getsize(p) > 16]
+        for path in targets:
+            with open(path, "r+b") as f:
+                data = f.read()
+                pos = len(data) // 2
+                f.seek(pos)
+                f.write(bytes([data[pos] ^ 0xFF]))
+
+    def _load_raw(self, tag):
+        """(state-dict-with-meta-merged, recorded-crc-or-None), or
+        (None, None) when nothing readable exists. A corrupted zip /
+        tensorstore raises all sorts (BadZipFile, zlib.error,
+        KeyError, ...) — any load failure means 'no snapshot here'."""
         import json
 
         out = None
@@ -88,23 +173,71 @@ class FitCheckpointer:
                 try:
                     with np.load(path) as z:
                         out = {k: z[k] for k in z.files}
-                except OSError:
-                    return None
+                except Exception:
+                    out = None
         if out is None:
-            return None
+            return None, None
+        crc = None
         meta_path = self._path(tag) + ".meta.json"
         if os.path.exists(meta_path):
             try:
                 with open(meta_path) as f:
-                    out.update({k: np.asarray(v)
-                                for k, v in json.load(f).items()})
+                    meta = json.load(f)
+                crc = meta.pop(INTEGRITY_KEY, None)
+                out.update({k: np.asarray(v) for k, v in meta.items()})
             except (OSError, json.JSONDecodeError):
                 pass
+        return out, crc
+
+    def _restore_verified(self, tag):
+        out, crc = self._load_raw(tag)
+        if out is None:
+            return None
+        if crc is not None:
+            numeric = {k: v for k, v in out.items()
+                       if np.asarray(v).dtype.kind not in "US"}
+            if _integrity_crc(numeric) != int(crc):
+                warnings.warn(
+                    f"checkpoint {tag!r} failed its CRC32 integrity "
+                    "check (torn or corrupted write); discarding it")
+                return None
+        # a pre-integrity snapshot (crc is None) restores unverified
+        return out
+
+    def restore(self, tag, fallback=True) -> dict | None:
+        """Load a snapshot regardless of which backend WROTE it: save()
+        picked the format at write time, so an .npz written where orbax
+        was absent must still restore once orbax becomes importable
+        (and vice versa) instead of silently restarting the fit.
+
+        Verifies the CRC32 recorded at save time; an unreadable or
+        corrupt snapshot falls back (fallback=True) to the rotated
+        ``<tag>.prev`` — the most recent valid snapshot — and returns
+        None only when nothing valid survives."""
+        out = self._restore_verified(tag)
+        if out is None and fallback:
+            prev = f"{tag}.prev"
+            if self.has_snapshot(prev):
+                out = self._restore_verified(prev)
+                if out is not None:
+                    warnings.warn(
+                        f"checkpoint {tag!r} was unreadable or corrupt; "
+                        f"restored the previous snapshot {prev!r}")
         return out
 
     def latest_iteration(self, tag) -> int:
         state = self.restore(tag)
         return int(state["iter"]) if state is not None and "iter" in state else -1
+
+
+def _warn_restart(tag, ckpt):
+    """Shared 'nothing valid survives' report for the checkpointed_*
+    drivers: on-disk snapshot(s) exist but none restored."""
+    if ckpt.has_snapshot(tag) or ckpt.has_snapshot(f"{tag}.prev"):
+        warnings.warn(
+            f"checkpoint {tag!r}: no valid snapshot survives "
+            "(all copies unreadable or corrupt); restarting the fit "
+            "from scratch")
 
 
 def checkpointed_fit(fitter, directory, tag="fit", every=1, maxiter=20,
@@ -117,10 +250,14 @@ def checkpointed_fit(fitter, directory, tag="fit", every=1, maxiter=20,
     Snapshots store parameter NAMES alongside values; on resume the
     values are matched by name, and a snapshot whose free-parameter
     set differs from the current model raises instead of silently
-    mis-assigning. "iter" counts completed fit iterations.
-    """
+    mis-assigning. "iter" counts completed fit iterations. A corrupt
+    snapshot falls back to the previous one; when no valid snapshot
+    survives the fit restarts cleanly from iteration 0 (with a
+    warning)."""
     ckpt = FitCheckpointer(directory)
     state = ckpt.restore(tag)
+    if state is None:
+        _warn_restart(tag, ckpt)
     chi2 = None
     if state is not None and "param_values" in state:
         names = [str(n) for n in np.asarray(state["param_names"])]
@@ -133,7 +270,8 @@ def checkpointed_fit(fitter, directory, tag="fit", every=1, maxiter=20,
         for name in current:
             getattr(fitter.model, name).value = float(vals[name])
         chi2 = float(state["chi2"])
-    done = max(ckpt.latest_iteration(tag), 0)
+    done = (int(state["iter"])
+            if state is not None and "iter" in state else 0)
     while done < maxiter:
         n = min(every, maxiter - done)
         chi2 = fitter.fit_toas(maxiter=n, **fit_kw)
@@ -153,18 +291,19 @@ def checkpointed_pta_fit(pta, directory, tag="pta", every=1, maxiter=4,
     resumes where it stopped (SURVEY 2.2 elasticity — per-pulsar
     divergence isolation already lives inside PTABatch; this adds the
     between-iterations snapshot). Returns (x, chi2, cov); cov is None
-    when the snapshot already covered maxiter."""
+    when the snapshot already covered maxiter. Corrupt snapshots fall
+    back to the previous one, then to a clean (warned) restart."""
     if method not in ("gls", "wls"):
         raise ValueError(f"method must be 'gls' or 'wls', got {method!r}")
     ckpt = FitCheckpointer(directory)
     names = [n for n, _, _ in pta.free_map()]
     state = ckpt.restore(tag)
+    if state is None:
+        _warn_restart(tag, ckpt)
     if state is not None and not all(
             k in state for k in ("param_names", "x", "chi2", "iter")):
         # partial/foreign snapshot (e.g. a single-pulsar checkpointed_fit
         # tag, or a damaged sidecar): restart cleanly rather than crash
-        import warnings
-
         warnings.warn(f"checkpoint {tag!r} is not a PTA snapshot "
                       f"(keys {sorted(state)}); restarting the fit")
         state = None

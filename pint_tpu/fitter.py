@@ -21,6 +21,21 @@ class ConvergenceFailure(RuntimeError):
     pass
 
 
+def _maybe_inject_solver_diverge(method):
+    """resilience hook at the single-pulsar solve entries: the
+    ``solver_diverge`` fault point makes fit_toas raise the same
+    ConvergenceFailure a real blow-up would, so retry/restart paths
+    (checkpointed_fit and callers) are exercisable on demand. No-op
+    (one falsy check) when nothing is armed."""
+    from .resilience import faultinject
+
+    fault = faultinject.fire("solver_diverge", method=method)
+    if fault:
+        raise ConvergenceFailure(
+            f"injected solver divergence (fault point solver_diverge, "
+            f"method={method}, fire={fault['fire']})")
+
+
 class MaxiterReached(ConvergenceFailure):
     """Downhill loop hit maxiter before the tolerance was met
     (reference: fitter.py::MaxiterReached). Carries the best state so
@@ -767,6 +782,7 @@ class WLSFitter(Fitter):
         import jax
         import jax.numpy as jnp
 
+        _maybe_inject_solver_diverge("wls")
         corr = _correlated_noise_components(self.model)
         if corr:
             raise CorrelatedErrors(corr)
@@ -938,6 +954,7 @@ class GLSFitter(Fitter):
                  precision="f64"):
         import time
 
+        _maybe_inject_solver_diverge("gls")
         _reject_free_dmjump(self.model)
         _warn_degraded_once()
         check_precision(precision)

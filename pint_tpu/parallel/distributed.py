@@ -31,7 +31,8 @@ import os
 
 
 def initialize_distributed(coordinator_address=None, num_processes=None,
-                           process_id=None, local_device_ids=None):
+                           process_id=None, local_device_ids=None,
+                           timeout_s=None):
     """Initialize the jax distributed runtime (DCN); idempotent.
     Returns (process_id, num_processes).
 
@@ -39,10 +40,30 @@ def initialize_distributed(coordinator_address=None, num_processes=None,
     and otherwise stay None, so jax's built-in cluster auto-detection
     (TPU pod metadata, SLURM, ...) keeps working — substituting
     single-process defaults here would silently split a real fleet
-    into standalone hosts."""
+    into standalone hosts.
+
+    timeout_s (or the JAX_COORDINATOR_TIMEOUT_S env var): bound the
+    coordinator handshake. An unreachable/mistyped coordinator address
+    otherwise hangs this call for jax's own multi-minute default with
+    no indication of what it is waiting for; with a timeout the
+    failure is a TimeoutError naming the coordinator address, this
+    process's id, and the elapsed wait. The watchdog thread is a
+    daemon, so a worker stuck inside the native barrier cannot keep
+    the interpreter alive after the error surfaces."""
+    import inspect
+    import threading
+    import time
+
     import jax
 
-    if jax.distributed.is_initialized():
+    # not every jax build exposes is_initialized (the 0.4.x graft
+    # doesn't); fall back to the runtime state object it wraps
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is None:
+        def is_init():
+            state = getattr(jax.distributed, "global_state", None)
+            return getattr(state, "client", None) is not None
+    if is_init():
         return jax.process_index(), jax.process_count()
     if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
         num_processes = int(os.environ["JAX_NUM_PROCESSES"])
@@ -50,10 +71,55 @@ def initialize_distributed(coordinator_address=None, num_processes=None,
         process_id = int(os.environ["JAX_PROCESS_ID"])
     if coordinator_address is None:
         coordinator_address = os.environ.get("JAX_COORDINATOR_ADDRESS")
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes, process_id=process_id,
-        local_device_ids=local_device_ids)
+    if timeout_s is None and "JAX_COORDINATOR_TIMEOUT_S" in os.environ:
+        timeout_s = float(os.environ["JAX_COORDINATOR_TIMEOUT_S"])
+
+    init_kw = dict(coordinator_address=coordinator_address,
+                   num_processes=num_processes, process_id=process_id,
+                   local_device_ids=local_device_ids)
+    # newer jax exposes the handshake bound directly; pass it through
+    # WITH a grace margin past our watchdog — the native client
+    # LOG(FATAL)s the whole process when ITS deadline fires, so ours
+    # must fire first to surface a catchable TimeoutError (the native
+    # bound then stops an abandoned worker from waiting forever)
+    if timeout_s is not None:
+        try:
+            sig = inspect.signature(jax.distributed.initialize)
+            if "initialization_timeout" in sig.parameters:
+                init_kw["initialization_timeout"] = int(timeout_s) + 30
+        except (TypeError, ValueError):
+            pass
+
+    if timeout_s is None:
+        jax.distributed.initialize(**init_kw)
+        return jax.process_index(), jax.process_count()
+
+    outcome = {}
+
+    def _worker():
+        try:
+            jax.distributed.initialize(**init_kw)
+            outcome["ok"] = True
+        except Exception as e:  # surfaced in the caller below
+            outcome["error"] = e
+
+    t0 = time.monotonic()
+    worker = threading.Thread(target=_worker, daemon=True,
+                              name="pint-tpu-dist-init")
+    worker.start()
+    worker.join(timeout_s)
+    elapsed = time.monotonic() - t0
+    if worker.is_alive():
+        raise TimeoutError(
+            f"jax.distributed.initialize did not complete within "
+            f"{timeout_s:.1f}s (waited {elapsed:.1f}s): coordinator "
+            f"{coordinator_address!r} unreachable or not every process "
+            f"joined (this process_id={process_id}, "
+            f"num_processes={num_processes}). Check the coordinator "
+            "address/port and that all processes launched; raise "
+            "JAX_COORDINATOR_TIMEOUT_S if the cluster is just slow.")
+    if "error" in outcome:
+        raise outcome["error"]
     return jax.process_index(), jax.process_count()
 
 

@@ -441,6 +441,23 @@ class PTABatch:
             return jax.tree_util.tree_map(np.asarray, tree)
         return jax.device_get(tree)
 
+    def _maybe_inject_divergence(self, chi2, method):
+        """resilience hook: the ``solver_diverge`` fault point poisons
+        the requested lanes' chi2 with NaN right where a real solver
+        blow-up would surface (before _isolate_diverged), so the
+        quarantine/serve paths downstream see the genuine article.
+        No-op (one falsy check) when nothing is armed."""
+        from ..resilience import faultinject
+
+        fault = faultinject.fire("solver_diverge", method=method)
+        if not fault:
+            return chi2
+        chi2 = np.array(chi2, np.float64)
+        n = len(chi2)
+        for lane in fault.get("lanes", [0]):
+            chi2[int(lane) % n] = np.nan
+        return chi2
+
     def _isolate_diverged(self, x0, x, chi2):
         """Per-pulsar fault isolation (SURVEY section 5 "failure
         detection"): a diverged lane (non-finite chi2 or params) must
@@ -548,6 +565,7 @@ class PTABatch:
         # exponent range.
         x, chi2, covn, norm = self._pull((x, chi2, covn, norm))
         cov = covn / (norm[:, :, None] * norm[:, None, :])
+        chi2 = self._maybe_inject_divergence(chi2, "wls")
         x, chi2 = self._isolate_diverged(x0, x, chi2)
         self._record_metrics("wls", t0, maxiter, warm=compiled)
         return x, chi2, cov
@@ -962,6 +980,7 @@ class PTABatch:
             return self.gls_fit(maxiter=maxiter, threshold=threshold,
                                 ecorr_mode=ecorr_mode, precision="f64")
         cov = covn / (norm[:, :, None] * norm[:, None, :])
+        chi2 = self._maybe_inject_divergence(chi2, "gls")
         x, chi2 = self._isolate_diverged(x0, x, chi2)
         self._record_metrics("gls", t0, maxiter, warm=compiled)
         return x, chi2, cov

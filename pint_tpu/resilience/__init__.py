@@ -1,0 +1,39 @@
+"""Fault injection, retry/backoff, circuit breaking, and health for
+the pint_tpu serving/fitting stack.
+
+Import surface:
+
+- :mod:`pint_tpu.resilience.faultinject` — named deterministic
+  injection points (``inject`` context manager, ``PINT_TPU_FAULTS``
+  env spec).
+- :mod:`pint_tpu.resilience.retry` — ``BackoffPolicy`` /
+  ``with_retries`` and the per-slot ``CircuitBreaker``.
+- :mod:`pint_tpu.resilience.health` — the engine ``HealthMonitor``
+  (healthy -> degraded -> draining).
+
+Nothing in this package imports jax; it is safe to import from any
+layer (including checkpoint/restore paths on machines without
+accelerators).
+"""
+
+from .faultinject import (  # noqa: F401
+    POINTS,
+    FaultInjected,
+    FaultPoint,
+    arm,
+    arm_from_env,
+    armed,
+    disarm,
+    fire,
+    inject,
+    parse_spec,
+)
+from .health import STATES, HealthMonitor  # noqa: F401
+from .retry import (  # noqa: F401
+    BackoffPolicy,
+    CircuitBreaker,
+    is_retryable,
+    with_retries,
+)
+
+arm_from_env()

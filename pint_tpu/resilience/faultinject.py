@@ -1,0 +1,208 @@
+"""Deterministic fault injection for the serving/fitting stack.
+
+None of the failure modes this package handles (poisoned TOAs,
+transient compile/dispatch failures, solver divergence, corrupt
+checkpoints) can be exercised deterministically by normal inputs, so
+the handling code would otherwise be untestable. This registry gives
+every failure mode a NAMED injection point with seeded, countable
+trigger semantics; production code calls :func:`fire` at the site
+where the real fault would surface, and tests/benches arm points with
+:func:`inject` (or the ``PINT_TPU_FAULTS`` env var) to make the fault
+happen on demand.
+
+Injection points (site locations in parentheses):
+
+- ``toa_nan`` — a request arrives carrying NaN TOA values
+  (``serve.engine.ServeEngine.submit`` intake, before validation).
+- ``toa_inf_error`` — a request arrives with non-finite TOA
+  uncertainties (same intake site).
+- ``compile_fail`` — a transient executable-compile failure
+  (``serve.engine`` cold-flush compile; retryable by default).
+- ``dispatch_slow`` — a slow device dispatch (``serve.engine`` flush
+  execute; payload ``delay_s``).
+- ``solver_diverge`` — a fit produces non-finite per-lane results
+  (``parallel.pta`` batched fits via ``_maybe_inject_divergence``;
+  single-pulsar ``fitter`` solve entries raise
+  ``ConvergenceFailure``). Payload ``lanes`` picks the poisoned
+  lanes.
+- ``checkpoint_corrupt`` — a snapshot is damaged on disk after a
+  save (``checkpoint.FitCheckpointer.save``).
+
+Disarmed sites cost one falsy-dict check; nothing here imports jax.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+
+import numpy as np
+
+POINTS = ("toa_nan", "toa_inf_error", "compile_fail", "dispatch_slow",
+          "solver_diverge", "checkpoint_corrupt")
+
+
+class FaultInjected(RuntimeError):
+    """Raised at sites whose fault effect is an exception (e.g.
+    ``compile_fail``). ``retryable`` steers the serve retry policy:
+    True models a transient failure, False a persistent one."""
+
+    def __init__(self, point, retryable=True, detail=None):
+        super().__init__(f"injected fault: {point}")
+        self.point = point
+        self.retryable = bool(retryable)
+        self.detail = dict(detail or {})
+
+
+class FaultPoint:
+    """One armed injection point.
+
+    rate: per-eligibility-check fire probability (seeded rng, so the
+        fire pattern is a pure function of (seed, check sequence)).
+    count: cap on total fires (None = unlimited) — ``count=1`` models
+        a transient fault that a retry survives.
+    after: skip the first ``after`` eligibility checks (lets a fault
+        land mid-stream instead of on the first request).
+    payload: site-interpreted detail merged into :func:`fire`'s return
+        (e.g. ``{"lanes": [1]}`` for solver_diverge, ``{"delay_s":
+        0.5}`` for dispatch_slow, ``{"retryable": False}`` for
+        compile_fail).
+    """
+
+    def __init__(self, name, rate=1.0, count=None, after=0, seed=0,
+                 payload=None):
+        if name not in POINTS:
+            raise ValueError(f"unknown fault point {name!r}; "
+                             f"known points: {POINTS}")
+        self.name = name
+        self.rate = float(rate)
+        self.count = None if count is None else int(count)
+        self.after = int(after)
+        self.seed = int(seed)
+        self.payload = dict(payload or {})
+        self.rng = np.random.default_rng(self.seed)
+        self.checks = 0
+        self.fires = 0
+
+    def should_fire(self):
+        """Advance the deterministic trigger state by one eligibility
+        check. The rng draw happens on every eligible check (fired or
+        not), so the fire PATTERN over a request stream depends only
+        on the seed, not on unrelated control flow."""
+        self.checks += 1
+        if self.checks <= self.after:
+            return False
+        if self.count is not None and self.fires >= self.count:
+            return False
+        if self.rate < 1.0 and float(self.rng.random()) >= self.rate:
+            return False
+        self.fires += 1
+        return True
+
+
+# name -> FaultPoint; empty in production (fire() is then one falsy
+# check)
+_armed: dict = {}
+
+
+def fire(name, **ctx):
+    """The hook production code calls at an injection site. Returns
+    None when the point is disarmed or its trigger says "not this
+    time"; otherwise a dict of the point's payload merged with the
+    site's ``ctx`` (plus ``point`` and the 1-based ``fire`` ordinal).
+    """
+    if not _armed:
+        return None
+    pt = _armed.get(name)
+    if pt is None or not pt.should_fire():
+        return None
+    return {**pt.payload, **ctx, "point": name, "fire": pt.fires}
+
+
+def armed():
+    """Read-only view of the currently armed points."""
+    return dict(_armed)
+
+
+def arm(point: FaultPoint):
+    """Arm one point (replacing any armed point of the same name)."""
+    _armed[point.name] = point
+    return point
+
+
+def disarm(name=None):
+    """Disarm one point, or everything when name is None."""
+    if name is None:
+        _armed.clear()
+    else:
+        _armed.pop(name, None)
+
+
+@contextmanager
+def inject(*points):
+    """Arm FaultPoints (or bare point names, meaning fire-always) for
+    the duration of the block, restoring the previous arming after::
+
+        with inject(FaultPoint("toa_nan", rate=0.05, seed=7)):
+            engine.run_stream(requests)
+    """
+    before = dict(_armed)
+    try:
+        for p in points:
+            arm(p if isinstance(p, FaultPoint) else FaultPoint(p))
+        yield _armed
+    finally:
+        _armed.clear()
+        _armed.update(before)
+
+
+def parse_spec(spec):
+    """Parse a ``PINT_TPU_FAULTS`` spec string into FaultPoints.
+
+    Grammar: ``point[:key=value[,key=value...]][;point...]`` with keys
+    rate/count/after/seed/delay_s/retryable/lanes — unknown keys land
+    in the payload. Example::
+
+        PINT_TPU_FAULTS="toa_nan:rate=0.05,seed=7;compile_fail:count=1"
+    """
+    points = []
+    for part in str(spec).split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, rest = part.partition(":")
+        kw = {"rate": 1.0, "count": None, "after": 0, "seed": 0}
+        payload = {}
+        for item in rest.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            k, _, v = item.partition("=")
+            k = k.strip()
+            v = v.strip()
+            if k in ("rate",):
+                kw[k] = float(v)
+            elif k in ("count", "after", "seed"):
+                kw[k] = int(v)
+            elif k == "lanes":
+                payload[k] = [int(x) for x in v.split("+")]
+            elif k == "retryable":
+                payload[k] = v.lower() in ("1", "true", "yes")
+            else:
+                try:
+                    payload[k] = float(v)
+                except ValueError:
+                    payload[k] = v
+        points.append(FaultPoint(name.strip(), payload=payload, **kw))
+    return points
+
+
+def arm_from_env(env="PINT_TPU_FAULTS"):
+    """Arm every point named in the env var (no-op when unset).
+    Called once at package import so ``PINT_TPU_FAULTS=... python
+    -m pint_tpu.scripts.pint_serve_bench`` injects without code
+    changes; returns the armed points."""
+    spec = os.environ.get(env)
+    if not spec:
+        return []
+    return [arm(p) for p in parse_spec(spec)]

@@ -1,0 +1,153 @@
+"""Engine health state machine: healthy -> degraded -> draining.
+
+A serving engine needs one word that load balancers / operators can
+act on, computed from the failure signals the resilience layer
+already tracks:
+
+- breaker state (``retry.CircuitBreaker``): any open breaker means a
+  slot's traffic is being rejected -> at least degraded; several open
+  at once means the engine is structurally unable to serve ->
+  draining.
+- service-side shed/error rate over a sliding request window: above
+  ``degraded_shed_rate`` -> degraded, above ``draining_shed_rate`` ->
+  draining. Client-input rejections (nonfinite_input) deliberately do
+  NOT count: a garbage request is the client's fault and must not
+  mark a correctly-rejecting engine unhealthy.
+- flush-latency watchdog: a flush exceeding ``flush_watchdog_s``
+  (wedge-shaped latency, the tunneled-TPU failure mode) -> degraded.
+
+Transitions are re-evaluated on every note_* call against the
+injected clock, so tests drive the machine deterministically with a
+fake clock. Recovery is hysteretic: leaving degraded requires the
+signals clear AND ``recovery_s`` of quiet; draining additionally
+requires every breaker closed. While draining, the engine sheds new
+submits ("draining" rejections are excluded from the shed-rate window
+so the state can actually recover).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+STATES = ("healthy", "degraded", "draining")
+
+
+class HealthMonitor:
+    def __init__(self, clock=time.monotonic, window=64, min_events=8,
+                 degraded_shed_rate=0.2, draining_shed_rate=0.6,
+                 draining_open_breakers=2, flush_watchdog_s=5.0,
+                 recovery_s=30.0):
+        self.clock = clock
+        self.window = int(window)
+        self.min_events = int(min_events)
+        self.degraded_shed_rate = float(degraded_shed_rate)
+        self.draining_shed_rate = float(draining_shed_rate)
+        self.draining_open_breakers = int(draining_open_breakers)
+        self.flush_watchdog_s = float(flush_watchdog_s)
+        self.recovery_s = float(recovery_s)
+        self.state = "healthy"
+        self.since = clock()
+        self.reasons = []
+        self._events = deque(maxlen=self.window)  # 1 = service-side bad
+        self._open_breakers = 0
+        self._breaker_trips = 0
+        self._watchdog_breaches = 0
+        self._last_breach_t = None
+        self._last_reason_t = None
+
+    # -- signal intake ----------------------------------------------
+
+    def note_request(self, status, reason=None):
+        """One finished request. "shed"/"error" count against the
+        engine; "rejected" counts only for service-side reasons
+        (circuit_open, quarantine) — nonfinite_input and draining are
+        the client's/operator's doing."""
+        bad = status in ("shed", "error") or (
+            status == "rejected"
+            and reason not in ("nonfinite_input", "draining"))
+        self._events.append(1 if bad else 0)
+        self._evaluate()
+
+    def note_flush(self, wall_s):
+        """Flush wall time for the latency watchdog."""
+        if wall_s > self.flush_watchdog_s:
+            self._watchdog_breaches += 1
+            self._last_breach_t = self.clock()
+        self._evaluate()
+
+    def note_breakers(self, open_count, tripped=False):
+        """Breaker census from the engine (after record_*)."""
+        self._open_breakers = int(open_count)
+        if tripped:
+            self._breaker_trips += 1
+        self._evaluate()
+
+    # -- evaluation --------------------------------------------------
+
+    def shed_rate(self):
+        if len(self._events) < self.min_events:
+            return 0.0
+        return sum(self._events) / len(self._events)
+
+    def _current_reasons(self, now):
+        reasons = []
+        sr = self.shed_rate()
+        if self._open_breakers >= self.draining_open_breakers:
+            reasons.append("breakers_open")
+        elif self._open_breakers:
+            reasons.append("breaker_open")
+        if sr >= self.draining_shed_rate:
+            reasons.append("shed_rate_critical")
+        elif sr >= self.degraded_shed_rate:
+            reasons.append("shed_rate")
+        if (self._last_breach_t is not None
+                and now - self._last_breach_t < self.recovery_s):
+            reasons.append("flush_watchdog")
+        return reasons
+
+    def _evaluate(self):
+        now = self.clock()
+        reasons = self._current_reasons(now)
+        severe = ("breakers_open" in reasons
+                  or "shed_rate_critical" in reasons)
+        if reasons:
+            self._last_reason_t = now
+        target = self.state
+        if severe:
+            target = "draining"
+        elif reasons:
+            # draining is sticky until every breaker closes AND the
+            # quiet period elapses; lesser signals keep it degraded
+            # only if we weren't draining
+            target = "draining" if self.state == "draining" else "degraded"
+        else:
+            # recovery hysteresis: require recovery_s of quiet
+            quiet = (self._last_reason_t is None
+                     or now - self._last_reason_t >= self.recovery_s)
+            if self.state == "draining":
+                target = "degraded" if quiet and not self._open_breakers \
+                    else "draining"
+            elif self.state == "degraded" and quiet:
+                target = "healthy"
+        if target != self.state:
+            self.state = target
+            self.since = now
+        self.reasons = reasons
+
+    # -- export ------------------------------------------------------
+
+    def snapshot(self):
+        """JSON-safe health block for ServeTelemetry.snapshot / bench
+        JSON."""
+        now = self.clock()
+        self._evaluate()
+        return {
+            "state": self.state,
+            "since_s": round(now - self.since, 6),
+            "reasons": list(self.reasons),
+            "shed_rate": round(self.shed_rate(), 4),
+            "open_breakers": self._open_breakers,
+            "breaker_trips": self._breaker_trips,
+            "watchdog_breaches": self._watchdog_breaches,
+        }

@@ -1,0 +1,205 @@
+"""Retry with jittered exponential backoff + per-key circuit breaker.
+
+Two failure-handling primitives the serve engine composes:
+
+- :class:`BackoffPolicy` / :func:`with_retries` — a transient
+  compile/dispatch failure gets a bounded number of retries with
+  exponentially growing, seeded-jittered sleeps (deterministic under a
+  fixed seed, so tests can assert the exact delay sequence).
+- :class:`CircuitBreaker` — a slot that keeps failing (or keeps
+  recompiling when it should be warm) trips OPEN after ``threshold``
+  consecutive failures; traffic to that slot is rejected with a
+  structured reason instead of hanging the engine on a doomed flush.
+  After ``cooldown_s`` one half-open trial is admitted; success closes
+  the breaker, failure re-opens it.
+
+The sleep function is injectable everywhere (tests drive a fake
+clock); nothing here imports jax.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from .faultinject import FaultInjected
+
+# substrings of exception text that mark a failure as transient on the
+# tunneled-TPU stack (relay hiccups surface as UNAVAILABLE/DEADLINE
+# grpc statuses inside XLA RuntimeErrors)
+TRANSIENT_MARKS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "RESOURCE_EXHAUSTED",
+                   "transient", "temporarily")
+
+
+def is_retryable(exc):
+    """Retry policy gate: injected faults carry an explicit flag;
+    real exceptions are retryable only when they look like transient
+    runtime/transport failures — a ValueError (bad request) or a
+    structural failure must fail fast, not burn retries."""
+    if isinstance(exc, FaultInjected):
+        return exc.retryable
+    if isinstance(exc, (TimeoutError, ConnectionError, OSError)):
+        return True
+    if isinstance(exc, RuntimeError):
+        msg = str(exc)
+        return any(m in msg for m in TRANSIENT_MARKS)
+    return False
+
+
+class BackoffPolicy:
+    """Jittered exponential backoff schedule.
+
+    delay(attempt) = min(max_s, base_s * factor**attempt) * jitter
+    with jitter drawn uniformly from [1 - jitter_frac, 1 + jitter_frac)
+    off a seeded rng — the full-jitter-style decorrelation that stops
+    retry convoys, made deterministic so the chaos suite can assert
+    the exact sequence.
+    """
+
+    def __init__(self, max_attempts=3, base_s=0.05, factor=2.0,
+                 max_s=2.0, jitter_frac=0.5, seed=0):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = int(max_attempts)
+        self.base_s = float(base_s)
+        self.factor = float(factor)
+        self.max_s = float(max_s)
+        self.jitter_frac = float(jitter_frac)
+        self.seed = int(seed)
+        self.rng = np.random.default_rng(self.seed)
+
+    def delay(self, attempt):
+        """Sleep seconds before retry number ``attempt`` (0-based).
+        Consumes one rng draw per call — call exactly once per retry
+        to keep the sequence reproducible."""
+        raw = min(self.max_s, self.base_s * self.factor ** attempt)
+        if self.jitter_frac <= 0.0:
+            return raw
+        u = float(self.rng.random())  # [0, 1)
+        return raw * (1.0 - self.jitter_frac + 2.0 * self.jitter_frac * u)
+
+    def delays(self, n=None):
+        """The next ``n`` (default: retries remaining after the first
+        attempt) delays, materialized — advances the rng."""
+        n = self.max_attempts - 1 if n is None else int(n)
+        return [self.delay(i) for i in range(n)]
+
+
+def with_retries(fn, policy=None, sleep=time.sleep,
+                 retryable=is_retryable, on_retry=None):
+    """Call ``fn()`` with up to ``policy.max_attempts`` attempts.
+    Non-retryable exceptions (per ``retryable``) and the final
+    attempt's exception propagate; ``on_retry(attempt, exc, delay_s)``
+    is invoked before each backoff sleep (telemetry hook)."""
+    policy = policy or BackoffPolicy()
+    for attempt in range(policy.max_attempts):
+        try:
+            return fn()
+        except Exception as e:
+            last_attempt = attempt >= policy.max_attempts - 1
+            if last_attempt or not retryable(e):
+                raise
+            d = policy.delay(attempt)
+            if on_retry is not None:
+                on_retry(attempt, e, d)
+            if d > 0:
+                sleep(d)
+
+
+class CircuitBreaker:
+    """Per-key breaker over consecutive failures.
+
+    States per key: "closed" (normal), "open" (rejecting), and
+    "half_open" (cooldown elapsed; exactly one trial request is
+    admitted — success closes, failure re-opens). Keys are the serve
+    engine's slot keys, so one pathological request shape cannot take
+    down the other slots' traffic.
+    """
+
+    def __init__(self, threshold=3, cooldown_s=30.0, clock=time.monotonic):
+        if threshold < 1:
+            raise ValueError("threshold must be >= 1")
+        self.threshold = int(threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.clock = clock
+        self._keys = {}  # key -> {consecutive, opened_at, trial}
+        self.trips = 0
+
+    def _entry(self, key):
+        return self._keys.setdefault(
+            key, {"consecutive": 0, "opened_at": None, "trial": False})
+
+    def state(self, key):
+        e = self._keys.get(key)
+        if e is None or e["opened_at"] is None:
+            return "closed"
+        if self.clock() - e["opened_at"] >= self.cooldown_s:
+            return "half_open"
+        return "open"
+
+    def allow(self, key):
+        """May a request for ``key`` proceed right now? In half-open,
+        only the first caller gets through (the trial); the rest stay
+        rejected until the trial reports."""
+        s = self.state(key)
+        if s == "closed":
+            return True
+        if s == "half_open":
+            e = self._entry(key)
+            if not e["trial"]:
+                e["trial"] = True
+                return True
+        return False
+
+    def record_success(self, key):
+        e = self._entry(key)
+        e["consecutive"] = 0
+        e["opened_at"] = None
+        e["trial"] = False
+
+    def record_failure(self, key):
+        """Returns True when THIS failure trips the breaker open (the
+        caller counts trips / notifies health)."""
+        e = self._entry(key)
+        e["consecutive"] += 1
+        if e["opened_at"] is not None:
+            # failed half-open trial: re-open with a fresh cooldown
+            e["opened_at"] = self.clock()
+            e["trial"] = False
+            return False
+        if e["consecutive"] >= self.threshold:
+            e["opened_at"] = self.clock()
+            e["trial"] = False
+            self.trips += 1
+            return True
+        return False
+
+    def trip(self, key):
+        """Force the breaker open for ``key`` without a consecutive
+        failure streak — used for contract violations like repeated
+        unexpected recompiles. Returns True when this call newly
+        opened the breaker."""
+        e = self._entry(key)
+        already_open = e["opened_at"] is not None
+        e["opened_at"] = self.clock()
+        e["trial"] = False
+        if not already_open:
+            self.trips += 1
+            return True
+        return False
+
+    def open_count(self):
+        return sum(1 for k in self._keys if self.state(k) != "closed")
+
+    def retry_after_s(self, key):
+        """Seconds until ``key``'s cooldown elapses (0 when not open)."""
+        e = self._keys.get(key)
+        if e is None or e["opened_at"] is None:
+            return 0.0
+        return max(0.0, self.cooldown_s - (self.clock() - e["opened_at"]))
+
+    def snapshot(self):
+        """JSON-safe counters for telemetry snapshots."""
+        return {"trips": self.trips, "open": self.open_count(),
+                "tracked_keys": len(self._keys)}

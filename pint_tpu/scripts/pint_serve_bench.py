@@ -135,6 +135,114 @@ def run_serve_stream(n_requests=216, max_batch=8, max_latency_s=0.05,
     return report
 
 
+def run_chaos_stream(n_requests=216, fault_rate=0.05,
+                     fault_point="toa_nan", max_batch=8,
+                     max_latency_s=0.05, bucket_floor=64,
+                     cache_capacity=32, sizes=(48, 96, 180),
+                     per_combo=3, maxiter=3, precision="f64",
+                     mesh=None, seed=0, rel_tol=1e-9):
+    """Chaos acceptance run: the serve stream with a low-rate fault
+    schedule injected at intake, differenced against a fault-free run
+    of the same stream.
+
+    The contract being checked (ISSUE 2 acceptance): every UNINJECTED
+    request completes "ok" with results identical (to fp tolerance) to
+    the fault-free run — a poisoned neighbor must cost nothing; every
+    INJECTED request gets a structured rejection (or quarantine); the
+    engine finishes the stream (no hang), ends in the "healthy" state,
+    and performs zero unexpected recompiles. Returns a JSON-safe
+    report with report["ok"] summarizing all of it."""
+    from pint_tpu.resilience import FaultPoint, inject
+    from pint_tpu.serve import FitRequest, ServeEngine
+
+    models, toas_list = build_serve_fleet(sizes=sizes,
+                                          per_combo=per_combo,
+                                          seed=seed)
+    n_pulsars = len(models)
+
+    def req(i):
+        return FitRequest(models[i % n_pulsars],
+                          toas_list[i % n_pulsars],
+                          maxiter=maxiter, precision=precision)
+
+    def engine():
+        return ServeEngine(max_batch=max_batch,
+                           max_latency_s=max_latency_s,
+                           bucket_floor=bucket_floor,
+                           cache_capacity=cache_capacity, mesh=mesh)
+
+    # fault-free reference stream
+    eng0 = engine()
+    eng0.prewarm([req(i) for i in range(n_pulsars)])
+    clean = eng0.run_stream([req(i) for i in range(n_requests)])
+
+    # chaos stream: prewarm UNARMED (warmup is part of deployment,
+    # not of the fault schedule), then inject for the stream itself
+    eng1 = engine()
+    warm_compiles = eng1.prewarm([req(i) for i in range(n_pulsars)])
+    pt = FaultPoint(fault_point, rate=fault_rate, seed=seed)
+    with inject(pt):
+        chaos = eng1.run_stream([req(i) for i in range(n_requests)])
+    snap = eng1.snapshot()
+
+    injected = [i for i, r in enumerate(chaos)
+                if (r.telemetry.get("detail", {}) or {})
+                .get("injected_point")]
+    inj_structured = all(
+        chaos[i].status == "rejected"
+        and chaos[i].telemetry.get("rejected") is True
+        for i in injected)
+    worst = 0.0
+    healthy_failures = 0
+    for i, (rc, rf) in enumerate(zip(clean, chaos)):
+        if i in injected:
+            continue
+        if rf.status != "ok" or rc.status != "ok":
+            healthy_failures += 1
+            continue
+        rel = np.max(np.abs(np.asarray(rf.value["x"])
+                            - np.asarray(rc.value["x"]))
+                     / np.maximum(np.abs(np.asarray(rc.value["x"])),
+                                  1e-30))
+        if not np.isfinite(rel) or rel > rel_tol:
+            healthy_failures += 1
+        worst = max(worst, float(rel))
+    counters = snap["counters"]
+    report = {
+        "n_requests": n_requests,
+        "fault_point": fault_point,
+        "fault_rate": fault_rate,
+        "injected": len(injected),
+        "fires": pt.fires,
+        "injected_structured": bool(inj_structured),
+        "healthy": n_requests - len(injected),
+        "healthy_failures": healthy_failures,
+        "max_rel_diff_vs_clean": worst,
+        "all_done": all(r.done for r in chaos),
+        "warmup_executables": warm_compiles,
+        "recompiles_after_warmup": (snap["executables_compiled"]
+                                    - warm_compiles),
+        "unexpected_recompiles": counters.get("unexpected_recompiles",
+                                              0),
+        "health_state": snap["health"]["state"],
+        "health": snap["health"],
+        "breaker": snap["breaker"],
+        "shed": sum(v for k, v in counters.items()
+                    if k.startswith("shed_")),
+        "retries": counters.get("retries", 0),
+        "quarantined": counters.get("quarantined", 0),
+        "counters": counters,
+    }
+    report["ok"] = bool(
+        report["all_done"]
+        and report["healthy_failures"] == 0
+        and report["injected"] == report["fires"]
+        and report["injected_structured"]
+        and report["health_state"] == "healthy"
+        and report["unexpected_recompiles"] == 0)
+    return report
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="pint_serve_bench",
@@ -152,7 +260,30 @@ def main(argv=None) -> int:
     p.add_argument("--hit-threshold", type=float, default=0.9,
                    help="fail (rc 1) when the post-warmup cache hit "
                         "rate drops below this")
+    p.add_argument("--chaos", action="store_true",
+                   help="run the chaos acceptance stream (low-rate "
+                        "fault injection vs a fault-free reference) "
+                        "instead of the plain serve bench")
+    p.add_argument("--fault-rate", type=float, default=0.05)
+    p.add_argument("--fault-point", default="toa_nan")
     args = p.parse_args(argv)
+
+    if args.chaos:
+        report = run_chaos_stream(
+            n_requests=args.requests, fault_rate=args.fault_rate,
+            fault_point=args.fault_point, max_batch=args.max_batch,
+            max_latency_s=args.max_latency,
+            bucket_floor=args.bucket_floor, maxiter=args.maxiter,
+            precision=args.precision)
+        print(json.dumps(report, default=float))
+        if not report["ok"]:
+            print(f"FAIL: chaos contract violated "
+                  f"(healthy_failures={report['healthy_failures']}, "
+                  f"health={report['health_state']}, "
+                  f"unexpected_recompiles="
+                  f"{report['unexpected_recompiles']})",
+                  file=sys.stderr)
+        return 0 if report["ok"] else 1
 
     report = run_serve_stream(
         n_requests=args.requests, max_batch=args.max_batch,

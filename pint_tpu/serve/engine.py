@@ -1,8 +1,9 @@
 """Synchronous in-process serving engine: submit -> micro-batch ->
-warm executable -> result, with degradation and per-request
-telemetry. scripts/pint_serve_bench.py drives it end-to-end; there is
-deliberately no network layer — the batching/caching/degradation
-engine is the part that transfers to a real serving stack.
+warm executable -> result, with degradation, fault handling, and
+per-request telemetry. scripts/pint_serve_bench.py drives it
+end-to-end; there is deliberately no network layer — the
+batching/caching/degradation engine is the part that transfers to a
+real serving stack.
 
 Shape stability is the whole game. A flush pads the TOA axis to the
 slot's pow2 bucket (PTABatch(pad_toas=...)) and the pulsar/lane axis
@@ -13,15 +14,34 @@ retracing. Replicated lanes cost padded FLOPs, not correctness: lanes
 are independent under vmap and extra-lane results are discarded;
 padded TOA rows carry the 1e30-sigma sentinel (stack_prepared) so
 they vanish from every whitened reduction.
+
+Fault handling (pint_tpu.resilience) is layered on the same
+invariant. Lane independence means a poisoned request can only
+corrupt its own lane's numbers, so: (1) non-finite TOA values/errors
+are rejected at submit before they reach a slot; (2) a flush that
+still produces non-finite per-lane results rejects exactly those
+lanes and re-runs the healthy subset on the SAME warm executable
+(identical padded shapes -> no recompile); (3) a flush that dies with
+an exception is retried with jittered backoff when transient, else
+bisected so one pathological request cannot fail its co-batched
+neighbors; (4) a slot that keeps failing or keeps recompiling trips a
+circuit breaker and its traffic gets structured rejections instead of
+hanging the engine; (5) everything feeds the HealthMonitor
+(healthy -> degraded -> draining) exported via snapshot().
 """
 
 from __future__ import annotations
 
+import copy
 import time
 import warnings
 
 import numpy as np
 
+from ..resilience import faultinject
+from ..resilience.faultinject import FaultInjected
+from ..resilience.health import HealthMonitor
+from ..resilience.retry import BackoffPolicy, CircuitBreaker, with_retries
 from . import policy
 from .batcher import MicroBatcher
 from .excache import ExecutableCache
@@ -33,13 +53,20 @@ class ServeEngine:
     """In-process online timing service over PTABatch executables.
 
     clock: injectable monotonic-seconds callable (tests drive the
-    flush timer deterministically with a fake clock).
+        flush timer, breaker cooldowns, and health transitions
+        deterministically with a fake clock).
+    sleep: injectable sleep for retry backoff and injected dispatch
+        delays (tests pass the fake clock's advance).
+    backoff / breaker / health: resilience policies; defaults are
+        constructed on the engine's clock.
     """
 
     def __init__(self, max_batch=8, max_latency_s=0.05, max_queue=256,
                  cache_capacity=32, bucket_floor=256,
                  oversize_toas=policy.DEFAULT_OVERSIZE_TOAS,
-                 mesh=None, clock=time.monotonic):
+                 mesh=None, clock=time.monotonic, sleep=time.sleep,
+                 backoff=None, breaker=None, health=None,
+                 bisect_depth=4):
         self.batcher = MicroBatcher(max_batch=max_batch,
                                     max_latency_s=max_latency_s,
                                     bucket_floor=bucket_floor)
@@ -49,16 +76,37 @@ class ServeEngine:
         self.oversize_toas = oversize_toas
         self.mesh = mesh
         self.clock = clock
+        self._sleep = sleep
+        self.backoff = backoff or BackoffPolicy()
+        self.breaker = breaker or CircuitBreaker(clock=clock)
+        self.health = health or HealthMonitor(clock=clock)
+        self.bisect_depth = int(bisect_depth)
         self.executables_compiled = 0
+        # slot_key -> set of exec_keys seen: a second DISTINCT
+        # executable for a slot is an unexpected recompile (shapes are
+        # supposed to be pinned), counted and breaker-relevant
+        self._slot_exec_keys = {}
+        self._slot_recompiles = {}
 
     # -- intake ------------------------------------------------------
 
     def submit(self, request):
         """Route one request. Returns a ServeResult handle, filled in
         when its slot flushes; a submit that fills a slot flushes it
-        inline, and shed/spilled requests complete immediately."""
+        inline, and shed/spilled/rejected requests complete
+        immediately."""
         res = ServeResult(request=request)
         now = self.clock()
+        fault = (faultinject.fire("toa_nan",
+                                  request_id=request.request_id)
+                 or faultinject.fire("toa_inf_error",
+                                     request_id=request.request_id))
+        if fault:
+            request = self._corrupted(request, fault)
+            res.request = request
+        if self.health.state == "draining":
+            return self._reject(request, res, "draining", request.kind,
+                                health_state="draining")
         try:
             routing = policy.resolve(request)
         except ValueError as e:
@@ -68,11 +116,24 @@ class ServeEngine:
             self.telemetry.record(request_id=request.request_id,
                                   kind=request.kind, status="error",
                                   reason=res.reason)
+            self.health.note_request("error")
             return res
+        nv, ne = self._nonfinite_counts(request)
+        if nv or ne:
+            detail = {"nonfinite_values": nv, "nonfinite_errors": ne}
+            if fault:
+                detail["injected_point"] = fault["point"]
+            return self._reject(request, res, "nonfinite_input",
+                                routing[0], **detail)
         if policy.is_oversize(len(request.toas), self.oversize_toas):
             self.telemetry.incr("spilled_oversize")
             self._execute_solo(request, res, routing, now)
             return res
+        key = self.batcher.slot_key(request, routing)
+        if not self.breaker.allow(key):
+            return self._reject(
+                request, res, "circuit_open", routing[0],
+                retry_after_s=round(self.breaker.retry_after_s(key), 3))
         if self.batcher.depth() >= self.max_queue:
             res.status = "shed"
             res.reason = "queue_full"
@@ -84,10 +145,53 @@ class ServeEngine:
             self.telemetry.record(request_id=request.request_id,
                                   kind=routing[0], status="shed",
                                   reason="queue_full")
+            self.health.note_request("shed")
             return res
-        key = self.batcher.slot_key(request, routing)
         if self.batcher.admit(key, request, res, now):
             self._flush(key)
+        return res
+
+    @staticmethod
+    def _nonfinite_counts(request):
+        """Non-finite entries in the request's TOA values and
+        uncertainties. freq_mhz is deliberately NOT checked — infinite
+        frequency is the legitimate encoding of barycentered TOAs."""
+        sec = np.asarray(request.toas.sec, dtype=np.float64)
+        err = np.asarray(request.toas.error_us, dtype=np.float64)
+        nv = int(sec.size - np.count_nonzero(np.isfinite(sec)))
+        ne = int(err.size - np.count_nonzero(np.isfinite(err)))
+        return nv, ne
+
+    @staticmethod
+    def _corrupted(request, fault):
+        """Apply a toa_nan / toa_inf_error injection to a DEEP COPY of
+        the request's TOAs — callers (and the bench's shared fleet)
+        must never observe the corruption."""
+        toas = copy.deepcopy(request.toas)
+        idx = int(fault.get("index", 0)) % max(1, len(toas))
+        if fault["point"] == "toa_nan":
+            toas.sec = np.array(toas.sec, dtype=np.float64, copy=True)
+            toas.sec[idx] = np.nan
+        else:
+            toas.error_us = np.array(toas.error_us, dtype=np.float64,
+                                     copy=True)
+            toas.error_us[idx] = np.inf
+        req = copy.copy(request)
+        req.toas = toas
+        return req
+
+    def _reject(self, req, res, reason, kind=None, **detail):
+        """Complete ``res`` as a structured rejection (client keeps a
+        machine-readable reason; telemetry and health see it)."""
+        res.status = "rejected"
+        res.reason = reason
+        res.telemetry = policy.rejection(reason,
+                                         request_id=req.request_id,
+                                         **detail)
+        self.telemetry.incr(f"rejected_{reason}")
+        self.telemetry.record(request_id=req.request_id, kind=kind,
+                              status="rejected", reason=reason)
+        self.health.note_request("rejected", reason)
         return res
 
     def poll(self, now=None):
@@ -126,7 +230,7 @@ class ServeEngine:
         Returns the number of executables compiled."""
         before = self.executables_compiled
         for res in self.run_stream(requests):
-            if res.status == "error":
+            if res.status in ("error", "rejected"):
                 raise RuntimeError(f"prewarm request "
                                    f"{res.request.request_id} failed: "
                                    f"{res.reason}")
@@ -136,8 +240,10 @@ class ServeEngine:
 
     def snapshot(self):
         """JSON-safe service snapshot: telemetry aggregate + cache
-        counters + compile/queue state."""
-        snap = self.telemetry.snapshot(cache=self.cache)
+        counters + health/breaker state + compile/queue state."""
+        snap = self.telemetry.snapshot(cache=self.cache,
+                                       health=self.health,
+                                       breaker=self.breaker)
         snap["executables_compiled"] = self.executables_compiled
         snap["queue_depth"] = self.batcher.depth()
         return snap
@@ -163,10 +269,12 @@ class ServeEngine:
                 self.telemetry.record(request_id=req.request_id,
                                       status="shed", reason="deadline",
                                       queue_wait_s=now - t_sub)
+                self.health.note_request("shed")
             else:
                 live.append((req, res, t_sub))
         if live:
             self._execute(key, live, flush_start=now)
+            self.health.note_flush(self.clock() - now)
 
     def _fail(self, live, kind, exc):
         reason = f"{type(exc).__name__}: {exc}"
@@ -176,8 +284,64 @@ class ServeEngine:
             res.reason = reason
             self.telemetry.record(request_id=req.request_id, kind=kind,
                                   status="error", reason=reason)
+            self.health.note_request("error")
 
-    def _execute(self, slot_key, live, flush_start):
+    def _on_retry(self, attempt, exc, delay_s):
+        self.telemetry.incr("retries")
+
+    def _execute(self, slot_key, live, flush_start, depth=0):
+        """Fault-handling driver around one batched flush.
+
+        - transient exceptions: retried with jittered backoff;
+        - persistent exceptions: batch bisected (down to singletons)
+          so only the pathological request(s) fail — then the breaker
+          records the failure;
+        - poisoned lanes (non-finite per-lane results): rejected with
+          a structured reason, healthy subset re-run on the same warm
+          executable (lane independence + identical padded shapes
+          guarantee no recompile and unchanged healthy results).
+        """
+        kind = slot_key[2]
+        try:
+            poisoned = with_retries(
+                lambda: self._execute_batch(slot_key, live, flush_start),
+                policy=self.backoff, sleep=self._sleep,
+                on_retry=self._on_retry)
+        except Exception as e:
+            if len(live) > 1 and depth < self.bisect_depth:
+                self.telemetry.incr("flush_bisects")
+                mid = len(live) // 2
+                self._execute(slot_key, live[:mid], flush_start,
+                              depth + 1)
+                self._execute(slot_key, live[mid:], flush_start,
+                              depth + 1)
+                return
+            self._fail(live, kind, e)
+            tripped = self.breaker.record_failure(slot_key)
+            self.health.note_breakers(self.breaker.open_count(), tripped)
+            return
+        # don't let a routine success close a breaker that was
+        # force-tripped (unexpected recompiles) moments ago
+        if self.breaker.state(slot_key) != "open":
+            self.breaker.record_success(slot_key)
+        self.health.note_breakers(self.breaker.open_count())
+        if poisoned:
+            healthy = [ent for i, ent in enumerate(live)
+                       if i not in poisoned]
+            reason = ("solver_diverged" if kind == "fit"
+                      else "nonfinite_result")
+            for i in sorted(poisoned):
+                req, res, _ = live[i]
+                self.telemetry.incr("quarantined")
+                self._reject(req, res, reason, kind, quarantined=True)
+            if healthy:
+                self._execute(slot_key, healthy, flush_start, depth)
+
+    def _execute_batch(self, slot_key, live, flush_start):
+        """One attempt at a batched flush. Commits results and returns
+        an empty set on success; returns the set of poisoned live-lane
+        indices (committing NOTHING) when per-lane results are
+        non-finite; raises on structural/compile/dispatch failure."""
         from ..parallel.pta import PTABatch
 
         _, bucket, kind, method, maxiter, precision = slot_key
@@ -190,89 +354,106 @@ class ServeEngine:
         models += [models[-1]] * (lanes - n_live)
         toas_list += [toas_list[-1]] * (lanes - n_live)
         t0 = self.clock()
-        try:
-            pta = PTABatch(models, toas_list, mesh=self.mesh,
-                           pad_toas=bucket)
-        except Exception as e:
-            self._fail(live, kind, e)
-            return
+        pta = PTABatch(models, toas_list, mesh=self.mesh,
+                       pad_toas=bucket)
         pack_s = self.clock() - t0
         exec_key = (slot_key, lanes, pta.shape_signature())
         fns = self.cache.lookup(exec_key)
         cold = fns is None
         compile_s = 0.0
         if cold:
+            fault = faultinject.fire("compile_fail", slot=str(slot_key))
+            if fault:
+                raise FaultInjected("compile_fail",
+                                    retryable=fault.get("retryable",
+                                                        True),
+                                    detail=fault)
             if kind == "fit":
                 # AOT-compile so the compile cost is attributed to this
                 # (cold) flush explicitly instead of smeared into its
                 # execute time
                 t0 = self.clock()
-                try:
-                    pta.aot_compile(method, maxiter=maxiter,
-                                    precision=precision)
-                except Exception as e:
-                    self._fail(live, kind, e)
-                    return
+                pta.aot_compile(method, maxiter=maxiter,
+                                precision=precision)
                 compile_s = self.clock() - t0
             self.executables_compiled += 1
             self.cache.insert(exec_key, pta._fns)
+            seen = self._slot_exec_keys.setdefault(slot_key, set())
+            if seen and exec_key not in seen:
+                # shapes are pinned, so a second distinct executable
+                # for a slot means the zero-retrace contract broke
+                self.telemetry.incr("unexpected_recompiles")
+                n = self._slot_recompiles.get(slot_key, 0) + 1
+                self._slot_recompiles[slot_key] = n
+                if n >= self.breaker.threshold:
+                    tripped = self.breaker.trip(slot_key)
+                    self.health.note_breakers(self.breaker.open_count(),
+                                              tripped)
+            seen.add(exec_key)
         else:
             pta._fns = fns
+            self._slot_exec_keys.setdefault(slot_key, set()).add(exec_key)
+
+        fault = faultinject.fire("dispatch_slow", slot=str(slot_key))
+        if fault:
+            self._sleep(float(fault.get("delay_s", 0.25)))
 
         degraded = False
-        diverged = set()
         t0 = self.clock()
-        try:
-            if kind == "fit":
-                with warnings.catch_warnings(record=True) as caught:
-                    warnings.simplefilter("always")
-                    if method == "gls":
-                        x, chi2, cov = pta.gls_fit(maxiter=maxiter,
-                                                   precision=precision)
-                    else:
-                        x, chi2, cov = pta.wls_fit(maxiter=maxiter)
-                degraded = policy.mixed_fell_back(caught)
-                # the fallback is accounted as degradation; everything
-                # else (divergence reports etc.) is re-emitted
-                for w in caught:
-                    if policy.MIXED_FALLBACK_MARK not in str(w.message):
-                        warnings.warn_explicit(w.message, w.category,
-                                               w.filename, w.lineno)
-                x, chi2, cov = (np.asarray(x), np.asarray(chi2),
-                                np.asarray(cov))
-                names = [n for n, _, _ in pta.free_map()]
-                diverged = set(pta.diverged)
+        if kind == "fit":
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                if method == "gls":
+                    x, chi2, cov = pta.gls_fit(maxiter=maxiter,
+                                               precision=precision)
+                else:
+                    x, chi2, cov = pta.wls_fit(maxiter=maxiter)
+            degraded = policy.mixed_fell_back(caught)
+            # the fallback is accounted as degradation; everything
+            # else (divergence reports etc.) is re-emitted
+            for w in caught:
+                if policy.MIXED_FALLBACK_MARK not in str(w.message):
+                    warnings.warn_explicit(w.message, w.category,
+                                           w.filename, w.lineno)
+            x, chi2, cov = (np.asarray(x), np.asarray(chi2),
+                            np.asarray(cov))
+            names = [n for n, _, _ in pta.free_map()]
+            diverged = set(pta.diverged)
+            poisoned = {i for i in range(n_live)
+                        if i in diverged
+                        or not (np.all(np.isfinite(x[i]))
+                                and np.isfinite(chi2[i]))}
 
-                def value_of(i):
-                    return {"x": x[i], "chi2": float(chi2[i]),
-                            "cov": cov[i], "free_names": names}
-            elif kind == "resid":
-                r, _ = pta.time_residuals()
-                r = np.asarray(r)
+            def value_of(i):
+                return {"x": x[i], "chi2": float(chi2[i]),
+                        "cov": cov[i], "free_names": names}
+        elif kind == "resid":
+            r, _ = pta.time_residuals()
+            r = np.asarray(r)
+            poisoned = {i for i in range(n_live)
+                        if not np.all(np.isfinite(
+                            r[i, :len(live[i][0].toas)]))}
 
-                def value_of(i):
-                    return {"resid_s": r[i, :len(live[i][0].toas)]}
-            else:  # "phase" (policy.resolve rejected everything else)
-                ph, _ = pta.phases()
-                ph = np.asarray(ph)
+            def value_of(i):
+                return {"resid_s": r[i, :len(live[i][0].toas)]}
+        else:  # "phase" (policy.resolve rejected everything else)
+            ph, _ = pta.phases()
+            ph = np.asarray(ph)
+            poisoned = {i for i in range(n_live)
+                        if not np.all(np.isfinite(
+                            ph[i, :len(live[i][0].toas)]))}
 
-                def value_of(i):
-                    return {"phase": ph[i, :len(live[i][0].toas)]}
-        except Exception as e:
-            self._fail(live, kind, e)
-            return
+            def value_of(i):
+                return {"phase": ph[i, :len(live[i][0].toas)]}
         execute_s = self.clock() - t0
+        if poisoned:
+            return poisoned
         if degraded:
             self.telemetry.incr("degraded_mixed", n_live)
         done = self.clock()
         for i, (req, res, t_sub) in enumerate(live):
-            if i in diverged:
-                res.status = "error"
-                res.reason = "diverged"
-                self.telemetry.incr("diverged")
-            else:
-                res.status = "ok"
-                res.value = value_of(i)
+            res.status = "ok"
+            res.value = value_of(i)
             rec = {"request_id": req.request_id, "kind": kind,
                    "status": res.status, "reason": res.reason,
                    "queue_wait_s": flush_start - t_sub,
@@ -282,6 +463,8 @@ class ServeEngine:
                    "degraded": degraded, "spilled": False}
             res.telemetry = rec
             self.telemetry.record(**rec)
+            self.health.note_request("ok")
+        return set()
 
     def _execute_solo(self, request, res, routing, submitted_at):
         """Oversize spill: run unbatched, padded to the request's own
@@ -336,3 +519,4 @@ class ServeEngine:
                "degraded": degraded, "spilled": True}
         res.telemetry = rec
         self.telemetry.record(**rec)
+        self.health.note_request("ok")

@@ -45,14 +45,17 @@ class ServeTelemetry:
                 if r.get("status") == status
                 and r.get(phase) is not None]
 
-    def snapshot(self, cache=None):
+    def snapshot(self, cache=None, health=None, breaker=None):
         """JSON-safe aggregate: request counts, per-phase p50/p99/max
         over completed requests, counters, and (optionally) the
-        executable cache's hit/miss/evict counters."""
+        executable cache's hit/miss/evict counters plus the resilience
+        layer's health state and circuit-breaker census."""
         snap = {
             "requests": len(self.records),
             "requests_ok": sum(1 for r in self.records
                                if r.get("status") == "ok"),
+            "requests_rejected": sum(1 for r in self.records
+                                     if r.get("status") == "rejected"),
             "counters": dict(sorted(self.counters.items())),
         }
         for phase in self.PHASES:
@@ -62,10 +65,15 @@ class ServeTelemetry:
                            "max": max(vals) if vals else None}
         if cache is not None:
             snap["cache"] = cache.counters()
+        if health is not None:
+            snap["health"] = health.snapshot()
+        if breaker is not None:
+            snap["breaker"] = breaker.snapshot()
         return snap
 
-    def to_json(self, cache=None, **dump_kw):
-        return json.dumps(self.snapshot(cache=cache), **dump_kw)
+    def to_json(self, cache=None, health=None, breaker=None, **dump_kw):
+        return json.dumps(self.snapshot(cache=cache, health=health,
+                                        breaker=breaker), **dump_kw)
 
     def reset(self):
         self.counters = {}

@@ -76,9 +76,12 @@ class ServeResult:
     """Mutable handle returned by ServeEngine.submit; filled in when
     the request's slot flushes (or immediately on shed/spill/error).
 
-    status: "pending" -> "ok" | "shed" | "error".
-    reason: shed/error cause ("queue_full", "deadline", "diverged",
-        or an exception summary).
+    status: "pending" -> "ok" | "shed" | "error" | "rejected".
+    reason: shed/error/rejection cause ("queue_full", "deadline",
+        "nonfinite_input", "circuit_open", "solver_diverged",
+        "nonfinite_result", "draining", or an exception summary);
+        "rejected" statuses always carry a structured
+        policy.rejection payload in ``telemetry``.
     value: kind-dependent payload (fit: x/chi2/cov/free_names;
         resid: resid_s; phase: phase).
     telemetry: the per-request record metrics.ServeTelemetry

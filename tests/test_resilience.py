@@ -1,0 +1,618 @@
+"""Chaos suite for pint_tpu.resilience: deterministic fault
+injection, retry/backoff, circuit breaking, lane quarantine, health
+state, checkpoint integrity, and the coordinator timeout — every
+injection point exercised end-to-end on CPU with a fake clock
+(tier-1-safe: no real sleeps, no accelerator, tiny batches)."""
+
+import copy
+import warnings
+
+import numpy as np
+import pytest
+
+warnings.simplefilter("ignore")
+
+from pint_tpu import checkpoint as ckpt_mod
+from pint_tpu import fitter
+from pint_tpu.checkpoint import FitCheckpointer, checkpointed_pta_fit
+from pint_tpu.models import get_model
+from pint_tpu.parallel import PTABatch
+from pint_tpu.resilience import (BackoffPolicy, CircuitBreaker,
+                                 FaultInjected, FaultPoint,
+                                 HealthMonitor, arm_from_env, armed,
+                                 disarm, inject, parse_spec,
+                                 with_retries)
+from pint_tpu.serve import FitRequest, ResidualRequest, ServeEngine
+from pint_tpu.serve import policy as serve_policy
+from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+PAR = """
+PSR RSLT{i}
+RAJ 11:0{i}:00.0
+DECJ 9:00:00.0
+F0 2{i}9.125 1
+F1 -3e-16 1
+PEPOCH 55500
+DM 11.{i} 1
+"""
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _pulsar(i=0, n_toa=24, seed=0):
+    m = get_model(PAR.format(i=i))
+    rng = np.random.default_rng(seed + i)
+    mjds = np.sort(rng.uniform(54500, 56500, n_toa))
+    t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=1400.0,
+                                obs="gbt", add_noise=True, seed=seed + i,
+                                iterations=0)
+    return m, t
+
+
+@pytest.fixture(scope="module")
+def two_pulsars():
+    return [_pulsar(0, 24), _pulsar(1, 24)]
+
+
+@pytest.fixture(autouse=True)
+def _disarm_everything():
+    yield
+    disarm()
+
+
+def _fake_engine(clock=None, **kw):
+    clock = clock or FakeClock()
+    kw.setdefault("max_latency_s", 1e9)
+    kw.setdefault("bucket_floor", 32)
+    eng = ServeEngine(clock=clock, sleep=clock.advance, **kw)
+    return eng, clock
+
+
+# -- fault injection registry ----------------------------------------
+
+
+def test_fault_point_determinism():
+    a = FaultPoint("toa_nan", rate=0.3, seed=7)
+    b = FaultPoint("toa_nan", rate=0.3, seed=7)
+    pa = [a.should_fire() for _ in range(200)]
+    pb = [b.should_fire() for _ in range(200)]
+    assert pa == pb  # pure function of the seed
+    assert 20 < sum(pa) < 100  # rate is roughly honored
+    # count caps total fires; after skips leading checks
+    c = FaultPoint("toa_nan", count=2, after=3)
+    fires = [c.should_fire() for _ in range(8)]
+    assert fires == [False, False, False, True, True, False, False,
+                     False]
+
+
+def test_fire_requires_arming(two_pulsars):
+    from pint_tpu.resilience import fire
+
+    assert fire("toa_nan") is None  # disarmed: no-op
+    with inject("toa_nan"):
+        out = fire("toa_nan", request_id="r1")
+        assert out["point"] == "toa_nan" and out["fire"] == 1
+        assert out["request_id"] == "r1"
+        assert "toa_nan" in armed()
+    assert armed() == {} and fire("toa_nan") is None
+
+
+def test_parse_spec_and_env(monkeypatch):
+    pts = parse_spec("toa_nan:rate=0.05,seed=7;"
+                     "compile_fail:count=1,retryable=false;"
+                     "solver_diverge:lanes=0+2;"
+                     "dispatch_slow:delay_s=0.5")
+    by = {p.name: p for p in pts}
+    assert by["toa_nan"].rate == 0.05 and by["toa_nan"].seed == 7
+    assert by["compile_fail"].count == 1
+    assert by["compile_fail"].payload == {"retryable": False}
+    assert by["solver_diverge"].payload == {"lanes": [0, 2]}
+    assert by["dispatch_slow"].payload == {"delay_s": 0.5}
+    with pytest.raises(ValueError, match="unknown fault point"):
+        parse_spec("not_a_point")
+    monkeypatch.setenv("PINT_TPU_FAULTS", "toa_nan:rate=0.5")
+    armed_pts = arm_from_env()
+    assert [p.name for p in armed_pts] == ["toa_nan"]
+    assert armed()["toa_nan"].rate == 0.5
+
+
+# -- retry / backoff / breaker ---------------------------------------
+
+
+def test_backoff_deterministic_and_bounded():
+    d1 = BackoffPolicy(max_attempts=6, base_s=0.05, max_s=0.4,
+                       seed=3).delays(5)
+    d2 = BackoffPolicy(max_attempts=6, base_s=0.05, max_s=0.4,
+                       seed=3).delays(5)
+    assert d1 == d2  # deterministic under the seed
+    for i, d in enumerate(d1):
+        raw = min(0.4, 0.05 * 2.0 ** i)
+        assert 0.5 * raw <= d <= 1.5 * raw  # jitter_frac=0.5 envelope
+    nojit = BackoffPolicy(jitter_frac=0.0, base_s=0.1, max_s=0.3)
+    assert nojit.delays(4) == [0.1, 0.2, 0.3, 0.3]
+
+
+def test_with_retries_transient_then_success():
+    calls, slept = [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise FaultInjected("compile_fail", retryable=True)
+        return "done"
+
+    out = with_retries(flaky, BackoffPolicy(max_attempts=4, seed=0),
+                       sleep=slept.append)
+    assert out == "done" and len(calls) == 3 and len(slept) == 2
+
+
+def test_with_retries_fails_fast_on_nonretryable():
+    calls = []
+
+    def broken():
+        calls.append(1)
+        raise ValueError("bad request")
+
+    with pytest.raises(ValueError):
+        with_retries(broken, BackoffPolicy(max_attempts=5),
+                     sleep=lambda s: None)
+    assert len(calls) == 1  # no retries burned on a permanent failure
+
+
+def test_circuit_breaker_lifecycle():
+    clock = FakeClock()
+    br = CircuitBreaker(threshold=2, cooldown_s=10.0, clock=clock)
+    assert br.allow("slot")
+    assert br.record_failure("slot") is False
+    assert br.record_failure("slot") is True  # trips on the 2nd
+    assert br.state("slot") == "open" and not br.allow("slot")
+    assert br.retry_after_s("slot") == pytest.approx(10.0)
+    clock.advance(10.1)
+    assert br.state("slot") == "half_open"
+    assert br.allow("slot")        # the single half-open trial
+    assert not br.allow("slot")    # everyone else stays rejected
+    br.record_failure("slot")      # trial failed: re-open, new cooldown
+    assert br.state("slot") == "open"
+    clock.advance(10.1)
+    assert br.allow("slot")
+    br.record_success("slot")      # trial succeeded: closed again
+    assert br.state("slot") == "closed" and br.allow("slot")
+    assert br.snapshot()["trips"] == 1
+
+
+# -- engine intake validation (satellite fix) ------------------------
+
+
+def test_intake_rejects_nonfinite(two_pulsars):
+    (m0, t0), (m1, t1) = two_pulsars
+    bad = copy.deepcopy(t0)
+    bad.sec = np.array(bad.sec)
+    bad.sec[3] = np.nan
+    eng, _ = _fake_engine(max_batch=2)
+    rb = eng.submit(FitRequest(m0, bad, maxiter=2))
+    assert rb.status == "rejected" and rb.reason == "nonfinite_input"
+    assert rb.telemetry["rejected"] is True
+    assert rb.telemetry["detail"]["nonfinite_values"] == 1
+    rg = eng.submit(FitRequest(m1, t1, maxiter=2))
+    eng.drain()
+    assert rg.status == "ok"  # the neighbor never saw the poison
+    assert eng.telemetry.counters["rejected_nonfinite_input"] == 1
+    # client-fault rejections must not degrade the engine's health
+    assert eng.snapshot()["health"]["state"] == "healthy"
+
+
+def test_intake_rejects_inf_errors_not_inf_freq(two_pulsars):
+    (m0, t0), _ = two_pulsars
+    inf_err = copy.deepcopy(t0)
+    inf_err.error_us = np.array(inf_err.error_us)
+    inf_err.error_us[0] = np.inf
+    eng, _ = _fake_engine(max_batch=1)
+    res = eng.submit(ResidualRequest(m0, inf_err))
+    assert res.status == "rejected"
+    assert res.telemetry["detail"]["nonfinite_errors"] == 1
+    # infinite FREQUENCY is legitimate (barycentered TOAs) and must
+    # pass intake
+    bary = copy.deepcopy(t0)
+    bary.freq_mhz = np.full_like(np.array(bary.freq_mhz), np.inf)
+    assert ServeEngine._nonfinite_counts(
+        ResidualRequest(m0, bary)) == (0, 0)
+
+
+def test_injected_toa_nan_never_mutates_caller(two_pulsars):
+    (m0, t0), _ = two_pulsars
+    sec_before = np.array(t0.sec, copy=True)
+    eng, _ = _fake_engine(max_batch=1)
+    with inject(FaultPoint("toa_nan")):
+        req = FitRequest(m0, t0, maxiter=2)
+        res = eng.submit(req)
+    assert res.status == "rejected" and res.reason == "nonfinite_input"
+    assert res.telemetry["detail"]["injected_point"] == "toa_nan"
+    np.testing.assert_array_equal(np.array(t0.sec), sec_before)
+    assert req.toas is t0  # the caller's request object is untouched
+
+
+def test_injected_toa_inf_error(two_pulsars):
+    (m0, t0), _ = two_pulsars
+    eng, _ = _fake_engine(max_batch=1)
+    with inject(FaultPoint("toa_inf_error")):
+        res = eng.submit(ResidualRequest(m0, t0))
+    assert res.status == "rejected"
+    assert res.telemetry["detail"]["injected_point"] == "toa_inf_error"
+    assert res.telemetry["detail"]["nonfinite_errors"] == 1
+    assert np.all(np.isfinite(np.array(t0.error_us)))
+
+
+# -- lane quarantine -------------------------------------------------
+
+
+def test_quarantine_isolates_poisoned_lane(two_pulsars):
+    """solver_diverge poisons lane 0 of a 2-lane flush: lane 0 must be
+    rejected with a structured reason and lane 1 completed from the
+    warm re-run with results identical to the offline path."""
+    (m0, t0), (m1, t1) = two_pulsars
+    eng, _ = _fake_engine(max_batch=2)
+    with inject(FaultPoint("solver_diverge", count=1,
+                           payload={"lanes": [0]})):
+        r0 = eng.submit(FitRequest(m0, t0, maxiter=3))
+        r1 = eng.submit(FitRequest(m1, t1, maxiter=3))
+    assert r0.status == "rejected" and r0.reason == "solver_diverged"
+    assert r0.telemetry["detail"]["quarantined"] is True
+    assert r1.status == "ok"
+    assert eng.telemetry.counters["quarantined"] == 1
+    off = PTABatch([m1], [t1])
+    x_off, _, _ = off.wls_fit(maxiter=3)
+    rel = np.max(np.abs(r1.value["x"] - np.asarray(x_off)[0])
+                 / np.maximum(np.abs(np.asarray(x_off)[0]), 1e-30))
+    assert rel <= 1e-12
+
+
+def test_compile_fail_transient_is_retried(two_pulsars):
+    (m0, t0), _ = two_pulsars
+    eng, clock = _fake_engine(max_batch=1)
+    with inject(FaultPoint("compile_fail", count=1)):
+        res = eng.submit(ResidualRequest(m0, t0))
+    assert res.status == "ok"  # retry compiled and served it
+    assert eng.telemetry.counters["retries"] == 1
+    assert clock.t > 0  # the backoff slept on the fake clock
+
+
+def test_bisect_completes_healthy_requests(two_pulsars):
+    """A non-retryable whole-flush failure is bisected: with the fault
+    exhausted after one fire, both halves succeed — no healthy request
+    fails, and the bisect is counted."""
+    (m0, t0), (m1, t1) = two_pulsars
+    eng, _ = _fake_engine(max_batch=2)
+    with inject(FaultPoint("compile_fail", count=1,
+                           payload={"retryable": False})):
+        r0 = eng.submit(ResidualRequest(m0, t0))
+        r1 = eng.submit(ResidualRequest(m1, t1))
+    assert r0.status == "ok" and r1.status == "ok"
+    assert eng.telemetry.counters["flush_bisects"] == 1
+    assert eng.telemetry.counters.get("retries") is None
+
+
+def test_dispatch_slow_trips_watchdog(two_pulsars):
+    (m0, t0), _ = two_pulsars
+    clock = FakeClock()
+    health = HealthMonitor(clock=clock, flush_watchdog_s=5.0,
+                           recovery_s=30.0)
+    eng, _ = _fake_engine(clock=clock, max_batch=1, health=health)
+    with inject(FaultPoint("dispatch_slow", count=1,
+                           payload={"delay_s": 9.0})):
+        res = eng.submit(ResidualRequest(m0, t0))
+    assert res.status == "ok"  # slow, not wrong
+    snap = eng.snapshot()["health"]
+    assert snap["state"] == "degraded"
+    assert "flush_watchdog" in snap["reasons"]
+    assert snap["watchdog_breaches"] == 1
+    # quiet recovery: watchdog memory expires after recovery_s
+    clock.advance(61.0)
+    eng.submit(ResidualRequest(m0, t0))
+    eng.drain()
+    assert eng.snapshot()["health"]["state"] == "healthy"
+
+
+# -- circuit breaker through the engine ------------------------------
+
+
+def test_breaker_trips_and_recovers_through_engine(two_pulsars):
+    (m0, t0), _ = two_pulsars
+    clock = FakeClock()
+    breaker = CircuitBreaker(threshold=2, cooldown_s=30.0, clock=clock)
+    eng, _ = _fake_engine(clock=clock, max_batch=1, breaker=breaker)
+    with inject(FaultPoint("compile_fail",
+                           payload={"retryable": False})):
+        r1 = eng.submit(ResidualRequest(m0, t0))
+        r2 = eng.submit(ResidualRequest(m0, t0))
+        assert r1.status == "error" and r2.status == "error"
+        # breaker now open: traffic is rejected BEFORE flushing
+        r3 = eng.submit(ResidualRequest(m0, t0))
+    assert r3.status == "rejected" and r3.reason == "circuit_open"
+    assert r3.telemetry["detail"]["retry_after_s"] > 0
+    assert eng.snapshot()["health"]["state"] != "healthy"
+    # cooldown elapses, fault is gone: half-open trial closes it
+    clock.advance(30.1)
+    r4 = eng.submit(ResidualRequest(m0, t0))
+    assert r4.status == "ok"
+    assert breaker.state(next(iter(breaker._keys))) == "closed"
+
+
+def test_unexpected_recompile_trips_breaker(two_pulsars):
+    (m0, t0), _ = two_pulsars
+    clock = FakeClock()
+    breaker = CircuitBreaker(threshold=1, cooldown_s=30.0, clock=clock)
+    eng, _ = _fake_engine(clock=clock, max_batch=1, breaker=breaker)
+    req = ResidualRequest(m0, t0)
+    key = eng.batcher.slot_key(req, serve_policy.resolve(req))
+    # a prior executable for this slot that the next compile won't
+    # match = the shape-contract violation the counter exists for
+    eng._slot_exec_keys[key] = {"sentinel-executable"}
+    res = eng.submit(ResidualRequest(m0, t0))
+    assert res.status == "ok"  # the request itself still completes
+    assert eng.telemetry.counters["unexpected_recompiles"] == 1
+    assert breaker.open_count() == 1
+    follow = eng.submit(ResidualRequest(m0, t0))
+    assert follow.status == "rejected"
+    assert follow.reason == "circuit_open"
+
+
+# -- health state machine --------------------------------------------
+
+
+def test_health_shed_rate_transitions():
+    clock = FakeClock()
+    h = HealthMonitor(clock=clock, window=8, min_events=4,
+                      degraded_shed_rate=0.25, draining_shed_rate=0.75,
+                      recovery_s=10.0)
+    assert h.state == "healthy"
+    for _ in range(3):
+        h.note_request("ok")
+    h.note_request("shed")
+    assert h.state == "degraded" and "shed_rate" in h.reasons
+    for _ in range(6):
+        h.note_request("shed")
+    assert h.state == "draining"
+    assert "shed_rate_critical" in h.reasons
+    # draining rejections don't feed the window: recovery is possible
+    for _ in range(8):
+        h.note_request("rejected", "draining")
+        h.note_request("ok")
+    clock.advance(10.1)
+    h.note_request("ok")
+    clock.advance(10.1)
+    h.note_request("ok")
+    assert h.state == "healthy"
+
+
+def test_health_ignores_client_fault_rejections():
+    h = HealthMonitor(clock=FakeClock(), window=8, min_events=4)
+    for _ in range(20):
+        h.note_request("rejected", "nonfinite_input")
+    assert h.state == "healthy" and h.shed_rate() == 0.0
+
+
+def test_draining_engine_rejects_submits(two_pulsars):
+    (m0, t0), _ = two_pulsars
+    clock = FakeClock()
+    health = HealthMonitor(clock=clock, min_events=2,
+                           draining_shed_rate=0.5)
+    health.note_request("shed")
+    health.note_request("shed")
+    assert health.state == "draining"
+    eng, _ = _fake_engine(clock=clock, max_batch=1, health=health)
+    res = eng.submit(ResidualRequest(m0, t0))
+    assert res.status == "rejected" and res.reason == "draining"
+    assert eng.telemetry.counters["rejected_draining"] == 1
+
+
+def test_snapshot_exports_health_and_breaker(two_pulsars):
+    (m0, t0), _ = two_pulsars
+    eng, _ = _fake_engine(max_batch=1)
+    eng.submit(ResidualRequest(m0, t0))
+    snap = eng.snapshot()
+    assert snap["health"]["state"] == "healthy"
+    assert set(snap["breaker"]) == {"trips", "open", "tracked_keys"}
+    import json
+
+    json.dumps(snap)  # JSON-safe end to end
+
+
+# -- checkpoint integrity (satellite fix) ----------------------------
+
+
+def _state(i):
+    return {"x": np.linspace(0, 1, 8) + i, "iter": i,
+            "chi2": np.array([4.0 + i]),
+            "param_names": np.array(["F0", "F1"])}
+
+
+def test_checkpoint_crc_roundtrip(tmp_path):
+    ckpt = FitCheckpointer(tmp_path)
+    ckpt.save("fit", _state(1))
+    out = ckpt.restore("fit")
+    assert int(out["iter"]) == 1
+    np.testing.assert_array_equal(out["x"], _state(1)["x"])
+    assert [str(n) for n in out["param_names"]] == ["F0", "F1"]
+    assert ckpt_mod.INTEGRITY_KEY not in out
+
+
+def test_checkpoint_corruption_falls_back_to_prev(tmp_path):
+    ckpt = FitCheckpointer(tmp_path)
+    ckpt.save("fit", _state(1))
+    ckpt.save("fit", _state(2))  # rotates iter=1 to fit.prev
+    ckpt._corrupt_snapshot("fit")
+    with pytest.warns(UserWarning,
+                      match="unreadable or corrupt|integrity"):
+        out = ckpt.restore("fit")
+    assert out is not None and int(out["iter"]) == 1  # the .prev copy
+    # corrupt the fallback too: nothing valid survives
+    ckpt._corrupt_snapshot("fit.prev")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        assert ckpt.restore("fit") is None
+
+
+def test_checkpoint_corrupt_injection_point(tmp_path):
+    ckpt = FitCheckpointer(tmp_path)
+    ckpt.save("fit", _state(1))
+    with inject(FaultPoint("checkpoint_corrupt")):
+        ckpt.save("fit", _state(2))  # snapshot damaged on disk
+    with pytest.warns(UserWarning):
+        out = ckpt.restore("fit")
+    assert out is not None and int(out["iter"]) == 1
+
+
+def test_checkpointed_pta_fit_restarts_cleanly(tmp_path):
+    m, t = _pulsar(2, 20)
+    pta = PTABatch([m], [t])
+    x, chi2, _ = checkpointed_pta_fit(pta, tmp_path, tag="w", every=1,
+                                      maxiter=1, method="wls")
+    assert np.all(np.isfinite(np.asarray(chi2)))
+    ckpt = FitCheckpointer(tmp_path)
+    ckpt._corrupt_snapshot("w")  # only one snapshot exists: no .prev
+    pta2 = PTABatch([m], [t])
+    with pytest.warns(UserWarning, match="no valid snapshot survives"):
+        x2, chi2b, _ = checkpointed_pta_fit(pta2, tmp_path, tag="w",
+                                            every=1, maxiter=1,
+                                            method="wls")
+    # restarted from scratch and refit to the same answer
+    np.testing.assert_allclose(np.asarray(x2), np.asarray(x),
+                               rtol=1e-9)
+
+
+def test_legacy_snapshot_without_crc_restores(tmp_path):
+    import json
+    import os
+
+    ckpt = FitCheckpointer(tmp_path)
+    ckpt.save("fit", _state(3))
+    meta_path = os.path.join(str(tmp_path), "fit.meta.json")
+    with open(meta_path) as fh:
+        meta = json.load(fh)
+    assert ckpt_mod.INTEGRITY_KEY in meta
+    del meta[ckpt_mod.INTEGRITY_KEY]  # pre-integrity-era sidecar
+    with open(meta_path, "w") as fh:
+        json.dump(meta, fh)
+    out = ckpt.restore("fit")
+    assert out is not None and int(out["iter"]) == 3
+
+
+# -- solver_diverge at the fitter/pta entries ------------------------
+
+
+def test_fitter_solver_diverge_raises():
+    m, t = _pulsar(3, 20)
+    f = fitter.WLSFitter(t, m)
+    with inject(FaultPoint("solver_diverge", count=1)):
+        with pytest.raises(fitter.ConvergenceFailure,
+                           match="injected solver divergence"):
+            f.fit_toas(maxiter=1)
+        # count=1 exhausted: the hook goes quiet again
+        fitter._maybe_inject_solver_diverge("wls")
+    fitter._maybe_inject_solver_diverge("wls")  # disarmed: no-op
+
+
+def test_pta_solver_diverge_isolates_lane(two_pulsars):
+    (m0, t0), (m1, t1) = two_pulsars
+    pta = PTABatch([m0, m1], [t0, t1])
+    x_clean, _, _ = pta.wls_fit(maxiter=2)
+    pta2 = PTABatch([m0, m1], [t0, t1])
+    with inject(FaultPoint("solver_diverge", count=1,
+                           payload={"lanes": [1]})):
+        with pytest.warns(UserWarning, match="diverged"):
+            x, chi2, _ = pta2.wls_fit(maxiter=2)
+    assert list(pta2.diverged) == [1]
+    assert not np.isfinite(chi2[1])
+    # lane 0 is untouched; lane 1 got its start vector back
+    np.testing.assert_allclose(x[0], np.asarray(x_clean)[0], rtol=1e-12)
+    np.testing.assert_array_equal(x[1], np.asarray(pta2._x0())[1])
+
+
+# -- distributed coordinator timeout (satellite fix) -----------------
+
+
+def test_initialize_distributed_timeout_message():
+    """Unreachable coordinator must surface a TimeoutError naming the
+    address, process id, and elapsed time within the configured bound
+    (subprocess: the abandoned native handshake thread must not leak
+    into the test session)."""
+    import subprocess
+    import sys
+
+    code = (
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "from pint_tpu.parallel.distributed import "
+        "initialize_distributed\n"
+        "try:\n"
+        "    initialize_distributed("
+        "coordinator_address='127.0.0.1:1', num_processes=2, "
+        "process_id=0, timeout_s=2.0)\n"
+        "    print('NO-ERROR')\n"
+        "except TimeoutError as e:\n"
+        "    print('TIMEOUT-OK:', e)\n"
+        "import os as _os\n"
+        "_os._exit(0)\n")
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=120,
+                         cwd="/root/repo")
+    assert "TIMEOUT-OK:" in out.stdout
+    assert "127.0.0.1:1" in out.stdout
+    assert "process_id=0" in out.stdout
+    assert "did not complete within 2.0s" in out.stdout
+
+
+def test_initialize_distributed_env_timeout(monkeypatch):
+    """JAX_COORDINATOR_TIMEOUT_S is honored without the kwarg."""
+    import subprocess
+    import sys
+
+    code = (
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "os.environ['JAX_COORDINATOR_TIMEOUT_S'] = '2'\n"
+        "from pint_tpu.parallel.distributed import "
+        "initialize_distributed\n"
+        "try:\n"
+        "    initialize_distributed("
+        "coordinator_address='127.0.0.1:1', num_processes=2, "
+        "process_id=0)\n"
+        "except TimeoutError:\n"
+        "    print('ENV-TIMEOUT-OK')\n"
+        "import os as _os\n"
+        "_os._exit(0)\n")
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=120,
+                         cwd="/root/repo")
+    assert "ENV-TIMEOUT-OK" in out.stdout
+
+
+# -- end-to-end chaos acceptance (miniature) -------------------------
+
+
+def test_chaos_stream_contract():
+    """Miniature of the bench chaos acceptance run: 20% toa_nan into a
+    small mixed stream — every injected request structurally rejected,
+    every healthy request identical to the fault-free run, engine ends
+    healthy with zero unexpected recompiles."""
+    from pint_tpu.scripts.pint_serve_bench import run_chaos_stream
+
+    report = run_chaos_stream(n_requests=24, fault_rate=0.2,
+                              max_batch=4, bucket_floor=32,
+                              sizes=(24,), per_combo=1, maxiter=2,
+                              seed=1)
+    assert report["ok"], report
+    assert report["injected"] >= 1  # the schedule actually fired
+    assert report["healthy_failures"] == 0
+    assert report["max_rel_diff_vs_clean"] == 0.0
+    assert report["health_state"] == "healthy"
+    assert report["unexpected_recompiles"] == 0
