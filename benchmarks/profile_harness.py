@@ -98,12 +98,41 @@ def _chaos_workload(n_requests, fault_rate):
     return report
 
 
+def _fleet_pipeline_workload(n_psr, n_toas):
+    """Pipelined fleet executor on a mixed-structure fleet (wls + gls
+    buckets, two TOA widths): concurrent AOT compile vs the
+    serial-equivalent sum, then pipelined fit vs sequential. Asserts
+    the bitwise-equivalence contract the pipeline guarantees. Returns
+    the fleet_pipeline_metrics dict."""
+    import warnings
+
+    warnings.simplefilter("ignore")
+    from pint_tpu.parallel import PTAFleet, fleet_pipeline_metrics
+    from pint_tpu.scripts.pint_serve_bench import build_serve_fleet
+
+    models, toas_list = build_serve_fleet(
+        sizes=(max(16, n_toas // 2), n_toas), per_combo=max(1, n_psr // 4),
+        seed=3)
+    fleet = PTAFleet(models, toas_list, toa_bucket="pow2",
+                     bucket_floor=16, pipeline=True)
+    report = fleet_pipeline_metrics(fleet, method="auto", maxiter=3)
+    assert report["fleet_pipeline_bitwise"], \
+        "pipelined fleet fit diverged bitwise from the sequential path"
+    for key in ("fleet_compile_serial_s", "fleet_compile_concurrent_s",
+                "fleet_fit_sequential_s", "fleet_fit_pipelined_s",
+                "fleet_pipeline_overlap_pct"):
+        v = report[key]
+        assert v is not None and np.isfinite(v), \
+            f"fleet pipeline metric {key} is not finite: {v!r}"
+    return report
+
+
 def main(argv=None):
     import jax
 
     p = argparse.ArgumentParser()
     p.add_argument("--workload", choices=("wls", "pta", "serve",
-                                          "chaos"),
+                                          "chaos", "fleet_pipeline"),
                    default="wls")
     p.add_argument("--n-toas", type=int, default=5000)
     p.add_argument("--n-psr", type=int, default=8)
@@ -116,6 +145,15 @@ def main(argv=None):
                    help="injection rate for --workload chaos")
     p.add_argument("--trace", help="jax.profiler trace output dir")
     args = p.parse_args(argv)
+
+    if args.workload == "fleet_pipeline":
+        t0 = time.perf_counter()
+        report = _fleet_pipeline_workload(args.n_psr, args.n_toas)
+        report.update({"workload": "fleet_pipeline",
+                       "platform": jax.default_backend(),
+                       "wall_s": round(time.perf_counter() - t0, 3)})
+        print(json.dumps(report, default=float))
+        return 0
 
     if args.workload == "chaos":
         t0 = time.perf_counter()

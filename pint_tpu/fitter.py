@@ -419,12 +419,68 @@ def gls_eigh_solve(A, b, threshold=1e-12):
     return dxn, covn
 
 
-def check_precision(precision):
+def check_precision(precision, allow_auto=False):
     """Validate the GLS precision-mode argument (single home for the
-    accepted set; shared by GLSFitter, PTABatch, and sharded_gls_fit)."""
-    if precision not in ("f64", "mixed"):
+    accepted set; shared by GLSFitter, PTABatch, and sharded_gls_fit).
+    ``allow_auto=True`` additionally admits "auto" — the per-bucket
+    measured choice implemented by PTABatch (callers that cannot
+    resolve "auto" keep the strict two-mode contract)."""
+    allowed = ("f64", "mixed", "auto") if allow_auto else ("f64", "mixed")
+    if precision not in allowed:
         raise ValueError(
-            f"precision must be 'f64' or 'mixed', got {precision!r}")
+            f"precision must be one of {allowed}, got {precision!r}")
+
+
+def aot_lower(fn, *args):
+    """Trace ``fn`` at ``args`` to a lowered (pre-XLA) module, timing
+    the trace. ``fn`` may already be a jax.jit wrapper; anything else
+    is wrapped. Returns {"lowered", "trace_s"}.
+
+    This is one half of the AOT jit(...).lower().compile() split
+    (the other is :func:`aot_backend_compile`), factored here so every
+    AOT entry point — PTABatch.aot_compile, the fleet's concurrent
+    compiler, sharded_gls_fit — shares one timing convention: tracing
+    is Python/GIL-bound and must be timed on the calling thread, while
+    the XLA backend compile releases the GIL and can run concurrently."""
+    import time
+
+    import jax
+
+    if not hasattr(fn, "lower"):
+        fn = jax.jit(fn)
+    t0 = time.perf_counter()
+    lowered = fn.lower(*args)
+    return {"lowered": lowered,
+            "trace_s": round(time.perf_counter() - t0, 3)}
+
+
+def aot_backend_compile(lowered):
+    """XLA-compile a lowered module, timing the backend compile and
+    reading the executable's own cost model (best-effort). Returns
+    {"compiled", "backend_compile_s", "flops", "bytes_accessed"}.
+
+    Safe to call from a worker thread: XLA compilation releases the
+    GIL, which is what makes the fleet's concurrent multi-bucket
+    compile an actual wall-clock win rather than a GIL convoy."""
+    import time
+
+    t0 = time.perf_counter()
+    compiled = lowered.compile()
+    backend_s = time.perf_counter() - t0
+    flops = bytes_ac = None
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):  # older jax: per-device list
+            cost = cost[0] if cost else {}
+        f = cost.get("flops")
+        b = cost.get("bytes accessed")
+        flops = float(f) if f is not None else None
+        bytes_ac = float(b) if b is not None else None
+    except Exception:
+        pass  # cost analysis is best-effort; the timing split is not
+    return {"compiled": compiled,
+            "backend_compile_s": round(backend_s, 3),
+            "flops": flops, "bytes_accessed": bytes_ac}
 
 
 def gls_gram(Mn, q, precision="f64"):

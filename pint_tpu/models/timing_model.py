@@ -44,7 +44,18 @@ class TimingModelError(Exception):
     pass
 
 
-_STAGING_DEPTH = 0
+import threading as _threading
+
+# staging depth is PER THREAD: the pipelined PTAFleet builds bucket
+# batches in a worker pool, and a process-global depth would let one
+# worker's active staging scope silently no-op another worker's final
+# device_put_staged transfer (jax.default_device is already
+# thread-local config, so the placement side matches)
+_STAGING_STATE = _threading.local()
+
+
+def _staging_depth():
+    return getattr(_STAGING_STATE, "depth", 0)
 
 
 class _cpu_staging:
@@ -52,12 +63,12 @@ class _cpu_staging:
     (no-op when the default backend already is cpu or no cpu backend
     exists). Used to stage packing before one batched transfer to the
     accelerator. Nesting-aware: device_put_staged is inert while any
-    staging context is active, so an outer batcher (PTABatch) can wrap
-    many PreparedTiming constructions and do ONE transfer at the end."""
+    staging context is active ON THIS THREAD, so an outer batcher
+    (PTABatch) can wrap many PreparedTiming constructions and do ONE
+    transfer at the end — and concurrent batchers on other threads
+    stage independently."""
 
     def __enter__(self):
-        global _STAGING_DEPTH
-
         import contextlib
 
         import jax
@@ -70,38 +81,52 @@ class _cpu_staging:
         except RuntimeError:
             pass
         self._ctx.__enter__()
-        _STAGING_DEPTH += 1
+        _STAGING_STATE.depth = _staging_depth() + 1
         return self
 
     def __exit__(self, *exc):
-        global _STAGING_DEPTH
-
-        _STAGING_DEPTH -= 1
+        _STAGING_STATE.depth = _staging_depth() - 1
         return self._ctx.__exit__(*exc)
 
 
-def device_put_staged(tree):
+def _numpy_transferable(x):
+    """numpy leaves safe to move to the device as-is: plain numeric
+    dtypes of <= 8 bytes. float128/longdouble (itemsize 16) and object
+    arrays must stay on host — jnp would silently downcast them."""
+    return (isinstance(x, np.ndarray) and x.dtype.kind in "biufc"
+            and x.dtype.itemsize <= 8)
+
+
+def device_put_staged(tree, include_numpy=False):
     """Move every jax-array leaf of a pytree to the default backend's
     device 0 in a single batched device_put; non-array leaves (python
     scalars, longdouble arrays) pass through untouched.
+
+    ``include_numpy=True`` additionally moves plain-numeric numpy
+    leaves in the same batched transfer (skipping the intermediate
+    host jnp.asarray copy a caller would otherwise make); longdouble
+    and object arrays still pass through untouched.
 
     The target device must be explicit: device_put with device=None is
     the identity for arrays already committed to ANY device (including
     the CPU staging device), which would defer the transfer to every
     jit dispatch — re-paying tunnel latency per fit iteration.
 
-    Inside an active _cpu_staging context this is a no-op: the
-    outermost staging scope owns the single batched transfer."""
+    Inside an active _cpu_staging context (on this thread) this is a
+    no-op: the outermost staging scope owns the single batched
+    transfer."""
     import jax
 
-    if _STAGING_DEPTH > 0:
+    if _staging_depth() > 0:
         return tree
     # local_devices, not devices: in a multi-process fleet
     # (jax.distributed) devices()[0] belongs to process 0 and is
     # non-addressable elsewhere
     target = jax.local_devices()[0]
     leaves, treedef = jax.tree_util.tree_flatten(tree)
-    is_arr = [isinstance(x, jax.Array) for x in leaves]
+    is_arr = [isinstance(x, jax.Array)
+              or (include_numpy and _numpy_transferable(x))
+              for x in leaves]
     arrs = [x for x, a in zip(leaves, is_arr) if a]
     if arrs:
         moved = iter(jax.device_put(arrs, target))
@@ -549,6 +574,30 @@ def _sigma_impl(model, params, batch, prep):
     return sigma
 
 
+def _register_barrier_batching():
+    """jax 0.4.x ships optimization_barrier without a vmap batching
+    rule, so any barrier emitted inside a later-vmapped overlay dies
+    with NotImplementedError AFTER tracing (outside any try/except at
+    the call site). The barrier is the identity on values, so the
+    batching rule is the canonical identity batcher: bind the batched
+    operands, keep their batch dims. Registered idempotently on first
+    overlay; newer jax versions that already have the rule are left
+    alone."""
+    try:
+        from jax._src.lax import lax as _lax_internal
+        from jax.interpreters import batching as _batching
+
+        prim = _lax_internal.optimization_barrier_p
+        if prim not in _batching.primitive_batchers:
+            def _identity_batcher(args, dims):
+                return prim.bind(*args), dims
+
+            _batching.primitive_batchers[prim] = _identity_batcher
+    except Exception:
+        pass  # private-module move in a future jax: barrier under
+        # vmap then fails as before, nothing new breaks
+
+
 def _overlay_params(x, params0, free_map):
     """Overlay flat free-param vector x onto the params0 pytree.
 
@@ -566,6 +615,7 @@ def _overlay_params(x, params0, free_map):
     """
     import jax
 
+    _register_barrier_batching()
     p = dict(params0)
     for i, (_, key, idx) in enumerate(free_map):
         if idx is None:
@@ -573,7 +623,16 @@ def _overlay_params(x, params0, free_map):
         else:
             p = {**p, key: p[key].at[idx].set(x[i])}
     if any(isinstance(v, jax.core.Tracer) for v in jax.tree.leaves(p)):
-        p = jax.lax.optimization_barrier(p)
+        try:
+            p = jax.lax.optimization_barrier(p)
+        except NotImplementedError:
+            # jax 0.4.x has no differentiation rule for the barrier.
+            # This only triggers when the overlay runs INSIDE a
+            # jacfwd/jvp closure (e.g. toa_shard's per-shard design
+            # matrix): there the params are differentiation inputs,
+            # not foldable constants, so skipping the barrier loses
+            # nothing
+            pass
     return p
 
 

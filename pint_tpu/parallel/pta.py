@@ -247,6 +247,12 @@ def pure_sigma_fn(template_model, static):
     return sigma_us
 
 
+# precision="auto" verdicts, keyed on (structure, shapes, fit options);
+# process-wide so every PTABatch with the same bucket structure reuses
+# one timed probe instead of re-racing mixed vs f64
+_PRECISION_AUTO_CACHE = {}
+
+
 class PTABatch:
     """Batched multi-pulsar fitting (the reference's per-pulsar Python
     loop becomes one vmapped, mesh-sharded program).
@@ -325,29 +331,41 @@ class PTABatch:
         ``from_packed`` this lets a caller cache the expensive host
         pack (TOA prep + stacking) across processes — the bench's
         full-scale stage rebuilds a 670k-TOA fleet from disk in
-        seconds instead of minutes."""
+        seconds instead of minutes.
+
+        The whole (params, prep, batch) tree comes back in ONE batched
+        device_get (the per-leaf np.asarray loop this replaced
+        serialized a device round-trip per array — the bulk of the
+        0.62 s pack_s line in BENCH_r05), and the snapshot is cached
+        per instance: params/prep/batch are immutable for the life of
+        the batch (the same invariant _x0 relies on), so a refit
+        reuses the staged host buffers instead of re-pulling."""
         import jax
 
-        def to_np(t):
-            return jax.tree_util.tree_map(lambda x: np.asarray(x), t)
-
+        if getattr(self, "_pack_state_cache", None) is not None:
+            return self._pack_state_cache
         from ..toa import TOABatch
 
-        return {"params": to_np(self.params), "prep": to_np(self.prep),
-                "batch": {f: np.asarray(getattr(self.batch, f))
-                          for f in TOABatch._fields},
-                "static": dict(self.static),
-                "n_toas": np.asarray(self.n_toas),
-                "free_map": list(self.free_map())}
+        fields = {f: getattr(self.batch, f) for f in TOABatch._fields}
+        params, prep, fields = jax.device_get(
+            (self.params, self.prep, fields))
+        self._pack_state_cache = {
+            "params": params, "prep": prep, "batch": fields,
+            "static": dict(self.static),
+            "n_toas": np.asarray(self.n_toas),
+            "free_map": list(self.free_map())}
+        return self._pack_state_cache
 
     @classmethod
     def from_packed(cls, template_model, state, mesh=None):
         """Rebuild a PTABatch from ``pack_state()`` output, skipping
         host TOA prep entirely. template_model provides the component
-        structure (it must match the one that produced the state)."""
-        import jax.numpy as jnp
+        structure (it must match the one that produced the state).
 
-        from ..models.timing_model import _cpu_staging, device_put_staged
+        The numpy state goes to the device in ONE batched device_put
+        (device_put_staged(include_numpy=True)) — no intermediate
+        per-leaf jnp.asarray host copies."""
+        from ..models.timing_model import device_put_staged
         from ..toa import TOABatch
 
         self = cls.__new__(cls)
@@ -356,13 +374,9 @@ class PTABatch:
         self.toas_list = None
         self.preps = None
         self._free_map = [tuple(x) for x in state["free_map"]]
-        with _cpu_staging():
-            params = {k: jnp.asarray(v) for k, v in state["params"].items()}
-            prep = {k: jnp.asarray(v) for k, v in state["prep"].items()}
-            batch = TOABatch(**{k: jnp.asarray(v)
-                                for k, v in state["batch"].items()})
         self.params, self.prep, self.batch = device_put_staged(
-            (params, prep, batch))
+            (dict(state["params"]), dict(state["prep"]),
+             TOABatch(**state["batch"])), include_numpy=True)
         self.static = dict(state["static"])
         self.n_toas = np.asarray(state["n_toas"])
         self.template = template_model
@@ -438,7 +452,8 @@ class PTABatch:
                 self._fns["pull_rep"] = jax.jit(lambda t: t,
                                                 out_shardings=rep)
             tree = self._fns["pull_rep"](tree)
-            return jax.tree_util.tree_map(np.asarray, tree)
+            # after replication every leaf is fully addressable: one
+            # batched device_get instead of a per-leaf np.asarray loop
         return jax.device_get(tree)
 
     def _maybe_inject_divergence(self, chi2, method):
@@ -538,25 +553,30 @@ class PTABatch:
 
         return ("wls", maxiter, threshold), fit_one
 
-    def wls_fit(self, maxiter=3, threshold=1e-12):
-        """Vmapped, mesh-sharded multi-pulsar WLS fit.
-
-        Returns (x_fit (n_psr, n_free), chi2 (n_psr,), cov (n_psr, k, k)).
-        Diverged pulsars (non-finite results) are reported via
-        self.diverged and returned with their starting vectors.
-        """
+    def _dispatch_wls(self, maxiter=3, threshold=1e-12):
+        """Dispatch the WLS program WITHOUT pulling results: jax async
+        dispatch queues the device work and returns immediately, so a
+        fleet can dispatch every bucket before any bucket's blocking
+        host pull (PTAFleet.fit(pipeline=True)). Returns a handle for
+        :meth:`_finalize_wls`; wls_fit == finalize(dispatch)."""
         import time
 
         import jax
 
         key, fit_one = self._build_wls(maxiter, threshold)
         t0 = time.perf_counter()
-        compiled = key in self._fns
-        if not compiled:
+        warm = key in self._fns
+        if not warm:
             self._fns[key] = jax.jit(jax.vmap(fit_one))
         x0 = self._x0()
-        x, chi2, (covn, norm) = self._fns[key](x0, self.params,
-                                               self.batch, self.prep)
+        out = self._fns[key](x0, self.params, self.batch, self.prep)
+        return {"method": "wls", "t0": t0, "warm": warm, "x0": x0,
+                "maxiter": maxiter, "out": out}
+
+    def _finalize_wls(self, handle):
+        """Blocking half of the WLS fit: pull the dispatched results,
+        run divergence isolation, record metrics."""
+        x, chi2, (covn, norm) = handle["out"]
         # ONE batched device->host pull (device_get overlaps the
         # per-array copies): behind a tunneled device each separate
         # np.asarray sync costs ~90 ms of round-trip latency.
@@ -566,9 +586,19 @@ class PTABatch:
         x, chi2, covn, norm = self._pull((x, chi2, covn, norm))
         cov = covn / (norm[:, :, None] * norm[:, None, :])
         chi2 = self._maybe_inject_divergence(chi2, "wls")
-        x, chi2 = self._isolate_diverged(x0, x, chi2)
-        self._record_metrics("wls", t0, maxiter, warm=compiled)
+        x, chi2 = self._isolate_diverged(handle["x0"], x, chi2)
+        self._record_metrics("wls", handle["t0"], handle["maxiter"],
+                             warm=handle["warm"])
         return x, chi2, cov
+
+    def wls_fit(self, maxiter=3, threshold=1e-12):
+        """Vmapped, mesh-sharded multi-pulsar WLS fit.
+
+        Returns (x_fit (n_psr, n_free), chi2 (n_psr,), cov (n_psr, k, k)).
+        Diverged pulsars (non-finite results) are reported via
+        self.diverged and returned with their starting vectors.
+        """
+        return self._finalize_wls(self._dispatch_wls(maxiter, threshold))
 
     def _record_metrics(self, method, t0, maxiter, warm):
         """Per-fit metrics surface (SURVEY section 5): wall time
@@ -929,6 +959,114 @@ class PTABatch:
         return (("gls", maxiter, threshold, marginalize, precision, hoist),
                 fit_one)
 
+    def _resolve_precision(self, precision, maxiter=2, threshold=1e-12,
+                           ecorr_mode="auto"):
+        """Resolve precision="auto" to the MEASURED winner of "f64" vs
+        "mixed" for this bucket structure (gls_mixed_speedup = 0.768
+        on CPU made mixed a regression where it runs today, so the
+        choice must be timed, not assumed). Both programs are compiled
+        and warmed, one warm run each is timed, and the faster mode
+        wins — unless the mixed run's refinement diagnostic failed, in
+        which case f64 wins outright (a mode that would immediately
+        fall back is never faster). The verdict is cached per process
+        keyed on (structure, shapes, fit options); the compiled
+        programs stay in self._fns so the probe work is not wasted.
+        Explicit "f64"/"mixed" pass through untouched."""
+        import time
+
+        import jax
+
+        from ..fitter import check_precision, relres_failed
+
+        check_precision(precision, allow_auto=True)
+        if precision != "auto":
+            return precision
+        cache_key = (self.structure_key(self.template),
+                     self.shape_signature(), maxiter, threshold,
+                     ecorr_mode)
+        choice = _PRECISION_AUTO_CACHE.get(cache_key)
+        if choice is not None:
+            return choice
+        args = (self._x0(), self.params, self.batch, self.prep)
+        timings = {}
+        mixed_failed = False
+        for mode in ("f64", "mixed"):
+            key, fit_one = self._build_gls(maxiter, threshold,
+                                           ecorr_mode, mode)
+            if key not in self._fns:
+                self._fns[key] = jax.jit(jax.vmap(fit_one))
+            out = self._fns[key](*args)  # compile + warm-up
+            jax.block_until_ready(out)
+            if mode == "mixed":
+                relres = jax.device_get(out[2][2])
+                mixed_failed = relres_failed(relres)
+            t0 = time.perf_counter()
+            jax.block_until_ready(self._fns[key](*args))
+            timings[mode] = time.perf_counter() - t0
+        choice = ("f64" if mixed_failed
+                  or timings["f64"] <= timings["mixed"] else "mixed")
+        _PRECISION_AUTO_CACHE[cache_key] = choice
+        self.precision_auto = {"choice": choice,
+                               "f64_s": round(timings["f64"], 4),
+                               "mixed_s": round(timings["mixed"], 4),
+                               "mixed_relres_failed": mixed_failed}
+        return choice
+
+    def _dispatch_gls(self, maxiter=2, threshold=1e-12, ecorr_mode="auto",
+                      precision="f64"):
+        """Dispatch the GLS program WITHOUT pulling results (see
+        _dispatch_wls); gls_fit == finalize(dispatch). Resolves
+        precision="auto" to the measured per-structure winner first."""
+        import time
+
+        import jax
+
+        precision = self._resolve_precision(precision, maxiter,
+                                            threshold, ecorr_mode)
+        key, fit_one = self._build_gls(maxiter, threshold, ecorr_mode,
+                                       precision)
+        t0 = time.perf_counter()
+        warm = key in self._fns
+        if not warm:
+            self._fns[key] = jax.jit(jax.vmap(fit_one))
+        x0 = self._x0()
+        out = self._fns[key](x0, self.params, self.batch, self.prep)
+        return {"method": "gls", "t0": t0, "warm": warm, "x0": x0,
+                "maxiter": maxiter, "threshold": threshold,
+                "ecorr_mode": ecorr_mode, "precision": precision,
+                "out": out}
+
+    def _finalize_gls(self, handle):
+        """Blocking half of the GLS fit: pull, mixed-precision
+        fallback check, divergence isolation, metrics."""
+        x, chi2, (covn, norm, relres) = handle["out"]
+        # one batched pull; see _finalize_wls
+        x, chi2, covn, norm, relres = self._pull(
+            (x, chi2, covn, norm, relres))
+        from ..fitter import relres_failed
+
+        if handle["precision"] == "mixed" and relres_failed(relres):
+            # the f32 preconditioner failed to contract for >= 1 pulsar
+            # (kept spectrum wider than ~1e7, or NaN from an f32
+            # overflow): redo the batch in f64 — correctness is
+            # non-negotiable, the speedup opt-in
+            import warnings
+
+            warnings.warn(
+                f"mixed-precision GLS refinement did not converge "
+                f"(max rel resid {float(np.max(relres)):.2e}); "
+                "refitting in f64")
+            return self.gls_fit(maxiter=handle["maxiter"],
+                                threshold=handle["threshold"],
+                                ecorr_mode=handle["ecorr_mode"],
+                                precision="f64")
+        cov = covn / (norm[:, :, None] * norm[:, None, :])
+        chi2 = self._maybe_inject_divergence(chi2, "gls")
+        x, chi2 = self._isolate_diverged(handle["x0"], x, chi2)
+        self._record_metrics("gls", handle["t0"], handle["maxiter"],
+                             warm=handle["warm"])
+        return x, chi2, cov
+
     def gls_fit(self, maxiter=2, threshold=1e-12, ecorr_mode="auto",
                 precision="f64"):
         """Vmapped, mesh-sharded multi-pulsar GLS fit — the
@@ -944,46 +1082,78 @@ class PTABatch:
         A per-pulsar convergence diagnostic guards the mode: if any
         pulsar's refinement failed to contract the whole batch is
         automatically refit in f64 with a warning.
+        ``precision="auto"`` times one warm mixed vs f64 run for this
+        bucket structure (cached per process) and uses the winner —
+        see :meth:`_resolve_precision`.
 
         Returns (x_fit, chi2_whitened, cov) like wls_fit; diverged
         pulsars reported via self.diverged.
         """
-        import time
+        return self._finalize_gls(self._dispatch_gls(
+            maxiter, threshold, ecorr_mode, precision))
 
+    def _build_method(self, method, maxiter, threshold, ecorr_mode,
+                      precision):
+        """Shared method dispatch for program_key/aot_lower: returns
+        (cache_key, fit_one) with the per-method maxiter default
+        applied (gls: 2, wls: 3)."""
+        if method == "gls":
+            maxiter = 2 if maxiter is None else maxiter
+            return self._build_gls(maxiter, threshold, ecorr_mode,
+                                   precision)
+        if method == "wls":
+            if precision != "f64":
+                raise ValueError(
+                    "precision applies to the GLS path only; WLS has "
+                    "no mixed-precision mode")
+            maxiter = 3 if maxiter is None else maxiter
+            return self._build_wls(maxiter, threshold)
+        raise ValueError(f"aot_compile: unknown method {method!r}")
+
+    def program_key(self, method="gls", maxiter=None, threshold=1e-12,
+                    ecorr_mode="auto", precision="f64"):
+        """The _fns cache key the given fit options compile to — lets
+        a fleet/serve scheduler test ``key in batch._fns`` (is this
+        program already warm?) without building or tracing anything."""
+        return self._build_method(method, maxiter, threshold, ecorr_mode,
+                                  precision)[0]
+
+    def aot_lower(self, method="gls", maxiter=None, threshold=1e-12,
+                  ecorr_mode="auto", precision="f64"):
+        """Trace (lower) one vmapped fit program WITHOUT compiling it.
+
+        Tracing is GIL-bound Python work, so a pipelined executor runs
+        this serially on the caller thread and farms only the XLA
+        backend compile (:meth:`_aot_backend_compile`, which releases
+        the GIL) out to a thread pool — concurrent tracing would just
+        timeshare the interpreter and inflate every per-bucket trace
+        measurement.
+
+        Returns {key, method, lowered, trace_s}; feed the whole dict
+        to _aot_backend_compile to finish and install the executable.
+        """
+        from .. import fitter
+
+        key, fit_one = self._build_method(method, maxiter, threshold,
+                                          ecorr_mode, precision)
         import jax
 
-        key, fit_one = self._build_gls(maxiter, threshold, ecorr_mode,
-                                       precision)
-        t0 = time.perf_counter()
-        compiled = key in self._fns
-        if not compiled:
-            self._fns[key] = jax.jit(jax.vmap(fit_one))
-        x0 = self._x0()
-        x, chi2, (covn, norm, relres) = self._fns[key](
-            x0, self.params, self.batch, self.prep)
-        # one batched pull; see wls_fit
-        x, chi2, covn, norm, relres = self._pull(
-            (x, chi2, covn, norm, relres))
-        from ..fitter import relres_failed
+        low = fitter.aot_lower(jax.jit(jax.vmap(fit_one)), self._x0(),
+                               self.params, self.batch, self.prep)
+        return {"key": key, "method": method, "lowered": low["lowered"],
+                "trace_s": low["trace_s"]}
 
-        if precision == "mixed" and relres_failed(relres):
-            # the f32 preconditioner failed to contract for >= 1 pulsar
-            # (kept spectrum wider than ~1e7, or NaN from an f32
-            # overflow): redo the batch in f64 — correctness is
-            # non-negotiable, the speedup opt-in
-            import warnings
+    def _aot_backend_compile(self, low):
+        """XLA backend compile of an :meth:`aot_lower` handle; thread-
+        safe (pure XLA, releases the GIL) so a fleet can run many
+        buckets' compiles concurrently. Installs the executable in the
+        fit cache and returns the aot_compile info dict."""
+        from .. import fitter
 
-            warnings.warn(
-                f"mixed-precision GLS refinement did not converge "
-                f"(max rel resid {float(np.max(relres)):.2e}); "
-                "refitting in f64")
-            return self.gls_fit(maxiter=maxiter, threshold=threshold,
-                                ecorr_mode=ecorr_mode, precision="f64")
-        cov = covn / (norm[:, :, None] * norm[:, None, :])
-        chi2 = self._maybe_inject_divergence(chi2, "gls")
-        x, chi2 = self._isolate_diverged(x0, x, chi2)
-        self._record_metrics("gls", t0, maxiter, warm=compiled)
-        return x, chi2, cov
+        info = fitter.aot_backend_compile(low["lowered"])
+        self._fns[low["key"]] = info.pop("compiled")
+        return {"method": low["method"], "trace_s": low["trace_s"],
+                **info}
 
     def aot_compile(self, method="gls", maxiter=None, threshold=1e-12,
                     ecorr_mode="auto", precision="f64"):
@@ -1002,47 +1172,12 @@ class PTABatch:
         Returns {trace_s, backend_compile_s, flops, bytes_accessed}
         (cost fields None when the backend doesn't report them). The
         executable is installed in the fit cache, so the next
-        wls_fit/gls_fit call with the same options runs warm.
+        wls_fit/gls_fit call with the same options runs warm. For the
+        concurrent multi-bucket path see :func:`fleet_aot_compile`,
+        which splits this into aot_lower + _aot_backend_compile.
         """
-        import time
-
-        import jax
-
-        if method == "gls":
-            maxiter = 2 if maxiter is None else maxiter
-            key, fit_one = self._build_gls(maxiter, threshold, ecorr_mode,
-                                           precision)
-        elif method == "wls":
-            if precision != "f64":
-                raise ValueError(
-                    "precision applies to the GLS path only; WLS has "
-                    "no mixed-precision mode")
-            maxiter = 3 if maxiter is None else maxiter
-            key, fit_one = self._build_wls(maxiter, threshold)
-        else:
-            raise ValueError(f"aot_compile: unknown method {method!r}")
-        args = (self._x0(), self.params, self.batch, self.prep)
-        t0 = time.perf_counter()
-        lowered = jax.jit(jax.vmap(fit_one)).lower(*args)
-        trace_s = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        compiled = lowered.compile()
-        backend_s = time.perf_counter() - t0
-        flops = bytes_ac = None
-        try:
-            cost = compiled.cost_analysis()
-            if isinstance(cost, (list, tuple)):  # older jax: per-device list
-                cost = cost[0] if cost else {}
-            f = cost.get("flops")
-            b = cost.get("bytes accessed")
-            flops = float(f) if f is not None else None
-            bytes_ac = float(b) if b is not None else None
-        except Exception:
-            pass  # cost analysis is best-effort; the timing split is not
-        self._fns[key] = compiled
-        return {"method": method, "trace_s": round(trace_s, 3),
-                "backend_compile_s": round(backend_s, 3),
-                "flops": flops, "bytes_accessed": bytes_ac}
+        return self._aot_backend_compile(self.aot_lower(
+            method, maxiter, threshold, ecorr_mode, precision))
 
     @staticmethod
     def structure_key(model):
@@ -1118,10 +1253,105 @@ class PTABatch:
                      for leaf in leaves)
 
 
+def fleet_aot_compile(jobs, max_workers=None):
+    """Compile many bucket programs with the trace/XLA split the GIL
+    dictates: all traces run serially on the caller thread (tracing is
+    pure Python; concurrent tracing only timeshares the interpreter),
+    then every XLA backend compile — which releases the GIL — runs in
+    a thread pool. With the persistent compilation cache enabled
+    (PINT_TPU_COMPILE_CACHE / jax_compilation_cache_dir) hits resolve
+    inside the pool too, so a warm cache collapses the whole phase.
+
+    jobs: list of (batch, kwargs) where kwargs are aot_compile-style
+    options including "method". Returns (infos, wall_s): infos in job
+    order, each the aot_compile info dict; wall_s the total elapsed
+    including the serial trace phase — compare against
+    sum(trace_s + backend_compile_s) for the concurrency win.
+    """
+    import os
+    import time
+    from concurrent.futures import ThreadPoolExecutor
+
+    t0 = time.perf_counter()
+    lowered = [batch.aot_lower(**kw) for batch, kw in jobs]
+    if not lowered:
+        return [], 0.0
+    workers = max_workers or min(len(lowered), os.cpu_count() or 1)
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        infos = list(pool.map(
+            lambda pair: pair[0]._aot_backend_compile(pair[1]),
+            zip([b for b, _ in jobs], lowered)))
+    return infos, time.perf_counter() - t0
+
+
+def fleet_pipeline_metrics(fleet, method="auto", maxiter=3, repeats=2,
+                           max_workers=None, **kw):
+    """Measured pipeline report for one fleet — the shared
+    instrumentation surface behind bench.py's fleet-pipeline stage,
+    profile_harness --workload fleet_pipeline, and the serve bench:
+
+    - fleet_compile_serial_s / fleet_compile_concurrent_s: the
+      serial-equivalent sum(trace_s + backend_compile_s) of every cold
+      program vs the wall clock of compiling them through
+      fleet_aot_compile (trace serial, XLA concurrent). None when
+      every program was already warm (nothing left to compile).
+    - fleet_fit_sequential_s / fleet_fit_pipelined_s: best-of-repeats
+      WARM fit wall through each executor path (min, not mean — CPU
+      bench rounds alias host load into means).
+    - fleet_pipeline_overlap_pct: 100 * (1 - pipelined/sequential),
+      the fraction of the sequential wall the pipelined executor
+      recovers by dispatch-all + overlapped host finalize.
+    - fleet_pipeline_bitwise: pipelined results identical to
+      sequential (np.array_equal on every x/chi2/cov).
+    """
+    import time
+
+    infos, concurrent_s = fleet.precompile(method=method,
+                                           maxiter=maxiter,
+                                           max_workers=max_workers)
+    if infos:
+        serial_s = sum(i["trace_s"] + i["backend_compile_s"]
+                       for i in infos)
+    else:
+        serial_s = concurrent_s = None
+    # one warm pass per path (also the bitwise reference)
+    xs_s, chi_s, cov_s = fleet.fit(method=method, maxiter=maxiter,
+                                   pipeline=False, **kw)
+    xs_p, chi_p, cov_p = fleet.fit(method=method, maxiter=maxiter,
+                                   pipeline=True, **kw)
+    bitwise = bool(
+        np.array_equal(chi_s, chi_p)
+        and all(np.array_equal(a, b) for a, b in zip(xs_s, xs_p))
+        and all(np.array_equal(a, b) for a, b in zip(cov_s, cov_p)))
+    seq_s = pipe_s = float("inf")
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        fleet.fit(method=method, maxiter=maxiter, pipeline=False, **kw)
+        seq_s = min(seq_s, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        fleet.fit(method=method, maxiter=maxiter, pipeline=True, **kw)
+        pipe_s = min(pipe_s, time.perf_counter() - t0)
+    return {
+        "fleet_compile_serial_s": (round(serial_s, 3)
+                                   if serial_s is not None else None),
+        "fleet_compile_concurrent_s": (round(concurrent_s, 3)
+                                       if concurrent_s is not None
+                                       else None),
+        "fleet_fit_sequential_s": round(seq_s, 4),
+        "fleet_fit_pipelined_s": round(pipe_s, 4),
+        "fleet_pipeline_overlap_pct": round(
+            100.0 * (1.0 - pipe_s / seq_s), 2) if seq_s > 0 else 0.0,
+        "fleet_pipeline_bitwise": bitwise,
+        "fleet_buckets": len(fleet.group_indices),
+    }
+
+
 class PTAFleet:
     """Mixed-structure PTA fitting: bucket pulsars by model structure,
-    one PTABatch per bucket, fit buckets sequentially (each bucket is
-    one vmapped mesh-sharded program).
+    one PTABatch per bucket, fit buckets sequentially or — with
+    ``pipeline=True`` — through the pipelined executor that overlaps
+    host prep, compilation, and device compute across buckets (each
+    bucket is one vmapped mesh-sharded program either way).
 
     Real PTA datasets mix isolated pulsars, different binary models and
     noise configurations; PTABatch requires uniform structure
@@ -1168,20 +1398,30 @@ class PTAFleet:
             j -= 1
         return sorted(bounds)
 
-    def __init__(self, models, toas_list, mesh=None, toa_bucket=None):
+    def __init__(self, models, toas_list, mesh=None, toa_bucket=None,
+                 bucket_floor=256, pipeline=False):
         """toa_bucket=None: group by model structure only (each batch
         pads to its own max TOA count). toa_bucket="pow2": additionally
-        bucket pulsars by next-power-of-two TOA count — on ragged real
-        datasets (NANOGrav spans 10^2..10^4.5 TOAs/pulsar) structure-
-        only grouping pads EVERY pulsar to the fleet max, a ~3x FLOP
-        and memory tax; pow2 bucketing caps padding waste at 2x per
-        pulsar while keeping the compiled-program count at
-        O(log(max/min)). toa_bucket="split<k>" (e.g. "split2"): at
-        most k buckets per model structure with thresholds chosen by
-        the exact minimum-padded-area dynamic program
-        (optimal_split_bounds) — fewest programs for a given padding
-        budget, the right trade where each extra compile is wedge
-        exposure on a tunneled device (SURVEY.md section 7.3 item 4)."""
+        bucket pulsars by next-power-of-two TOA count (>= bucket_floor,
+        the same serve/batcher.py pow2_bucket convention the online
+        engine keys its slots on, so fleet buckets and serve slots
+        cannot desynchronize) — on ragged real datasets (NANOGrav spans
+        10^2..10^4.5 TOAs/pulsar) structure-only grouping pads EVERY
+        pulsar to the fleet max, a ~3x FLOP and memory tax; pow2
+        bucketing caps padding waste at 2x per pulsar while keeping
+        the compiled-program count at O(log(max/min)).
+        toa_bucket="split<k>" (e.g. "split2"): at most k buckets per
+        model structure with thresholds chosen by the exact
+        minimum-padded-area dynamic program (optimal_split_bounds) —
+        fewest programs for a given padding budget, the right trade
+        where each extra compile is wedge exposure on a tunneled
+        device (SURVEY.md section 7.3 item 4).
+
+        pipeline=True defers PTABatch construction to a worker pool:
+        buckets pack concurrently with each other and with whatever
+        the caller does next (compile, earlier buckets' fits), and
+        fit() defaults to the pipelined executor. Results are bitwise
+        identical to pipeline=False — only scheduling changes."""
         self.buckets = {}
         self.order = []  # (bucket_key, index_within_bucket) per pulsar
         split_k = None
@@ -1208,10 +1448,10 @@ class PTAFleet:
         for i, (m, t) in enumerate(zip(models, toas_list)):
             key = PTABatch.structure_key(m)
             if toa_bucket == "pow2":
-                b = 256
-                while b < len(t):
-                    b *= 2
-                key = (key, b)
+                # canonical pow2 convention shared with serve slot keys
+                from ..serve.batcher import pow2_bucket
+
+                key = (key, pow2_bucket(len(t), bucket_floor))
             elif split_k is not None:
                 for b in split_bounds[key]:
                     if len(t) <= b:
@@ -1219,38 +1459,257 @@ class PTAFleet:
                 key = (key, b)
             groups.setdefault(key, []).append(i)
         self.group_indices = groups
+        self.pipeline = bool(pipeline)
         self.batches = {}
-        for key, idxs in groups.items():
-            self.batches[key] = PTABatch([models[i] for i in idxs],
-                                         [toas_list[i] for i in idxs],
-                                         mesh=mesh)
+        self._batch_futures = {}
+        self._prep_pool = None
+        if self.pipeline and len(groups) > 1:
+            import os
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._prep_pool = ThreadPoolExecutor(
+                max_workers=min(len(groups), os.cpu_count() or 1))
+            for key, idxs in groups.items():
+                self._batch_futures[key] = self._prep_pool.submit(
+                    PTABatch, [models[i] for i in idxs],
+                    [toas_list[i] for i in idxs], mesh=mesh)
+        else:
+            for key, idxs in groups.items():
+                self.batches[key] = PTABatch([models[i] for i in idxs],
+                                             [toas_list[i] for i in idxs],
+                                             mesh=mesh)
         self.n = len(models)
         real = sum(len(t) for t in toas_list)
-        padded = sum(int(b.batch.tdb_sec.shape[0] * b.batch.tdb_sec.shape[1])
-                     for b in self.batches.values())
+        # analytic padded area (PTABatch pads to the bucket max, so
+        # len(bucket) * max(counts) == the packed array area) — no need
+        # to force deferred batches just to read a shape
+        padded = sum(
+            len(idxs) * max(len(toas_list[i]) for i in idxs)
+            for idxs in groups.values())
         self.padding_ratio = padded / max(real, 1)
 
-    def fit(self, method="auto", maxiter=3, **kw):
+    def _resolve(self, key):
+        """The bucket's PTABatch, blocking on its deferred pack if
+        pipeline=True and it has not landed yet."""
+        batch = self.batches.get(key)
+        if batch is None:
+            batch = self._batch_futures.pop(key).result()
+            self.batches[key] = batch
+            if not self._batch_futures and self._prep_pool is not None:
+                self._prep_pool.shutdown(wait=False)
+                self._prep_pool = None
+        return batch
+
+    @classmethod
+    def from_batches(cls, batches):
+        """Wrap already-built PTABatches (e.g. bench.py's pickled
+        full-scale pack cache) as a fleet so they can ride the
+        pipelined executor / concurrent compile without re-packing.
+        Pulsar order is the concatenation of the batches' rows."""
+        fleet = cls.__new__(cls)
+        fleet.buckets = {}
+        fleet.order = []
+        fleet.pipeline = False
+        fleet._batch_futures = {}
+        fleet._prep_pool = None
+        fleet.batches = dict(enumerate(batches))
+        start = 0
+        fleet.group_indices = {}
+        for k, b in fleet.batches.items():
+            n = b.n_pulsars
+            fleet.group_indices[k] = list(range(start, start + n))
+            start += n
+        fleet.n = start
+        real = sum(int(n) for b in batches for n in b.n_toas)
+        padded = sum(int(b.batch.tdb_sec.shape[0]
+                         * b.batch.tdb_sec.shape[1]) for b in batches)
+        fleet.padding_ratio = padded / max(real, 1)
+        return fleet
+
+    def _use_gls(self, batch, method):
+        return (method == "gls"
+                or (method == "auto"
+                    and batch._noise_bw_fn() is not None))
+
+    @staticmethod
+    def _scatter(xs, chi2s, covs, idxs, x, chi2, cov):
+        """Scatter one bucket's stacked results to per-pulsar slots —
+        one host conversion per array, then row indexing (the old
+        per-pulsar np.asarray(x)[j] re-converted the whole stack for
+        every row)."""
+        x, chi2, cov = np.asarray(x), np.asarray(chi2), np.asarray(cov)
+        for j, i in enumerate(idxs):
+            xs[i] = x[j]
+            chi2s[i] = chi2[j]
+            covs[i] = cov[j]
+
+    def fit(self, method="auto", maxiter=3, pipeline=None,
+            max_workers=None, **kw):
         """Fit every bucket; returns per-pulsar lists (x, chi2, cov)
         in the original pulsar order. method: "wls", "gls", or "auto"
-        (gls when the bucket has correlated-noise components)."""
+        (gls when the bucket has correlated-noise components).
+
+        pipeline=True (default: the fleet's own pipeline flag) runs
+        the pipelined executor: cold bucket programs are traced
+        serially then XLA-compiled concurrently in a thread pool
+        (max_workers), every bucket's program is DISPATCHED before any
+        result is pulled (JAX async dispatch queues the device work,
+        so per-bucket wall time becomes max-of-buckets instead of
+        sum), and host-side finalize of earlier buckets overlaps
+        device execution of later ones. Finalization runs in the same
+        bucket order as the sequential path, so results — including
+        fault-injection schedules and mixed-precision fallbacks — are
+        bitwise identical; only per-bucket fit_wall_s metrics change
+        meaning (they include queue wait in pipeline mode).
+        """
+        if pipeline is None:
+            pipeline = self.pipeline
         xs = [None] * self.n
         chi2s = np.zeros(self.n)
         covs = [None] * self.n
         self.diverged = []
+        self.fit_metrics = {}
+        if not pipeline:
+            for key, idxs in self.group_indices.items():
+                batch = self._resolve(key)
+                fit = (batch.gls_fit if self._use_gls(batch, method)
+                       else batch.wls_fit)
+                x, chi2, cov = fit(maxiter=maxiter, **kw)
+                self._scatter(xs, chi2s, covs, idxs, x, chi2, cov)
+                self.diverged.extend(idxs[j] for j in batch.diverged)
+                self.fit_metrics[key] = batch.metrics
+            return xs, chi2s, covs
+        return self._fit_pipelined(method, maxiter, max_workers, **kw)
+
+    def _fit_pipelined(self, method, maxiter, max_workers, **kw):
+        import os
+        from concurrent.futures import ThreadPoolExecutor
+
+        xs = [None] * self.n
+        chi2s = np.zeros(self.n)
+        covs = [None] * self.n
+        # 1) plan: resolve batches (in bucket order, so later deferred
+        # packs overlap earlier planning) and pin down each bucket's
+        # program, resolving precision="auto" now — the probe both
+        # fits and times, and the verdict decides which program to
+        # compile
+        plan = []
         for key, idxs in self.group_indices.items():
-            batch = self.batches[key]
-            use_gls = (method == "gls"
-                       or (method == "auto"
-                           and batch._noise_bw_fn() is not None))
-            fit = batch.gls_fit if use_gls else batch.wls_fit
-            x, chi2, cov = fit(maxiter=maxiter, **kw)
-            for j, i in enumerate(idxs):
-                xs[i] = np.asarray(x)[j]
-                chi2s[i] = np.asarray(chi2)[j]
-                covs[i] = np.asarray(cov)[j]
-            self.diverged.extend(idxs[j] for j in batch.diverged)
+            batch = self._resolve(key)
+            use_gls = self._use_gls(batch, method)
+            bkw = dict(kw)
+            allowed = ({"threshold", "ecorr_mode", "precision"}
+                       if use_gls else {"threshold"})
+            extra = set(bkw) - allowed
+            if extra:
+                # same TypeError the sequential path's wls_fit/gls_fit
+                # call would raise
+                raise TypeError(
+                    f"{'gls' if use_gls else 'wls'}_fit() got unexpected "
+                    f"keyword arguments {sorted(extra)}")
+            if use_gls and bkw.get("precision") == "auto":
+                bkw["precision"] = batch._resolve_precision(
+                    bkw["precision"], maxiter,
+                    bkw.get("threshold", 1e-12),
+                    bkw.get("ecorr_mode", "auto"))
+            if use_gls:
+                pkey = batch.program_key(
+                    "gls", maxiter, bkw.get("threshold", 1e-12),
+                    bkw.get("ecorr_mode", "auto"),
+                    bkw.get("precision", "f64"))
+            else:
+                pkey = batch.program_key(
+                    "wls", maxiter, bkw.get("threshold", 1e-12))
+            plan.append((key, idxs, batch, use_gls, bkw, pkey))
+        # 2) trace cold programs serially (GIL), compile concurrently
+        cold = [(key, batch, use_gls, bkw)
+                for key, idxs, batch, use_gls, bkw, pkey in plan
+                if pkey not in batch._fns]
+        self.compile_infos = {}
+        compile_futs = {}
+        pool = None
+        if cold:
+            lowered = []
+            for key, batch, use_gls, bkw in cold:
+                lkw = {"method": "gls" if use_gls else "wls",
+                       "maxiter": maxiter,
+                       "threshold": bkw.get("threshold", 1e-12)}
+                if use_gls:
+                    lkw["ecorr_mode"] = bkw.get("ecorr_mode", "auto")
+                    lkw["precision"] = bkw.get("precision", "f64")
+                lowered.append((key, batch, batch.aot_lower(**lkw)))
+            pool = ThreadPoolExecutor(
+                max_workers=max_workers
+                or min(len(cold), os.cpu_count() or 1))
+            compile_futs = {
+                key: pool.submit(batch._aot_backend_compile, low)
+                for key, batch, low in lowered}
+        try:
+            # 3) dispatch every bucket before pulling anything (JAX
+            # async dispatch queues the device work); a bucket waits
+            # only for its OWN compile
+            handles = []
+            for key, idxs, batch, use_gls, bkw, pkey in plan:
+                fut = compile_futs.get(key)
+                if fut is not None:
+                    self.compile_infos[key] = fut.result()
+                if use_gls:
+                    h = batch._dispatch_gls(
+                        maxiter, bkw.get("threshold", 1e-12),
+                        bkw.get("ecorr_mode", "auto"),
+                        bkw.get("precision", "f64"))
+                else:
+                    h = batch._dispatch_wls(
+                        maxiter, bkw.get("threshold", 1e-12))
+                handles.append((key, idxs, batch, use_gls, h))
+            # 4) finalize in the SAME bucket order as the sequential
+            # path — the host unpack of bucket i overlaps device
+            # execution of buckets i+1.. still queued, and the
+            # fault-injection fire() sequence matches sequential
+            # exactly (bitwise guarantee)
+            self.diverged = []
+            self.fit_metrics = {}
+            for key, idxs, batch, use_gls, h in handles:
+                fin = (batch._finalize_gls if use_gls
+                       else batch._finalize_wls)
+                x, chi2, cov = fin(h)
+                self._scatter(xs, chi2s, covs, idxs, x, chi2, cov)
+                self.diverged.extend(idxs[j] for j in batch.diverged)
+                self.fit_metrics[key] = batch.metrics
+        finally:
+            if pool is not None:
+                pool.shutdown(wait=False)
         return xs, chi2s, covs
+
+    def precompile(self, method="auto", maxiter=3, max_workers=None,
+                   threshold=1e-12, ecorr_mode="auto", precision="f64"):
+        """Concurrently AOT-compile every bucket's fit program that is
+        not already warm (see fleet_aot_compile for the trace/XLA
+        split). precision="auto" compiles BOTH gls modes per bucket so
+        the runtime probe dispatches warm either way. Returns
+        (infos, wall_s); infos also land in self.compile_infos."""
+        jobs = []
+        for key in self.group_indices:
+            batch = self._resolve(key)
+            use_gls = self._use_gls(batch, method)
+            if use_gls:
+                modes = (("f64", "mixed") if precision == "auto"
+                         else (precision,))
+                for mode in modes:
+                    kwargs = {"method": "gls", "maxiter": maxiter,
+                              "threshold": threshold,
+                              "ecorr_mode": ecorr_mode,
+                              "precision": mode}
+                    if batch.program_key(**kwargs) not in batch._fns:
+                        jobs.append((batch, kwargs))
+            else:
+                kwargs = {"method": "wls", "maxiter": maxiter,
+                          "threshold": threshold}
+                if batch.program_key(**kwargs) not in batch._fns:
+                    jobs.append((batch, kwargs))
+        infos, wall_s = fleet_aot_compile(jobs, max_workers=max_workers)
+        self.compile_infos = dict(enumerate(infos))
+        return infos, wall_s
 
     def free_maps(self):
         """Per-pulsar free-parameter maps in original order."""

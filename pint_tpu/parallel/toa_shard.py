@@ -16,6 +16,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax >= 0.5 exports shard_map at top level
+    _shard_map = jax.shard_map
+except AttributeError:  # jax 0.4.x: experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 
 def _data_spec(x, n_rows, axis):
     """PartitionSpec sharding whichever dimension carries the TOA axis
@@ -72,7 +77,7 @@ def sharded_residuals(template_model, static, mesh: Mesh, params, batch, prep,
                     jax.tree_util.tree_map(lambda _: P(), params))
     batch = _place(mesh, batch, batch_specs)
     prep = _place(mesh, prep, prep_specs)
-    fn = jax.shard_map(
+    fn = _shard_map(
         local, mesh=mesh,
         in_specs=(jax.tree_util.tree_map(lambda _: P(), params),
                   batch_specs, prep_specs),
@@ -95,10 +100,17 @@ def sharded_chi2(template_model, static, mesh, params, batch, prep, axis="toa"):
 
 
 def sharded_gls_fit(model, toas, mesh: Mesh, maxiter=2, threshold=1e-12,
-                    axis="toa", precision="f64"):
+                    axis="toa", precision="f64", compile_timings=None):
     """Single-pulsar GLS fit with the TOA axis sharded over ``mesh`` —
     the sequence-parallel path for a pulsar whose TOA/photon count
     outgrows one chip (SURVEY section 5 "long-context").
+
+    ``compile_timings``: optional dict; when given, every sharded step
+    program is AOT-compiled through fitter.aot_lower /
+    aot_backend_compile and the per-program
+    {trace_s, backend_compile_s} splits are recorded into it — the
+    same instrumentation surface PTABatch.aot_compile exposes, so
+    bench/profile tooling can attribute sharded-path compile cost.
 
     Per shard: local residuals + local jacfwd design block + local
     noise-basis rows; cross-shard coupling is the weighted mean (psum),
@@ -285,7 +297,21 @@ def sharded_gls_fit(model, toas, mesh: Mesh, maxiter=2, threshold=1e-12,
         return (x - dx[1:nparam], chi2, covn[1:nparam, 1:nparam],
                 norm[1:nparam], jnp.zeros(()))
 
-    step = jax.jit(jax.shard_map(
+    def _maybe_aot(name, fn, *args):
+        # AOT-compile one sharded program when the caller wants the
+        # trace/XLA timing split; otherwise leave the lazy jit
+        if compile_timings is None:
+            return fn
+        from ..fitter import aot_backend_compile, aot_lower
+
+        low = aot_lower(fn, *args)
+        info = aot_backend_compile(low["lowered"])
+        compile_timings[name] = {
+            "trace_s": low["trace_s"],
+            "backend_compile_s": info["backend_compile_s"]}
+        return info["compiled"]
+
+    step = jax.jit(_shard_map(
         local, mesh=mesh,
         in_specs=(P(), batch_specs, prep_specs),
         out_specs=(P(), P(), P(), P(), P())))
@@ -294,20 +320,23 @@ def sharded_gls_fit(model, toas, mesh: Mesh, maxiter=2, threshold=1e-12,
     x = jax.device_put(x0, NamedSharding(mesh, P()))
     relres_hist = []
     if hoist:
-        pre_step = jax.jit(jax.shard_map(
+        pre_step = jax.jit(_shard_map(
             pre_local, mesh=mesh, in_specs=(batch_specs, prep_specs),
             out_specs=(P(axis), P(axis), P(), P(), P())))
+        pre_step = _maybe_aot("pre_step", pre_step, batch, arrays)
         pre = pre_step(batch, arrays)
-        step_h = jax.jit(jax.shard_map(
+        step_h = jax.jit(_shard_map(
             local_hoisted, mesh=mesh,
             in_specs=(P(), batch_specs, prep_specs,
                       P(axis), P(axis), P(), P(), P()),
             out_specs=(P(), P(), P(), P(), P())))
+        step_h = _maybe_aot("step_h", step_h, x, batch, arrays, *pre)
         for _ in range(maxiter):
             x, chi2, covn, norm, relres = step_h(x, batch, arrays, *pre)
         x, chi2, covn, norm = jax.device_get((x, chi2, covn, norm))
         cov = cov_from_normalized(covn, norm)
         return x, float(chi2), cov
+    step = _maybe_aot("step", step, x, batch, arrays)
     for _ in range(maxiter):
         x, chi2, covn, norm, relres = step(x, batch, arrays)
         # every iteration's residual is checked: an early
@@ -327,6 +356,7 @@ def sharded_gls_fit(model, toas, mesh: Mesh, maxiter=2, threshold=1e-12,
             "refitting in f64")
         return sharded_gls_fit(model, toas, mesh, maxiter=maxiter,
                                threshold=threshold, axis=axis,
-                               precision="f64")
+                               precision="f64",
+                               compile_timings=compile_timings)
     cov = cov_from_normalized(covn, norm)
     return x, float(chi2), cov

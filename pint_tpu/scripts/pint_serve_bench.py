@@ -72,12 +72,16 @@ def run_serve_stream(n_requests=216, max_batch=8, max_latency_s=0.05,
                      bucket_floor=64, cache_capacity=32,
                      sizes=(48, 96, 180), per_combo=3, maxiter=3,
                      precision="f64", compare_offline=True, mesh=None,
-                     seed=0):
+                     seed=0, concurrent_prewarm=False):
     """Prewarm + stream n_requests fit requests round-robin over the
     mixed fleet; returns a JSON-safe report with the engine snapshot,
     recompile count after warmup, and (optionally) the max relative
     parameter difference vs the offline PTAFleet fit of the same
-    pulsars."""
+    pulsars. concurrent_prewarm=True warms the cache through
+    ServeEngine.prewarm_concurrent (trace-serial / XLA-concurrent,
+    the fleet executor's compile path) instead of serial flushes."""
+    import time as _time
+
     from pint_tpu.serve import FitRequest, ServeEngine
 
     models, toas_list = build_serve_fleet(sizes=sizes,
@@ -94,7 +98,13 @@ def run_serve_stream(n_requests=216, max_batch=8, max_latency_s=0.05,
                           maxiter=maxiter, precision=precision)
 
     # one request per pulsar covers every (structure, bucket) slot
-    warm_compiles = eng.prewarm([req(i) for i in range(n_pulsars)])
+    t_warm = _time.perf_counter()
+    if concurrent_prewarm:
+        warm_compiles = eng.prewarm_concurrent(
+            [req(i) for i in range(n_pulsars)])
+    else:
+        warm_compiles = eng.prewarm([req(i) for i in range(n_pulsars)])
+    prewarm_wall_s = _time.perf_counter() - t_warm
     results = eng.run_stream([req(i) for i in range(n_requests)])
     snap = eng.snapshot()
     statuses = {}
@@ -108,6 +118,8 @@ def run_serve_stream(n_requests=216, max_batch=8, max_latency_s=0.05,
                                for r in results if r.telemetry}),
         "statuses": statuses,
         "warmup_executables": warm_compiles,
+        "concurrent_prewarm": bool(concurrent_prewarm),
+        "prewarm_wall_s": round(prewarm_wall_s, 3),
         "recompiles_after_warmup": (snap["executables_compiled"]
                                     - warm_compiles),
         "cache": snap["cache"],
@@ -122,6 +134,24 @@ def run_serve_stream(n_requests=216, max_batch=8, max_latency_s=0.05,
 
         fleet = PTAFleet(models, toas_list, mesh=mesh)
         xs, _, _ = fleet.fit(method="auto", maxiter=maxiter)
+        # warm sequential-vs-pipelined executor comparison on the same
+        # fleet: the programs are compiled now, so the delta is pure
+        # scheduling (dispatch-all + overlapped host unpack)
+        t0 = _time.perf_counter()
+        xs_s, chi_s, _ = fleet.fit(method="auto", maxiter=maxiter,
+                                   pipeline=False)
+        seq_s = _time.perf_counter() - t0
+        t0 = _time.perf_counter()
+        xs_p, chi_p, _ = fleet.fit(method="auto", maxiter=maxiter,
+                                   pipeline=True)
+        pipe_s = _time.perf_counter() - t0
+        report["fleet_fit_sequential_s"] = round(seq_s, 4)
+        report["fleet_fit_pipelined_s"] = round(pipe_s, 4)
+        report["fleet_pipeline_overlap_pct"] = round(
+            100.0 * (1.0 - pipe_s / seq_s), 2) if seq_s > 0 else 0.0
+        report["fleet_pipeline_bitwise"] = bool(
+            np.array_equal(chi_s, chi_p)
+            and all(np.array_equal(a, b) for a, b in zip(xs_s, xs_p)))
         worst = 0.0
         for i, r in enumerate(results):
             if r.status != "ok":
@@ -257,6 +287,10 @@ def main(argv=None) -> int:
                    choices=("f64", "mixed"))
     p.add_argument("--no-offline-check", action="store_true",
                    help="skip the PTAFleet cross-check")
+    p.add_argument("--concurrent-prewarm", action="store_true",
+                   help="warm the executable cache via "
+                        "prewarm_concurrent (trace-serial, "
+                        "XLA-concurrent) instead of serial flushes")
     p.add_argument("--hit-threshold", type=float, default=0.9,
                    help="fail (rc 1) when the post-warmup cache hit "
                         "rate drops below this")
@@ -289,7 +323,8 @@ def main(argv=None) -> int:
         n_requests=args.requests, max_batch=args.max_batch,
         max_latency_s=args.max_latency, bucket_floor=args.bucket_floor,
         maxiter=args.maxiter, precision=args.precision,
-        compare_offline=not args.no_offline_check)
+        compare_offline=not args.no_offline_check,
+        concurrent_prewarm=args.concurrent_prewarm)
     print(json.dumps(report, default=float))
     hit_rate = report["cache"]["hit_rate"] or 0.0
     ok = (report["recompiles_after_warmup"] == 0

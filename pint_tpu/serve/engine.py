@@ -250,6 +250,105 @@ class ServeEngine:
 
     # -- execution ---------------------------------------------------
 
+    def _padded_batch(self, bucket, models, toas_list):
+        """Lane-padded PTABatch for one slot flush: the pulsar/lane
+        axis replicates the last (model, toas) up to max_batch and the
+        TOA axis pads to the slot's pow2 bucket, so every flush of a
+        slot presents the executable cache with identical shapes."""
+        from ..parallel.pta import PTABatch
+
+        lanes = self.batcher.max_batch
+        n = len(models)
+        models = models + [models[-1]] * (lanes - n)
+        toas_list = toas_list + [toas_list[-1]] * (lanes - n)
+        return PTABatch(models, toas_list, mesh=self.mesh,
+                        pad_toas=bucket)
+
+    def prewarm_concurrent(self, requests, max_workers=None):
+        """Concurrent prewarm: group representative requests by slot,
+        build one lane-padded PTABatch per slot, then compile every
+        fit slot's program through the same trace-serial /
+        XLA-concurrent path the fleet executor uses
+        (parallel.pta.fleet_aot_compile) instead of pushing each
+        request through a serial flush. resid/phase slots are warmed
+        by running their (cheap) jitted programs inline. The resulting
+        executables land in the cache under EXACTLY the exec keys the
+        lazy flush path would produce — same slot key, same lane
+        padding, same shape signature — so steady-state traffic
+        dispatches warm with zero retracing (tested in
+        test_fleet_pipeline.py). Resets telemetry/cache counters like
+        prewarm; returns the number of executables compiled."""
+        from ..parallel.pta import fleet_aot_compile
+
+        slots = {}
+        for req in requests:
+            key = self.batcher.slot_key(req, policy.resolve(req))
+            slots.setdefault(key, []).append(req)
+        before = self.executables_compiled
+        jobs = []
+        staged = []  # (slot_key, exec_key, pta, kind)
+        for slot_key, reqs in slots.items():
+            _, bucket, kind, method, maxiter, precision = slot_key
+            reqs = reqs[:self.batcher.max_batch]
+            pta = self._padded_batch(bucket, [r.model for r in reqs],
+                                     [r.toas for r in reqs])
+            exec_key = (slot_key, self.batcher.max_batch,
+                        pta.shape_signature())
+            if self.cache.lookup(exec_key) is not None:
+                continue
+            if kind == "fit":
+                jobs.append((pta, {"method": method, "maxiter": maxiter,
+                                   "precision": precision}))
+            elif kind == "resid":
+                pta.time_residuals()
+            else:  # "phase"
+                pta.phases()
+            staged.append((slot_key, exec_key, pta))
+        fleet_aot_compile(jobs, max_workers=max_workers)
+        self.cache.prefill((exec_key, pta._fns)
+                           for _, exec_key, pta in staged)
+        for slot_key, exec_key, _ in staged:
+            self.executables_compiled += 1
+            self._slot_exec_keys.setdefault(slot_key, set()).add(exec_key)
+        self.telemetry.reset()
+        self.cache.reset_counters()
+        return self.executables_compiled - before
+
+    def prefill_from_fleet(self, fleet, method="auto", maxiter=3,
+                           precision="f64"):
+        """Adopt an offline PTAFleet's already-compiled bucket program
+        tables as serve cache entries, so a service starting next to a
+        fleet job inherits its warm executables instead of recompiling.
+
+        An entry can only ever HIT when a flush reproduces the fleet
+        batch's exact shapes: the engine's max_batch must equal the
+        bucket's lane count and the slot bucket must equal the batch's
+        padded TOA width (fleet buckets built with toa_bucket="pow2"
+        and the same bucket_floor satisfy the latter by construction —
+        the shared serve/batcher.py pow2_bucket convention). Shape
+        mismatches just stay cache misses; nothing is ever served from
+        a wrong-shape table. Returns the number of entries inserted.
+        """
+        from ..parallel.pta import PTABatch
+
+        entries = []
+        for bkey in fleet.group_indices:
+            batch = fleet._resolve(bkey)
+            if not batch._fns:
+                continue  # nothing compiled for this bucket yet
+            use_gls = (method == "gls"
+                       or (method == "auto"
+                           and batch._noise_bw_fn() is not None))
+            mname = "gls" if use_gls else "wls"
+            lanes = batch.n_pulsars
+            bucket = int(batch.batch.tdb_sec.shape[1])
+            slot_key = (PTABatch.structure_key(batch.template), bucket,
+                        "fit", mname, maxiter, precision)
+            exec_key = (slot_key, lanes, batch.shape_signature())
+            entries.append((exec_key, batch._fns))
+            self._slot_exec_keys.setdefault(slot_key, set()).add(exec_key)
+        return self.cache.prefill(entries)
+
     def _flush(self, key):
         entries = self.batcher.take(key)
         if not entries:
@@ -345,17 +444,12 @@ class ServeEngine:
         from ..parallel.pta import PTABatch
 
         _, bucket, kind, method, maxiter, precision = slot_key
-        models = [req.model for req, _, _ in live]
-        toas_list = [req.toas for req, _, _ in live]
         n_live = len(live)
-        # lane padding: replicate the last request up to max_batch so
-        # every flush of this slot presents identical shapes
         lanes = self.batcher.max_batch
-        models += [models[-1]] * (lanes - n_live)
-        toas_list += [toas_list[-1]] * (lanes - n_live)
         t0 = self.clock()
-        pta = PTABatch(models, toas_list, mesh=self.mesh,
-                       pad_toas=bucket)
+        pta = self._padded_batch(bucket,
+                                 [req.model for req, _, _ in live],
+                                 [req.toas for req, _, _ in live])
         pack_s = self.clock() - t0
         exec_key = (slot_key, lanes, pta.shape_signature())
         fns = self.cache.lookup(exec_key)

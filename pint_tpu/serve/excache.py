@@ -27,6 +27,7 @@ class ExecutableCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self.prefilled = 0
 
     def __len__(self):
         return len(self._entries)
@@ -59,10 +60,17 @@ class ExecutableCache:
 
     def prefill(self, entries):
         """Warm-start bulk insert of (key, fns) pairs —
-        ServeEngine.prewarm drives real compiles through this for the
-        N most common shapes before traffic arrives."""
+        ServeEngine.prewarm_concurrent / prefill_from_fleet drive real
+        compiles through this for the N most common shapes before
+        traffic arrives. Returns the number of entries inserted and
+        counts them in ``prefilled`` (separate from hit/miss so
+        steady-state telemetry stays clean)."""
+        n = 0
         for key, fns in entries:
             self.insert(key, fns)
+            n += 1
+        self.prefilled += n
+        return n
 
     def reset_counters(self):
         self.hits = self.misses = self.evictions = 0
@@ -71,4 +79,5 @@ class ExecutableCache:
         total = self.hits + self.misses
         return {"hits": self.hits, "misses": self.misses,
                 "evictions": self.evictions, "size": len(self._entries),
+                "prefilled": self.prefilled,
                 "hit_rate": (self.hits / total) if total else None}

@@ -1,0 +1,292 @@
+"""Pipelined fleet executor: bitwise equivalence vs the sequential
+path (including under injected solver divergence), optimal_split_bounds
+properties, concurrent AOT compilation feeding the serve
+ExecutableCache, pow2 bucket unification, precision="auto", and the
+bench fleet-pipeline metric contract."""
+
+import copy
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.models import get_model
+from pint_tpu.parallel import PTABatch, PTAFleet, fleet_pipeline_metrics
+from pint_tpu.resilience import FaultPoint, inject
+from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+
+def _spin_pulsars(n_psr=2, base_toas=24):
+    """Spin-only pulsars (WLS route under method="auto"), ragged."""
+    rng = np.random.default_rng(0)
+    models, toas_list = [], []
+    for i in range(n_psr):
+        par = (f"PSR FP{i}\nRAJ 1{i % 10}:00:00.0\nDECJ {5 + i}:30:00.0\n"
+               f"F0 {200 + 10 * i}.5 1\nF1 -{3 + i}e-16 1\nPEPOCH 55500\n"
+               f"DM {10 + i}.5 1\n")
+        m = get_model(par)
+        n = base_toas + 4 * i
+        mjds = np.sort(rng.uniform(55000, 56000, n))
+        t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=1400.0,
+                                    obs="gbt", add_noise=True, seed=i)
+        models.append(m)
+        toas_list.append(t)
+    return models, toas_list
+
+
+def _noise_pulsars(n_psr=2, n_epochs=10, per_epoch=3):
+    """EFAC/EQUAD/ECORR pulsars (GLS route under method="auto")."""
+    models, toas_list = [], []
+    for i in range(n_psr):
+        par = (f"PSR NP{i}\nRAJ 0{(2 * i) % 10}:30:00.0\n"
+               f"DECJ {8 + i}:00:00.0\n"
+               f"F0 {310 + 4 * i}.25 1\nF1 -{2 + i}e-16 1\nPEPOCH 55500\n"
+               f"DM {12 + i}.3 1\n"
+               "EFAC -f L-wide 1.2\nEQUAD -f L-wide 0.5\n"
+               "ECORR -f L-wide 0.9\n")
+        m = get_model(par)
+        ne = n_epochs + 2 * i
+        epoch_days = np.linspace(55000, 56000, ne)
+        mjds = np.concatenate(
+            [d + np.arange(per_epoch) * 0.5 / 86400.0 for d in epoch_days])
+        t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0,
+                                    freq_mhz=np.full(len(mjds), 1400.0),
+                                    obs="gbt", add_noise=True, seed=100 + i)
+        for f in t.flags:
+            f["f"] = "L-wide"
+        models.append(m)
+        toas_list.append(t)
+    return models, toas_list
+
+
+def _mixed_fleet(**fleet_kw):
+    """wls-structure + gls-structure pulsars in one fleet (2 buckets)."""
+    ms, ts = _spin_pulsars(2)
+    mn, tn = _noise_pulsars(2)
+    return PTAFleet(ms + mn, ts + tn, **fleet_kw)
+
+
+def _fit_arrays(fleet, **kw):
+    x, chi2, cov = fleet.fit(**kw)
+    return (np.asarray(x), np.asarray(chi2), np.asarray(cov),
+            sorted(int(i) for i in fleet.diverged))
+
+
+# -- bitwise equivalence ---------------------------------------------
+
+
+def test_pipelined_fit_bitwise_matches_sequential():
+    """Pipelined execution (async dispatch + concurrent AOT compile +
+    overlapped host prep) must be a pure scheduling change: bitwise
+    identical x/chi2/cov on a mixed wls+gls fleet."""
+    fleet = _mixed_fleet(pipeline=True)
+    xs, c2s, covs, div_s = _fit_arrays(fleet, method="auto", maxiter=3,
+                                       pipeline=False)
+    xp, c2p, covp, div_p = _fit_arrays(fleet, method="auto", maxiter=3,
+                                       pipeline=True)
+    assert np.array_equal(xs, xp)
+    assert np.array_equal(c2s, c2p)
+    assert np.array_equal(covs, covp)
+    assert div_s == div_p == []
+    assert sorted(fleet.fit_metrics) == sorted(fleet.group_indices)
+
+
+def test_pipelined_fit_bitwise_under_injected_divergence():
+    """The solver_diverge fault point fires on a deterministic
+    eligibility-check schedule; because the pipelined path finalizes
+    buckets in the same order the sequential path fits them, the SAME
+    bucket/lane diverges and the isolated results stay bitwise equal
+    (including the NaN chi2 on the poisoned lane)."""
+    fleet = _mixed_fleet()
+
+    def run(pipeline):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            # fresh FaultPoint per run: identical (seed, check
+            # sequence) => identical fire schedule iff the two paths
+            # hit the injection site in the same bucket order
+            with inject(FaultPoint("solver_diverge", count=1,
+                                   payload={"lanes": [1]})):
+                return _fit_arrays(fleet, method="auto", maxiter=3,
+                                   pipeline=pipeline)
+
+    xs, c2s, covs, div_s = run(False)
+    xp, c2p, covp, div_p = run(True)
+    assert div_s == div_p and len(div_s) == 1
+    assert np.array_equal(xs, xp)
+    assert np.array_equal(c2s, c2p, equal_nan=True)
+    assert np.array_equal(covs, covp, equal_nan=True)
+
+
+def test_pipelined_rejects_bad_kwargs_like_sequential():
+    """kwarg validation must not be deferred past dispatch: a wls
+    bucket given a gls-only kwarg raises the same TypeError either
+    way."""
+    ms, ts = _spin_pulsars(2)
+    fleet = PTAFleet(ms, ts)
+    with pytest.raises(TypeError, match="unexpected keyword"):
+        fleet.fit(method="wls", maxiter=3, pipeline=True,
+                  ecorr_mode="auto")
+    with pytest.raises(TypeError, match="unexpected keyword"):
+        fleet.fit(method="wls", maxiter=3, pipeline=False,
+                  ecorr_mode="auto")
+
+
+# -- optimal_split_bounds properties ---------------------------------
+
+
+def test_optimal_split_bounds_k_at_least_n():
+    counts = [30, 100, 550, 12]
+    bounds = PTAFleet.optimal_split_bounds(counts, k=10)
+    # k >= n: zero padding is achievable, so every distinct count is
+    # its own (or a duplicate-shared) bound
+    assert bounds == sorted(set(counts))
+    assert sum(min(x for x in bounds if x >= c)
+               for c in counts) == sum(counts)
+
+
+def test_optimal_split_bounds_duplicates_and_single():
+    # all-equal counts: one segment is already optimal
+    assert PTAFleet.optimal_split_bounds([40, 40, 40], k=3) == [40]
+    # single pulsar: its own count, regardless of k
+    assert PTAFleet.optimal_split_bounds([77], k=5) == [77]
+    assert PTAFleet.optimal_split_bounds([], k=3) == []
+
+
+def test_optimal_split_bounds_general_properties():
+    rng = np.random.default_rng(11)
+    counts = rng.integers(20, 3000, size=12).tolist()
+    for k in (1, 2, 3):
+        bounds = PTAFleet.optimal_split_bounds(counts, k)
+        assert bounds == sorted(bounds)
+        assert 1 <= len(bounds) <= k
+        assert bounds[-1] == max(counts)  # largest pulsar must fit
+        # padded area never exceeds the one-bucket baseline
+        area = sum(min(b for b in bounds if b >= c) for c in counts)
+        assert area <= len(counts) * max(counts)
+
+
+# -- pow2 bucket unification with serve/batcher ----------------------
+
+
+def test_fleet_pow2_buckets_use_serve_convention():
+    from pint_tpu.serve.batcher import pow2_bucket
+
+    ms, ts = _spin_pulsars(3, base_toas=30)  # counts 30, 34, 38
+    fleet = PTAFleet(ms, ts, toa_bucket="pow2", bucket_floor=16)
+    got = sorted(key[1] for key in fleet.group_indices)
+    want = sorted({pow2_bucket(len(t), 16) for t in ts})
+    assert got == want == [32, 64]
+    # floor dominates when counts sit below it (the serve slot floor)
+    fleet256 = PTAFleet(ms, ts, toa_bucket="pow2", bucket_floor=256)
+    assert [key[1] for key in fleet256.group_indices] == [256]
+
+
+# -- precision="auto" -------------------------------------------------
+
+
+def test_precision_auto_picks_and_matches_explicit():
+    mn, tn = _noise_pulsars(2)
+    pta = PTABatch([copy.deepcopy(m) for m in mn], tn)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        x_a, chi2_a, cov_a = pta.gls_fit(maxiter=2, precision="auto")
+    verdict = pta.precision_auto
+    assert verdict["choice"] in ("f64", "mixed")
+    assert verdict["f64_s"] > 0 and verdict["mixed_s"] > 0
+    # auto must equal the explicitly-requested winner bitwise
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        x_e, chi2_e, cov_e = pta.gls_fit(maxiter=2,
+                                         precision=verdict["choice"])
+    assert np.array_equal(np.asarray(x_a), np.asarray(x_e))
+    assert np.array_equal(np.asarray(chi2_a), np.asarray(chi2_e))
+    # the per-structure verdict is cached process-wide: a second auto
+    # fit must reuse it, not re-probe
+    from pint_tpu.parallel.pta import _PRECISION_AUTO_CACHE
+
+    n_cached = len(_PRECISION_AUTO_CACHE)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        pta.gls_fit(maxiter=2, precision="auto")
+    assert len(_PRECISION_AUTO_CACHE) == n_cached
+
+
+def test_precision_rejects_unknown():
+    mn, tn = _noise_pulsars(2)
+    pta = PTABatch(mn, tn)
+    with pytest.raises(ValueError, match="precision"):
+        pta.gls_fit(maxiter=2, precision="f16")
+
+
+# -- concurrent AOT compile and the serve ExecutableCache ------------
+
+
+def test_prewarm_concurrent_matches_lazy_cache_keys():
+    """Concurrent prewarm must stage exactly the executables the lazy
+    jit path would compile: same ExecutableCache keys, then a zero-miss
+    stream."""
+    from pint_tpu.serve import FitRequest, ServeEngine
+
+    (m0, t0), (m1, t1) = zip(*_spin_pulsars(2))
+    reqs = [FitRequest(copy.deepcopy(m0), t0, maxiter=3),
+            FitRequest(copy.deepcopy(m1), t1, maxiter=3)]
+
+    lazy = ServeEngine(max_batch=2, max_latency_s=1e9, bucket_floor=32)
+    for r in reqs:
+        lazy.submit(FitRequest(copy.deepcopy(r.model), r.toas, maxiter=3))
+    lazy.drain()
+
+    warm = ServeEngine(max_batch=2, max_latency_s=1e9, bucket_floor=32)
+    n = warm.prewarm_concurrent(reqs)
+    assert n >= 1
+    assert sorted(map(repr, warm.cache.keys())) == \
+        sorted(map(repr, lazy.cache.keys()))
+    assert warm.cache.counters()["prefilled"] == len(warm.cache)
+
+    results = [warm.submit(FitRequest(copy.deepcopy(r.model), r.toas,
+                                      maxiter=3)) for r in reqs]
+    warm.drain()
+    assert all(r.status == "ok" for r in results)
+    assert warm.cache.misses == 0 and warm.cache.hits >= 1
+
+
+def test_fleet_precompile_populates_program_tables():
+    """fleet.precompile (concurrent AOT) must install exactly the
+    program keys the lazy path would, and the subsequent pipelined fit
+    stays bitwise equal to a never-precompiled sequential fit."""
+    fleet = _mixed_fleet()
+    infos, wall_s = fleet.precompile(method="auto", maxiter=3)
+    assert len(infos) == len(fleet.group_indices) and wall_s > 0
+    for info in infos:
+        assert info["trace_s"] >= 0 and info["backend_compile_s"] >= 0
+    for key in fleet.group_indices:
+        batch = fleet.batches[key]
+        method = "gls" if fleet._use_gls(batch, "auto") else "wls"
+        assert batch.program_key(method=method, maxiter=3) in batch._fns
+    xp, c2p, covp, _ = _fit_arrays(fleet, method="auto", maxiter=3,
+                                   pipeline=True)
+    ref = _mixed_fleet()
+    xs, c2s, covs, _ = _fit_arrays(ref, method="auto", maxiter=3,
+                                   pipeline=False)
+    assert np.array_equal(xp, xs)
+    assert np.array_equal(c2p, c2s)
+    assert np.array_equal(covp, covs)
+
+
+# -- bench metric contract (tier-1-safe smoke) -----------------------
+
+
+def test_fleet_pipeline_metrics_keys_finite():
+    """The bench/profile fleet_pipeline stage contract: all new keys
+    present and finite, bitwise flag true."""
+    ms, ts = _spin_pulsars(3, base_toas=30)  # buckets 32 and 64
+    fleet = PTAFleet(ms, ts, toa_bucket="pow2", bucket_floor=16)
+    rep = fleet_pipeline_metrics(fleet, method="wls", maxiter=3,
+                                 repeats=1)
+    for key in ("fleet_compile_serial_s", "fleet_compile_concurrent_s",
+                "fleet_fit_sequential_s", "fleet_fit_pipelined_s",
+                "fleet_pipeline_overlap_pct"):
+        assert rep[key] is not None and np.isfinite(rep[key]), (key, rep)
+    assert rep["fleet_pipeline_bitwise"] is True
+    assert rep["fleet_buckets"] == 2
