@@ -835,6 +835,49 @@ def main():
                    f"overlap {fleet_report['fleet_pipeline_overlap_pct']}%"
                    f", bitwise={fleet_report['fleet_pipeline_bitwise']}")
 
+    # ------------------------------------------------------------------
+    # pintlint stage: static-analysis finding counts over the package
+    # (pure AST, no device work). The CI gate (tests/test_pintlint.py)
+    # enforces zero unsuppressed; the bench records the counts so a
+    # suppression creeping in shows up in the telemetry trail. Same
+    # optional posture: daemon thread + join timeout, skip with
+    # PINT_TPU_BENCH_SKIP_LINT=1.
+    lint_report = None
+
+    def _lint_stage():
+        nonlocal lint_report
+        try:
+            from pint_tpu.analysis import (LintConfig, counts_by_rule,
+                                           run as lint_run, unsuppressed)
+
+            pkg = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "pint_tpu")
+            findings = lint_run([pkg], config=LintConfig.default())
+            n_live = len(unsuppressed(findings))
+            lint_report = {
+                "unsuppressed": n_live,
+                "suppressed": len(findings) - n_live,
+                "counts_by_rule": counts_by_rule(findings),
+            }
+        except Exception as e:
+            _stage(f"pintlint stage failed ({type(e).__name__}: {e}); "
+                   f"headline JSON unaffected")
+
+    if os.environ.get("PINT_TPU_BENCH_SKIP_LINT") == "1":
+        _stage("pintlint stage skipped (PINT_TPU_BENCH_SKIP_LINT=1)")
+    else:
+        _stage("pintlint: static analysis over pint_tpu/")
+        tl = threading.Thread(target=_lint_stage, daemon=True)
+        tl.start()
+        tl.join(timeout=120)
+        if tl.is_alive():
+            lint_report = None
+            _stage("pintlint stage timed out; headline JSON unaffected")
+        elif lint_report is not None:
+            _stage(f"pintlint: {lint_report['unsuppressed']} "
+                   f"unsuppressed, {lint_report['suppressed']} "
+                   f"suppressed {lint_report['counts_by_rule']}")
+
     total_toas = n_psr * n_toa
     rate = total_toas / gls_refit_s  # TOAs GLS-refit per second
     projected_670k = gls_refit_s * (670_000 / total_toas)
@@ -938,6 +981,12 @@ def main():
                                    if fleet_report else None),
         "fleet_buckets": (fleet_report["fleet_buckets"]
                           if fleet_report else None),
+        "pintlint_unsuppressed": (lint_report["unsuppressed"]
+                                  if lint_report else None),
+        "pintlint_suppressed": (lint_report["suppressed"]
+                                if lint_report else None),
+        "pintlint_counts_by_rule": (lint_report["counts_by_rule"]
+                                    if lint_report else None),
         "platform": platform,
     }
     meta.update(full_meta)

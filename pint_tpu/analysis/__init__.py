@@ -1,0 +1,32 @@
+"""pintlint: codebase-aware static analysis for pint_tpu.
+
+The repo's correctness conventions — NaN-aware mixed-precision
+guards, the ExecutableCache zero-retrace contract, lock discipline on
+shared serving state, fault-registry coverage, synchronized timing
+regions — are enforced here as AST lint rules instead of reviewer
+memory. ``python -m pint_tpu.analysis pint_tpu/`` (or
+``pint_tpu/scripts/pintlint.py``) exits nonzero on any unsuppressed
+finding; tests/test_pintlint.py gates the tree in CI.
+
+Rule catalogue with bad/good examples: docs/lint_rules.md.
+"""
+
+from .config import LintConfig
+from .core import (Finding, Rule, RULES, all_rules, counts_by_rule,
+                   register, run, unsuppressed)
+# importing the rule modules populates the registry
+from . import (rules_bench, rules_faults, rules_locks,  # noqa: F401
+               rules_precision, rules_retrace)
+from .report import json_report, text_report
+
+__all__ = [
+    "Finding", "LintConfig", "Rule", "RULES", "all_rules",
+    "counts_by_rule", "json_report", "register", "run", "text_report",
+    "unsuppressed",
+]
+
+
+def run_lint(paths, config=None):
+    """Convenience wrapper: (findings, unsuppressed_findings)."""
+    findings = run(paths, config=config)
+    return findings, unsuppressed(findings)

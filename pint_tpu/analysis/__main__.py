@@ -1,0 +1,57 @@
+"""CLI: ``python -m pint_tpu.analysis [paths...]``.
+
+Exit status 0 when every finding is suppressed (each suppression is a
+reviewed, justified exception), 1 when unsuppressed findings remain,
+2 on usage errors. ``--format json`` emits the machine report bench.py
+folds into its meta block.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import (LintConfig, all_rules, json_report, run, text_report,
+               unsuppressed)
+
+
+def _list_rules():
+    lines = []
+    for rule in all_rules():
+        lines.append(f"{rule.id:24s} [{rule.family}] {rule.rationale}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="pintlint",
+        description="pint_tpu codebase-aware static analysis")
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files or directories to lint "
+                             "(default: the pint_tpu package)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--show-suppressed", action="store_true",
+                        help="include suppressed findings in text "
+                             "output")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+    if args.list_rules:
+        print(_list_rules())
+        return 0
+    paths = args.paths
+    if not paths:
+        import pint_tpu
+
+        paths = [pint_tpu.__path__[0]]
+    findings = run(paths, config=LintConfig.default())
+    if args.format == "json":
+        print(json_report(findings))
+    else:
+        print(text_report(findings,
+                          show_suppressed=args.show_suppressed))
+    return 1 if unsuppressed(findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,151 @@
+"""Codebase-tuned registries the pintlint rules check against.
+
+A generic linter cannot know which functions are f64-critical, which
+classes are shared across threads, or which names are legal fault
+points — those are THIS codebase's contracts. They live here, in one
+reviewable place, so adding a shared class or a fault point is a
+one-line registry edit and the rules pick it up everywhere.
+
+Tests construct ``LintConfig`` directly with fixture registries; the
+CLI and the CI gate use :meth:`LintConfig.default`, which binds the
+registries below plus the live fault-point tuple from
+``pint_tpu.resilience.faultinject``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+# -- precision ---------------------------------------------------------
+
+# Functions where introducing float32 (literals, dtype=, .astype) is a
+# correctness bug: the whitening/normal-equation chain feeding the
+# f64-critical residual solve. Keyed by path suffix; "*" marks a whole
+# module. gls_gram and the batched mixed branches are deliberately NOT
+# listed — their f32 is the sanctioned mixed-precision path, guarded at
+# runtime by fitter.relres_failed.
+F64_CRITICAL = {
+    "pint_tpu/fitter.py": {
+        "gls_whiten", "gls_normal", "gls_eigh_solve", "gls_eigh_refine",
+        "column_norms", "stack_noise_bases", "relres_failed",
+    },
+    "pint_tpu/timescales.py": {"*"},
+    "pint_tpu/residuals.py": {"*"},
+    "pint_tpu/dd.py": {"*"},
+}
+
+# -- lock discipline ---------------------------------------------------
+
+# Shared classes whose attributes may be mutated outside the owning
+# thread: every mutation of a monitored attribute must sit inside
+# ``with self._lock:`` (or live in a ``*_locked`` helper whose call
+# sites the locked-helper-call rule checks). attrs=None monitors every
+# self attribute except the exemptions.
+LOCKED_CLASSES = {
+    "ExecutableCache": {"lock": "_lock", "attrs": None},
+    "MicroBatcher": {"lock": "_lock", "attrs": None},
+    "HealthMonitor": {"lock": "_lock", "attrs": None},
+    "CircuitBreaker": {"lock": "_lock", "attrs": None},
+    # only the pipeline state shared with the prep worker pool; fit
+    # results (diverged, fit_metrics, ...) are caller-thread-only
+    "PTAFleet": {"lock": "_lock",
+                 "attrs": {"batches", "_batch_futures", "_prep_pool"}},
+}
+
+# Attributes never treated as shared state even under attrs=None:
+# injected collaborators and configuration, written once in __init__.
+LOCKED_CLASS_EXEMPT_ATTRS = frozenset({"_lock", "clock", "_sleep"})
+
+# Module-level caches mutated from multiple threads (the fleet
+# pipeline and concurrent prewarm both reach the per-process
+# precision-probe cache): mutations must hold the paired module lock.
+LOCKED_GLOBALS = {
+    "_PRECISION_AUTO_CACHE": "_PRECISION_AUTO_LOCK",
+}
+
+# -- retrace / sync hazards -------------------------------------------
+
+# Callables that trace their function argument: a function passed to
+# any of these is device code, where host-sync calls (float, .item,
+# np.asarray, time.*) either crash at trace time or silently bake a
+# traced value into the executable.
+TRACING_WRAPPERS = frozenset({
+    "jit", "vmap", "pmap", "pjit", "shard_map", "grad", "jacfwd",
+    "jacrev", "hessian", "checkpoint", "remat", "value_and_grad",
+    "scan", "while_loop", "fori_loop", "cond", "custom_jvp",
+    "custom_vjp",
+})
+
+# Host-sync callables forbidden inside traced functions.
+HOST_SYNC_CALLS = frozenset({
+    "float", "int", "bool", "np.asarray", "np.array", "numpy.asarray",
+    "numpy.array", "np.float64", "np.float32", "jax.device_get",
+    "device_get", "time.time", "time.perf_counter", "time.monotonic",
+})
+
+# Methods whose call on a traced value forces a device sync.
+HOST_SYNC_METHODS = frozenset({"item", "tolist", "block_until_ready"})
+
+# Modules (path substrings) where building a PTABatch without
+# pad_toas= breaks the zero-recompile serving contract: every flush of
+# a slot must present identical shapes to the executable cache.
+SERVE_PAD_MODULES = ("pint_tpu/serve/",)
+
+# -- fault injection ---------------------------------------------------
+
+# Call names whose first string argument must be a registered fault
+# point.
+FAULT_CALLS = frozenset({
+    "fire", "inject", "faultinject.fire", "faultinject.inject",
+    "FaultPoint", "faultinject.FaultPoint",
+})
+
+# Path suffix of the registry module; its POINTS tuple is the ground
+# truth, and the unfired check only runs when this file is in the scan
+# (linting one file must not claim the whole registry is unused).
+FAULT_REGISTRY_SUFFIX = "resilience/faultinject.py"
+
+# -- bench hygiene -----------------------------------------------------
+
+# Calls that dispatch device work asynchronously: timing them without
+# a block_until_ready (or an equivalent host pull) times the dispatch,
+# not the compute. "_fns" matches self._fns[key](...) program-table
+# dispatch; jit-wrapped local names are collected per file.
+ASYNC_DISPATCH_SUBSCRIPTS = frozenset({"_fns"})
+
+# Calls that synchronize: their presence inside a timing window makes
+# the measurement honest.
+SYNC_CALLS = frozenset({
+    "block_until_ready", "jax.block_until_ready", "device_get",
+    "jax.device_get", "np.asarray", "np.array", "float",
+})
+
+TIMER_CALLS = frozenset({
+    "time.perf_counter", "time.monotonic", "time.time",
+    "perf_counter", "monotonic", "self.clock", "clock",
+})
+
+# Names that mark a value as a NaN-signalling convergence diagnostic:
+# comparing one of these with ``>`` (False under NaN) silently
+# swallows a diverged fit. ADVICE.md round 5 found three variants of
+# exactly this bug; fitter.relres_failed is the sanctioned guard.
+NAN_DIAG_PATTERN = r"(?:^|_)rel_?res(?:id)?(?:_|$)|relres"
+
+
+@dataclass
+class LintConfig:
+    f64_critical: dict = field(default_factory=dict)
+    locked_classes: dict = field(default_factory=dict)
+    locked_class_exempt_attrs: frozenset = LOCKED_CLASS_EXEMPT_ATTRS
+    locked_globals: dict = field(default_factory=dict)
+    serve_pad_modules: tuple = ()
+    fault_points: tuple = None  # None -> parse from the registry file
+    fault_registry_suffix: str = FAULT_REGISTRY_SUFFIX
+    nan_diag_pattern: str = NAN_DIAG_PATTERN
+
+    @classmethod
+    def default(cls):
+        return cls(f64_critical=dict(F64_CRITICAL),
+                   locked_classes=dict(LOCKED_CLASSES),
+                   locked_globals=dict(LOCKED_GLOBALS),
+                   serve_pad_modules=SERVE_PAD_MODULES)

@@ -1,0 +1,100 @@
+"""Bench-hygiene rule family.
+
+- timing-no-block: a perf-counter window that dispatches device work
+  asynchronously (a ``self._fns[key](...)`` program-table call or a
+  jit-wrapped local) without a synchronizing call before the elapsed
+  time is computed. JAX dispatch is async: without block_until_ready
+  (or a host pull) the window times the enqueue, not the compute —
+  the classic way a bench reports a 100x phantom speedup.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .config import ASYNC_DISPATCH_SUBSCRIPTS, SYNC_CALLS, TIMER_CALLS
+from .core import Rule, call_name, register
+from .rules_retrace import TracedIndex
+
+
+def _is_timer_call(node):
+    if not isinstance(node, ast.Call):
+        return False
+    name = call_name(node)
+    return name in TIMER_CALLS
+
+
+def _subscript_root_attr(node):
+    """'_fns' for a ``self._fns[key](...)`` style callee."""
+    if isinstance(node, ast.Subscript) and \
+            isinstance(node.value, ast.Attribute):
+        return node.value.attr
+    return None
+
+
+@register
+class TimingNoBlockRule(Rule):
+    id = "timing-no-block"
+    family = "bench"
+    rationale = ("async dispatch inside a perf-counter window without "
+                 "block_until_ready times the enqueue, not the work")
+
+    def check_file(self, ctx):
+        traced = TracedIndex(ctx.tree)
+        for func in ast.walk(ctx.tree):
+            if isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_func(ctx, func, traced)
+
+    def _check_func(self, ctx, func, traced):
+        body = list(ast.walk(func))
+        # timer starts: t = time.perf_counter() (several windows may
+        # reuse one variable; pair each elapsed use with the closest
+        # preceding start of the same name)
+        starts = []
+        for node in body:
+            if isinstance(node, ast.Assign) and \
+                    _is_timer_call(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        starts.append((t.id, node.lineno))
+        if not starts:
+            return
+        ends = []
+        for node in body:
+            if isinstance(node, ast.BinOp) and \
+                    isinstance(node.op, ast.Sub) and \
+                    isinstance(node.right, ast.Name) and \
+                    node.right.id in {n for n, _ in starts}:
+                ends.append((node.right.id, node.lineno))
+        windows = []
+        for name, end_line in sorted(ends, key=lambda p: p[1]):
+            cands = [ln for n, ln in starts
+                     if n == name and ln < end_line]
+            if cands:
+                windows.append((name, max(cands), end_line))
+        for name, start_line, end_line in sorted(set(windows)):
+            window = [n for n in body
+                      if getattr(n, "lineno", None) is not None
+                      and start_line <= n.lineno <= end_line]
+            dispatch = None
+            synced = False
+            for node in window:
+                if not isinstance(node, ast.Call):
+                    continue
+                cname = call_name(node)
+                tail = cname.rsplit(".", 1)[-1] if cname else None
+                if cname in SYNC_CALLS or tail in SYNC_CALLS:
+                    synced = True
+                if _subscript_root_attr(node.func) in \
+                        ASYNC_DISPATCH_SUBSCRIPTS:
+                    dispatch = dispatch or node
+                elif traced.is_traced_name(cname, node):
+                    dispatch = dispatch or node
+            if dispatch is not None and not synced:
+                ctx.report(
+                    self.id, dispatch,
+                    f"device dispatch inside the '{name}' timing "
+                    f"window (lines {start_line}-{end_line}) with no "
+                    f"block_until_ready/host pull before the elapsed "
+                    f"computation: this times the async enqueue, not "
+                    f"the compute")

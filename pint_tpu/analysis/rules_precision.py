@@ -1,0 +1,114 @@
+"""Precision rule family.
+
+- nan-guard: NaN-unsafe failure guards on convergence diagnostics.
+- f32-in-f64: float32 introduced inside an f64-critical function.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Rule, call_name, mentions, register
+
+
+@register
+class NanGuardRule(Rule):
+    """``max(relres) > tol`` is False when relres is NaN, so the very
+    failure the diagnostic exists to signal (an f32 overflow / eigh
+    NaN propagating through refinement) silently passes the guard.
+    ADVICE.md round 5 found three live variants. The sanctioned forms
+    are ``fitter.relres_failed(...)`` or ``not np.all(x <= tol)`` —
+    NaN fails a ``<=`` comparison, so NaN means failure.
+    Python's builtin ``max`` is equally unsafe: ``max(0.0, nan)`` is
+    0.0 (comparison False keeps the first arg), so folding a
+    diagnostic through ``max`` erases the NaN; ``np.maximum`` /
+    ``jnp.maximum`` propagate it.
+    """
+
+    id = "nan-guard"
+    family = "precision"
+    rationale = ("'diag > tol' and builtin max() both treat NaN as "
+                 "success; use relres_failed()/not np.all(diag <= tol)")
+
+    def check_file(self, ctx):
+        diag = re.compile(ctx.config.nan_diag_pattern)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Compare):
+                for op, comparator in zip(node.ops, node.comparators):
+                    if not isinstance(op, (ast.Gt, ast.GtE)):
+                        continue
+                    if mentions(node.left, diag):
+                        ctx.report(self.id, node,
+                                   "NaN-unsafe failure guard on a "
+                                   "convergence diagnostic ('> tol' is "
+                                   "False under NaN); use "
+                                   "fitter.relres_failed() or "
+                                   "'not np.all(x <= tol)'")
+                        break
+            elif isinstance(node, ast.Call):
+                if (call_name(node) == "max" and len(node.args) >= 2
+                        and any(mentions(a, diag) for a in node.args)):
+                    ctx.report(self.id, node,
+                               "builtin max() on a convergence "
+                               "diagnostic returns the non-NaN "
+                               "argument (max(0.0, nan) == 0.0); use "
+                               "np.maximum, which propagates NaN")
+
+
+_F32_MARKERS = ("float32", "f32")
+
+
+@register
+class F32InF64Rule(Rule):
+    """The paper's contract is f64-critical residuals: the whitening /
+    normal-equation chain must stay f64 end to end. The ONLY sanctioned
+    f32 is the explicitly-guarded mixed-precision Gram (gls_gram and
+    the batched equivalents), which is registry-excluded. Everywhere
+    else in a registered f64-critical function, a float32 literal,
+    ``dtype=jnp.float32``, or ``.astype(...32)`` silently costs ~9
+    decimal digits on values (TOAs) that need ~16."""
+
+    id = "f32-in-f64"
+    family = "precision"
+    rationale = ("float32 introduced inside a function registered as "
+                 "f64-critical loses the precision the residual "
+                 "contract requires")
+
+    def _critical_names(self, ctx):
+        for suffix, names in ctx.config.f64_critical.items():
+            if ctx.path.endswith(suffix) or ctx.rel.endswith(suffix):
+                return names
+        return None
+
+    def check_file(self, ctx):
+        names = self._critical_names(ctx)
+        if names is None:
+            return
+        whole_module = "*" in names
+        seen = set()  # nested defs are walked twice; report once
+        for func in ast.walk(ctx.tree):
+            if not isinstance(func, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if not whole_module and func.name not in names:
+                continue
+            for node in ast.walk(func):
+                hit = None
+                if isinstance(node, ast.Attribute) and \
+                        node.attr in _F32_MARKERS:
+                    hit = node
+                elif isinstance(node, ast.Constant) and \
+                        node.value in _F32_MARKERS:
+                    hit = node
+                if hit is not None:
+                    key = (hit.lineno, hit.col_offset)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    ctx.report(
+                        self.id, hit,
+                        f"float32 introduced inside f64-critical "
+                        f"function '{func.name}'; the residual chain "
+                        f"requires f64 (mixed precision belongs in the "
+                        f"guarded gls_gram path only)")

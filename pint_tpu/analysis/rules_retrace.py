@@ -1,0 +1,258 @@
+"""Retrace / sync-hazard rule family.
+
+- host-sync-in-jit: host-side conversions inside traced functions.
+- static-unhashable: unhashable literals passed as jit static args.
+- serve-unpadded-batch: PTABatch built in the serve path without
+  pad_toas= (shape drift defeats the ExecutableCache).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .config import HOST_SYNC_CALLS, HOST_SYNC_METHODS, TRACING_WRAPPERS
+from .core import Rule, call_name, dotted_name, register
+
+
+def _tail(name):
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+class TracedIndex:
+    """Scope-aware index of locally-defined functions that end up
+    traced: defined under a tracing decorator, or passed (possibly
+    nested, e.g. ``jax.jit(jax.vmap(fit_one))``) to a tracing wrapper.
+
+    Resolution is lexical, so an unrelated host-side closure that
+    happens to share a name with a jitted function elsewhere in the
+    file (fitter.py has three distinct ``chi2_of``) is not flagged."""
+
+    _SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+    def __init__(self, tree):
+        self._scope_of = {id(tree): None}
+        self._defs = {}  # (id(scope), name) -> def node
+        self._traced = set()  # id(def node)
+        self._traced_bindings = set()  # (id(scope), name) of g = jit(f)
+        self._index(tree, tree)
+        self._mark(tree)
+
+    def _index(self, node, scope):
+        for child in ast.iter_child_nodes(node):
+            self._scope_of[id(child)] = scope
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._defs[(id(scope), child.name)] = child
+                self._index(child, child)
+            elif isinstance(child, ast.Lambda):
+                self._index(child, child)
+            else:
+                self._index(child, scope)
+
+    def _resolve(self, scope, name):
+        while scope is not None:
+            found = self._defs.get((id(scope), name))
+            if found is not None:
+                return found
+            scope = self._scope_of.get(id(scope))
+        return None
+
+    def _harvest(self, node, scope):
+        """Mark Name args of a tracing-wrapper call, recursing through
+        nested wrapper/partial calls."""
+        if isinstance(node, ast.Name):
+            found = self._resolve(scope, node.id)
+            if found is not None:
+                self._traced.add(id(found))
+        elif isinstance(node, ast.Call):
+            if _tail(call_name(node)) in TRACING_WRAPPERS | {"partial"}:
+                for arg in node.args:
+                    self._harvest(arg, scope)
+
+    def _mark(self, tree):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                # g = jax.jit(step): calls through g dispatch device
+                # work even though g itself is not a def
+                if isinstance(node.value, ast.Call) and \
+                        _tail(call_name(node.value)) in TRACING_WRAPPERS:
+                    scope = self._scope_of.get(id(node))
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self._traced_bindings.add((id(scope), t.id))
+            if isinstance(node, ast.Call):
+                if _tail(call_name(node)) in TRACING_WRAPPERS:
+                    scope = self._scope_of.get(id(node))
+                    for arg in node.args:
+                        self._harvest(arg, scope)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    name = (call_name(dec) if isinstance(dec, ast.Call)
+                            else dotted_name(dec))
+                    if _tail(name) in TRACING_WRAPPERS:
+                        self._traced.add(id(node))
+                    elif _tail(name) == "partial" and \
+                            isinstance(dec, ast.Call):
+                        heads = (dotted_name(a) for a in dec.args)
+                        if any(_tail(h) in TRACING_WRAPPERS
+                               for h in heads if h):
+                            self._traced.add(id(node))
+
+    def is_traced_def(self, func):
+        return id(func) in self._traced
+
+    def is_traced_name(self, name, at_node):
+        """True when ``name`` called at ``at_node`` lexically resolves
+        to a traced local function or a jit-result binding."""
+        if not name or "." in name:
+            return False
+        scope = self._scope_of.get(id(at_node))
+        probe = scope
+        while probe is not None:
+            if (id(probe), name) in self._traced_bindings:
+                return True
+            probe = self._scope_of.get(id(probe))
+        found = self._resolve(scope, name)
+        return found is not None and id(found) in self._traced
+
+    def __bool__(self):
+        return bool(self._traced) or bool(self._traced_bindings)
+
+
+@register
+class HostSyncInJitRule(Rule):
+    """A ``float()`` / ``.item()`` / ``np.asarray`` / ``time.*`` call
+    inside a jit-traced function either raises a concretization error
+    at trace time or — worse — executes once at trace time and bakes a
+    stale constant into every later run of the executable. Host
+    conversions belong in the finalize half of the dispatch/finalize
+    split (see PTABatch._pull)."""
+
+    id = "host-sync-in-jit"
+    family = "retrace"
+    rationale = ("host conversions inside traced functions either "
+                 "crash at trace time or freeze trace-time values "
+                 "into the executable")
+
+    def check_file(self, ctx):
+        traced = TracedIndex(ctx.tree)
+        if not traced:
+            return
+        seen = set()
+        for func in ast.walk(ctx.tree):
+            if not isinstance(func, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if not traced.is_traced_def(func):
+                continue
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                bad = None
+                if name in HOST_SYNC_CALLS:
+                    bad = name
+                elif (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in HOST_SYNC_METHODS):
+                    bad = f".{node.func.attr}()"
+                if bad is None:
+                    continue
+                key = (node.lineno, node.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                ctx.report(
+                    self.id, node,
+                    f"host-sync call {bad} inside traced function "
+                    f"'{func.name}'; move it to the finalize half of "
+                    f"the dispatch/finalize split")
+
+
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+               ast.SetComp)
+
+
+@register
+class StaticUnhashableRule(Rule):
+    """jit static arguments key the trace cache by value, so they must
+    be hashable — a list/dict/set static arg raises at dispatch, and a
+    mutable one that WAS converted to tuple per call retraces whenever
+    its identity-derived hash changes. Flags call sites passing
+    unhashable literals to parameters declared static via
+    static_argnames."""
+
+    id = "static-unhashable"
+    family = "retrace"
+    rationale = ("unhashable values passed as jit static args fail at "
+                 "dispatch or silently retrace per call")
+
+    def check_file(self, ctx):
+        static_names = {}  # wrapped function name -> set of static kwargs
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            tail = name.rsplit(".", 1)[-1] if name else None
+            if tail not in ("jit", "pjit"):
+                continue
+            statics = set()
+            for kw in node.keywords:
+                if kw.arg == "static_argnames":
+                    for sub in ast.walk(kw.value):
+                        if isinstance(sub, ast.Constant) and \
+                                isinstance(sub.value, str):
+                            statics.add(sub.value)
+            if not statics:
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    static_names.setdefault(arg.id, set()).update(statics)
+        if not static_names:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = call_name(node)
+            if callee not in static_names:
+                continue
+            for kw in node.keywords:
+                if kw.arg in static_names[callee] and \
+                        isinstance(kw.value, _UNHASHABLE):
+                    ctx.report(
+                        self.id, kw.value,
+                        f"unhashable literal passed to static arg "
+                        f"'{kw.arg}' of jitted '{callee}'; static args "
+                        f"key the trace cache and must be hashable "
+                        f"(use a tuple)")
+
+
+@register
+class ServeUnpaddedBatchRule(Rule):
+    """The serve path's zero-recompile contract requires every flush
+    of a slot to present identical shapes: PTABatch built without
+    ``pad_toas=`` pads to the batch's own max TOA count, so each new
+    TOA-count mixture compiles a fresh executable and the
+    ExecutableCache can never hit. Deliberate exceptions (the oversize
+    spill path) must carry a justified suppression."""
+
+    id = "serve-unpadded-batch"
+    family = "retrace"
+    rationale = ("an unpadded PTABatch in the serve path drifts the "
+                 "shape signature and defeats the ExecutableCache")
+
+    def check_file(self, ctx):
+        path = ctx.path.replace("\\", "/")
+        if not any(mod in path for mod in ctx.config.serve_pad_modules):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            tail = name.rsplit(".", 1)[-1] if name else None
+            if tail != "PTABatch":
+                continue
+            if not any(kw.arg == "pad_toas" for kw in node.keywords):
+                ctx.report(
+                    self.id, node,
+                    "PTABatch built in the serve path without "
+                    "pad_toas=: shapes drift per flush and the "
+                    "ExecutableCache zero-recompile contract breaks")

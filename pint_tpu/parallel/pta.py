@@ -14,6 +14,8 @@ vanish from every whitened reduction.
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from ..models.timing_model import PreparedTiming
@@ -249,8 +251,12 @@ def pure_sigma_fn(template_model, static):
 
 # precision="auto" verdicts, keyed on (structure, shapes, fit options);
 # process-wide so every PTABatch with the same bucket structure reuses
-# one timed probe instead of re-racing mixed vs f64
+# one timed probe instead of re-racing mixed vs f64. The fleet's
+# pipelined executor and concurrent prewarm reach this from worker
+# threads, so access holds _PRECISION_AUTO_LOCK (probes themselves run
+# outside the lock; racing probes converge via setdefault).
 _PRECISION_AUTO_CACHE = {}
+_PRECISION_AUTO_LOCK = threading.RLock()
 
 
 class PTABatch:
@@ -984,7 +990,8 @@ class PTABatch:
         cache_key = (self.structure_key(self.template),
                      self.shape_signature(), maxiter, threshold,
                      ecorr_mode)
-        choice = _PRECISION_AUTO_CACHE.get(cache_key)
+        with _PRECISION_AUTO_LOCK:
+            choice = _PRECISION_AUTO_CACHE.get(cache_key)
         if choice is not None:
             return choice
         args = (self._x0(), self.params, self.batch, self.prep)
@@ -1005,7 +1012,8 @@ class PTABatch:
             timings[mode] = time.perf_counter() - t0
         choice = ("f64" if mixed_failed
                   or timings["f64"] <= timings["mixed"] else "mixed")
-        _PRECISION_AUTO_CACHE[cache_key] = choice
+        with _PRECISION_AUTO_LOCK:
+            choice = _PRECISION_AUTO_CACHE.setdefault(cache_key, choice)
         self.precision_auto = {"choice": choice,
                                "f64_s": round(timings["f64"], 4),
                                "mixed_s": round(timings["mixed"], 4),
@@ -1460,6 +1468,7 @@ class PTAFleet:
             groups.setdefault(key, []).append(i)
         self.group_indices = groups
         self.pipeline = bool(pipeline)
+        self._lock = threading.RLock()
         self.batches = {}
         self._batch_futures = {}
         self._prep_pool = None
@@ -1490,15 +1499,19 @@ class PTAFleet:
 
     def _resolve(self, key):
         """The bucket's PTABatch, blocking on its deferred pack if
-        pipeline=True and it has not landed yet."""
-        batch = self.batches.get(key)
-        if batch is None:
-            batch = self._batch_futures.pop(key).result()
-            self.batches[key] = batch
-            if not self._batch_futures and self._prep_pool is not None:
-                self._prep_pool.shutdown(wait=False)
-                self._prep_pool = None
-        return batch
+        pipeline=True and it has not landed yet. Concurrent compile and
+        the pipelined executor both resolve buckets from worker
+        threads; the pop/insert pair must be atomic or a racing thread
+        pops a missing future."""
+        with self._lock:
+            batch = self.batches.get(key)
+            if batch is None:
+                batch = self._batch_futures.pop(key).result()
+                self.batches[key] = batch
+                if not self._batch_futures and self._prep_pool is not None:
+                    self._prep_pool.shutdown(wait=False)
+                    self._prep_pool = None
+            return batch
 
     @classmethod
     def from_batches(cls, batches):
@@ -1510,6 +1523,7 @@ class PTAFleet:
         fleet.buckets = {}
         fleet.order = []
         fleet.pipeline = False
+        fleet._lock = threading.RLock()
         fleet._batch_futures = {}
         fleet._prep_pool = None
         fleet.batches = dict(enumerate(batches))

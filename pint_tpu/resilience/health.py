@@ -27,6 +27,7 @@ so the state can actually recover).
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import deque
 
@@ -46,6 +47,7 @@ class HealthMonitor:
         self.draining_open_breakers = int(draining_open_breakers)
         self.flush_watchdog_s = float(flush_watchdog_s)
         self.recovery_s = float(recovery_s)
+        self._lock = threading.RLock()
         self.state = "healthy"
         self.since = clock()
         self.reasons = []
@@ -66,29 +68,33 @@ class HealthMonitor:
         bad = status in ("shed", "error") or (
             status == "rejected"
             and reason not in ("nonfinite_input", "draining"))
-        self._events.append(1 if bad else 0)
-        self._evaluate()
+        with self._lock:
+            self._events.append(1 if bad else 0)
+            self._evaluate_locked()
 
     def note_flush(self, wall_s):
         """Flush wall time for the latency watchdog."""
-        if wall_s > self.flush_watchdog_s:
-            self._watchdog_breaches += 1
-            self._last_breach_t = self.clock()
-        self._evaluate()
+        with self._lock:
+            if wall_s > self.flush_watchdog_s:
+                self._watchdog_breaches += 1
+                self._last_breach_t = self.clock()
+            self._evaluate_locked()
 
     def note_breakers(self, open_count, tripped=False):
         """Breaker census from the engine (after record_*)."""
-        self._open_breakers = int(open_count)
-        if tripped:
-            self._breaker_trips += 1
-        self._evaluate()
+        with self._lock:
+            self._open_breakers = int(open_count)
+            if tripped:
+                self._breaker_trips += 1
+            self._evaluate_locked()
 
     # -- evaluation --------------------------------------------------
 
     def shed_rate(self):
-        if len(self._events) < self.min_events:
-            return 0.0
-        return sum(self._events) / len(self._events)
+        with self._lock:
+            if len(self._events) < self.min_events:
+                return 0.0
+            return sum(self._events) / len(self._events)
 
     def _current_reasons(self, now):
         reasons = []
@@ -106,7 +112,10 @@ class HealthMonitor:
             reasons.append("flush_watchdog")
         return reasons
 
-    def _evaluate(self):
+    def _evaluate_locked(self):
+        # caller holds self._lock (note_* / snapshot take it; the
+        # serve engine's flush worker and submitter threads both land
+        # here)
         now = self.clock()
         reasons = self._current_reasons(now)
         severe = ("breakers_open" in reasons
@@ -140,14 +149,15 @@ class HealthMonitor:
     def snapshot(self):
         """JSON-safe health block for ServeTelemetry.snapshot / bench
         JSON."""
-        now = self.clock()
-        self._evaluate()
-        return {
-            "state": self.state,
-            "since_s": round(now - self.since, 6),
-            "reasons": list(self.reasons),
-            "shed_rate": round(self.shed_rate(), 4),
-            "open_breakers": self._open_breakers,
-            "breaker_trips": self._breaker_trips,
-            "watchdog_breaches": self._watchdog_breaches,
-        }
+        with self._lock:
+            now = self.clock()
+            self._evaluate_locked()
+            return {
+                "state": self.state,
+                "since_s": round(now - self.since, 6),
+                "reasons": list(self.reasons),
+                "shed_rate": round(self.shed_rate(), 4),
+                "open_breakers": self._open_breakers,
+                "breaker_trips": self._breaker_trips,
+                "watchdog_breaches": self._watchdog_breaches,
+            }

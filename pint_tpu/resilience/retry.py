@@ -19,6 +19,7 @@ clock); nothing here imports jax.
 
 from __future__ import annotations
 
+import threading
 import time
 
 import numpy as np
@@ -123,83 +124,97 @@ class CircuitBreaker:
         self.threshold = int(threshold)
         self.cooldown_s = float(cooldown_s)
         self.clock = clock
+        self._lock = threading.RLock()
         self._keys = {}  # key -> {consecutive, opened_at, trial}
         self.trips = 0
 
-    def _entry(self, key):
+    def _entry_locked(self, key):
+        # caller holds self._lock: the returned dict is live shared
+        # state, mutated in place by record_* / allow / trip
         return self._keys.setdefault(
             key, {"consecutive": 0, "opened_at": None, "trial": False})
 
     def state(self, key):
-        e = self._keys.get(key)
-        if e is None or e["opened_at"] is None:
-            return "closed"
-        if self.clock() - e["opened_at"] >= self.cooldown_s:
-            return "half_open"
-        return "open"
+        with self._lock:
+            e = self._keys.get(key)
+            if e is None or e["opened_at"] is None:
+                return "closed"
+            if self.clock() - e["opened_at"] >= self.cooldown_s:
+                return "half_open"
+            return "open"
 
     def allow(self, key):
         """May a request for ``key`` proceed right now? In half-open,
         only the first caller gets through (the trial); the rest stay
-        rejected until the trial reports."""
-        s = self.state(key)
-        if s == "closed":
-            return True
-        if s == "half_open":
-            e = self._entry(key)
-            if not e["trial"]:
-                e["trial"] = True
+        rejected until the trial reports. The trial claim is
+        check-then-set, so it must be atomic under the lock — without
+        it two racing submitters both get the half-open trial."""
+        with self._lock:
+            s = self.state(key)
+            if s == "closed":
                 return True
-        return False
+            if s == "half_open":
+                e = self._entry_locked(key)
+                if not e["trial"]:
+                    e["trial"] = True
+                    return True
+            return False
 
     def record_success(self, key):
-        e = self._entry(key)
-        e["consecutive"] = 0
-        e["opened_at"] = None
-        e["trial"] = False
+        with self._lock:
+            e = self._entry_locked(key)
+            e["consecutive"] = 0
+            e["opened_at"] = None
+            e["trial"] = False
 
     def record_failure(self, key):
         """Returns True when THIS failure trips the breaker open (the
         caller counts trips / notifies health)."""
-        e = self._entry(key)
-        e["consecutive"] += 1
-        if e["opened_at"] is not None:
-            # failed half-open trial: re-open with a fresh cooldown
-            e["opened_at"] = self.clock()
-            e["trial"] = False
+        with self._lock:
+            e = self._entry_locked(key)
+            e["consecutive"] += 1
+            if e["opened_at"] is not None:
+                # failed half-open trial: re-open with a fresh cooldown
+                e["opened_at"] = self.clock()
+                e["trial"] = False
+                return False
+            if e["consecutive"] >= self.threshold:
+                e["opened_at"] = self.clock()
+                e["trial"] = False
+                self.trips += 1
+                return True
             return False
-        if e["consecutive"] >= self.threshold:
-            e["opened_at"] = self.clock()
-            e["trial"] = False
-            self.trips += 1
-            return True
-        return False
 
     def trip(self, key):
         """Force the breaker open for ``key`` without a consecutive
         failure streak — used for contract violations like repeated
         unexpected recompiles. Returns True when this call newly
         opened the breaker."""
-        e = self._entry(key)
-        already_open = e["opened_at"] is not None
-        e["opened_at"] = self.clock()
-        e["trial"] = False
-        if not already_open:
-            self.trips += 1
-            return True
-        return False
+        with self._lock:
+            e = self._entry_locked(key)
+            already_open = e["opened_at"] is not None
+            e["opened_at"] = self.clock()
+            e["trial"] = False
+            if not already_open:
+                self.trips += 1
+                return True
+            return False
 
     def open_count(self):
-        return sum(1 for k in self._keys if self.state(k) != "closed")
+        with self._lock:
+            return sum(1 for k in self._keys if self.state(k) != "closed")
 
     def retry_after_s(self, key):
         """Seconds until ``key``'s cooldown elapses (0 when not open)."""
-        e = self._keys.get(key)
-        if e is None or e["opened_at"] is None:
-            return 0.0
-        return max(0.0, self.cooldown_s - (self.clock() - e["opened_at"]))
+        with self._lock:
+            e = self._keys.get(key)
+            if e is None or e["opened_at"] is None:
+                return 0.0
+            return max(0.0,
+                       self.cooldown_s - (self.clock() - e["opened_at"]))
 
     def snapshot(self):
         """JSON-safe counters for telemetry snapshots."""
-        return {"trips": self.trips, "open": self.open_count(),
-                "tracked_keys": len(self._keys)}
+        with self._lock:
+            return {"trips": self.trips, "open": self.open_count(),
+                    "tracked_keys": len(self._keys)}

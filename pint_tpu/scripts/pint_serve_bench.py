@@ -160,7 +160,9 @@ def run_serve_stream(n_requests=216, max_batch=8, max_latency_s=0.05,
             mine = np.asarray(r.value["x"])
             rel = np.max(np.abs(mine - off)
                          / np.maximum(np.abs(off), 1e-30))
-            worst = max(worst, float(rel))
+            # np.maximum propagates NaN; builtin max() would silently
+            # drop a NaN rel and report a clean worst-case
+            worst = float(np.maximum(worst, rel))
         report["max_param_rel_diff_vs_offline"] = worst
     return report
 
@@ -236,7 +238,7 @@ def run_chaos_stream(n_requests=216, fault_rate=0.05,
                                   1e-30))
         if not np.isfinite(rel) or rel > rel_tol:
             healthy_failures += 1
-        worst = max(worst, float(rel))
+        worst = float(np.maximum(worst, rel))
     counters = snap["counters"]
     report = {
         "n_requests": n_requests,

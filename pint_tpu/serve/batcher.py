@@ -18,6 +18,8 @@ in, which keeps flush-on-timer deterministic under test clocks.
 
 from __future__ import annotations
 
+import threading
+
 
 def pow2_bucket(n, floor=256):
     """Smallest power-of-two >= n, starting at ``floor`` (PTAFleet's
@@ -37,6 +39,7 @@ class MicroBatcher:
         self.max_batch = int(max_batch)
         self.max_latency_s = float(max_latency_s)
         self.bucket_floor = int(bucket_floor)
+        self._lock = threading.RLock()
         self._slots = {}  # key -> list[(request, result, t_submit)]
 
     def slot_key(self, request, routing):
@@ -52,24 +55,30 @@ class MicroBatcher:
 
     def depth(self):
         """Total queued requests across all slots."""
-        return sum(len(v) for v in self._slots.values())
+        with self._lock:
+            return sum(len(v) for v in self._slots.values())
 
     def admit(self, key, request, result, now):
         """Queue one request; True when the slot just reached
-        max_batch and must flush."""
-        entries = self._slots.setdefault(key, [])
-        entries.append((request, result, now))
-        return len(entries) >= self.max_batch
+        max_batch and must flush. Submitter threads race the engine's
+        flush loop on ``_slots``, hence the lock."""
+        with self._lock:
+            entries = self._slots.setdefault(key, [])
+            entries.append((request, result, now))
+            return len(entries) >= self.max_batch
 
     def due(self, now):
         """Slot keys whose OLDEST entry has waited >= max_latency_s
         (the latency timer fires per slot, oldest-first semantics)."""
-        return [k for k, v in self._slots.items()
-                if v and now - v[0][2] >= self.max_latency_s]
+        with self._lock:
+            return [k for k, v in self._slots.items()
+                    if v and now - v[0][2] >= self.max_latency_s]
 
     def take(self, key):
         """Remove and return a slot's queued entries."""
-        return self._slots.pop(key, [])
+        with self._lock:
+            return self._slots.pop(key, [])
 
     def pending_keys(self):
-        return [k for k, v in self._slots.items() if v]
+        with self._lock:
+            return [k for k, v in self._slots.items() if v]

@@ -572,6 +572,9 @@ class ServeEngine:
         live = [(request, res, submitted_at)]
         t0 = self.clock()
         try:
+            # deliberately unpadded: the spill path trades a per-shape
+            # compile for not inflating the shared bucket boundary
+            # pintlint: disable=serve-unpadded-batch
             pta = PTABatch([request.model], [request.toas],
                            mesh=self.mesh)
             pack_s = self.clock() - t0

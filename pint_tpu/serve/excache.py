@@ -15,14 +15,21 @@ f64 fallback a degraded mixed fit adds.
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 
 
 class ExecutableCache:
+    """Thread-safe: prewarm_concurrent inserts from worker threads
+    while the engine thread serves lookups, so every access to the
+    LRU map and its counters holds ``_lock`` (an RLock — prefill
+    re-enters through insert)."""
+
     def __init__(self, capacity=32):
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
         self.capacity = int(capacity)
+        self._lock = threading.RLock()
         self._entries = OrderedDict()  # key -> shared _fns table
         self.hits = 0
         self.misses = 0
@@ -30,21 +37,24 @@ class ExecutableCache:
         self.prefilled = 0
 
     def __len__(self):
-        return len(self._entries)
+        with self._lock:
+            return len(self._entries)
 
     def keys(self):
-        return list(self._entries)
+        with self._lock:
+            return list(self._entries)
 
     def lookup(self, key):
         """The fns table for key (LRU-refreshed) or None; counts
         hit/miss."""
-        fns = self._entries.get(key)
-        if fns is None:
-            self.misses += 1
-            return None
-        self._entries.move_to_end(key)
-        self.hits += 1
-        return fns
+        with self._lock:
+            fns = self._entries.get(key)
+            if fns is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return fns
 
     def insert(self, key, fns):
         """Insert (or refresh) an executable table, evicting
@@ -52,11 +62,12 @@ class ExecutableCache:
         drops the only strong reference to its compiled programs, so
         evicted XLA executables are actually freed, not just
         forgotten."""
-        self._entries[key] = fns
-        self._entries.move_to_end(key)
-        while len(self._entries) > self.capacity:
-            self._entries.popitem(last=False)
-            self.evictions += 1
+        with self._lock:
+            self._entries[key] = fns
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                self.evictions += 1
 
     def prefill(self, entries):
         """Warm-start bulk insert of (key, fns) pairs —
@@ -65,19 +76,23 @@ class ExecutableCache:
         traffic arrives. Returns the number of entries inserted and
         counts them in ``prefilled`` (separate from hit/miss so
         steady-state telemetry stays clean)."""
-        n = 0
-        for key, fns in entries:
-            self.insert(key, fns)
-            n += 1
-        self.prefilled += n
-        return n
+        with self._lock:
+            n = 0
+            for key, fns in entries:
+                self.insert(key, fns)
+                n += 1
+            self.prefilled += n
+            return n
 
     def reset_counters(self):
-        self.hits = self.misses = self.evictions = 0
+        with self._lock:
+            self.hits = self.misses = self.evictions = 0
 
     def counters(self):
-        total = self.hits + self.misses
-        return {"hits": self.hits, "misses": self.misses,
-                "evictions": self.evictions, "size": len(self._entries),
-                "prefilled": self.prefilled,
-                "hit_rate": (self.hits / total) if total else None}
+        with self._lock:
+            total = self.hits + self.misses
+            return {"hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions,
+                    "size": len(self._entries),
+                    "prefilled": self.prefilled,
+                    "hit_rate": (self.hits / total) if total else None}

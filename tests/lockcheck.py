@@ -1,0 +1,175 @@
+"""Runtime lock-discipline instrumentation — the dynamic counterpart
+of pintlint's static lock-discipline rule (pint_tpu/analysis/
+rules_locks.py).
+
+The static rule sees direct ``self.attr`` mutations; it cannot see a
+mutation through a local alias (``e = self._keys[k]; e["n"] += 1``) or
+prove that two threads actually interleave. This helper closes that
+gap at test time: instrument a shared class while a genuinely
+multi-threaded scenario runs (the fleet's pipelined fit, the serve
+engine's concurrent prewarm) and record every attribute rebind or
+dict mutation performed by a non-owner thread that does not hold the
+instance's RLock.
+
+Two mechanisms, composed by :func:`instrument`:
+
+- class-level ``__setattr__`` patching catches attribute REBINDS
+  (``self.hits += 1``, ``self._prep_pool = None``);
+- :class:`GuardedDict` / :class:`GuardedOrderedDict` wrap dict-valued
+  shared attributes so in-place mutations (``setdefault``, ``pop``,
+  ``move_to_end``) are checked too — these never go through
+  ``__setattr__``.
+
+A write is a violation only when BOTH hold: the writing thread is not
+the thread that constructed the instance (single-threaded setup code
+is fine unlocked), and the writer does not hold the lock (checked via
+RLock._is_owned()).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from contextlib import contextmanager
+
+
+class Violation:
+    __slots__ = ("cls", "attr", "op", "thread")
+
+    def __init__(self, cls, attr, op, thread):
+        self.cls = cls
+        self.attr = attr
+        self.op = op
+        self.thread = thread
+
+    def __repr__(self):
+        return (f"Violation({self.cls}.{self.attr} {self.op} "
+                f"from {self.thread!r})")
+
+
+def _lock_held(lock):
+    """True when the CURRENT thread holds ``lock`` (RLock only —
+    _is_owned is how threading.Condition itself checks)."""
+    is_owned = getattr(lock, "_is_owned", None)
+    return bool(is_owned()) if is_owned is not None else False
+
+
+def _unsynchronized(lock, owner_ident):
+    return (threading.get_ident() != owner_ident
+            and (lock is None or not _lock_held(lock)))
+
+
+class _GuardMixin:
+    """Mutator-checking mixin for dict types; reads stay unchecked
+    (the CPython dict read path is atomic enough for the monitored
+    structures, and checking reads would double the noise)."""
+
+    _MUTATORS = ("__setitem__", "__delitem__", "pop", "popitem",
+                 "setdefault", "update", "clear", "move_to_end")
+
+    def _bind_guard(self, label, lock, owner_ident, violations):
+        self._guard = (label, lock, owner_ident, violations)
+        return self
+
+    def _check(self, op):
+        guard = getattr(self, "_guard", None)
+        if guard is None:
+            return
+        label, lock, owner_ident, violations = guard
+        if _unsynchronized(lock, owner_ident):
+            cls, attr = label
+            violations.append(
+                Violation(cls, attr, op,
+                          threading.current_thread().name))
+
+
+def _checked(name):
+    def method(self, *args, **kwargs):
+        self._check(name)
+        return getattr(super(type(self), self), name)(*args, **kwargs)
+    method.__name__ = name
+    return method
+
+
+class GuardedDict(_GuardMixin, dict):
+    pass
+
+
+class GuardedOrderedDict(_GuardMixin, OrderedDict):
+    pass
+
+
+for _cls in (GuardedDict, GuardedOrderedDict):
+    for _m in _GuardMixin._MUTATORS:
+        if hasattr(_cls, _m):
+            setattr(_cls, _m, _checked(_m))
+
+
+@contextmanager
+def instrument(cls, violations, lock_attr="_lock", dict_attrs=(),
+               exempt=("clock", "_sleep"), instances=()):
+    """Patch ``cls`` so unsynchronized cross-thread writes are
+    recorded in ``violations`` (a list the caller owns).
+
+    ``dict_attrs`` names dict-valued shared attributes to wrap with
+    checked dicts on the given ``instances`` (and on any instance
+    constructed while the patch is active). ``exempt`` attributes are
+    never flagged. Restores the class on exit.
+    """
+    orig_setattr = cls.__setattr__
+    orig_init = cls.__init__
+    guard_key = "_lockcheck_owner"
+    wrapped = []
+
+    def _wrap_dicts(obj):
+        lock = obj.__dict__.get(lock_attr)
+        owner = obj.__dict__.get(guard_key, threading.get_ident())
+        for attr in dict_attrs:
+            cur = obj.__dict__.get(attr)
+            if cur is None or isinstance(cur, _GuardMixin):
+                continue
+            gcls = (GuardedOrderedDict if isinstance(cur, OrderedDict)
+                    else GuardedDict)
+            g = gcls(cur)._bind_guard((cls.__name__, attr), lock,
+                                      owner, violations)
+            obj.__dict__[attr] = g
+            wrapped.append((obj, attr, cur))
+
+    def patched_setattr(self, name, value):
+        d = self.__dict__
+        if guard_key not in d:
+            d[guard_key] = threading.get_ident()
+        lock = d.get(lock_attr)
+        if (name != lock_attr and name != guard_key
+                and name not in exempt
+                and lock_attr in d  # construction still in flight
+                and _unsynchronized(lock, d[guard_key])):
+            violations.append(
+                Violation(cls.__name__, name, "setattr",
+                          threading.current_thread().name))
+        orig_setattr(self, name, value)
+
+    def patched_init(self, *args, **kwargs):
+        orig_init(self, *args, **kwargs)
+        _wrap_dicts(self)
+
+    cls.__setattr__ = patched_setattr
+    cls.__init__ = patched_init
+    for obj in instances:
+        obj.__dict__.setdefault(guard_key, threading.get_ident())
+        _wrap_dicts(obj)
+    try:
+        yield violations
+    finally:
+        cls.__setattr__ = orig_setattr
+        cls.__init__ = orig_init
+        for obj, attr, cur in wrapped:
+            cur.clear()
+            cur.update(obj.__dict__[attr])
+            obj.__dict__[attr] = cur
+
+
+def assert_no_violations(violations):
+    assert not violations, (
+        "unsynchronized cross-thread writes detected:\n  "
+        + "\n  ".join(repr(v) for v in violations))

@@ -290,3 +290,94 @@ def test_fleet_pipeline_metrics_keys_finite():
         assert rep[key] is not None and np.isfinite(rep[key]), (key, rep)
     assert rep["fleet_pipeline_bitwise"] is True
     assert rep["fleet_buckets"] == 2
+
+
+# -- runtime lock discipline (lockcheck instrumented proxies) --------
+
+
+def test_lockcheck_detects_deliberate_violation():
+    """The instrumentation itself must fire: an attribute rebind and a
+    dict mutation from a foreign thread without the lock are both
+    recorded, and the same writes under the lock are not."""
+    import threading
+
+    from lockcheck import GuardedDict, instrument
+
+    class Shared:
+        def __init__(self):
+            self._lock = threading.RLock()
+            self.count = 0
+            self._slots = {}
+
+    s = Shared()
+    violations = []
+    with instrument(Shared, violations, dict_attrs=("_slots",),
+                    instances=[s]):
+        assert isinstance(s._slots, GuardedDict)
+
+        def unlocked():
+            s.count += 1
+            s._slots["k"] = 1
+
+        def locked():
+            with s._lock:
+                s.count += 1
+                s._slots["k2"] = 2
+
+        for fn in (unlocked, locked):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+    kinds = sorted((v.attr, v.op) for v in violations)
+    assert kinds == [("_slots", "__setitem__"), ("count", "setattr")]
+    # original dict restored with contents intact
+    assert not isinstance(s._slots, GuardedDict)
+    assert s._slots == {"k": 1, "k2": 2}
+
+
+def test_pipelined_fit_holds_fleet_lock():
+    """The pipelined executor's worker threads resolve deferred packs
+    and land them in fleet.batches; every such cross-thread write must
+    hold fleet._lock (PTAFleet._resolve's contract)."""
+    from lockcheck import assert_no_violations, instrument
+
+    from pint_tpu.parallel.pta import PTAFleet
+
+    violations = []
+    with instrument(PTAFleet, violations,
+                    dict_attrs=("batches", "_batch_futures")):
+        fleet = _mixed_fleet(pipeline=True)
+        _fit_arrays(fleet, method="auto", maxiter=3, pipeline=True)
+    assert_no_violations(violations)
+
+
+def test_prewarm_holds_cache_and_batcher_locks():
+    """Concurrent prewarm inserts into the ExecutableCache from worker
+    threads while submits queue through the MicroBatcher; every
+    cross-thread mutation of either must hold the owning _lock."""
+    import copy
+
+    from lockcheck import assert_no_violations, instrument
+
+    from pint_tpu.serve import FitRequest, ServeEngine
+    from pint_tpu.serve.batcher import MicroBatcher
+    from pint_tpu.serve.excache import ExecutableCache
+
+    (m0, t0), (m1, t1) = zip(*_spin_pulsars(2))
+    reqs = [FitRequest(copy.deepcopy(m0), t0, maxiter=3),
+            FitRequest(copy.deepcopy(m1), t1, maxiter=3)]
+    cache_violations = []
+    batcher_violations = []
+    with instrument(ExecutableCache, cache_violations,
+                    dict_attrs=("_entries",)), \
+            instrument(MicroBatcher, batcher_violations,
+                       dict_attrs=("_slots",)):
+        eng = ServeEngine(max_batch=2, max_latency_s=1e9,
+                          bucket_floor=32)
+        assert eng.prewarm_concurrent(reqs) >= 1
+        for r in reqs:
+            eng.submit(FitRequest(copy.deepcopy(r.model), r.toas,
+                                  maxiter=3))
+        eng.drain()
+    assert_no_violations(cache_violations)
+    assert_no_violations(batcher_violations)

@@ -279,3 +279,46 @@ def test_mixed_precision_via_downhill_and_wideband():
     assert l2 == pytest.approx(l1, rel=1e-8)
     with pytest.raises(ValueError, match="precision"):
         WidebandTOAFitter(t, get_model(par)).fit_toas(precision="bf16")
+
+
+def test_wideband_lm_mixed_noncontracting_preconditioner(monkeypatch):
+    """Regression for the WidebandLMFitter mixed path: when the f32
+    Gram fails to precondition a damped step (refinement relres above
+    tolerance), the fitter must warn and redo THAT step with the f64
+    Gram instead of silently keeping the unconverged update. Forced
+    here by patching gls_gram to return a non-contracting mixed Gram
+    (diagonal inflated by 10x the matrix scale, so refinement against
+    the true damped operator stalls at O(1) relative residual)."""
+    import jax.numpy as jnp
+
+    from pint_tpu import fitter as fit_mod
+    from pint_tpu.fitter import WidebandLMFitter
+
+    par = PAR + "RNAMP 1e-14\nRNIDX -3.0\nTNREDC 6\n"
+    m = get_model(par)
+    t = _toas(m, n=40, seed=2)
+    for fl in t.flags:
+        fl["pp_dm"] = "12.0"
+        fl["pp_dme"] = "1e-4"
+
+    c_64 = WidebandLMFitter(t, get_model(par)).fit_toas(maxiter=8)
+
+    real_gram = fit_mod.gls_gram
+    mixed_calls = {"n": 0}
+
+    def noncontracting_gram(Mn, q, precision="f64"):
+        A = real_gram(Mn, q, "f64")
+        if precision == "mixed":
+            mixed_calls["n"] += 1
+            return A + 10.0 * jnp.max(jnp.abs(A)) * jnp.eye(A.shape[0])
+        return A
+
+    monkeypatch.setattr(fit_mod, "gls_gram", noncontracting_gram)
+    with pytest.warns(UserWarning,
+                      match="mixed-precision LM refinement"):
+        c_mx = WidebandLMFitter(t, get_model(par)).fit_toas(
+            maxiter=8, precision="mixed")
+    assert mixed_calls["n"] >= 1  # the sabotaged path actually ran
+    # every sabotaged step fell back to the f64 Gram, so the fit
+    # matches the pure-f64 trajectory instead of quietly degrading
+    assert c_mx == pytest.approx(c_64, rel=1e-8)

@@ -1,0 +1,542 @@
+"""pintlint (pint_tpu/analysis): per-rule bad/good fixtures, the
+suppression grammar, the CLI contract, and the CI gate that keeps the
+whole tree at zero unsuppressed findings.
+
+The nan-guard bad fixtures are the three real bugs ADVICE.md round 5
+found in this codebase (np.max(relres) > tol at pta.py, float(rel) >
+tol at fitter.py, max(worst, float(rel)) at pint_serve_bench.py) —
+each rule is seeded from a failure that actually shipped.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from pint_tpu.analysis import (LintConfig, json_report, run,
+                               text_report, unsuppressed)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "pint_tpu")
+
+
+def lint(tmp_path, sources, config):
+    """Write {relpath: source} under tmp_path and lint the files."""
+    paths = []
+    for rel, src in sources.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+        paths.append(str(p))
+    return run(paths, config=config)
+
+
+def live(findings, rule):
+    return [f for f in unsuppressed(findings) if f.rule == rule]
+
+
+# -- nan-guard (seed fixtures: the three shipped bugs) ---------------
+
+
+NAN_CFG = LintConfig()
+
+
+def test_nan_guard_flags_gt_on_diagnostic(tmp_path):
+    bad = """
+        import numpy as np
+
+        def finalize(relres):
+            if np.max(relres) > 1e-8:  # the pta.py:937 bug
+                return "fallback"
+            return "ok"
+    """
+    fs = lint(tmp_path, {"m.py": bad}, NAN_CFG)
+    assert len(live(fs, "nan-guard")) == 1
+
+
+def test_nan_guard_flags_float_cast_gt(tmp_path):
+    bad = """
+        def check(rel_resid):
+            return float(rel_resid) > 1e-8  # the fitter.py bug
+    """
+    fs = lint(tmp_path, {"m.py": bad}, NAN_CFG)
+    assert len(live(fs, "nan-guard")) == 1
+
+
+def test_nan_guard_flags_builtin_max_fold(tmp_path):
+    bad = """
+        def worst_of(rels):
+            worst = 0.0
+            for rel in rels:
+                worst = max(worst, float(rel.relres))
+            return worst
+    """
+    fs = lint(tmp_path, {"m.py": bad}, NAN_CFG)
+    assert len(live(fs, "nan-guard")) == 1
+
+
+def test_nan_guard_quiet_on_sanctioned_forms(tmp_path):
+    good = """
+        import numpy as np
+
+        from pint_tpu.fitter import relres_failed
+
+        def finalize(relres):
+            if relres_failed(relres, tol=1e-8):
+                return "fallback"
+            return "ok"
+
+        def check(rel_resid):
+            return not np.all(rel_resid <= 1e-8)
+
+        def worst_of(rels):
+            worst = 0.0
+            for rel in rels:
+                worst = float(np.maximum(worst, rel.relres))
+            return worst
+    """
+    fs = lint(tmp_path, {"m.py": good}, NAN_CFG)
+    assert live(fs, "nan-guard") == []
+
+
+# -- f32-in-f64 ------------------------------------------------------
+
+
+F32_CFG = LintConfig(f64_critical={"crit.py": {"gls_whiten"}})
+
+
+def test_f32_in_f64_flags_astype(tmp_path):
+    bad = """
+        import jax.numpy as jnp
+
+        def gls_whiten(M, sigma):
+            Mw = (M / sigma[:, None]).astype(jnp.float32)
+            return Mw
+    """
+    fs = lint(tmp_path, {"crit.py": bad}, F32_CFG)
+    assert len(live(fs, "f32-in-f64")) == 1
+
+
+def test_f32_in_f64_quiet_outside_registry(tmp_path):
+    good = """
+        import jax.numpy as jnp
+
+        def gls_whiten(M, sigma):
+            return M / sigma[:, None]
+
+        def photon_kernel(x):
+            # not registered: deliberately-f32 kernels stay legal
+            return x.astype(jnp.float32)
+    """
+    fs = lint(tmp_path, {"crit.py": good}, F32_CFG)
+    assert live(fs, "f32-in-f64") == []
+
+
+# -- host-sync-in-jit ------------------------------------------------
+
+
+def test_host_sync_in_jit_flags_float(tmp_path):
+    bad = """
+        import jax
+
+        def fit_one(x):
+            return float(x) + 1.0
+
+        fit = jax.jit(fit_one)
+    """
+    fs = lint(tmp_path, {"m.py": bad}, LintConfig())
+    assert len(live(fs, "host-sync-in-jit")) == 1
+
+
+def test_host_sync_scoped_same_name_not_confused(tmp_path):
+    """A host-side closure sharing its name with a jitted function in
+    another scope must NOT be flagged (fitter.py has three distinct
+    chi2_of; only one is traced)."""
+    good = """
+        import jax
+
+        def device_side():
+            @jax.jit
+            def chi2_of(x):
+                return x * x
+            return chi2_of
+
+        def host_side(vals):
+            def chi2_of(x):
+                return float(x) * 2.0
+            return [chi2_of(v) for v in vals]
+    """
+    fs = lint(tmp_path, {"m.py": good}, LintConfig())
+    assert live(fs, "host-sync-in-jit") == []
+
+
+# -- static-unhashable -----------------------------------------------
+
+
+def test_static_unhashable_flags_list_literal(tmp_path):
+    bad = """
+        import jax
+
+        def solve(x, opts):
+            return x
+
+        solve = jax.jit(solve, static_argnames=("opts",))
+        y = solve(1.0, opts=["a", "b"])
+    """
+    fs = lint(tmp_path, {"m.py": bad}, LintConfig())
+    assert len(live(fs, "static-unhashable")) == 1
+
+
+def test_static_unhashable_quiet_on_tuple(tmp_path):
+    good = """
+        import jax
+
+        def solve(x, opts):
+            return x
+
+        solve = jax.jit(solve, static_argnames=("opts",))
+        y = solve(1.0, opts=("a", "b"))
+    """
+    fs = lint(tmp_path, {"m.py": good}, LintConfig())
+    assert live(fs, "static-unhashable") == []
+
+
+# -- serve-unpadded-batch --------------------------------------------
+
+
+SERVE_CFG = LintConfig(serve_pad_modules=("serve/",))
+
+
+def test_serve_unpadded_batch_flags_missing_pad(tmp_path):
+    bad = """
+        def flush(models, toas_list, bucket):
+            pta = PTABatch(models, toas_list)
+            return pta
+    """
+    fs = lint(tmp_path, {"serve/eng.py": bad}, SERVE_CFG)
+    assert len(live(fs, "serve-unpadded-batch")) == 1
+
+
+def test_serve_unpadded_batch_quiet_with_pad(tmp_path):
+    good = """
+        def flush(models, toas_list, bucket):
+            pta = PTABatch(models, toas_list, pad_toas=bucket)
+            return pta
+    """
+    fs = lint(tmp_path, {"serve/eng.py": good}, SERVE_CFG)
+    assert live(fs, "serve-unpadded-batch") == []
+
+
+# -- lock-discipline -------------------------------------------------
+
+
+LOCK_CFG = LintConfig(
+    locked_classes={"Cache": {"lock": "_lock", "attrs": None}},
+    locked_globals={"CACHE": "CACHE_LOCK"})
+
+
+def test_lock_discipline_flags_unlocked_mutations(tmp_path):
+    bad = """
+        import threading
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self.hits = 0
+                self._d = {}
+
+            def bump(self):
+                self.hits += 1
+
+            def put(self, key, value):
+                self._d[key] = value
+
+            def drop(self, key):
+                self._d.pop(key, None)
+    """
+    fs = lint(tmp_path, {"m.py": bad}, LOCK_CFG)
+    assert len(live(fs, "lock-discipline")) == 3
+
+
+def test_lock_discipline_quiet_under_lock(tmp_path):
+    good = """
+        import threading
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self.hits = 0
+                self._d = {}
+
+            def bump(self):
+                with self._lock:
+                    self.hits += 1
+
+            def put(self, key, value):
+                with self._lock:
+                    self._d[key] = value
+    """
+    fs = lint(tmp_path, {"m.py": good}, LOCK_CFG)
+    assert live(fs, "lock-discipline") == []
+
+
+def test_lock_discipline_module_global(tmp_path):
+    bad = """
+        import threading
+
+        CACHE = {}
+        CACHE_LOCK = threading.RLock()
+
+        def put(key, value):
+            CACHE[key] = value
+    """
+    good = """
+        import threading
+
+        CACHE = {}
+        CACHE_LOCK = threading.RLock()
+
+        def put(key, value):
+            with CACHE_LOCK:
+                CACHE[key] = value
+    """
+    assert len(live(lint(tmp_path, {"a/m.py": bad}, LOCK_CFG),
+                    "lock-discipline")) == 1
+    assert live(lint(tmp_path, {"b/m.py": good}, LOCK_CFG),
+                "lock-discipline") == []
+
+
+# -- locked-helper-call ----------------------------------------------
+
+
+def test_locked_helper_call_requires_lock(tmp_path):
+    bad = """
+        import threading
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self._d = {}
+
+            def _entry_locked(self, key):
+                return self._d.setdefault(key, {"n": 0})
+
+            def bump(self, key):
+                e = self._entry_locked(key)
+                e["n"] += 1
+    """
+    good = """
+        import threading
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self._d = {}
+
+            def _entry_locked(self, key):
+                return self._d.setdefault(key, {"n": 0})
+
+            def bump(self, key):
+                with self._lock:
+                    e = self._entry_locked(key)
+                    e["n"] += 1
+    """
+    assert len(live(lint(tmp_path, {"a/m.py": bad}, LOCK_CFG),
+                    "locked-helper-call")) == 1
+    assert live(lint(tmp_path, {"b/m.py": good}, LOCK_CFG),
+                "locked-helper-call") == []
+
+
+# -- fault-point coverage (both directions) --------------------------
+
+
+FAULT_REGISTRY = """
+    POINTS = ("toa_nan", "compile_fail")
+
+    def fire(point):
+        return point in POINTS
+"""
+
+
+def _fault_cfg():
+    return LintConfig(fault_registry_suffix="faultreg.py")
+
+
+def test_fault_point_unknown_flags_typo(tmp_path):
+    user = """
+        from faultreg import fire
+
+        def go():
+            fire("toa_nan")
+            fire("compile_fial")  # typo'd point: never fires
+            fire("compile_fail")
+    """
+    fs = lint(tmp_path, {"faultreg.py": FAULT_REGISTRY,
+                         "user.py": user}, _fault_cfg())
+    unknown = live(fs, "fault-point-unknown")
+    assert len(unknown) == 1 and "compile_fial" in unknown[0].message
+    assert live(fs, "fault-point-unfired") == []
+
+
+def test_fault_point_unfired_flags_dead_registry_entry(tmp_path):
+    user = """
+        from faultreg import fire
+
+        def go():
+            fire("toa_nan")
+    """
+    fs = lint(tmp_path, {"faultreg.py": FAULT_REGISTRY,
+                         "user.py": user}, _fault_cfg())
+    unfired = live(fs, "fault-point-unfired")
+    assert len(unfired) == 1 and "compile_fail" in unfired[0].message
+    assert live(fs, "fault-point-unknown") == []
+
+
+# -- timing-no-block -------------------------------------------------
+
+
+def test_timing_no_block_flags_async_window(tmp_path):
+    bad = """
+        import time
+
+        import jax
+
+        def bench():
+            def step(x):
+                return x * 2.0
+
+            g = jax.jit(step)
+            t0 = time.perf_counter()
+            out = g(1.0)  # async enqueue; nothing waits for the device
+            dt = time.perf_counter() - t0
+            return out, dt
+    """
+    fs = lint(tmp_path, {"m.py": bad}, LintConfig())
+    assert len(live(fs, "timing-no-block")) == 1
+
+
+def test_timing_no_block_quiet_with_block(tmp_path):
+    good = """
+        import time
+
+        import jax
+
+        def bench():
+            def step(x):
+                return x * 2.0
+
+            g = jax.jit(step)
+            t0 = time.perf_counter()
+            out = jax.block_until_ready(g(1.0))
+            dt = time.perf_counter() - t0
+            return out, dt
+    """
+    fs = lint(tmp_path, {"m.py": good}, LintConfig())
+    assert live(fs, "timing-no-block") == []
+
+
+# -- suppression grammar ---------------------------------------------
+
+
+def test_suppression_inline_next_line_and_file(tmp_path):
+    src = """
+        def a(relres):
+            return relres > 1e-8  # pintlint: disable=nan-guard
+
+        def b(relres):
+            # non-finite handled by the caller's isfinite gate
+            # pintlint: disable=nan-guard
+            return relres > 1e-8
+
+        def c(relres):
+            return relres > 1e-8
+    """
+    fs = lint(tmp_path, {"m.py": src}, NAN_CFG)
+    assert len(fs) == 3  # all three still REPORTED...
+    assert len(live(fs, "nan-guard")) == 1  # ...but only c counts
+    assert [f.suppressed for f in fs] == [True, True, False]
+
+    filewide = "# pintlint: disable-file=nan-guard\n" + textwrap.dedent(src)
+    p = tmp_path / "fw.py"
+    p.write_text(filewide)
+    fs2 = run([str(p)], config=NAN_CFG)
+    assert len(fs2) == 3 and unsuppressed(fs2) == []
+
+
+def test_suppression_all_wildcard(tmp_path):
+    src = """
+        def a(relres):
+            return relres > 1e-8  # pintlint: disable=all
+    """
+    fs = lint(tmp_path, {"m.py": src}, NAN_CFG)
+    assert len(fs) == 1 and unsuppressed(fs) == []
+
+
+# -- reporters + CLI -------------------------------------------------
+
+
+def test_reports_text_and_json(tmp_path):
+    src = """
+        def a(relres):
+            return relres > 1e-8
+    """
+    fs = lint(tmp_path, {"m.py": src}, NAN_CFG)
+    txt = text_report(fs)
+    assert "[nan-guard]" in txt and "1 finding(s)" in txt
+    payload = json.loads(json_report(fs))
+    assert payload["unsuppressed"] == 1
+    assert payload["counts_by_rule"] == {"nan-guard": 1}
+    assert payload["findings"][0]["rule"] == "nan-guard"
+
+
+def test_cli_exit_codes_and_list_rules(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(relres):\n    return relres > 1e-8\n")
+    good = tmp_path / "good.py"
+    good.write_text("def f(x):\n    return x\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    r = subprocess.run(
+        [sys.executable, "-m", "pint_tpu.analysis", str(bad),
+         "--format", "json"],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert r.returncode == 1, r.stderr
+    assert json.loads(r.stdout)["unsuppressed"] == 1
+
+    r = subprocess.run(
+        [sys.executable, "-m", "pint_tpu.analysis", str(good)],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert r.returncode == 0, r.stderr
+
+    r = subprocess.run(
+        [sys.executable, "-m", "pint_tpu.analysis", "--list-rules"],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert r.returncode == 0
+    for rule_id in ("nan-guard", "lock-discipline", "timing-no-block",
+                    "fault-point-unknown", "serve-unpadded-batch"):
+        assert rule_id in r.stdout
+
+
+# -- the CI gate -----------------------------------------------------
+
+
+def test_tree_has_zero_unsuppressed_findings():
+    """The acceptance criterion: pintlint over the whole package is
+    clean. Any new finding must be fixed or carry a justified
+    suppression comment — this test is the enforcement point."""
+    findings = run([PKG], config=LintConfig.default())
+    bad = unsuppressed(findings)
+    assert bad == [], text_report(findings)
+
+
+def test_tree_suppressions_stay_bounded():
+    """Suppressions are reviewed exceptions, not an escape hatch: the
+    count is pinned so silently suppressing a new finding class fails
+    here and forces a review of this test."""
+    findings = run([PKG], config=LintConfig.default())
+    suppressed = [f for f in findings if f.suppressed]
+    assert len(suppressed) <= 2, text_report(findings,
+                                             show_suppressed=True)

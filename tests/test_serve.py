@@ -297,3 +297,22 @@ def test_wideband_rejects_free_dmefac(cls):
     f = cls(t, m)
     with pytest.raises(ValueError, match="DMEFAC"):
         f.fit_toas(maxiter=2)
+
+
+@pytest.mark.parametrize("cls", [fitter.WidebandTOAFitter,
+                                 fitter.WidebandDownhillFitter,
+                                 fitter.WidebandLMFitter])
+def test_wideband_rejects_free_dmequad(cls):
+    """Freeing DMEQUAD must be rejected at every wideband entry point,
+    exactly like DMEFAC: the scaling is applied once at start-of-fit
+    values, so a "fitted" DMEQUAD would silently report its input."""
+    m = get_model(PAR.format(i=6) + "DMEQUAD -all 1 0.5 1\n")
+    mjds = np.linspace(55000, 55600, 30)
+    t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=1400.0,
+                                obs="gbt", add_noise=True, seed=6)
+    for fl in t.flags:
+        fl["pp_dm"] = "12.5001"
+        fl["pp_dme"] = "1e-4"
+    f = cls(t, m)
+    with pytest.raises(ValueError, match="DMEQUAD"):
+        f.fit_toas(maxiter=2)
