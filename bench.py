@@ -96,7 +96,32 @@ def _ragged_counts(n_psr=68, total=670_000, seed=7):
 # chip's headline throughput does this science workload extract",
 # which is the honest denominator for a correctness-bound emulated-f64
 # pipeline. BASELINE.md carries the full accounting model.
-PEAK_FLOPS = {"tpu": 1.97e14}
+#
+# The CPU entry is a nominal vector-f64 peak: cores x 2.5 GHz x 16
+# f64 FLOP/cycle (one AVX-512 FMA per cycle, or two AVX2 FMAs —
+# the same number either way). It is an order-of-magnitude
+# denominator so CPU rounds report a real gls_mfu_pct instead of
+# null; machines that know better set PINT_TPU_PEAK_FLOPS (a float,
+# FLOP/s) which overrides the table for every platform.
+
+
+def _cpu_peak_flops():
+    return (os.cpu_count() or 1) * 2.5e9 * 16
+
+
+PEAK_FLOPS = {"tpu": 1.97e14, "cpu": _cpu_peak_flops()}
+
+
+def _peak_flops(platform):
+    """MFU denominator for ``platform``: the PINT_TPU_PEAK_FLOPS env
+    override when set (and parseable), else the PEAK_FLOPS table."""
+    env = os.environ.get("PINT_TPU_PEAK_FLOPS")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass  # fall through to the table rather than die mid-bench
+    return PEAK_FLOPS.get(platform)
 
 # Dense-system column count of the bench GLS workload: 1 offset column
 # + 3 free params (F0, F1, DM — fixed by build_batch's par) + 2*30
@@ -120,9 +145,9 @@ def gls_model_flops(counts, maxiter=2, k=K_DENSE):
 
 
 def _mfu(flops, wall_s, platform):
-    """Model FLOPs utilization [%] against PEAK_FLOPS, or None when
-    the platform has no recorded peak (CPU) or flops are unknown."""
-    peak = PEAK_FLOPS.get(platform)
+    """Model FLOPs utilization [%] against _peak_flops, or None when
+    the platform has no recorded peak or flops are unknown."""
+    peak = _peak_flops(platform)
     if not flops or not wall_s or not peak:
         return None
     return round(100.0 * flops / wall_s / peak, 4)
@@ -167,13 +192,12 @@ def _reexec_cpu(reason):
 def _full_scale_stage(meta):
     """Measured (not projected) full-scale north star: 68 pulsars at
     ragged realistic TOA counts totaling ~670k, full GLS refit
-    wall-clock. Bucketing is platform-dependent (pow2's 6 programs
-    where compiles are cheap (CPU); the DP-optimal 2-program split2 on
-    TPU — see the bucket_mode comment below). The expensive host pack
-    is cached per mode in .bench_cache/ (pickle of PTABatch.pack_state
-    per bucket; the pow2, none, and split2 packs are pre-seeded by
-    builder runs on this machine) so driver re-runs only pay device
-    time."""
+    wall-clock. Bucketing is platform-dependent (the cost-model shape
+    planner's segment-packed layout where compiles are cheap (CPU);
+    the DP-optimal 2-program split2 on TPU — see the bucket_mode
+    comment below). The expensive host pack is cached per mode in
+    .bench_cache/ (pickle of PTABatch.pack_state per bucket) so
+    driver re-runs only pay device time."""
     import pickle
 
     import jax
@@ -182,17 +206,20 @@ def _full_scale_stage(meta):
     from pint_tpu.parallel import PTABatch, PTAFleet
 
     counts = _ragged_counts()
-    # bucket mode: pow2 (6 compiled programs, padding x1.37) is right
-    # where compiles are cheap (CPU); on the tunneled TPU each compile
-    # is wedge exposure (the r03 6-program marathon wedged the relay),
-    # so default to the optimal TWO-program split (padding x1.61 vs
-    # the r03 one-program x3.05 — PTAFleet.optimal_split_bounds DP).
-    # Override: PINT_TPU_BENCH_FULL_BUCKET = pow2 | none | split<k>.
+    # bucket mode: the shape planner (parallel/shapeplan.py) packs
+    # small pulsars into shared rows and optimizes the width ladder
+    # under a compile budget — padding x1.09 in <= 4 programs vs
+    # pow2's x1.37 in 6 — and is the default where compiles are cheap
+    # (CPU). On the tunneled TPU each compile is wedge exposure (the
+    # r03 6-program marathon wedged the relay), so default to the
+    # optimal TWO-program split (padding x1.61 vs the r03 one-program
+    # x3.05 — PTAFleet.optimal_split_bounds DP).
+    # Override: PINT_TPU_BENCH_FULL_BUCKET = plan | pow2 | none | split<k>.
     platform = jax.devices()[0].platform
-    default_mode = "split2" if platform == "tpu" else "pow2"
+    default_mode = "split2" if platform == "tpu" else "plan"
     bucket_mode = os.environ.get("PINT_TPU_BENCH_FULL_BUCKET",
                                  default_mode).strip().lower()
-    valid = (bucket_mode in ("pow2", "none")
+    valid = (bucket_mode in ("pow2", "none", "plan")
              or (bucket_mode.startswith("split")
                  and bucket_mode[5:].isdigit() and int(bucket_mode[5:]) > 0))
     if not valid:
@@ -204,22 +231,53 @@ def _full_scale_stage(meta):
     toa_bucket = None if bucket_mode == "none" else bucket_mode
     cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              ".bench_cache")
-    cache_path = os.path.join(
-        cache_dir, "full670k_v1.pkl" if bucket_mode == "pow2"
-        else f"full670k_{bucket_mode}_v1.pkl")
-    states = None
-    if os.path.exists(cache_path):
+
+    def _mode_cache_path(mode):
+        return os.path.join(
+            cache_dir, "full670k_v1.pkl" if mode == "pow2"
+            else f"full670k_{mode}_v1.pkl")
+
+    def _load_entries(path):
+        """Tolerant pack-cache reader -> [(par, idxs_or_None, state)]
+        or None. New caches store "entries" with per-bucket pulsar
+        indices; old ones store "states" without (idxs=None)."""
+        if not os.path.exists(path):
+            return None
         try:
-            t0 = time.time()
-            with open(cache_path, "rb") as fh:
+            with open(path, "rb") as fh:
                 payload = pickle.load(fh)
-            if payload.get("counts") == counts.tolist():
-                states = payload["states"]
-                _stage(f"full-scale pack cache hit "
-                       f"({time.time() - t0:.1f}s load)")
+            if payload.get("counts") != counts.tolist():
+                return None
+            if "entries" in payload:
+                return payload["entries"]
+            return [(par, None, st) for par, st in payload["states"]]
         except Exception as e:
             _stage(f"full-scale pack cache unreadable ({e}); rebuilding")
-    if states is None:
+            return None
+
+    def _write_entries(path, entries):
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+            with open(path + ".tmp", "wb") as fh:
+                pickle.dump({"counts": counts.tolist(),
+                             "entries": entries}, fh, protocol=4)
+            os.replace(path + ".tmp", path)
+        except Exception as e:
+            _stage(f"full-scale pack cache write failed ({e}); continuing")
+
+    def _fleet_entries(fleet, models):
+        return [(models[idxs[0]].as_parfile(), list(idxs), b.pack_state())
+                for (key, idxs), b in zip(fleet.group_indices.items(),
+                                          fleet.batches.values())]
+
+    cache_path = _mode_cache_path(bucket_mode)
+    t0 = time.time()
+    entries = _load_entries(cache_path)
+    if entries is not None:
+        _stage(f"full-scale pack cache hit "
+               f"({time.time() - t0:.1f}s load)")
+    models = toas_list = None
+    if entries is None:
         _stage(f"full-scale host prep: 68 ragged pulsars, "
                f"{counts.sum()} TOAs (~minutes, cached afterwards)")
         t0 = time.time()
@@ -256,24 +314,16 @@ def _full_scale_stage(meta):
         pack_s = time.time() - t0
         _stage(f"packed {len(fleet.batches)} buckets ({pack_s:.0f}s, "
                f"padding x{fleet.padding_ratio:.2f}); caching pack")
-        states = [(models[idxs[0]].as_parfile(), b.pack_state())
-                  for (key, idxs), b in zip(fleet.group_indices.items(),
-                                            fleet.batches.values())]
-        try:
-            os.makedirs(cache_dir, exist_ok=True)
-            with open(cache_path + ".tmp", "wb") as fh:
-                pickle.dump({"counts": counts.tolist(), "states": states},
-                            fh, protocol=4)
-            os.replace(cache_path + ".tmp", cache_path)
-        except Exception as e:
-            _stage(f"full-scale pack cache write failed ({e}); continuing")
+        entries = _fleet_entries(fleet, models)
+        _write_entries(cache_path, entries)
         batches = list(fleet.batches.values())
         rebuild_s = pack_s
     else:
         t0 = time.time()
         batches = [PTABatch.from_packed(get_model(par), st)
-                   for par, st in states]
+                   for par, _, st in entries]
         rebuild_s = time.time() - t0
+    bucket_idxs = [idxs for _, idxs, _ in entries]
     # actually-packed count, not counts.sum(): epoch clustering floors
     # each pulsar to a multiple of 4 TOAs
     real_toas = int(sum(int(np.sum(b.n_toas)) for b in batches))
@@ -337,7 +387,7 @@ def _full_scale_stage(meta):
     try:
         t0 = time.time()
         batches2 = [PTABatch.from_packed(get_model(par), st)
-                    for par, st in states]
+                    for par, _, st in entries]
         fleet_aot_compile(
             [(b, {"method": "gls", "maxiter": 2}) for b in batches2])
         for b in batches2:
@@ -349,6 +399,126 @@ def _full_scale_stage(meta):
                f"({type(e).__name__}: {e}); cold numbers unaffected")
     finite = all(np.isfinite(c).all() for c in chi2s)
     platform = jax.devices()[0].platform
+    # shape-plan accounting + planned-vs-pow2 head-to-head (plan mode
+    # only). The pow2 leg reuses its own pack cache (or the host prep
+    # built this run) and costs ~30s of compile+refit on CPU — cheap
+    # next to the one-time host prep, and it yields both the refit
+    # speedup AND the packed-vs-per-lane param agreement check.
+    plan_meta = {
+        "measured_670k_plan_n_programs": None,
+        "measured_670k_plan_widths": None,
+        "measured_670k_plan_padding_ratio": None,
+        "measured_670k_plan_compile_s": None,
+        "measured_670k_plan_signature": None,
+        "measured_670k_pow2_refit_s": None,
+        "measured_670k_pow2_compile_s": None,
+        "measured_670k_pow2_padding_ratio": None,
+        "measured_670k_plan_vs_pow2_refit_speedup": None,
+        "measured_670k_plan_vs_pow2_max_param_rel": None,
+    }
+    if bucket_mode == "plan":
+        from pint_tpu.parallel.shapeplan import plan_shapes
+
+        # reproduce the fleet's plan from the ACTUAL packed counts
+        # (epoch clustering floors each pulsar to a multiple of 4, so
+        # the requested counts would plan slightly differently)
+        plan = None
+        if all(ix is not None for ix in bucket_idxs):
+            actual = np.zeros(sum(len(ix) for ix in bucket_idxs), int)
+            for ix, b in zip(bucket_idxs, batches):
+                actual[np.asarray(ix)] = np.asarray(b.n_toas, int)
+            plan = plan_shapes(actual.tolist())
+        plan_meta.update({
+            "measured_670k_plan_n_programs": len(batches),
+            "measured_670k_plan_widths": sorted(
+                {int(b.batch.tdb_sec.shape[1]) for b in batches}),
+            "measured_670k_plan_padding_ratio": round(
+                padded / real_toas, 4),
+            "measured_670k_plan_compile_s": round(compile_s, 2),
+            "measured_670k_plan_signature": (plan.signature()
+                                             if plan else None),
+        })
+        if os.environ.get("PINT_TPU_BENCH_PLAN_COMPARE", "1") == "1":
+            pow2_path = _mode_cache_path("pow2")
+            pow2_entries = _load_entries(pow2_path)
+            if pow2_entries is None and models is not None:
+                _stage("plan-vs-pow2: packing the pow2 reference fleet")
+                fleet_p = PTAFleet(models, toas_list, toa_bucket="pow2")
+                pow2_entries = _fleet_entries(fleet_p, models)
+                _write_entries(pow2_path, pow2_entries)
+            if pow2_entries is None:
+                _stage("plan-vs-pow2 comparison skipped (no pow2 pack "
+                       "cache and host prep not rebuilt this run)")
+            else:
+                try:
+                    _stage("plan-vs-pow2: compiling + refitting the "
+                           "pow2 ladder")
+                    pow2_batches = [PTABatch.from_packed(get_model(p), st)
+                                    for p, _, st in pow2_entries]
+                    t0 = time.time()
+                    fleet_aot_compile(
+                        [(b, {"method": "gls", "maxiter": 2})
+                         for b in pow2_batches])
+                    for b in pow2_batches:
+                        b.gls_fit(maxiter=2)
+                    pow2_compile_s = time.time() - t0
+                    t0 = time.time()
+                    xps = []
+                    for b in pow2_batches:
+                        xp_, cp_, _ = b.gls_fit(maxiter=2)
+                        xps.append(np.asarray(xp_))
+                    pow2_refit_s = time.time() - t0
+                    p_real = sum(int(np.sum(b.n_toas))
+                                 for b in pow2_batches)
+                    p_pad = sum(int(b.batch.tdb_sec.shape[0]
+                                    * b.batch.tdb_sec.shape[1])
+                                for b in pow2_batches)
+                    maxrel = None
+                    pow2_idxs = [ix for _, ix, _ in pow2_entries]
+                    if (all(ix is not None for ix in bucket_idxs)
+                            and all(ix is not None for ix in pow2_idxs)):
+                        xa, xb = {}, {}
+                        for ix, x in zip(bucket_idxs, x64s):
+                            for j, i in enumerate(ix):
+                                xa[i] = x[j]
+                        for ix, x in zip(pow2_idxs, xps):
+                            for j, i in enumerate(ix):
+                                xb[i] = x[j]
+                        # per-pulsar rel error, elementwise but with the
+                        # denominator floored at ulp-of-the-vector-scale:
+                        # a converged-to-zero offset (|value| ~1e-16,
+                        # |diff| ~1e-30) would otherwise report ulps of
+                        # zero instead of agreement
+                        maxrel = float(max(
+                            np.max(np.abs(xa[i] - xb[i])
+                                   / np.maximum(
+                                       np.abs(xb[i]),
+                                       np.finfo(np.float64).eps
+                                       * np.max(np.abs(xb[i]))))
+                            for i in xa))
+                    plan_meta.update({
+                        "measured_670k_pow2_refit_s": round(
+                            pow2_refit_s, 3),
+                        "measured_670k_pow2_compile_s": round(
+                            pow2_compile_s, 2),
+                        "measured_670k_pow2_padding_ratio": round(
+                            p_pad / p_real, 4),
+                        "measured_670k_plan_vs_pow2_refit_speedup": round(
+                            pow2_refit_s / refit_s, 3),
+                        "measured_670k_plan_vs_pow2_max_param_rel":
+                            maxrel,
+                    })
+                    _stage(f"plan-vs-pow2: refit {refit_s:.2f}s vs "
+                           f"{pow2_refit_s:.2f}s (x"
+                           f"{pow2_refit_s / refit_s:.2f}), padding "
+                           f"x{padded / real_toas:.3f} vs "
+                           f"x{p_pad / p_real:.3f}, max param rel "
+                           f"{maxrel}")
+                    del pow2_batches
+                except Exception as e:
+                    _stage(f"plan-vs-pow2 comparison failed "
+                           f"({type(e).__name__}: {e}); plan numbers "
+                           "unaffected")
     # full-scale MIXED precision: measured only where it can win (TPU
     # MXU; on CPU the f32 Gram is a wash — BASELINE.md r5) unless
     # explicitly forced; costs len(batches) extra compiles, which
@@ -454,6 +624,7 @@ def _full_scale_stage(meta):
         "measured_670k_all_finite": finite,
         "measured_670k_platform": platform,
     })
+    meta.update(plan_meta)
     # snapshot ORDER matters: the worker publishes max_rel, fell_back,
     # then refit_s last — reading refit_s FIRST means a non-None value
     # guarantees the other two are its coherent partners (a late-
@@ -892,6 +1063,12 @@ def main():
         "n_pulsars": n_psr, "n_toas_per_pulsar": n_toa,
         "devices": n_dev,
         "noise": "EFAC+EQUAD+ECORR+PLRedNoise(30 harm)",
+        # first-class shape accounting: the full-scale stage's padded
+        # FLOP ratio and (plan mode) compiled-program count, promoted
+        # out of the measured_670k_* block for dashboards
+        "padding_ratio": full_meta.get("measured_670k_padding_ratio"),
+        "plan_n_programs": full_meta.get(
+            "measured_670k_plan_n_programs"),
         "host_prep_s": round(host_prep_s, 2), "pack_s": round(pack_s, 2),
         "gls_compile_s": round(gls_compile_s, 2),
         "gls_trace_s": gls_aot["trace_s"],
@@ -921,7 +1098,7 @@ def main():
         "wls_refit_wall_s": round(wls_refit_s, 4),
         "wls_refit_median_s": round(wls_stats["median"], 4),
         "wls_toas_per_sec": round(total_toas / wls_refit_s, 1),
-        "peak_flops_assumed": PEAK_FLOPS.get(platform),
+        "peak_flops_assumed": _peak_flops(platform),
         "htest_4M_photons_s": (round(htest_done_s, 4)
                                if htest_done_s is not None else None),
         "htest_photons_per_sec": (round(n_ph / htest_done_s, 0)

@@ -127,12 +127,81 @@ def _fleet_pipeline_workload(n_psr, n_toas):
     return report
 
 
+def _shapeplan_workload(n_psr, n_toas):
+    """Planned (segment-packed) fleet vs the pow2 ladder on a ragged
+    noise fleet: reports padding ratios, compiled-program counts, and
+    warm GLS refit walls for both layouts, and asserts the packed
+    params match the per-lane pow2 fit to <= 1e-13 relative."""
+    import warnings
+
+    warnings.simplefilter("ignore")
+    from pint_tpu.models import get_model
+    from pint_tpu.parallel import PTAFleet
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    rng = np.random.default_rng(11)
+    counts = np.linspace(max(16, n_toas // 6), n_toas, n_psr).astype(int)
+    models, toas_list = [], []
+    for i, n in enumerate(counts):
+        par = (f"PSR SP{i}\nRAJ 10:{i % 60:02d}:00.0\nDECJ 5:00:00.0\n"
+               f"F0 {200 + i}.5 1\nF1 -3e-16 1\nPEPOCH 55500\n"
+               f"DM {10 + i % 7}.2 1\n"
+               "EFAC -f L-wide 1.1\nEQUAD -f L-wide 0.4\n"
+               "ECORR -f L-wide 0.8\n"
+               "RNAMP 1e-14\nRNIDX -3.1\nTNREDC 8\n")
+        m = get_model(par)
+        n_ep = max(1, int(n) // 4)
+        days = np.sort(rng.uniform(54200, 56800, n_ep))
+        mjds = np.concatenate(
+            [d + np.arange(4) * 0.5 / 86400.0 for d in days])[:int(n)]
+        t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0,
+                                    freq_mhz=1400.0, obs="gbt",
+                                    add_noise=False, iterations=0)
+        for f in t.flags:
+            f["f"] = "L-wide"
+        models.append(m)
+        toas_list.append(t)
+
+    report = {}
+    fits = {}
+    for mode, kw in (("plan", {"toa_bucket": "plan", "plan_quantum": 32,
+                               "plan_max_pack": 4,
+                               "plan_compile_budget": 2,
+                               "plan_min_width": 64}),
+                     ("pow2", {"toa_bucket": "pow2",
+                               "bucket_floor": 64})):
+        fleet = PTAFleet(models, toas_list, **kw)
+        t0 = time.perf_counter()
+        xs, chi2, _ = fleet.fit(method="gls", maxiter=2)
+        cold_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        xs, chi2, _ = fleet.fit(method="gls", maxiter=2)
+        refit_s = time.perf_counter() - t0
+        fits[mode] = [np.asarray(x) for x in xs]
+        report.update({
+            f"{mode}_padding_ratio": round(fleet.padding_ratio, 4),
+            f"{mode}_n_programs": len(fleet.batches),
+            f"{mode}_cold_fit_s": round(cold_s, 3),
+            f"{mode}_refit_s": round(refit_s, 4),
+        })
+    maxrel = max(
+        float(np.max(np.abs(a - b) / np.maximum(np.abs(b), 1e-300)))
+        for a, b in zip(fits["plan"], fits["pow2"]))
+    report["max_param_rel_plan_vs_pow2"] = maxrel
+    assert maxrel <= 1e-13, \
+        f"packed fit diverged from the per-lane pow2 fit: {maxrel:.3e}"
+    assert report["plan_padding_ratio"] <= report["pow2_padding_ratio"], \
+        "the planner padded worse than the pow2 ladder it replaces"
+    return report
+
+
 def main(argv=None):
     import jax
 
     p = argparse.ArgumentParser()
     p.add_argument("--workload", choices=("wls", "pta", "serve",
-                                          "chaos", "fleet_pipeline"),
+                                          "chaos", "fleet_pipeline",
+                                          "shapeplan"),
                    default="wls")
     p.add_argument("--n-toas", type=int, default=5000)
     p.add_argument("--n-psr", type=int, default=8)
@@ -145,6 +214,15 @@ def main(argv=None):
                    help="injection rate for --workload chaos")
     p.add_argument("--trace", help="jax.profiler trace output dir")
     args = p.parse_args(argv)
+
+    if args.workload == "shapeplan":
+        t0 = time.perf_counter()
+        report = _shapeplan_workload(args.n_psr, args.n_toas)
+        report.update({"workload": "shapeplan",
+                       "platform": jax.default_backend(),
+                       "wall_s": round(time.perf_counter() - t0, 3)})
+        print(json.dumps(report, default=float))
+        return 0
 
     if args.workload == "fleet_pipeline":
         t0 = time.perf_counter()

@@ -91,6 +91,18 @@ HOST_SYNC_METHODS = frozenset({"item", "tolist", "block_until_ready"})
 # a slot must present identical shapes to the executable cache.
 SERVE_PAD_MODULES = ("pint_tpu/serve/",)
 
+# -- bucket shapes -----------------------------------------------------
+
+# Call names that pick a legacy pow2 bucket width directly.
+BUCKET_CALLS = frozenset({"pow2_bucket"})
+
+# Modules (path suffixes) allowed to call them: the canonical
+# implementation (serve/batcher.py) and the shape planner's sanctioned
+# wrapper (parallel/shapeplan.py::pow2_width). Everything else must
+# route bucket-shape decisions through the planner so the padded-FLOP
+# cost model stays in one place.
+BUCKET_ALLOWED_MODULES = ("parallel/shapeplan.py", "serve/batcher.py")
+
 # -- fault injection ---------------------------------------------------
 
 # Call names whose first string argument must be a registered fault
@@ -139,6 +151,7 @@ class LintConfig:
     locked_class_exempt_attrs: frozenset = LOCKED_CLASS_EXEMPT_ATTRS
     locked_globals: dict = field(default_factory=dict)
     serve_pad_modules: tuple = ()
+    bucket_allowed_modules: tuple = ()
     fault_points: tuple = None  # None -> parse from the registry file
     fault_registry_suffix: str = FAULT_REGISTRY_SUFFIX
     nan_diag_pattern: str = NAN_DIAG_PATTERN
@@ -148,4 +161,5 @@ class LintConfig:
         return cls(f64_critical=dict(F64_CRITICAL),
                    locked_classes=dict(LOCKED_CLASSES),
                    locked_globals=dict(LOCKED_GLOBALS),
-                   serve_pad_modules=SERVE_PAD_MODULES)
+                   serve_pad_modules=SERVE_PAD_MODULES,
+                   bucket_allowed_modules=BUCKET_ALLOWED_MODULES)

@@ -607,6 +607,56 @@ def gls_whiten(Mfull, sigma, sqrt_phi_inv):
     return Mn, norm, sqrt_phi_inv / norm
 
 
+def seg_column_norms(Mw, seg_id, n_seg):
+    """Per-segment exponent-range-safe L2 column norms, (n_seg, k).
+
+    The packed ragged layout (parallel/shapeplan.py) concatenates
+    several pulsars into one padded row; each pulsar's columns must be
+    normalized by ITS OWN rows only, or the normalization would leak
+    scale across pulsars. Same peak-scaling trick as column_norms,
+    with the max/sum reductions keyed by segment id."""
+    import jax
+    import jax.numpy as jnp
+
+    amax = jax.ops.segment_max(jnp.abs(Mw), seg_id, num_segments=n_seg)
+    # empty segments reduce to -inf; zero columns to 0 — both guard to 1
+    amax = jnp.where(amax > 0, amax, 1.0)
+    ssq = jax.ops.segment_sum(jnp.square(Mw / amax[seg_id]), seg_id,
+                              num_segments=n_seg)
+    n = jnp.sqrt(ssq)
+    return amax * jnp.where(n == 0, 1.0, n)
+
+
+def seg_gls_whiten(Mfull, sigma, sqrt_phi_inv, seg_id, n_seg):
+    """Segment-masked gls_whiten: (Mn, norm, q) where norm/q are
+    (n_seg, k) and each row is normalized by its own segment's norms.
+    Mirrors gls_whiten exactly when n_seg == 1."""
+    import jax.numpy as jnp
+
+    Mw = Mfull / sigma[:, None]
+    norm = jnp.hypot(seg_column_norms(Mw, seg_id, n_seg), sqrt_phi_inv)
+    Mn = Mw / norm[seg_id]
+    return Mn, norm, sqrt_phi_inv / norm
+
+
+def seg_gls_gram(Mn, q, block_seg, n_seg, block, precision="f64"):
+    """Segment-masked gls_gram: per-segment normal matrices
+    A_s = sum_{rows of s} Mn^T Mn + diag(q_s^2), shape (n_seg, k, k).
+
+    Rows must be block-aligned per segment (``block_seg`` gives the
+    segment id of each ``block``-row chunk — the shapeplan packed
+    layout guarantees alignment); the block factorization keeps the
+    intermediate ~block-fold smaller than a per-TOA outer-product
+    segment_sum (see kernels/seggram.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .kernels.seggram import segment_gram
+
+    A = segment_gram(Mn, block_seg, n_seg, block, precision=precision)
+    return A + jax.vmap(jnp.diag)(q * q)
+
+
 def gls_solve(Mfull, r, sigma, sqrt_phi_inv, threshold=1e-12,
               precision="f64"):
     """Whitened, column-normalized, prior-weighted normal-equation

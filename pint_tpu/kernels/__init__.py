@@ -7,3 +7,5 @@ materialize-then-reduce; everything else fuses fine.)
 
 from .harmonics import (harmonic_sums, harmonic_sums_jnp,  # noqa: F401
                         harmonic_sums_pallas)
+from .seggram import (segment_gram, segment_gram_jnp,  # noqa: F401
+                      segment_gram_pallas)

@@ -1,0 +1,126 @@
+"""Segment-summed Gram matrices for packed ragged batches.
+
+The shape-plan packed path (parallel/shapeplan.py, parallel/pta.py)
+concatenates several pulsars into one padded row, each occupying a
+contiguous quantum-aligned *segment* of blocks. The GLS normal matrix
+must then be accumulated per segment:
+
+    A_s = sum_{t in segment s} M[t]^T M[t]        (K x K per segment)
+
+A naive per-TOA ``segment_sum`` of outer products materializes an
+(n, K, K) intermediate — ~1 GB at the 670k scale. Because segments
+are block-aligned, the sum factorizes: reshape rows into (n/Q, Q, K)
+blocks, take one (Q, K)^T (Q, K) matmul per block (the same FLOPs as
+the unsegmented Gram), and segment-sum the (n/Q, K, K) block Grams —
+a ~Q-fold smaller intermediate.
+
+Dual path mirroring kernels/harmonics.py: a jnp reference (f64, used
+by the packed GLS fit — bitwise determinism matters there) and a
+Pallas TPU kernel that streams blocks HBM -> VMEM and feeds the MXU
+directly (f32; for mixed-precision Gram work on TPU where the fit
+already tolerates f32 block products). ``segment_gram`` dispatches;
+non-TPU backends and f64 calls always take the jnp path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+_LANE = 128  # MXU/VPU lane width: K tiles round up to this
+
+
+def block_grams_jnp(x, block):
+    """(n, K) rows -> (n/block, K, K) per-block Grams, f64."""
+    import jax.numpy as jnp
+
+    x = jnp.asarray(x)
+    n, k = x.shape
+    nb = n // block
+    xb = x.reshape(nb, block, k)
+    return jnp.einsum("nbk,nbl->nkl", xb, xb)
+
+
+def segment_gram_jnp(x, block_seg, n_seg, block):
+    """Reference path: per-segment Grams via block factorization.
+
+    x: (n, K) rows, n a multiple of ``block``.
+    block_seg: (n/block,) int segment id per block.
+    Returns (n_seg, K, K) in x's dtype (f64 in the packed fit).
+    """
+    import jax
+
+    grams = block_grams_jnp(x, block)
+    return jax.ops.segment_sum(grams, block_seg, num_segments=n_seg)
+
+
+def _kernel(bk_ref, out_ref):
+    """One grid step: Gram of one (block, K) tile on the MXU."""
+    import jax.numpy as jnp
+
+    x = bk_ref[:]
+    out_ref[:] = jnp.dot(x.T, x, preferred_element_type=jnp.float32)
+
+
+def block_grams_pallas(x, block, interpret=False):
+    """Pallas path: per-block Grams in f32, K padded to the lane
+    width. Returns (n/block, K, K) f32; the segment reduction stays
+    outside (cheap, f64-capable)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    x = jnp.asarray(x, jnp.float32)
+    n, k = x.shape
+    nb = n // block
+    kpad = -(-k // _LANE) * _LANE
+    if kpad != k:
+        x = jnp.pad(x, ((0, 0), (0, kpad - k)))
+    out = pl.pallas_call(
+        _kernel,
+        grid=(nb,),
+        in_specs=[pl.BlockSpec((block, kpad), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((kpad, kpad), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((nb * kpad, kpad), jnp.float32),
+        interpret=interpret,
+    )(x)
+    return out.reshape(nb, kpad, kpad)[:, :k, :k]
+
+
+def segment_gram_pallas(x, block_seg, n_seg, block, interpret=False):
+    """Pallas block Grams + f64 segment reduction (n/block x K x K,
+    small next to the row data)."""
+    import jax
+    import jax.numpy as jnp
+
+    grams = block_grams_pallas(x, block, interpret=interpret)
+    return jax.ops.segment_sum(grams.astype(jnp.float64), block_seg,
+                               num_segments=n_seg)
+
+
+@functools.lru_cache(maxsize=1)
+def _tpu_backend():
+    import jax
+
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def segment_gram(x, block_seg, n_seg, block, precision="f64"):
+    """Dispatch: Pallas kernel on TPU when f32 block products are
+    acceptable (``precision="mixed"``), jnp otherwise. The packed GLS
+    fit is f64-only today, so it pins the jnp path; the kernel exists
+    for the mixed-precision Gram work the TPU path will grow into,
+    verified against the reference by tests/test_shapeplan.py."""
+    if precision == "mixed" and _tpu_backend():
+        try:
+            return segment_gram_pallas(x, block_seg, n_seg, block)
+        except Exception:  # mosaic/version quirks: fall back silently
+            pass
+    return segment_gram_jnp(x, block_seg, n_seg, block)

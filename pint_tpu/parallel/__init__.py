@@ -1,5 +1,7 @@
 from .pta import (PTABatch, PTAFleet, fleet_aot_compile,  # noqa: F401
                   fleet_pipeline_metrics, stack_prepared)
+from .shapeplan import (PlanBucket, PlanRow, Segment,  # noqa: F401
+                        ShapePlan, plan_shapes, pow2_width)
 from .mesh import make_mesh, make_mesh2d, shard_batch  # noqa: F401
 from .distributed import (initialize_distributed,  # noqa: F401
                           process_pulsar_slice, global_pulsar_mesh)

@@ -57,11 +57,19 @@ def initialize_distributed(coordinator_address=None, num_processes=None,
     import jax
 
     # not every jax build exposes is_initialized (the 0.4.x graft
-    # doesn't); fall back to the runtime state object it wraps
+    # doesn't); fall back to the runtime state object it wraps, which
+    # 0.4.37 keeps only at jax._src.distributed.global_state (the
+    # public module re-exports neither name)
     is_init = getattr(jax.distributed, "is_initialized", None)
     if is_init is None:
         def is_init():
             state = getattr(jax.distributed, "global_state", None)
+            if state is None:
+                try:
+                    from jax._src import distributed as _dist_src
+                    state = getattr(_dist_src, "global_state", None)
+                except ImportError:
+                    state = None
             return getattr(state, "client", None) is not None
     if is_init():
         return jax.process_index(), jax.process_count()

@@ -215,6 +215,239 @@ def stack_prepared(preps: list[PreparedTiming], pad_toas=None):
     return params_stack, prep_stack, batch_stack, static, n_toas
 
 
+def stack_packed(preps: list[PreparedTiming], bucket, e_quantum=32):
+    """Pack same-structure PreparedTimings into the segment-packed
+    layout of a shapeplan :class:`~.shapeplan.PlanBucket` — several
+    small pulsars share one padded row instead of each paying for a
+    full bucket width (the padded-FLOP fix the planner exists for).
+
+    Layout (R rows, W = bucket.width columns, S = bucket.n_slots):
+
+    - TOA-dim leaves (batch fields, per-TOA prep arrays, (k, n) masks,
+      (n, k) bases) are COMBINED: each row concatenates its members'
+      padded segments, so packed memory matches the unpacked stack —
+      there is no S-fold copy. The packed GLS path evaluates each slot
+      over the whole row and masks to its own segment afterwards.
+    - params and non-TOA prep leaves are SLOT-STACKED (R, S, ...);
+      rows with fewer members repeat slot 0 (dummy slots own no
+      blocks, so their garbage fits are finite and dropped at the
+      result gather).
+    - prep["_pack_block_slot"] (R, W/Q) int32 maps each Q-sized block
+      of TOA rows to its owning slot (Q = gcd of all segment widths —
+      segments are quantum-aligned, so any common divisor works and
+      the gcd gives the cheapest segment sums).
+    - With sparse ECORR, prep["ecorr_eidx"] is renumbered to row-
+      global epoch ids (members offset by e_quantum-aligned spans),
+      prep["ecorr_owner"] becomes the per-slot (NE,) global owner
+      vector (-1 off-slot), and prep["_pack_eblock_slot"] (R, NE/Qe)
+      keys the epoch blocks by slot.
+
+    Returns (params, prep, batch, static, n_toas, pack); ``pack`` is
+    the host-side layout descriptor (row_of/slot_of gather indices,
+    block quanta, slot-stacked key list) the packed GLS path and
+    result gather consume.
+    """
+    import math
+
+    import jax.numpy as jnp
+
+    from ..toa import TOABatch
+
+    W = int(bucket.width)
+    rows = bucket.rows
+    R = len(rows)
+    S = max(len(r.segments) for r in rows)
+    Q = math.gcd(W, *[s.width for r in rows for s in r.segments])
+
+    # effective member pad widths: the last member absorbs the row
+    # tail, so tail padding stays ordinary sentinel rows of a real
+    # pulsar (exactly the sequential path's padding semantics)
+    layout = []  # per row: [[prep_index, pad_width], ...]
+    for r in rows:
+        segs = [[s.index, s.width] for s in r.segments]
+        segs[-1][1] += W - r.used
+        layout.append(segs)
+    n_psr = len(preps)
+    if sorted(i for r in layout for i, _ in r) != list(range(n_psr)):
+        raise ValueError("plan bucket must cover the prepared pulsars "
+                         "exactly once (indices 0..n-1)")
+
+    # uniform ECORR representation across the bucket (see
+    # stack_prepared: a mixed bucket densifies the sparse members)
+    if (any("ecorr_U" in p.prep for p in preps)
+            and any("ecorr_eidx" in p.prep for p in preps)):
+        from ..models.noise import EcorrNoise
+
+        for p in preps:
+            if "ecorr_eidx" in p.prep:
+                p.prep["ecorr_U"] = EcorrNoise.dense_U(p.prep)
+                del p.prep["ecorr_eidx"]
+    sparse_ecorr = "ecorr_eidx" in preps[0].prep
+
+    padded = {}  # prep index -> (TOABatch, arrays, static)
+    for r in layout:
+        for i, w in r:
+            padded[i] = _pad_single(preps[i], w)
+
+    # classify prep keys once (member 0): an axis equal to the
+    # member's own pad width marks a combined (TOA-dim) leaf, same
+    # rule as _toa_dim_pad; everything else is slot-stacked
+    i0, w0 = layout[0][0]
+    combined_keys, slot_keys = set(), set()
+    for k, v in padded[i0][1].items():
+        if k in ("ecorr_eidx", "ecorr_owner"):
+            continue  # placed specially below
+        a = np.asarray(v)
+        if ((a.ndim == 1 and a.shape[0] == w0)
+                or (a.ndim == 2 and w0 in a.shape)):
+            combined_keys.add(k)
+        else:
+            slot_keys.add(k)
+
+    # 2-D combined leaves: which axis is the TOA axis, and the
+    # bucket-wide max of the other (ragged mask/basis counts pad with
+    # zeros exactly like stack_prepared)
+    info2d = {}
+    for k in combined_keys:
+        a0 = np.asarray(padded[i0][1][k])
+        if a0.ndim == 2:
+            taxis = 0 if a0.shape[0] == w0 else 1
+            kax = 1 - taxis
+            kmax = max(np.asarray(padded[i][1][k]).shape[kax]
+                       for r in layout for i, _ in r)
+            info2d[k] = (taxis, kax, kmax)
+    slot_shapes = {}
+    for k in slot_keys:
+        shapes = [np.asarray(padded[i][1][k]).shape
+                  for r in layout for i, _ in r]
+        slot_shapes[k] = tuple(max(s[d] for s in shapes)
+                               for d in range(len(shapes[0])))
+
+    # row-global epoch numbering: each member's epochs occupy an
+    # e_quantum-aligned span so the per-slot epoch Gram can reduce by
+    # block (pad epochs have owner -1 -> zero Sherman-Morrison weight)
+    NE = 0
+    epoch_info = {}
+    if sparse_ecorr:
+        for r in layout:
+            eoff = 0
+            for i, _ in r:
+                k_i = int(np.asarray(preps[i].prep["ecorr_owner"]).shape[0])
+                espan = -(-k_i // int(e_quantum)) * int(e_quantum)
+                epoch_info[i] = (eoff, k_i, espan)
+                eoff += espan
+            NE = max(NE, eoff)
+
+    static = dict(padded[i0][2])
+    prep_rows, batch_rows = [], []
+    for r in layout:
+        comb = {}
+        for k in combined_keys:
+            parts = [np.asarray(padded[i][1][k]) for i, _ in r]
+            if parts[0].ndim == 1:
+                comb[k] = np.concatenate(parts)
+            else:
+                taxis, kax, kmax = info2d[k]
+                shaped = []
+                for a in parts:
+                    tgt = list(a.shape)
+                    tgt[kax] = kmax
+                    shaped.append(_pad_to(a, tuple(tgt)))
+                comb[k] = np.concatenate(shaped, axis=taxis)
+        for k in slot_keys:
+            vals = [_pad_to(padded[i][1][k], slot_shapes[k])
+                    for i, _ in r]
+            vals += [vals[0]] * (S - len(vals))
+            comb[k] = np.stack(vals)
+        if sparse_ecorr:
+            eparts, owners = [], []
+            for i, _ in r:
+                eoff, k_i, _ = epoch_info[i]
+                e = np.asarray(padded[i][1]["ecorr_eidx"])
+                eparts.append(np.where(e >= 0, e + eoff, -1)
+                              .astype(np.int32))
+                ow = np.full(NE, -1, dtype=np.int64)
+                ow[eoff:eoff + k_i] = np.asarray(
+                    preps[i].prep["ecorr_owner"])
+                owners.append(ow)
+            owners += [np.full(NE, -1, dtype=np.int64)] * (S - len(owners))
+            comb["ecorr_eidx"] = np.concatenate(eparts)
+            comb["ecorr_owner"] = np.stack(owners)
+            ebs = np.zeros(NE // int(e_quantum), dtype=np.int32)
+            for s_i, (i, _) in enumerate(r):
+                eoff, _, espan = epoch_info[i]
+                ebs[eoff // int(e_quantum):
+                    (eoff + espan) // int(e_quantum)] = s_i
+            comb["_pack_eblock_slot"] = ebs
+        elif "ecorr_owner" in preps[i0].prep:
+            # dense-U bucket: owner stays local per slot (columns are
+            # shared across slots; each slot's rows carry its own U)
+            kU = max(np.asarray(p.prep["ecorr_owner"]).shape[0]
+                     for p in preps)
+            owners = []
+            for i, _ in r:
+                ow = np.full(kU, -1, dtype=np.int64)
+                o = np.asarray(preps[i].prep["ecorr_owner"])
+                ow[:o.shape[0]] = o
+                owners.append(ow)
+            owners += [np.full(kU, -1, dtype=np.int64)] * (S - len(owners))
+            comb["ecorr_owner"] = np.stack(owners)
+        bs = np.zeros(W // Q, dtype=np.int32)
+        off = 0
+        for s_i, (i, w) in enumerate(r):
+            bs[off // Q:(off + w) // Q] = s_i
+            off += w
+        comb["_pack_block_slot"] = bs
+        prep_rows.append(comb)
+
+        fields = {}
+        for name in TOABatch._fields:
+            parts = [np.asarray(getattr(padded[i][0], name))
+                     for i, _ in r]
+            if parts[0].ndim == 3:  # planet (n_planets, n, 3)
+                fields[name] = np.concatenate(parts, axis=1)
+            else:
+                fields[name] = np.concatenate(parts, axis=0)
+        batch_rows.append(fields)
+
+    slot_param_keys = set(slot_keys)
+    if "ecorr_owner" in preps[i0].prep:
+        slot_param_keys.add("ecorr_owner")
+    prep_stack = {k: jnp.asarray(np.stack([pr[k] for pr in prep_rows]))
+                  for k in prep_rows[0]}
+    batch_stack = TOABatch(**{
+        name: jnp.asarray(np.stack([br[name] for br in batch_rows]))
+        for name in TOABatch._fields})
+
+    keys = preps[0].params0.keys()
+    params_stack = {}
+    for k in keys:
+        arrs = [np.atleast_1d(np.asarray(p.params0[k])) for p in preps]
+        klen = max(a.shape[0] for a in arrs)
+        rows_np = []
+        for r in layout:
+            vals = [_pad_to(arrs[i], (klen,)) for i, _ in r]
+            vals += [vals[0]] * (S - len(vals))
+            rows_np.append(np.stack(vals))
+        out = np.stack(rows_np)  # (R, S, klen)
+        if np.asarray(preps[0].params0[k]).ndim == 0:
+            out = out[:, :, 0]
+        params_stack[k] = jnp.asarray(out)
+
+    row_of = np.zeros(n_psr, dtype=np.int64)
+    slot_of = np.zeros(n_psr, dtype=np.int64)
+    for rr, r in enumerate(layout):
+        for s_i, (i, _) in enumerate(r):
+            row_of[i] = rr
+            slot_of[i] = s_i
+    n_toas = np.array([p.batch.n_toas for p in preps])
+    pack = {"width": W, "quantum": Q, "e_quantum": int(e_quantum),
+            "n_rows": R, "n_slots": S, "n_epochs": int(NE),
+            "row_of": row_of, "slot_of": slot_of,
+            "slot_keys": sorted(slot_param_keys)}
+    return params_stack, prep_stack, batch_stack, static, n_toas, pack
+
+
 def pure_phase_fn(template_model, static):
     """(params, batch, prep) -> continuous phase; pure, closure-free over
     data so it vmaps over pulsars and shard_maps over the TOA axis."""
@@ -266,19 +499,37 @@ class PTABatch:
     All models must share component structure; see stack_prepared.
     """
 
-    def __init__(self, models, toas_list, mesh=None, pad_toas=None):
+    def __init__(self, models, toas_list, mesh=None, pad_toas=None,
+                 plan=None):
+        """``plan`` (a shapeplan PlanBucket whose segment indices cover
+        models/toas_list exactly once) switches to the segment-packed
+        layout: several pulsars share one padded row, the GLS math
+        runs per-segment (stack_packed / _build_gls_packed), and
+        results gather back to per-pulsar order. Packed batches are
+        GLS-only and f64-only; no mesh sharding."""
         from ..models.timing_model import _cpu_staging, device_put_staged
 
         self.models = models
         self.toas_list = toas_list
         self.pad_toas = pad_toas
+        self._pack = None
+        if plan is not None and mesh is not None:
+            raise ValueError("packed plan batches do not support a "
+                             "device mesh")
+        if plan is not None and pad_toas is not None:
+            raise ValueError("pad_toas and plan are mutually exclusive")
         # stage per-pulsar packing + stacking on the CPU backend, then
         # one batched transfer of the stacked trees (behind a tunnel,
         # per-array transfers dominate the pack otherwise)
         with _cpu_staging():
             self.preps = [m.prepare(t) for m, t in zip(models, toas_list)]
-            (self.params, self.prep, self.batch, self.static,
-             self.n_toas) = stack_prepared(self.preps, pad_toas=pad_toas)
+            if plan is not None:
+                (self.params, self.prep, self.batch, self.static,
+                 self.n_toas, self._pack) = stack_packed(self.preps, plan)
+            else:
+                (self.params, self.prep, self.batch, self.static,
+                 self.n_toas) = stack_prepared(self.preps,
+                                               pad_toas=pad_toas)
         self.params, self.prep, self.batch = device_put_staged(
             (self.params, self.prep, self.batch))
         self.template = models[0]
@@ -324,6 +575,9 @@ class PTABatch:
         pulsar count while self.models holds only the local slice."""
         import jax
 
+        if getattr(self, "_pack", None):
+            # packed layout: leading axis is rows, not pulsars
+            return int(len(self._pack["row_of"]))
         return int(jax.tree_util.tree_leaves(self.params)[0].shape[0])
 
     def free_map(self):
@@ -360,6 +614,8 @@ class PTABatch:
             "static": dict(self.static),
             "n_toas": np.asarray(self.n_toas),
             "free_map": list(self.free_map())}
+        if getattr(self, "_pack", None):
+            self._pack_state_cache["pack"] = dict(self._pack)
         return self._pack_state_cache
 
     @classmethod
@@ -379,6 +635,10 @@ class PTABatch:
         self.models = [template_model] * n_psr  # divergence labels only
         self.toas_list = None
         self.preps = None
+        self._pack = dict(state["pack"]) if "pack" in state else None
+        if self._pack is not None and mesh is not None:
+            raise ValueError("packed plan batches do not support a "
+                             "device mesh")
         self._free_map = [tuple(x) for x in state["free_map"]]
         self.params, self.prep, self.batch = device_put_staged(
             (dict(state["params"]), dict(state["prep"]),
@@ -410,6 +670,17 @@ class PTABatch:
             raise ValueError(
                 f"start vector shape {x.shape} != "
                 f"({self.n_pulsars}, {k})")
+        if getattr(self, "_pack", None):
+            # scatter per-pulsar rows into the (rows, slots, k) packed
+            # start tensor; dummy slots keep their slot-0 defaults
+            import jax
+
+            self._x0_cache = None
+            base = np.array(jax.device_get(self._x0()), np.float64)
+            base[self._pack["row_of"], self._pack["slot_of"]] = \
+                np.asarray(x, np.float64)
+            self._x0_cache = jnp.asarray(base)
+            return
         self._x0_cache = x
 
     def _overlay(self, params, x):
@@ -438,7 +709,11 @@ class PTABatch:
                 vals.append(v if (v.ndim == 0 or idx is None) else v[idx])
             return jnp.stack(vals)
 
-        self._x0_cache = jax.vmap(pull_one)(self.params)
+        if getattr(self, "_pack", None):
+            # packed layout: params are (rows, slots, ...) -> (R, S, k)
+            self._x0_cache = jax.vmap(jax.vmap(pull_one))(self.params)
+        else:
+            self._x0_cache = jax.vmap(pull_one)(self.params)
         return self._x0_cache
 
     def _pull(self, tree):
@@ -521,6 +796,11 @@ class PTABatch:
 
         from ..fitter import _warn_degraded_once
 
+        if getattr(self, "_pack", None):
+            raise RuntimeError(
+                "WLS is not supported on packed plan batches; the "
+                "planner gives WLS structures singleton rows "
+                "(PTABatch(..., pad_toas=width)) instead")
         _warn_degraded_once()
         resid_fn = self._resid_fn()
 
@@ -698,6 +978,9 @@ class PTABatch:
                               gls_eigh_refine, gls_eigh_solve, gls_gram,
                               gls_whiten, stack_noise_bases)
 
+        if getattr(self, "_pack", None):
+            return self._build_gls_packed(maxiter, threshold,
+                                          ecorr_mode, precision)
         _warn_degraded_once()
 
         if ecorr_mode not in ("auto", "dense"):
@@ -965,6 +1248,198 @@ class PTABatch:
         return (("gls", maxiter, threshold, marginalize, precision, hoist),
                 fit_one)
 
+    def _build_gls_packed(self, maxiter=2, threshold=1e-12,
+                          ecorr_mode="auto", precision="f64"):
+        """(cache key, per-ROW fit_one) for the segment-packed GLS
+        program — the shapeplan layout where several pulsars share one
+        padded row (stack_packed).
+
+        Same math as one_step_dense / one_step_marg in the SAME
+        operation order, with every whole-row reduction replaced by
+        its per-segment form: fitter.seg_gls_whiten for the whitened
+        column normalization, kernels/seggram block-factorized segment
+        Grams for the normal matrices, and segment sums keyed by the
+        per-TOA owner for the b/chi2/epoch reductions. Each slot
+        evaluates phase/design/noise with ITS params over the whole
+        row (foreign-row outputs are masked out before any reduction);
+        the slot loop accumulates the combined arrays in place so peak
+        memory stays at one row, not n_slots rows. Packed batches are
+        f64-only: the mixed path's refinement operator is whole-row
+        shaped and has no segment form yet.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from ..fitter import (_warn_degraded_once, check_precision,
+                              gls_eigh_solve, seg_gls_whiten,
+                              stack_noise_bases)
+        from ..kernels.seggram import segment_gram
+
+        _warn_degraded_once()
+        if ecorr_mode not in ("auto", "dense"):
+            raise ValueError(
+                f"ecorr_mode must be 'auto' or 'dense', got {ecorr_mode!r}")
+        check_precision(precision)
+        if precision != "f64":
+            raise ValueError(
+                "packed plan batches are f64-only; use a pow2/split "
+                "bucket for precision='mixed'")
+        phase_fn = self._phase_fn()
+        sigma_fn = self._sigma_fn()
+        has_ecorr = "EcorrNoise" in self.template.components
+        marginalize = has_ecorr and ecorr_mode == "auto"
+        if marginalize:
+            if self._ecorr_marg_ok is None:
+                self._ecorr_marg_ok = bool(
+                    "ecorr_eidx" in self.prep
+                    and self.prep["ecorr_owner"].shape[-1] > 0)
+            marginalize = self._ecorr_marg_ok
+        noise_bw = (self._noise_bw_fn(exclude_ecorr=True) if marginalize
+                    else self._noise_bw_fn())
+        ecorr_comp = (self.template.components.get("EcorrNoise")
+                      if marginalize else None)
+        pack = self._pack
+        S = int(pack["n_slots"])
+        Q = int(pack["quantum"])
+        Qe = int(pack["e_quantum"])
+        slot_keys = frozenset(pack["slot_keys"])
+
+        def fit_one(x0, params, batch, prep):
+            # one packed ROW: x0 (S, k); params slot-stacked (S, ...);
+            # prep mixes combined row leaves with slot-stacked leaves
+            shared = {k: v for k, v in prep.items()
+                      if k not in slot_keys
+                      and not k.startswith("_pack_")}
+            block_slot = prep["_pack_block_slot"]
+            W = batch.tdb_sec.shape[0]
+            owner = jnp.repeat(block_slot, Q, total_repeat_length=W)
+
+            def eval_slot(x_s, s):
+                ps = jax.tree_util.tree_map(lambda v: v[s], params)
+                full = dict(shared)
+                for k in slot_keys:
+                    full[k] = prep[k][s]
+                p = self._overlay(ps, x_s)
+                ph = phase_fn(p, batch, full)
+                sig = sigma_fn(p, batch, full)
+
+                def phase_of(xv):
+                    return phase_fn(self._overlay(ps, xv), batch, full)
+
+                M = jax.jacfwd(phase_of)(x_s) / p["F"][0]
+                M = jnp.concatenate([jnp.ones((W, 1)), M], axis=1)
+                bw = (noise_bw(p, full) if noise_bw is not None
+                      else None) or (None, None)
+                Mfull, spi, nparam = stack_noise_bases(M, bw)
+                w_ec = None
+                if marginalize:
+                    _, w_ec = ecorr_comp.epoch_index_weight(
+                        p, {**full, **self.static})
+                return ph, sig, Mfull, spi, w_ec, p["F"][0], nparam
+
+            def one_step(x):
+                # slot-by-slot accumulation of the combined per-TOA
+                # arrays: peak memory one (W, K) design, not (S, W, K)
+                spis, f0s = [], []
+                w_ec = None
+                for s in range(S):
+                    ph_s, sig_s, Mf_s, spi_s, wec_s, f0_s, nparam = \
+                        eval_slot(x[s], s)
+                    if s == 0:
+                        ph, sig, Mfull = ph_s, sig_s, Mf_s
+                    else:
+                        m = owner == s
+                        ph = jnp.where(m, ph_s, ph)
+                        sig = jnp.where(m, sig_s, sig)
+                        Mfull = jnp.where(m[:, None], Mf_s, Mfull)
+                    spis.append(spi_s)
+                    f0s.append(f0_s)
+                    if wec_s is not None:
+                        # disjoint global epoch spans: summing the
+                        # per-slot weight vectors assembles the row's
+                        w_ec = wec_s if w_ec is None else w_ec + wec_s
+                spi = jnp.stack(spis)  # (S, K)
+                F0 = jnp.stack(f0s)    # (S,)
+                # per-segment weighted phase mean — the packed analog
+                # of _resid_fn's whole-row mean subtraction
+                frac = ph - jnp.floor(ph + 0.5)
+                wts = 1.0 / jnp.square(sig)
+                num = jax.ops.segment_sum(frac * wts, owner,
+                                          num_segments=S)
+                den = jax.ops.segment_sum(wts, owner, num_segments=S)
+                frac = frac - (num / den)[owner]
+                r = frac / F0[owner]
+                sigma_s = sig * 1e-6
+                Mn, norm, q = seg_gls_whiten(Mfull, sigma_s, spi,
+                                             owner, S)
+                z = r / sigma_s
+                b0 = jax.ops.segment_sum(Mn * z[:, None], owner,
+                                         num_segments=S)
+                rNr = jax.ops.segment_sum(z * z, owner, num_segments=S)
+                A0 = segment_gram(Mn, block_slot, S, Q,
+                                  precision=precision)
+                if marginalize:
+                    a = 1.0 / sigma_s
+                    NE = w_ec.shape[0]
+                    eidx = prep["ecorr_eidx"]  # row-global epoch ids
+                    e_idx = jnp.where((eidx >= 0) & (eidx < NE),
+                                      eidx, NE)
+                    s_e = jax.ops.segment_sum(
+                        a * a, e_idx, num_segments=NE + 1)[:NE]
+                    G = jax.ops.segment_sum(
+                        Mn * a[:, None], e_idx, num_segments=NE + 1)[:NE]
+                    t_e = jax.ops.segment_sum(
+                        z * a, e_idx, num_segments=NE + 1)[:NE]
+                    w_s2 = w_ec * 1e-12
+                    c = w_s2 / (1.0 + w_s2 * s_e)  # w=0 (pad) -> c=0
+                    Gc = jnp.sqrt(c)[:, None] * G
+                    eblock_slot = prep["_pack_eblock_slot"]
+                    eowner = jnp.repeat(eblock_slot, Qe,
+                                        total_repeat_length=NE)
+                    D = segment_gram(Gc, eblock_slot, S, Qe,
+                                     precision=precision)
+                    bn = b0 - jax.ops.segment_sum(
+                        (c * t_e)[:, None] * G, eowner, num_segments=S)
+                    rCr = rNr - jax.ops.segment_sum(
+                        c * jnp.square(t_e), eowner, num_segments=S)
+                    An = A0 - D + jax.vmap(jnp.diag)(q * q)
+                else:
+                    An = A0 + jax.vmap(jnp.diag)(q * q)
+                    bn = b0
+                    rCr = rNr
+                dxn, covn = jax.vmap(
+                    lambda Ai, bi: gls_eigh_solve(Ai, bi, threshold))(
+                        An, bn)
+                dx_all = dxn / norm
+                chi2 = rCr - jnp.sum(bn * dxn, axis=1)
+                return (x - dx_all[:, 1:nparam], chi2,
+                        (covn[:, 1:nparam, 1:nparam], norm[:, 1:nparam]))
+
+            x = x0
+            for _ in range(maxiter):
+                x, chi2, (covn, norm) = one_step(x)
+            return x, chi2, (covn, norm, jnp.zeros(x.shape[0]))
+
+        return (("gls", maxiter, threshold, marginalize, precision,
+                 "packed"), fit_one)
+
+    @staticmethod
+    def _precision_verdict(timings, mixed_failed):
+        """Pure decision rule behind precision="auto": f64 wins when
+        the mixed probe's refinement diagnostic failed (a mode that
+        would immediately fall back is never faster) or when the
+        timed warm run says f64 is at least as fast. Ties go to f64 —
+        equal speed buys nothing for the precision risk. Mixed has to
+        EARN its slot with a strictly faster measured run; on CPU it
+        never does (gls_mixed_speedup 0.768, BASELINE.md r5: the f32
+        Gram vectorizes no wider than f64 on AVX while the refinement
+        pass doubles the passes), which is exactly why the verdict is
+        measured rather than assumed from the platform."""
+        if mixed_failed:
+            return "f64"
+        return ("f64" if timings["f64"] <= timings["mixed"]
+                else "mixed")
+
     def _resolve_precision(self, precision, maxiter=2, threshold=1e-12,
                            ecorr_mode="auto"):
         """Resolve precision="auto" to the MEASURED winner of "f64" vs
@@ -987,6 +1462,10 @@ class PTABatch:
         check_precision(precision, allow_auto=True)
         if precision != "auto":
             return precision
+        if getattr(self, "_pack", None):
+            # packed batches are f64-only (no segment-masked mixed
+            # refinement operator): auto resolves without a probe
+            return "f64"
         cache_key = (self.structure_key(self.template),
                      self.shape_signature(), maxiter, threshold,
                      ecorr_mode)
@@ -1010,8 +1489,7 @@ class PTABatch:
             t0 = time.perf_counter()
             jax.block_until_ready(self._fns[key](*args))
             timings[mode] = time.perf_counter() - t0
-        choice = ("f64" if mixed_failed
-                  or timings["f64"] <= timings["mixed"] else "mixed")
+        choice = self._precision_verdict(timings, mixed_failed)
         with _PRECISION_AUTO_LOCK:
             choice = _PRECISION_AUTO_CACHE.setdefault(cache_key, choice)
         self.precision_auto = {"choice": choice,
@@ -1051,6 +1529,18 @@ class PTABatch:
         # one batched pull; see _finalize_wls
         x, chi2, covn, norm, relres = self._pull(
             (x, chi2, covn, norm, relres))
+        x0 = handle["x0"]
+        if getattr(self, "_pack", None):
+            # gather packed (rows, slots, ...) results back to
+            # per-pulsar original order BEFORE fault injection and
+            # divergence isolation, so lane indices / restored start
+            # vectors keep their sequential-path semantics
+            ro, so = self._pack["row_of"], self._pack["slot_of"]
+            x, chi2 = x[ro, so], chi2[ro, so]
+            covn, norm = covn[ro, so], norm[ro, so]
+            relres = relres[ro, so]
+            x0 = self._pull(x0)[ro, so]
+        handle = {**handle, "x0": x0}
         from ..fitter import relres_failed
 
         if handle["precision"] == "mixed" and relres_failed(relres):
@@ -1215,6 +1705,10 @@ class PTABatch:
         dispatch warm."""
         import jax
 
+        if getattr(self, "_pack", None):
+            raise RuntimeError("time_residuals is not supported on "
+                               "packed plan batches (serve lanes use "
+                               "regular ladder-width batches)")
         key = ("resid",)
         if key not in self._fns:
             resid_fn = self._resid_fn()
@@ -1236,6 +1730,10 @@ class PTABatch:
         time_residuals."""
         import jax
 
+        if getattr(self, "_pack", None):
+            raise RuntimeError("phases is not supported on packed "
+                               "plan batches (serve lanes use regular "
+                               "ladder-width batches)")
         key = ("phase",)
         if key not in self._fns:
             self._fns[key] = jax.jit(jax.vmap(self._phase_fn()))
@@ -1407,7 +1905,9 @@ class PTAFleet:
         return sorted(bounds)
 
     def __init__(self, models, toas_list, mesh=None, toa_bucket=None,
-                 bucket_floor=256, pipeline=False):
+                 bucket_floor=256, pipeline=False,
+                 plan_compile_budget=None, plan_max_pack=None,
+                 plan_quantum=None, plan_min_width=None):
         """toa_bucket=None: group by model structure only (each batch
         pads to its own max TOA count). toa_bucket="pow2": additionally
         bucket pulsars by next-power-of-two TOA count (>= bucket_floor,
@@ -1425,6 +1925,17 @@ class PTAFleet:
         where each extra compile is wedge exposure on a tunneled
         device (SURVEY.md section 7.3 item 4).
 
+        toa_bucket="plan": shape-planned buckets (shapeplan.plan_shapes
+        per structure): small pulsars pack several-per-row into
+        segment-packed PTABatches (GLS-capable structures) or
+        singleton planned-width rows (WLS structures), with the width
+        ladder chosen to minimize padded FLOPs under a compile budget
+        (plan_compile_budget, default 4). On the 670k bench workload
+        the planner lands at padding <= 1.10 with <= 4 programs where
+        pow2 pays 1.46 over 6. Knobs: plan_compile_budget,
+        plan_max_pack (max pulsars per row), plan_quantum (segment
+        alignment).
+
         pipeline=True defers PTABatch construction to a worker pool:
         buckets pack concurrently with each other and with whatever
         the caller does next (compile, earlier buckets' fits), and
@@ -1441,9 +1952,9 @@ class PTAFleet:
             if split_k < 1:
                 raise ValueError(f"toa_bucket {toa_bucket!r}: 'split<k>' "
                                  f"needs a positive integer k")
-        elif toa_bucket not in (None, "pow2"):
-            raise ValueError(f"toa_bucket must be None, 'pow2', or "
-                             f"'split<k>', got {toa_bucket!r}")
+        elif toa_bucket not in (None, "pow2", "plan"):
+            raise ValueError(f"toa_bucket must be None, 'pow2', 'plan', "
+                             f"or 'split<k>', got {toa_bucket!r}")
         split_bounds = {}
         if split_k is not None:
             by_struct = {}
@@ -1452,20 +1963,61 @@ class PTAFleet:
                                      []).append(len(t))
             split_bounds = {sk: self.optimal_split_bounds(cs, split_k)
                             for sk, cs in by_struct.items()}
-        groups = {}
-        for i, (m, t) in enumerate(zip(models, toas_list)):
-            key = PTABatch.structure_key(m)
-            if toa_bucket == "pow2":
-                # canonical pow2 convention shared with serve slot keys
-                from ..serve.batcher import pow2_bucket
+        self.plans = {}
+        build_kwargs = {}
+        if toa_bucket == "plan":
+            from . import shapeplan
 
-                key = (key, pow2_bucket(len(t), bucket_floor))
-            elif split_k is not None:
-                for b in split_bounds[key]:
-                    if len(t) <= b:
-                        break
-                key = (key, b)
-            groups.setdefault(key, []).append(i)
+            plan_kw = {}
+            if plan_compile_budget is not None:
+                plan_kw["compile_budget"] = int(plan_compile_budget)
+            if plan_quantum is not None:
+                plan_kw["quantum"] = int(plan_quantum)
+            if plan_min_width is not None:
+                plan_kw["min_width"] = int(plan_min_width)
+            max_pack = (int(plan_max_pack) if plan_max_pack is not None
+                        else shapeplan.DEFAULT_MAX_PACK)
+            by_struct = {}
+            for i, (m, t) in enumerate(zip(models, toas_list)):
+                by_struct.setdefault(PTABatch.structure_key(m),
+                                     []).append(i)
+            groups = {}
+            for skey, idxs in by_struct.items():
+                tmpl = models[idxs[0]]
+                # packing needs the per-segment GLS math; structures
+                # with no correlated-noise basis take the WLS route,
+                # so they get singleton planned-width rows instead
+                packable = any(
+                    getattr(c, "basis_weight", None) is not None
+                    for c in tmpl.components.values())
+                plan = shapeplan.plan_shapes(
+                    [len(toas_list[i]) for i in idxs],
+                    max_pack=max_pack if packable else 1, **plan_kw)
+                self.plans[skey] = plan
+                for bucket in plan.buckets:
+                    key = (skey, ("plan", bucket.width))
+                    groups[key] = [idxs[j] for j in bucket.indices()]
+                    if packable and any(len(r.segments) > 1
+                                        for r in bucket.rows):
+                        build_kwargs[key] = {"plan": bucket.renumbered()}
+                    else:
+                        build_kwargs[key] = {"pad_toas": bucket.width}
+        else:
+            groups = {}
+            for i, (m, t) in enumerate(zip(models, toas_list)):
+                key = PTABatch.structure_key(m)
+                if toa_bucket == "pow2":
+                    # canonical pow2 convention shared with serve slot
+                    # keys, routed through the shape planner's wrapper
+                    from .shapeplan import pow2_width
+
+                    key = (key, pow2_width(len(t), bucket_floor))
+                elif split_k is not None:
+                    for b in split_bounds[key]:
+                        if len(t) <= b:
+                            break
+                    key = (key, b)
+                groups.setdefault(key, []).append(i)
         self.group_indices = groups
         self.pipeline = bool(pipeline)
         self._lock = threading.RLock()
@@ -1481,20 +2033,26 @@ class PTAFleet:
             for key, idxs in groups.items():
                 self._batch_futures[key] = self._prep_pool.submit(
                     PTABatch, [models[i] for i in idxs],
-                    [toas_list[i] for i in idxs], mesh=mesh)
+                    [toas_list[i] for i in idxs], mesh=mesh,
+                    **build_kwargs.get(key, {}))
         else:
             for key, idxs in groups.items():
                 self.batches[key] = PTABatch([models[i] for i in idxs],
                                              [toas_list[i] for i in idxs],
-                                             mesh=mesh)
+                                             mesh=mesh,
+                                             **build_kwargs.get(key, {}))
         self.n = len(models)
         real = sum(len(t) for t in toas_list)
-        # analytic padded area (PTABatch pads to the bucket max, so
-        # len(bucket) * max(counts) == the packed array area) — no need
-        # to force deferred batches just to read a shape
-        padded = sum(
-            len(idxs) * max(len(toas_list[i]) for i in idxs)
-            for idxs in groups.values())
+        if toa_bucket == "plan":
+            # the plan IS the padded geometry (packed rows included)
+            padded = sum(p.padded_area for p in self.plans.values())
+        else:
+            # analytic padded area (PTABatch pads to the bucket max, so
+            # len(bucket) * max(counts) == the packed array area) — no
+            # need to force deferred batches just to read a shape
+            padded = sum(
+                len(idxs) * max(len(toas_list[i]) for i in idxs)
+                for idxs in groups.values())
         self.padding_ratio = padded / max(real, 1)
 
     def _resolve(self, key):

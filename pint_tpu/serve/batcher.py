@@ -3,14 +3,20 @@ flush on batch-full or a max-latency timer — the prompt-batching
 pattern of inference serving applied to timing requests.
 
 A slot key is everything that must match for two requests to share
-one compiled executable: the PTABatch structure signature, the pow2
-TOA bucket the request pads into, and the resolved routing
-(kind, method, maxiter, precision). The pow2 convention is
-PTAFleet.toa_bucket="pow2" (parallel/pta.py) with a configurable
-floor; unlike PTAFleet — which pads each offline batch to its own max
+one compiled executable: the PTABatch structure signature, the TOA
+bucket the request pads into, and the resolved routing
+(kind, method, maxiter, precision). The default bucket ladder is the
+pow2 convention of PTAFleet.toa_bucket="pow2" (parallel/pta.py) with
+a configurable floor; passing a ``plan`` (parallel/shapeplan.py
+ShapePlan) replaces it with the plan's optimized width ladder —
+smallest planned width that fits, pow2 fallback above the ladder.
+Unlike PTAFleet — which pads each offline batch to its own max
 count — the serve path pads to the bucket BOUNDARY
 (PTABatch(pad_toas=...)), so every flush of a slot presents identical
-shapes and the executable cache can do its job.
+shapes and the executable cache can do its job. Serve slots never
+segment-pack multiple pulsars into one row (requests arrive one
+pulsar at a time and lanes are the batching axis); the plan
+contributes its ladder widths and its signature, not its row packing.
 
 The batcher holds no clock of its own: the engine passes timestamps
 in, which keeps flush-on-timer deterministic under test clocks.
@@ -33,14 +39,23 @@ def pow2_bucket(n, floor=256):
 
 class MicroBatcher:
     def __init__(self, max_batch=8, max_latency_s=0.05,
-                 bucket_floor=256):
+                 bucket_floor=256, plan=None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self.max_batch = int(max_batch)
         self.max_latency_s = float(max_latency_s)
         self.bucket_floor = int(bucket_floor)
+        self.plan = plan  # optional shapeplan.ShapePlan width ladder
         self._lock = threading.RLock()
         self._slots = {}  # key -> list[(request, result, t_submit)]
+
+    def bucket_for(self, n):
+        """TOA bucket for a request of ``n`` TOAs: the shape plan's
+        ladder when one is set (smallest planned width that fits,
+        pow2 above the ladder), else the legacy pow2 ladder."""
+        if self.plan is not None:
+            return int(self.plan.width_for(int(n)))
+        return pow2_bucket(n, self.bucket_floor)
 
     def slot_key(self, request, routing):
         """(structure_key, toa_bucket, kind, method, maxiter,
@@ -50,7 +65,7 @@ class MicroBatcher:
 
         kind, method, maxiter, precision = routing
         return (PTABatch.structure_key(request.model),
-                pow2_bucket(len(request.toas), self.bucket_floor),
+                self.bucket_for(len(request.toas)),
                 kind, method, maxiter, precision)
 
     def depth(self):

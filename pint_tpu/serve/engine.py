@@ -66,10 +66,12 @@ class ServeEngine:
                  oversize_toas=policy.DEFAULT_OVERSIZE_TOAS,
                  mesh=None, clock=time.monotonic, sleep=time.sleep,
                  backoff=None, breaker=None, health=None,
-                 bisect_depth=4):
+                 bisect_depth=4, plan=None):
+        self.plan = plan  # optional shapeplan.ShapePlan width ladder
         self.batcher = MicroBatcher(max_batch=max_batch,
                                     max_latency_s=max_latency_s,
-                                    bucket_floor=bucket_floor)
+                                    bucket_floor=bucket_floor,
+                                    plan=plan)
         self.max_queue = int(max_queue)
         self.cache = ExecutableCache(cache_capacity)
         self.telemetry = ServeTelemetry()
@@ -250,6 +252,16 @@ class ServeEngine:
 
     # -- execution ---------------------------------------------------
 
+    def _exec_key(self, slot_key, lanes, pta):
+        """Full executable signature. When a shape plan is active its
+        stable signature joins the key, so executables compiled under
+        one plan's ladder never collide with another plan's (or the
+        pow2 ladder's) entries in a shared cache."""
+        base = (slot_key, lanes, pta.shape_signature())
+        if self.plan is not None:
+            return base + (self.plan.signature(),)
+        return base
+
     def _padded_batch(self, bucket, models, toas_list):
         """Lane-padded PTABatch for one slot flush: the pulsar/lane
         axis replicates the last (model, toas) up to max_batch and the
@@ -292,8 +304,8 @@ class ServeEngine:
             reqs = reqs[:self.batcher.max_batch]
             pta = self._padded_batch(bucket, [r.model for r in reqs],
                                      [r.toas for r in reqs])
-            exec_key = (slot_key, self.batcher.max_batch,
-                        pta.shape_signature())
+            exec_key = self._exec_key(slot_key, self.batcher.max_batch,
+                                      pta)
             if self.cache.lookup(exec_key) is not None:
                 continue
             if kind == "fit":
@@ -303,6 +315,49 @@ class ServeEngine:
                 pta.time_residuals()
             else:  # "phase"
                 pta.phases()
+            staged.append((slot_key, exec_key, pta))
+        fleet_aot_compile(jobs, max_workers=max_workers)
+        self.cache.prefill((exec_key, pta._fns)
+                           for _, exec_key, pta in staged)
+        for slot_key, exec_key, _ in staged:
+            self.executables_compiled += 1
+            self._slot_exec_keys.setdefault(slot_key, set()).add(exec_key)
+        self.telemetry.reset()
+        self.cache.reset_counters()
+        return self.executables_compiled - before
+
+    def prewarm_ladder(self, request, max_workers=None):
+        """Compile one fit executable per planned ladder width from a
+        single representative request, so EVERY planned slot shape is
+        warm before traffic arrives — not just the widths the prewarm
+        sample happened to hit. Requires a shape plan; widths smaller
+        than the representative request are skipped (nothing that
+        size can pad into them). Returns the number of executables
+        compiled; telemetry/cache counters are reset like prewarm."""
+        from ..parallel.pta import PTABatch, fleet_aot_compile
+
+        if self.plan is None:
+            raise ValueError("prewarm_ladder requires a shape plan")
+        kind, method, maxiter, precision = policy.resolve(request)
+        if kind != "fit":
+            raise ValueError("prewarm_ladder warms fit slots; got "
+                             f"kind={kind!r}")
+        skey = PTABatch.structure_key(request.model)
+        before = self.executables_compiled
+        jobs = []
+        staged = []
+        for w in self.plan.widths:
+            if w < len(request.toas):
+                continue
+            slot_key = (skey, int(w), kind, method, maxiter, precision)
+            pta = self._padded_batch(int(w), [request.model],
+                                     [request.toas])
+            exec_key = self._exec_key(slot_key, self.batcher.max_batch,
+                                      pta)
+            if self.cache.lookup(exec_key) is not None:
+                continue
+            jobs.append((pta, {"method": method, "maxiter": maxiter,
+                               "precision": precision}))
             staged.append((slot_key, exec_key, pta))
         fleet_aot_compile(jobs, max_workers=max_workers)
         self.cache.prefill((exec_key, pta._fns)
@@ -451,7 +506,7 @@ class ServeEngine:
                                  [req.model for req, _, _ in live],
                                  [req.toas for req, _, _ in live])
         pack_s = self.clock() - t0
-        exec_key = (slot_key, lanes, pta.shape_signature())
+        exec_key = self._exec_key(slot_key, lanes, pta)
         fns = self.cache.lookup(exec_key)
         cold = fns is None
         compile_s = 0.0

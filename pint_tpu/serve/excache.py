@@ -1,5 +1,8 @@
 """Executable cache: LRU of warm compiled-program tables keyed by the
-full executable signature (slot key + lane count + shape fingerprint).
+full executable signature (slot key + lane count + shape fingerprint,
+plus the shape plan's stable signature when the engine serves a
+planned width ladder — see ServeEngine._exec_key — so entries
+compiled under different plans never collide).
 
 PTABatch keeps its compiled programs in a per-instance ``_fns`` dict;
 serving builds a fresh PTABatch per flush, which would recompile

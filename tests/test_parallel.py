@@ -578,6 +578,8 @@ def test_distributed_two_process_fit(tmp_path):
     import sys
     import textwrap
 
+    import pytest
+
     # single-process reference in THIS session (8-device CPU mesh)
     models, toas_list = _dist_fleet()
     ref = PTABatch([copy.deepcopy(m) for m in models], toas_list)
@@ -606,6 +608,11 @@ def test_distributed_two_process_fit(tmp_path):
     outs = _spawn_pair()
     if not all(f"DIST2-OK {pid}" in out for pid, (out, _) in enumerate(outs)):
         outs = _spawn_pair()
+    if any("Multiprocess computations aren't implemented on the CPU "
+           "backend" in err for _, err in outs):
+        pytest.skip("this jaxlib's CPU backend has no cross-process "
+                    "collectives; the DCN path needs TPU or a "
+                    "multiprocess-capable CPU build")
     for pid, (out, err) in enumerate(outs):
         assert f"DIST2-OK {pid}" in out, (pid, out[-500:], err[-3000:])
 

@@ -230,6 +230,70 @@ def test_serve_unpadded_batch_quiet_with_pad(tmp_path):
     assert live(fs, "serve-unpadded-batch") == []
 
 
+# -- bucket-hardcoded ------------------------------------------------
+
+
+BUCKET_CFG = LintConfig(
+    bucket_allowed_modules=("parallel/shapeplan.py", "serve/batcher.py"))
+
+
+def test_bucket_hardcoded_flags_direct_call(tmp_path):
+    bad = """
+        from pint_tpu.serve.batcher import pow2_bucket
+
+        def group(toas_list, floor):
+            return {pow2_bucket(len(t), floor) for t in toas_list}
+    """
+    fs = lint(tmp_path, {"parallel/grouping.py": bad}, BUCKET_CFG)
+    assert len(live(fs, "bucket-hardcoded")) == 1
+
+
+def test_bucket_hardcoded_flags_attribute_call(tmp_path):
+    bad = """
+        from pint_tpu.serve import batcher
+
+        def width(n):
+            return batcher.pow2_bucket(n)
+    """
+    fs = lint(tmp_path, {"serve/eng.py": bad}, BUCKET_CFG)
+    assert len(live(fs, "bucket-hardcoded")) == 1
+
+
+def test_bucket_hardcoded_quiet_in_allowed_modules(tmp_path):
+    impl = """
+        def pow2_bucket(n, floor=256):
+            b = int(floor)
+            while b < n:
+                b *= 2
+            return b
+
+        def slot(n):
+            return pow2_bucket(n)
+    """
+    wrapper = """
+        def pow2_width(n, floor=256):
+            from ..serve.batcher import pow2_bucket
+
+            return pow2_bucket(n, floor)
+    """
+    fs = lint(tmp_path, {"serve/batcher.py": impl,
+                         "parallel/shapeplan.py": wrapper}, BUCKET_CFG)
+    assert live(fs, "bucket-hardcoded") == []
+
+
+def test_bucket_hardcoded_quiet_on_planner_api(tmp_path):
+    good = """
+        from pint_tpu.parallel.shapeplan import ladder_width, pow2_width
+
+        def width(n, plan):
+            if plan is not None:
+                return ladder_width(n, plan.widths)
+            return pow2_width(n)
+    """
+    fs = lint(tmp_path, {"serve/eng.py": good}, BUCKET_CFG)
+    assert live(fs, "bucket-hardcoded") == []
+
+
 # -- lock-discipline -------------------------------------------------
 
 

@@ -8,6 +8,9 @@ import warnings
 
 import numpy as np
 import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property tests need the hypothesis package")
 from hypothesis import given, settings, strategies as st
 
 warnings.simplefilter("ignore")
