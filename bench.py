@@ -924,9 +924,10 @@ def main():
     # serve stage: optional, daemon thread + join timeout, skip with
     # PINT_TPU_BENCH_SKIP_CHAOS=1.
     chaos_report = None
+    device_chaos_report = None
 
     def _chaos_stage():
-        nonlocal chaos_report
+        nonlocal chaos_report, device_chaos_report
         try:
             from pint_tpu.scripts.pint_serve_bench import run_chaos_stream
 
@@ -936,6 +937,26 @@ def main():
         except Exception as e:
             _stage(f"chaos stage failed ({type(e).__name__}: {e}); "
                    "headline JSON unaffected")
+        # device-level chaos (multi-lane only): one device_loss across
+        # the serve lanes AND a FleetMesh fleet fit — quarantine +
+        # work stealing must keep every request ok and the fleet
+        # params within 1e-15 of the healthy run. On a single-device
+        # host the report stays None (the dryrun_multichip variant
+        # records it with virtual devices).
+        try:
+            import jax
+
+            if len(jax.devices()) > 1:
+                from pint_tpu.scripts.pint_serve_bench import \
+                    run_device_chaos
+
+                rep = run_device_chaos(n_requests=48,
+                                       fault_point="device_loss",
+                                       bucket_floor=64)
+                device_chaos_report = rep  # set LAST
+        except Exception as e:
+            _stage(f"device-chaos stage failed ({type(e).__name__}: "
+                   f"{e}); headline JSON unaffected")
 
     chaos_wedged = False
     if os.environ.get("PINT_TPU_BENCH_SKIP_CHAOS") == "1":
@@ -960,6 +981,14 @@ def main():
             if not chaos_report["ok"]:
                 _stage("chaos: CONTRACT VIOLATED — healthy requests "
                        "must not fail under injected faults")
+        if chaos_wedged:
+            device_chaos_report = None
+        elif device_chaos_report is not None:
+            _stage(f"device-chaos: ok={device_chaos_report['ok']} "
+                   f"({device_chaos_report['n_lanes']} lanes, lost "
+                   f"{device_chaos_report['serve_lost_lanes']}, "
+                   f"{device_chaos_report['fleet_stolen_buckets']} "
+                   "buckets stolen)")
 
     # fleet-pipeline side metric: a mixed-structure fleet (3 model
     # structures x 2 TOA buckets) through fleet_pipeline_metrics —
@@ -1142,6 +1171,22 @@ def main():
                               if chaos_report else None),
         "chaos_breaker": (chaos_report["breaker"]
                           if chaos_report else None),
+        "chaos_device_ok": (device_chaos_report["ok"]
+                            if device_chaos_report else None),
+        "chaos_device_n_lanes": (device_chaos_report["n_lanes"]
+                                 if device_chaos_report else None),
+        "chaos_device_lost_lanes": (
+            device_chaos_report["serve_lost_lanes"]
+            if device_chaos_report else None),
+        "chaos_device_stolen_buckets": (
+            device_chaos_report["fleet_stolen_buckets"]
+            if device_chaos_report else None),
+        "chaos_device_serve_failures": (
+            device_chaos_report["serve_failures"]
+            if device_chaos_report else None),
+        "chaos_device_fleet_rel_diff": (
+            device_chaos_report["fleet_max_rel_diff_vs_healthy"]
+            if device_chaos_report else None),
         "fleet_compile_serial_s": (fleet_report["fleet_compile_serial_s"]
                                    if fleet_report else None),
         "fleet_compile_concurrent_s": (

@@ -117,6 +117,14 @@ FAULT_CALLS = frozenset({
 # (linting one file must not claim the whole registry is unused).
 FAULT_REGISTRY_SUFFIX = "resilience/faultinject.py"
 
+# Path markers identifying test files. Device-level fault points
+# (the registry's DEVICE_POINTS tuple) must be ARMED — inject()/
+# FaultPoint() — from at least one test: a device failure mode that
+# no test can trigger is chaos coverage on paper only. The check runs
+# only when test files are in the scan, so linting the package alone
+# stays quiet.
+TEST_PATH_MARKERS = ("/tests/", "/test_")
+
 # -- bench hygiene -----------------------------------------------------
 
 # Calls that dispatch device work asynchronously: timing them without
@@ -153,7 +161,9 @@ class LintConfig:
     serve_pad_modules: tuple = ()
     bucket_allowed_modules: tuple = ()
     fault_points: tuple = None  # None -> parse from the registry file
+    device_fault_points: tuple = None  # None -> parse DEVICE_POINTS
     fault_registry_suffix: str = FAULT_REGISTRY_SUFFIX
+    test_path_markers: tuple = TEST_PATH_MARKERS
     nan_diag_pattern: str = NAN_DIAG_PATTERN
 
     @classmethod

@@ -16,17 +16,31 @@ from .config import FAULT_CALLS
 from .core import Rule, call_name, register
 
 
-def _parse_points(tree):
-    """The POINTS tuple of a registry module, or None."""
+def _parse_points(tree, name="POINTS"):
+    """A string-tuple assignment of a registry module, or None."""
     for node in tree.body:
         if isinstance(node, ast.Assign):
             for t in node.targets:
-                if isinstance(t, ast.Name) and t.id == "POINTS":
+                if isinstance(t, ast.Name) and t.id == name:
                     if isinstance(node.value, (ast.Tuple, ast.List)):
                         vals = [el.value for el in node.value.elts
                                 if isinstance(el, ast.Constant)]
                         return tuple(vals), node.lineno
     return None
+
+
+def _find_registry(project, name="POINTS"):
+    """(parsed tuple+lineno, FileContext) of the registry module in the
+    scanned tree, or (None, None)."""
+    suffix = project.config.fault_registry_suffix
+    for ctx in project.files:
+        path = ctx.path.replace("\\", "/")
+        if path.endswith(suffix):
+            parsed = _parse_points(ctx.tree, name)
+            if parsed:
+                return parsed, ctx
+            break
+    return None, None
 
 
 def _point_sites(tree):
@@ -60,15 +74,7 @@ class FaultPointCoverageRule(Rule):
 
     def finish(self, project):
         cfg = project.config
-        registry = None
-        registry_ctx = None
-        for ctx in project.files:
-            path = ctx.path.replace("\\", "/")
-            if path.endswith(cfg.fault_registry_suffix):
-                parsed = _parse_points(ctx.tree)
-                if parsed:
-                    registry, registry_ctx = parsed, ctx
-                break
+        registry, registry_ctx = _find_registry(project)
         if cfg.fault_points is not None:
             points = set(cfg.fault_points)
         elif registry is not None:
@@ -108,3 +114,69 @@ class FaultPointUnfiredRule(Rule):
     family = "faults"
     rationale = ("a registered point with no fire() site is promised "
                  "chaos coverage that cannot be triggered")
+
+
+@register
+class FaultPointUntestedRule(Rule):
+    """Device-level fault points (the registry's DEVICE_POINTS tuple:
+    device_loss, collective_timeout, straggler_delay) model failures
+    of a whole chip, not of one request — a fire() site alone proves
+    the code CAN inject them, not that the recovery ladder (lane
+    quarantine, work stealing, checkpoint resume) is ever driven. Each
+    device point must be ARMED — inject()/FaultPoint() with the point
+    as its first argument — from at least one test file. Runs only
+    when both the registry and at least one test file are in the scan,
+    so a package-only lint stays quiet."""
+
+    id = "fault-point-untested"
+    family = "faults"
+    rationale = ("a device-level fault point no test arms means the "
+                 "quarantine/steal/resume path it exists to exercise "
+                 "is never driven in CI")
+
+    def finish(self, project):
+        cfg = project.config
+        if cfg.device_fault_points is not None:
+            device_points = tuple(cfg.device_fault_points)
+            registry, registry_ctx = _find_registry(project)
+        else:
+            registry, registry_ctx = _find_registry(
+                project, "DEVICE_POINTS")
+            if registry is None:
+                return
+            device_points = registry[0]
+        if registry_ctx is None:
+            return
+        markers = tuple(cfg.test_path_markers)
+
+        def _is_test(path):
+            # dir markers ("/tests/") match anywhere in the path;
+            # file markers ("/test_") match the basename only — a
+            # "test_*" substring in a parent directory (pytest tmp
+            # dirs are named after the test) must not count
+            p = "/" + path.replace("\\", "/")
+            base = p.rsplit("/", 1)[-1]
+            return any(m in p if m.endswith("/")
+                       else base.startswith(m.lstrip("/"))
+                       for m in markers)
+
+        test_ctxs = [ctx for ctx in project.files
+                     if _is_test(ctx.path)]
+        if not test_ctxs:
+            return  # package-only scan: nothing to prove
+        armed = set()
+        for ctx in test_ctxs:
+            for call, point, _node in _point_sites(ctx.tree):
+                # arming is inject()/FaultPoint(); a bare fire() in a
+                # test exercises nothing unless a point is armed, and
+                # fire() in test helpers is rare enough to ignore
+                if call.rsplit(".", 1)[-1] in ("inject", "FaultPoint"):
+                    armed.add(point)
+        line = registry[1] if registry is not None else 1
+        for point in sorted(set(device_points) - armed):
+            registry_ctx.report(
+                self.id, line,
+                f"device-level fault point '{point}' is never armed "
+                f"(inject()/FaultPoint()) by any test in the scanned "
+                f"tree: its quarantine/steal/resume recovery path is "
+                f"untested")

@@ -230,6 +230,79 @@ class FitCheckpointer:
         return int(state["iter"]) if state is not None and "iter" in state else -1
 
 
+# version stamp of the resilience-state checkpoint LAYOUT (the
+# breaker/health dicts inside carry their own per-component versions,
+# checked by the load_state_dict methods)
+RESILIENCE_STATE_VERSION = 1
+
+
+def save_resilience_state(directory, tag="resilience", breaker=None,
+                          health=None):
+    """Persist CircuitBreaker / HealthMonitor state through
+    FitCheckpointer so a restarted process does not forget tripped
+    breakers or a draining health standing (ISSUE 6 satellite).
+
+    The JSON-encoded state rides as a uint8 byte array, NOT a sidecar
+    string: the save path's CRC32 integrity record only covers
+    numeric arrays, and breaker state is exactly the kind of small
+    blob a torn write corrupts silently. Rotation to ``<tag>.prev``
+    and corrupt-fallback come with FitCheckpointer for free.
+    ``directory`` may be a path or an existing FitCheckpointer."""
+    import json
+
+    ckpt = (directory if isinstance(directory, FitCheckpointer)
+            else FitCheckpointer(directory))
+    state = {}
+    if breaker is not None:
+        state["breaker"] = breaker.state_dict()
+    if health is not None:
+        state["health"] = health.state_dict()
+    blob = np.frombuffer(
+        json.dumps(state, sort_keys=True).encode(), dtype=np.uint8)
+    ckpt.save(tag, {"resilience_json": blob.copy(),
+                    "resilience_version": RESILIENCE_STATE_VERSION})
+    return ckpt
+
+
+def restore_resilience_state(directory, tag="resilience", breaker=None,
+                             health=None):
+    """Load a save_resilience_state snapshot and apply it to the given
+    breaker/health objects. Any mismatch — missing snapshot, foreign
+    layout version, undecodable blob, or a per-component version the
+    load_state_dict methods reject — warns and leaves the objects in
+    their reset state rather than guessing. Returns the set of
+    component names actually restored."""
+    import json
+
+    ckpt = (directory if isinstance(directory, FitCheckpointer)
+            else FitCheckpointer(directory))
+    state = ckpt.restore(tag)
+    if state is None or "resilience_json" not in state:
+        return set()
+    version = int(np.asarray(state.get("resilience_version", -1)))
+    if version != RESILIENCE_STATE_VERSION:
+        warnings.warn(
+            f"resilience checkpoint {tag!r} has layout version "
+            f"{version}, this build writes {RESILIENCE_STATE_VERSION}; "
+            "resetting breaker/health state")
+        return set()
+    try:
+        blob = np.asarray(state["resilience_json"], dtype=np.uint8)
+        decoded = json.loads(blob.tobytes().decode())
+    except (ValueError, UnicodeDecodeError) as e:
+        warnings.warn(f"resilience checkpoint {tag!r} is undecodable "
+                      f"({type(e).__name__}: {e}); resetting state")
+        return set()
+    restored = set()
+    if breaker is not None and "breaker" in decoded:
+        if breaker.load_state_dict(decoded["breaker"]):
+            restored.add("breaker")
+    if health is not None and "health" in decoded:
+        if health.load_state_dict(decoded["health"]):
+            restored.add("health")
+    return restored
+
+
 def _warn_restart(tag, ckpt):
     """Shared 'nothing valid survives' report for the checkpointed_*
     drivers: on-disk snapshot(s) exist but none restored."""

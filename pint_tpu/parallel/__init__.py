@@ -2,6 +2,9 @@ from .pta import (PTABatch, PTAFleet, fleet_aot_compile,  # noqa: F401
                   fleet_pipeline_metrics, stack_prepared)
 from .shapeplan import (PlanBucket, PlanRow, Segment,  # noqa: F401
                         ShapePlan, plan_shapes, pow2_width)
-from .mesh import make_mesh, make_mesh2d, shard_batch  # noqa: F401
+from .mesh import (make_mesh, make_mesh2d, shard_batch,  # noqa: F401
+                   lane_meshes)
 from .distributed import (initialize_distributed,  # noqa: F401
                           process_pulsar_slice, global_pulsar_mesh)
+from .fleetmesh import (FleetMesh, DeviceLane, DeviceLost,  # noqa: F401
+                        CollectiveTimeout, run_watched)

@@ -98,15 +98,29 @@ def initialize_distributed(coordinator_address=None, num_processes=None,
         except (TypeError, ValueError):
             pass
 
+    def _initialize():
+        # fleetmesh's work-steal path re-runs initialize_distributed
+        # when it re-shards buckets after a device loss; on jax builds
+        # where the is_init() fallback chain above cannot see the
+        # runtime state (the attribute moved between 0.4.x releases),
+        # the native client raises instead of no-oping. Treat exactly
+        # that "already initialized" RuntimeError as success — every
+        # other error still propagates.
+        try:
+            jax.distributed.initialize(**init_kw)
+        except RuntimeError as e:
+            if "already initialized" not in str(e).lower():
+                raise
+
     if timeout_s is None:
-        jax.distributed.initialize(**init_kw)
+        _initialize()
         return jax.process_index(), jax.process_count()
 
     outcome = {}
 
     def _worker():
         try:
-            jax.distributed.initialize(**init_kw)
+            _initialize()
             outcome["ok"] = True
         except Exception as e:  # surfaced in the caller below
             outcome["error"] = e

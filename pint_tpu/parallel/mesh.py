@@ -23,6 +23,17 @@ def make_mesh(n_pulsar_shards=None, devices=None) -> Mesh:
     return Mesh(np.array(devices[:n]), axis_names=("pulsar",))
 
 
+def lane_meshes(devices=None):
+    """One single-device 1-D 'pulsar' Mesh PER device, in device
+    order — the per-device failure domains fleetmesh.DeviceLane wraps.
+    A bucket fit placed on one of these meshes touches exactly one
+    chip, so losing that chip poisons one lane's buckets and nothing
+    else (contrast make_mesh, where every bucket spans all devices and
+    one lost chip kills every in-flight program)."""
+    devices = devices if devices is not None else jax.devices()
+    return [Mesh(np.array([d]), axis_names=("pulsar",)) for d in devices]
+
+
 def make_mesh2d(n_pulsar_shards, n_toa_shards, devices=None) -> Mesh:
     """2-D ('pulsar', 'toa') mesh: pulsar data parallelism combined
     with TOA-axis (sequence) sharding inside each pulsar shard. The

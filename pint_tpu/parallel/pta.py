@@ -1904,6 +1904,96 @@ class PTAFleet:
             j -= 1
         return sorted(bounds)
 
+    @classmethod
+    def plan_groups(cls, models, toas_list, toa_bucket=None,
+                    bucket_floor=256, plan_compile_budget=None,
+                    plan_max_pack=None, plan_quantum=None,
+                    plan_min_width=None):
+        """Bucket assignment WITHOUT building any PTABatch: returns
+        (groups, build_kwargs, plans) where groups maps bucket key ->
+        pulsar indices, build_kwargs maps bucket key -> the PTABatch
+        constructor kwargs (plan= / pad_toas=) that bucket needs, and
+        plans maps structure key -> ShapePlan (toa_bucket="plan"
+        only). Shared by __init__ and by fleetmesh.FleetMesh, whose
+        DeviceLanes defer per-lane batch construction until a bucket
+        is actually dispatched to (or stolen by) a device."""
+        split_k = None
+        if isinstance(toa_bucket, str) and toa_bucket.startswith("split"):
+            try:
+                split_k = int(toa_bucket[5:])
+            except ValueError:
+                split_k = 0
+            if split_k < 1:
+                raise ValueError(f"toa_bucket {toa_bucket!r}: 'split<k>' "
+                                 f"needs a positive integer k")
+        elif toa_bucket not in (None, "pow2", "plan"):
+            raise ValueError(f"toa_bucket must be None, 'pow2', 'plan', "
+                             f"or 'split<k>', got {toa_bucket!r}")
+        split_bounds = {}
+        if split_k is not None:
+            by_struct = {}
+            for m, t in zip(models, toas_list):
+                by_struct.setdefault(PTABatch.structure_key(m),
+                                     []).append(len(t))
+            split_bounds = {sk: cls.optimal_split_bounds(cs, split_k)
+                            for sk, cs in by_struct.items()}
+        plans = {}
+        build_kwargs = {}
+        if toa_bucket == "plan":
+            from . import shapeplan
+
+            plan_kw = {}
+            if plan_compile_budget is not None:
+                plan_kw["compile_budget"] = int(plan_compile_budget)
+            if plan_quantum is not None:
+                plan_kw["quantum"] = int(plan_quantum)
+            if plan_min_width is not None:
+                plan_kw["min_width"] = int(plan_min_width)
+            max_pack = (int(plan_max_pack) if plan_max_pack is not None
+                        else shapeplan.DEFAULT_MAX_PACK)
+            by_struct = {}
+            for i, (m, t) in enumerate(zip(models, toas_list)):
+                by_struct.setdefault(PTABatch.structure_key(m),
+                                     []).append(i)
+            groups = {}
+            for skey, idxs in by_struct.items():
+                tmpl = models[idxs[0]]
+                # packing needs the per-segment GLS math; structures
+                # with no correlated-noise basis take the WLS route,
+                # so they get singleton planned-width rows instead
+                packable = any(
+                    getattr(c, "basis_weight", None) is not None
+                    for c in tmpl.components.values())
+                plan = shapeplan.plan_shapes(
+                    [len(toas_list[i]) for i in idxs],
+                    max_pack=max_pack if packable else 1, **plan_kw)
+                plans[skey] = plan
+                for bucket in plan.buckets:
+                    key = (skey, ("plan", bucket.width))
+                    groups[key] = [idxs[j] for j in bucket.indices()]
+                    if packable and any(len(r.segments) > 1
+                                        for r in bucket.rows):
+                        build_kwargs[key] = {"plan": bucket.renumbered()}
+                    else:
+                        build_kwargs[key] = {"pad_toas": bucket.width}
+        else:
+            groups = {}
+            for i, (m, t) in enumerate(zip(models, toas_list)):
+                key = PTABatch.structure_key(m)
+                if toa_bucket == "pow2":
+                    # canonical pow2 convention shared with serve slot
+                    # keys, routed through the shape planner's wrapper
+                    from .shapeplan import pow2_width
+
+                    key = (key, pow2_width(len(t), bucket_floor))
+                elif split_k is not None:
+                    for b in split_bounds[key]:
+                        if len(t) <= b:
+                            break
+                    key = (key, b)
+                groups.setdefault(key, []).append(i)
+        return groups, build_kwargs, plans
+
     def __init__(self, models, toas_list, mesh=None, toa_bucket=None,
                  bucket_floor=256, pipeline=False,
                  plan_compile_budget=None, plan_max_pack=None,
@@ -1943,81 +2033,12 @@ class PTAFleet:
         identical to pipeline=False — only scheduling changes."""
         self.buckets = {}
         self.order = []  # (bucket_key, index_within_bucket) per pulsar
-        split_k = None
-        if isinstance(toa_bucket, str) and toa_bucket.startswith("split"):
-            try:
-                split_k = int(toa_bucket[5:])
-            except ValueError:
-                split_k = 0
-            if split_k < 1:
-                raise ValueError(f"toa_bucket {toa_bucket!r}: 'split<k>' "
-                                 f"needs a positive integer k")
-        elif toa_bucket not in (None, "pow2", "plan"):
-            raise ValueError(f"toa_bucket must be None, 'pow2', 'plan', "
-                             f"or 'split<k>', got {toa_bucket!r}")
-        split_bounds = {}
-        if split_k is not None:
-            by_struct = {}
-            for m, t in zip(models, toas_list):
-                by_struct.setdefault(PTABatch.structure_key(m),
-                                     []).append(len(t))
-            split_bounds = {sk: self.optimal_split_bounds(cs, split_k)
-                            for sk, cs in by_struct.items()}
-        self.plans = {}
-        build_kwargs = {}
-        if toa_bucket == "plan":
-            from . import shapeplan
-
-            plan_kw = {}
-            if plan_compile_budget is not None:
-                plan_kw["compile_budget"] = int(plan_compile_budget)
-            if plan_quantum is not None:
-                plan_kw["quantum"] = int(plan_quantum)
-            if plan_min_width is not None:
-                plan_kw["min_width"] = int(plan_min_width)
-            max_pack = (int(plan_max_pack) if plan_max_pack is not None
-                        else shapeplan.DEFAULT_MAX_PACK)
-            by_struct = {}
-            for i, (m, t) in enumerate(zip(models, toas_list)):
-                by_struct.setdefault(PTABatch.structure_key(m),
-                                     []).append(i)
-            groups = {}
-            for skey, idxs in by_struct.items():
-                tmpl = models[idxs[0]]
-                # packing needs the per-segment GLS math; structures
-                # with no correlated-noise basis take the WLS route,
-                # so they get singleton planned-width rows instead
-                packable = any(
-                    getattr(c, "basis_weight", None) is not None
-                    for c in tmpl.components.values())
-                plan = shapeplan.plan_shapes(
-                    [len(toas_list[i]) for i in idxs],
-                    max_pack=max_pack if packable else 1, **plan_kw)
-                self.plans[skey] = plan
-                for bucket in plan.buckets:
-                    key = (skey, ("plan", bucket.width))
-                    groups[key] = [idxs[j] for j in bucket.indices()]
-                    if packable and any(len(r.segments) > 1
-                                        for r in bucket.rows):
-                        build_kwargs[key] = {"plan": bucket.renumbered()}
-                    else:
-                        build_kwargs[key] = {"pad_toas": bucket.width}
-        else:
-            groups = {}
-            for i, (m, t) in enumerate(zip(models, toas_list)):
-                key = PTABatch.structure_key(m)
-                if toa_bucket == "pow2":
-                    # canonical pow2 convention shared with serve slot
-                    # keys, routed through the shape planner's wrapper
-                    from .shapeplan import pow2_width
-
-                    key = (key, pow2_width(len(t), bucket_floor))
-                elif split_k is not None:
-                    for b in split_bounds[key]:
-                        if len(t) <= b:
-                            break
-                    key = (key, b)
-                groups.setdefault(key, []).append(i)
+        groups, build_kwargs, self.plans = self.plan_groups(
+            models, toas_list, toa_bucket=toa_bucket,
+            bucket_floor=bucket_floor,
+            plan_compile_budget=plan_compile_budget,
+            plan_max_pack=plan_max_pack, plan_quantum=plan_quantum,
+            plan_min_width=plan_min_width)
         self.group_indices = groups
         self.pipeline = bool(pipeline)
         self._lock = threading.RLock()
@@ -2157,6 +2178,8 @@ class PTAFleet:
         import os
         from concurrent.futures import ThreadPoolExecutor
 
+        from ..resilience import faultinject
+
         xs = [None] * self.n
         chi2s = np.zeros(self.n)
         covs = [None] * self.n
@@ -2221,10 +2244,22 @@ class PTAFleet:
             # async dispatch queues the device work); a bucket waits
             # only for its OWN compile
             handles = []
-            for key, idxs, batch, use_gls, bkw, pkey in plan:
+            for bi, (key, idxs, batch, use_gls, bkw, pkey) in \
+                    enumerate(plan):
                 fut = compile_futs.get(key)
                 if fut is not None:
                     self.compile_infos[key] = fut.result()
+                # device-level chaos: a straggling device delays THIS
+                # bucket's dispatch without failing it — downstream
+                # buckets still dispatch, finalize order is unchanged,
+                # so results stay bitwise-equal to sequential. The
+                # payload's "lane" (when set) pins which bucket index
+                # straggles; fire() ctx must not shadow it.
+                fault = faultinject.fire("straggler_delay", bucket=bi)
+                if fault and int(fault.get("lane", bi)) == bi:
+                    import time as _time
+
+                    _time.sleep(float(fault.get("delay_s", 0.0)))
                 if use_gls:
                     h = batch._dispatch_gls(
                         maxiter, bkw.get("threshold", 1e-12),

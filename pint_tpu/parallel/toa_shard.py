@@ -45,6 +45,19 @@ def _place(mesh, tree, specs):
         if isinstance(x, jax.Array) else x, tree, specs)
 
 
+def _watched_get(arrays, watchdog_s, what):
+    """jax.device_get bounded by the collective watchdog (None: plain
+    blocking get). The get is where a hung psum/all_gather actually
+    wedges the caller — dispatch is async — so this is the one site
+    that needs the bound."""
+    if watchdog_s is None:
+        return jax.device_get(arrays)
+    from .fleetmesh import run_watched
+
+    return run_watched(lambda: jax.device_get(arrays), watchdog_s,
+                       what=what)
+
+
 def sharded_residuals(template_model, static, mesh: Mesh, params, batch, prep,
                       axis="toa"):
     """Residual seconds with the TOA axis sharded over ``mesh``.
@@ -100,7 +113,8 @@ def sharded_chi2(template_model, static, mesh, params, batch, prep, axis="toa"):
 
 
 def sharded_gls_fit(model, toas, mesh: Mesh, maxiter=2, threshold=1e-12,
-                    axis="toa", precision="f64", compile_timings=None):
+                    axis="toa", precision="f64", compile_timings=None,
+                    watchdog_s=None):
     """Single-pulsar GLS fit with the TOA axis sharded over ``mesh`` —
     the sequence-parallel path for a pulsar whose TOA/photon count
     outgrows one chip (SURVEY section 5 "long-context").
@@ -128,6 +142,12 @@ def sharded_gls_fit(model, toas, mesh: Mesh, maxiter=2, threshold=1e-12,
     whose exact-residual matvec is two O(n_local k) products plus one
     psum per step — the distributed twin of PTABatch's mixed mode,
     with the same non-contraction fallback to f64.
+
+    ``watchdog_s``: bound the blocking device pull of the fit results
+    with ``fleetmesh.run_watched`` — THIS is the call a hung psum /
+    all_gather wedges (dispatch is async; the hang surfaces at the
+    pull), so with a bound it raises a catchable
+    ``fleetmesh.CollectiveTimeout`` instead of blocking forever.
 
     Returns (x, whitened_chi2, cov) as numpy, matching
     fitter.GLSFitter on the same data (pinned by test_parallel.py).
@@ -333,7 +353,8 @@ def sharded_gls_fit(model, toas, mesh: Mesh, maxiter=2, threshold=1e-12,
         step_h = _maybe_aot("step_h", step_h, x, batch, arrays, *pre)
         for _ in range(maxiter):
             x, chi2, covn, norm, relres = step_h(x, batch, arrays, *pre)
-        x, chi2, covn, norm = jax.device_get((x, chi2, covn, norm))
+        x, chi2, covn, norm = _watched_get(
+            (x, chi2, covn, norm), watchdog_s, "sharded_gls_fit hoisted")
         cov = cov_from_normalized(covn, norm)
         return x, float(chi2), cov
     step = _maybe_aot("step", step, x, batch, arrays)
@@ -344,7 +365,8 @@ def sharded_gls_fit(model, toas, mesh: Mesh, maxiter=2, threshold=1e-12,
         # solve happens to converge (a Python max() would also swallow
         # a NaN — fitter.relres_failed is the nan-aware guard)
         relres_hist.append(float(relres))
-    x, chi2, covn, norm = jax.device_get((x, chi2, covn, norm))
+    x, chi2, covn, norm = _watched_get(
+        (x, chi2, covn, norm), watchdog_s, "sharded_gls_fit")
     from ..fitter import relres_failed
 
     if precision == "mixed" and relres_failed(relres_hist):

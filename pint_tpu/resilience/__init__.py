@@ -17,6 +17,7 @@ accelerators).
 """
 
 from .faultinject import (  # noqa: F401
+    DEVICE_POINTS,
     POINTS,
     FaultInjected,
     FaultPoint,

@@ -27,6 +27,21 @@ Injection points (site locations in parentheses):
   lanes.
 - ``checkpoint_corrupt`` — a snapshot is damaged on disk after a
   save (``checkpoint.FitCheckpointer.save``).
+- ``device_loss`` — a device in the fleet mesh dies mid-fit
+  (``parallel.fleetmesh.FleetMesh`` bucket dispatch raises
+  ``DeviceLost``; serve per-device lane flushes). Payload ``lane``
+  pins which DeviceLane index dies; omitted means whichever lane
+  fires first.
+- ``collective_timeout`` — a cross-device collective (psum /
+  all_gather) hangs past the watchdog
+  (``parallel.fleetmesh``'s watched result pulls raise
+  ``CollectiveTimeout``). Payload ``hang_s`` sets the simulated
+  hang; >= the watchdog bound means timeout, less is a late-but-ok
+  collective.
+- ``straggler_delay`` — one device runs slow without failing
+  (``parallel.fleetmesh`` bucket dispatch and the pipelined fleet
+  executor's per-bucket dispatch loop). Payload ``delay_s`` sets
+  the injected stall, ``lane`` pins the slow lane.
 
 Disarmed sites cost one falsy-dict check; nothing here imports jax.
 """
@@ -39,7 +54,14 @@ from contextlib import contextmanager
 import numpy as np
 
 POINTS = ("toa_nan", "toa_inf_error", "compile_fail", "dispatch_slow",
-          "solver_diverge", "checkpoint_corrupt")
+          "solver_diverge", "checkpoint_corrupt", "device_loss",
+          "collective_timeout", "straggler_delay")
+
+# the device-level failure domain (ISSUE 6): points that model a chip
+# / lane dying, hanging, or straggling rather than a bad request —
+# pintlint's coverage rule additionally requires each of these to be
+# ARMED by a test, not just fired by production code
+DEVICE_POINTS = ("device_loss", "collective_timeout", "straggler_delay")
 
 
 class FaultInjected(RuntimeError):
@@ -186,6 +208,9 @@ def parse_spec(spec):
                 kw[k] = int(v)
             elif k == "lanes":
                 payload[k] = [int(x) for x in v.split("+")]
+            elif k == "lane":
+                # device-level points address one DeviceLane by index
+                payload[k] = int(v)
             elif k == "retryable":
                 payload[k] = v.lower() in ("1", "true", "yes")
             else:

@@ -29,9 +29,14 @@ from __future__ import annotations
 
 import threading
 import time
+import warnings
 from collections import deque
 
 STATES = ("healthy", "degraded", "draining")
+
+# version stamp for HealthMonitor.state_dict snapshots (see
+# retry.STATE_VERSION for the convention)
+STATE_VERSION = 1
 
 
 class HealthMonitor:
@@ -161,3 +166,65 @@ class HealthMonitor:
                 "breaker_trips": self._breaker_trips,
                 "watchdog_breaches": self._watchdog_breaches,
             }
+
+    # -- checkpoint serialization -----------------------------------
+
+    def state_dict(self):
+        """JSON-safe full monitor state for checkpointing. Clock-based
+        fields (since, last breach/reason times) serialize as
+        seconds-AGO relative to the monitor's own clock; restore
+        re-anchors them on the restoring clock, so hysteresis windows
+        survive a process restart on a different monotonic epoch."""
+        with self._lock:
+            now = self.clock()
+
+            def ago(t):
+                return None if t is None else max(0.0, now - t)
+
+            return {"version": STATE_VERSION, "kind": "health_monitor",
+                    "state": self.state,
+                    "since_ago_s": max(0.0, now - self.since),
+                    "reasons": list(self.reasons),
+                    "events": [int(e) for e in self._events],
+                    "open_breakers": int(self._open_breakers),
+                    "breaker_trips": int(self._breaker_trips),
+                    "watchdog_breaches": int(self._watchdog_breaches),
+                    "last_breach_ago_s": ago(self._last_breach_t),
+                    "last_reason_ago_s": ago(self._last_reason_t)}
+
+    def load_state_dict(self, state):
+        """Restore a state_dict() snapshot (a restarted process keeps
+        its degraded/draining standing and recovery hysteresis).
+        Warns and leaves the monitor reset on a version/kind or state
+        mismatch. Returns True when state was applied."""
+        if (not isinstance(state, dict)
+                or state.get("kind") != "health_monitor"
+                or int(state.get("version", -1)) != STATE_VERSION
+                or state.get("state") not in STATES):
+            got = (state.get("version")
+                   if isinstance(state, dict) else type(state).__name__)
+            warnings.warn(
+                "HealthMonitor.load_state_dict: snapshot version/kind "
+                f"mismatch (got {got!r}, want {STATE_VERSION}); "
+                "resetting health state")
+            return False
+
+        with self._lock:
+            now = self.clock()
+
+            def at(ago):
+                return None if ago is None else now - float(ago)
+
+            self.state = str(state["state"])
+            self.since = now - float(state.get("since_ago_s", 0.0))
+            self.reasons = [str(r) for r in state.get("reasons", [])]
+            self._events = deque(
+                (1 if int(e) else 0 for e in state.get("events", [])),
+                maxlen=self.window)
+            self._open_breakers = int(state.get("open_breakers", 0))
+            self._breaker_trips = int(state.get("breaker_trips", 0))
+            self._watchdog_breaches = int(
+                state.get("watchdog_breaches", 0))
+            self._last_breach_t = at(state.get("last_breach_ago_s"))
+            self._last_reason_t = at(state.get("last_reason_ago_s"))
+        return True

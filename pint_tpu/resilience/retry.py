@@ -21,10 +21,16 @@ from __future__ import annotations
 
 import threading
 import time
+import warnings
 
 import numpy as np
 
 from .faultinject import FaultInjected
+
+# version stamp for CircuitBreaker.state_dict snapshots; bump on any
+# layout change so a restored foreign snapshot warns-and-resets
+# instead of silently mis-restoring breaker state
+STATE_VERSION = 1
 
 # substrings of exception text that mark a failure as transient on the
 # tunneled-TPU stack (relay hiccups surface as UNAVAILABLE/DEADLINE
@@ -218,3 +224,62 @@ class CircuitBreaker:
         with self._lock:
             return {"trips": self.trips, "open": self.open_count(),
                     "tracked_keys": len(self._keys)}
+
+    # -- checkpoint serialization -----------------------------------
+
+    def state_dict(self):
+        """JSON-safe full breaker state for checkpointing. opened_at
+        is a monotonic-clock reading with no meaning in another
+        process, so open keys serialize their REMAINING cooldown
+        instead; restore re-anchors it on the restoring clock. Keys
+        (serve slot tuples, lane tuples) ride as repr strings."""
+        with self._lock:
+            now = self.clock()
+            keys = []
+            for key, e in self._keys.items():
+                remaining = None
+                if e["opened_at"] is not None:
+                    remaining = max(0.0,
+                                    self.cooldown_s - (now - e["opened_at"]))
+                keys.append([repr(key), int(e["consecutive"]),
+                             remaining, bool(e["trial"])])
+            return {"version": STATE_VERSION, "kind": "circuit_breaker",
+                    "threshold": self.threshold,
+                    "cooldown_s": self.cooldown_s,
+                    "trips": int(self.trips), "keys": keys}
+
+    def load_state_dict(self, state):
+        """Restore a state_dict() snapshot so a restarted process does
+        not forget tripped breakers. A version/kind mismatch (foreign
+        or future snapshot) warns and leaves the breaker reset —
+        guessing at another layout could silently mis-open or
+        mis-close keys. Returns True when state was applied."""
+        import ast
+
+        if (not isinstance(state, dict)
+                or state.get("kind") != "circuit_breaker"
+                or int(state.get("version", -1)) != STATE_VERSION):
+            got = (state.get("version")
+                   if isinstance(state, dict) else type(state).__name__)
+            warnings.warn(
+                "CircuitBreaker.load_state_dict: snapshot version/kind "
+                f"mismatch (got {got!r}, want {STATE_VERSION}); "
+                "resetting breaker state")
+            return False
+        with self._lock:
+            self._keys.clear()
+            self.trips = int(state.get("trips", 0))
+            now = self.clock()
+            for rkey, consecutive, remaining, trial in state.get("keys", []):
+                try:
+                    # slot keys are tuples of str/int: repr round-trips
+                    key = ast.literal_eval(rkey)
+                except (ValueError, SyntaxError):
+                    key = rkey
+                opened_at = None
+                if remaining is not None:
+                    opened_at = now - (self.cooldown_s - float(remaining))
+                self._keys[key] = {"consecutive": int(consecutive),
+                                   "opened_at": opened_at,
+                                   "trial": bool(trial)}
+        return True

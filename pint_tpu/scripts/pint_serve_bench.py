@@ -275,6 +275,138 @@ def run_chaos_stream(n_requests=216, fault_rate=0.05,
     return report
 
 
+def run_device_chaos(n_requests=96, fault_point="device_loss",
+                     n_devices=None, max_batch=8, max_latency_s=0.05,
+                     bucket_floor=64, cache_capacity=32,
+                     sizes=(48, 96, 180), per_combo=3, maxiter=3,
+                     precision="f64", seed=0, rel_tol=1e-9,
+                     fleet_rel_tol=1e-15):
+    """Device-level chaos acceptance: both multi-device surfaces run
+    with an injected device-level fault and are differenced against
+    fault-free runs on the same lanes.
+
+    Serve leg (always device_loss): the request stream on an N-lane
+    ServeEngine loses one routed device mid-stream; the contract is
+    that the lane is quarantined, its slots shed onto the next alive
+    lane, every request still completes "ok", and results match the
+    fault-free stream bitwise (same programs, different chip).
+
+    Fleet leg (``fault_point``: device_loss / collective_timeout /
+    straggler_delay): a FleetMesh fleet fit takes the fault and must
+    complete on the survivors with parameters within ``fleet_rel_tol``
+    (ISSUE 6 acceptance: <= 1e-15) of the healthy fit, stealing the
+    dead lane's buckets deterministically.
+
+    Returns a JSON-safe report; report["ok"] summarizes both legs.
+    Keys are bench.py's chaos_device_* meta values."""
+    import jax
+
+    from pint_tpu.parallel import FleetMesh
+    from pint_tpu.resilience import DEVICE_POINTS, FaultPoint, inject
+    from pint_tpu.serve import FitRequest, ServeEngine
+
+    if fault_point not in DEVICE_POINTS:
+        raise ValueError(f"fault_point must be one of {DEVICE_POINTS}, "
+                         f"got {fault_point!r}")
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n_lanes = len(devices)
+    models, toas_list = build_serve_fleet(sizes=sizes,
+                                          per_combo=per_combo,
+                                          seed=seed)
+    n_pulsars = len(models)
+
+    def req(i):
+        return FitRequest(models[i % n_pulsars],
+                          toas_list[i % n_pulsars],
+                          maxiter=maxiter, precision=precision)
+
+    def engine():
+        return ServeEngine(max_batch=max_batch,
+                           max_latency_s=max_latency_s,
+                           bucket_floor=bucket_floor,
+                           cache_capacity=cache_capacity,
+                           devices=devices)
+
+    # -- serve leg: one device dies mid-stream ----------------------
+    eng0 = engine()
+    clean = eng0.run_stream([req(i) for i in range(n_requests)])
+    eng1 = engine()
+    # after=2: the loss lands mid-stream (a couple of flushes in),
+    # small enough to fire even when slots batch efficiently
+    with inject(FaultPoint("device_loss", rate=1.0, count=1,
+                           after=2, seed=seed)):
+        chaos = eng1.run_stream([req(i) for i in range(n_requests)])
+    snap = eng1.snapshot()
+    serve_failures = sum(1 for r in chaos if r.status != "ok")
+    worst_serve = 0.0
+    for rc, rf in zip(clean, chaos):
+        if rc.status != "ok" or rf.status != "ok":
+            continue
+        rel = np.max(np.abs(np.asarray(rf.value["x"])
+                            - np.asarray(rc.value["x"]))
+                     / np.maximum(np.abs(np.asarray(rc.value["x"])),
+                                  1e-30))
+        worst_serve = float(np.maximum(worst_serve, rel))
+    dev = snap.get("devices", {})
+
+    # -- fleet leg: FleetMesh fit through the injected fault --------
+    fleet_kw = dict(devices=devices, toa_bucket="pow2",
+                    bucket_floor=bucket_floor)
+    if fault_point == "collective_timeout":
+        # injected hangs advance a no-op sleep; the real watchdog
+        # bound stays generous so genuine compiles never trip it
+        fleet_kw.update(collective_timeout_s=120.0,
+                        sleep=lambda s: None)
+    else:
+        fleet_kw.update(collective_timeout_s=None)
+    payloads = {"device_loss": {},
+                "collective_timeout": {"hang_s": 240.0},
+                "straggler_delay": {"delay_s": 0.0}}
+    fm_h = FleetMesh(models, toas_list, **fleet_kw)
+    hx, hc, _ = fm_h.fit(maxiter=maxiter)
+    fm_c = FleetMesh(models, toas_list, **fleet_kw)
+    with inject(FaultPoint(fault_point, rate=1.0, count=1, seed=seed,
+                           payload=payloads[fault_point])):
+        cx, cc, _ = fm_c.fit(maxiter=maxiter)
+    worst_fleet = 0.0
+    for i in range(n_pulsars):
+        rel = np.max(np.abs(np.asarray(cx[i]) - np.asarray(hx[i]))
+                     / np.maximum(np.abs(np.asarray(hx[i])), 1e-30))
+        worst_fleet = float(np.maximum(worst_fleet, rel))
+    fsnap = fm_c.snapshot()
+
+    report = {
+        "fault_point": fault_point,
+        "n_lanes": n_lanes,
+        "n_requests": n_requests,
+        "serve_failures": serve_failures,
+        "serve_max_rel_diff_vs_clean": worst_serve,
+        "serve_lost_lanes": dev.get("lost_lanes", []),
+        "serve_device_lost": snap["counters"].get("device_lost", 0),
+        "fleet_max_rel_diff_vs_healthy": worst_fleet,
+        "fleet_lost_lanes": fsnap["lost_lanes"],
+        "fleet_stolen_buckets": fsnap["stolen_buckets"],
+        "fleet_reassignments": fsnap["reassignments"],
+        "all_done": all(r.done for r in chaos),
+    }
+    # device_loss must actually kill a lane on each leg; the other
+    # fault points are absorbed (strike/delay) without lane loss
+    expect_loss = fault_point == "device_loss"
+    report["ok"] = bool(
+        report["all_done"]
+        and serve_failures == 0
+        and worst_serve <= rel_tol
+        and len(report["serve_lost_lanes"]) == 1
+        and report["serve_device_lost"] == 1
+        and worst_fleet <= fleet_rel_tol
+        and (len(report["fleet_lost_lanes"]) == (1 if expect_loss
+                                                 else 0))
+        and (report["fleet_stolen_buckets"] >= 1) == expect_loss)
+    return report
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="pint_serve_bench",
@@ -301,10 +433,36 @@ def main(argv=None) -> int:
                         "fault injection vs a fault-free reference) "
                         "instead of the plain serve bench")
     p.add_argument("--fault-rate", type=float, default=0.05)
-    p.add_argument("--fault-point", default="toa_nan")
+    p.add_argument("--fault-point", default="toa_nan",
+                   help="request-level point for the chaos stream, or "
+                        "a device-level point (device_loss, "
+                        "collective_timeout, straggler_delay) to run "
+                        "the multi-lane device-chaos acceptance "
+                        "instead")
+    p.add_argument("--devices", type=int, default=None,
+                   help="device-chaos only: cap the lane count "
+                        "(default: every jax device)")
     args = p.parse_args(argv)
 
     if args.chaos:
+        from pint_tpu.resilience import DEVICE_POINTS
+
+        if args.fault_point in DEVICE_POINTS:
+            report = run_device_chaos(
+                n_requests=args.requests,
+                fault_point=args.fault_point,
+                n_devices=args.devices, max_batch=args.max_batch,
+                max_latency_s=args.max_latency,
+                bucket_floor=args.bucket_floor, maxiter=args.maxiter,
+                precision=args.precision)
+            print(json.dumps(report, default=float))
+            if not report["ok"]:
+                print("FAIL: device-chaos contract violated "
+                      f"(serve_failures={report['serve_failures']}, "
+                      f"fleet_rel="
+                      f"{report['fleet_max_rel_diff_vs_healthy']})",
+                      file=sys.stderr)
+            return 0 if report["ok"] else 1
         report = run_chaos_stream(
             n_requests=args.requests, fault_rate=args.fault_rate,
             fault_point=args.fault_point, max_batch=args.max_batch,

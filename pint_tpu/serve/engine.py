@@ -35,6 +35,7 @@ from __future__ import annotations
 import copy
 import time
 import warnings
+import zlib
 
 import numpy as np
 
@@ -59,6 +60,14 @@ class ServeEngine:
         delays (tests pass the fake clock's advance).
     backoff / breaker / health: resilience policies; defaults are
         constructed on the engine's clock.
+    devices: optional device list; each becomes a
+        parallel.fleetmesh.DeviceLane failure domain with its OWN
+        health/breaker. Slots route to a lane by a crc32 of the slot
+        key (stable across processes), executables and the
+        zero-retrace contract are tracked per (slot, lane), and a
+        quarantined lane (device_loss) sheds its slots onto the next
+        alive lane. devices=None keeps the single-implicit-device
+        engine byte-identical to before.
     """
 
     def __init__(self, max_batch=8, max_latency_s=0.05, max_queue=256,
@@ -66,7 +75,7 @@ class ServeEngine:
                  oversize_toas=policy.DEFAULT_OVERSIZE_TOAS,
                  mesh=None, clock=time.monotonic, sleep=time.sleep,
                  backoff=None, breaker=None, health=None,
-                 bisect_depth=4, plan=None):
+                 bisect_depth=4, plan=None, devices=None):
         self.plan = plan  # optional shapeplan.ShapePlan width ladder
         self.batcher = MicroBatcher(max_batch=max_batch,
                                     max_latency_s=max_latency_s,
@@ -84,9 +93,19 @@ class ServeEngine:
         self.health = health or HealthMonitor(clock=clock)
         self.bisect_depth = int(bisect_depth)
         self.executables_compiled = 0
+        self.device_lanes = None
+        if devices is not None:
+            from ..parallel.fleetmesh import DeviceLane
+
+            self.device_lanes = [DeviceLane(i, d, clock=clock)
+                                 for i, d in enumerate(devices)]
         # slot_key -> set of exec_keys seen: a second DISTINCT
         # executable for a slot is an unexpected recompile (shapes are
-        # supposed to be pinned), counted and breaker-relevant
+        # supposed to be pinned), counted and breaker-relevant. With
+        # device lanes the tracking key is (slot_key, lane_index): a
+        # slot legitimately compiles once per lane it lands on (a
+        # steal after device loss included), and only a second
+        # executable on the SAME lane breaks the contract.
         self._slot_exec_keys = {}
         self._slot_recompiles = {}
 
@@ -242,10 +261,15 @@ class ServeEngine:
 
     def snapshot(self):
         """JSON-safe service snapshot: telemetry aggregate + cache
-        counters + health/breaker state + compile/queue state."""
+        counters + health/breaker state + compile/queue state; with
+        device lanes configured, a ``devices`` block with each lane's
+        own health/breaker census rides along."""
+        lanes = ([ln.snapshot() for ln in self.device_lanes]
+                 if self.device_lanes is not None else None)
         snap = self.telemetry.snapshot(cache=self.cache,
                                        health=self.health,
-                                       breaker=self.breaker)
+                                       breaker=self.breaker,
+                                       devices=lanes)
         snap["executables_compiled"] = self.executables_compiled
         snap["queue_depth"] = self.batcher.depth()
         return snap
@@ -262,17 +286,49 @@ class ServeEngine:
             return base + (self.plan.signature(),)
         return base
 
-    def _padded_batch(self, bucket, models, toas_list):
+    def _route_lane(self, slot_key):
+        """Deterministic slot -> device-lane routing: crc32 of the
+        slot key picks the home lane (stable across processes and
+        engine restarts — no dict-order or hash-seed dependence), and
+        dead/open/draining lanes are walked past in index order so a
+        quarantined device sheds its slots onto the next alive lane.
+        Returns None when devices aren't configured (the
+        single-implicit-device default) or when no lane survives."""
+        if not self.device_lanes:
+            return None
+        n = len(self.device_lanes)
+        home = zlib.crc32(repr(slot_key).encode()) % n
+        for step in range(n):
+            lane = self.device_lanes[(home + step) % n]
+            if lane.alive():
+                return lane
+        return None
+
+    def _seen_key(self, slot_key, lane):
+        """Zero-retrace tracking key: per (slot, lane) when device
+        lanes are on — a steal onto a new lane compiles once
+        legitimately — else the slot key itself (unchanged default)."""
+        return slot_key if lane is None else (slot_key, lane.index)
+
+    def _padded_batch(self, bucket, models, toas_list, lane=None):
         """Lane-padded PTABatch for one slot flush: the pulsar/lane
         axis replicates the last (model, toas) up to max_batch and the
         TOA axis pads to the slot's pow2 bucket, so every flush of a
-        slot presents the executable cache with identical shapes."""
+        slot presents the executable cache with identical shapes.
+        With a device lane routed (and no explicit mesh), the batch
+        arrays commit to that lane's device so the flush runs inside
+        its failure domain."""
         from ..parallel.pta import PTABatch
 
         lanes = self.batcher.max_batch
         n = len(models)
         models = models + [models[-1]] * (lanes - n)
         toas_list = toas_list + [toas_list[-1]] * (lanes - n)
+        if lane is not None and self.mesh is None:
+            import jax
+
+            with jax.default_device(lane.device):
+                return PTABatch(models, toas_list, pad_toas=bucket)
         return PTABatch(models, toas_list, mesh=self.mesh,
                         pad_toas=bucket)
 
@@ -501,12 +557,36 @@ class ServeEngine:
         _, bucket, kind, method, maxiter, precision = slot_key
         n_live = len(live)
         lanes = self.batcher.max_batch
+        dev_lane = self._route_lane(slot_key)
+        if self.device_lanes is not None:
+            fault = faultinject.fire("device_loss", slot=str(slot_key))
+            if (fault and dev_lane is not None
+                    and int(fault.get("lane", dev_lane.index))
+                    == dev_lane.index):
+                # the routed device died: quarantine its lane and let
+                # the crc32 walk shed this slot onto the next alive
+                # lane — the flush proceeds there, no request fails
+                dev_lane.quarantine()
+                self.telemetry.incr("device_lost")
+                dev_lane = self._route_lane(slot_key)
+            if dev_lane is None:
+                from ..parallel.fleetmesh import DeviceLost
+
+                raise DeviceLost(
+                    f"no alive device lane for slot {slot_key!r} "
+                    f"({len(self.device_lanes)} lanes quarantined)")
         t0 = self.clock()
         pta = self._padded_batch(bucket,
                                  [req.model for req, _, _ in live],
-                                 [req.toas for req, _, _ in live])
+                                 [req.toas for req, _, _ in live],
+                                 lane=dev_lane)
         pack_s = self.clock() - t0
         exec_key = self._exec_key(slot_key, lanes, pta)
+        if dev_lane is not None:
+            # per-lane executables: a stolen slot compiles fresh on
+            # its new lane instead of reusing device-committed state
+            exec_key = exec_key + (("lane", dev_lane.index),)
+        seen_key = self._seen_key(slot_key, dev_lane)
         fns = self.cache.lookup(exec_key)
         cold = fns is None
         compile_s = 0.0
@@ -527,13 +607,14 @@ class ServeEngine:
                 compile_s = self.clock() - t0
             self.executables_compiled += 1
             self.cache.insert(exec_key, pta._fns)
-            seen = self._slot_exec_keys.setdefault(slot_key, set())
+            seen = self._slot_exec_keys.setdefault(seen_key, set())
             if seen and exec_key not in seen:
                 # shapes are pinned, so a second distinct executable
-                # for a slot means the zero-retrace contract broke
+                # for a slot (on this lane) means the zero-retrace
+                # contract broke
                 self.telemetry.incr("unexpected_recompiles")
-                n = self._slot_recompiles.get(slot_key, 0) + 1
-                self._slot_recompiles[slot_key] = n
+                n = self._slot_recompiles.get(seen_key, 0) + 1
+                self._slot_recompiles[seen_key] = n
                 if n >= self.breaker.threshold:
                     tripped = self.breaker.trip(slot_key)
                     self.health.note_breakers(self.breaker.open_count(),
@@ -541,7 +622,7 @@ class ServeEngine:
             seen.add(exec_key)
         else:
             pta._fns = fns
-            self._slot_exec_keys.setdefault(slot_key, set()).add(exec_key)
+            self._slot_exec_keys.setdefault(seen_key, set()).add(exec_key)
 
         fault = faultinject.fire("dispatch_slow", slot=str(slot_key))
         if fault:
@@ -613,6 +694,10 @@ class ServeEngine:
             res.telemetry = rec
             self.telemetry.record(**rec)
             self.health.note_request("ok")
+        if dev_lane is not None:
+            dev_lane.health.note_request("ok")
+            dev_lane.health.note_flush(done - flush_start)
+            dev_lane.breaker.record_success(dev_lane.key)
         return set()
 
     def _execute_solo(self, request, res, routing, submitted_at):

@@ -45,11 +45,16 @@ class ServeTelemetry:
                 if r.get("status") == status
                 and r.get(phase) is not None]
 
-    def snapshot(self, cache=None, health=None, breaker=None):
+    def snapshot(self, cache=None, health=None, breaker=None,
+                 devices=None):
         """JSON-safe aggregate: request counts, per-phase p50/p99/max
         over completed requests, counters, and (optionally) the
         executable cache's hit/miss/evict counters plus the resilience
-        layer's health state and circuit-breaker census."""
+        layer's health state and circuit-breaker census.
+
+        devices: list of DeviceLane.snapshot() dicts (the engine's
+        per-device failure domains); summarized into a ``devices``
+        block with alive/lost census alongside the per-lane detail."""
         snap = {
             "requests": len(self.records),
             "requests_ok": sum(1 for r in self.records
@@ -69,11 +74,21 @@ class ServeTelemetry:
             snap["health"] = health.snapshot()
         if breaker is not None:
             snap["breaker"] = breaker.snapshot()
+        if devices is not None:
+            snap["devices"] = {
+                "n_lanes": len(devices),
+                "alive_lanes": sum(1 for d in devices if d.get("alive")),
+                "lost_lanes": [d["index"] for d in devices
+                               if d.get("lost")],
+                "lanes": list(devices),
+            }
         return snap
 
-    def to_json(self, cache=None, health=None, breaker=None, **dump_kw):
+    def to_json(self, cache=None, health=None, breaker=None,
+                devices=None, **dump_kw):
         return json.dumps(self.snapshot(cache=cache, health=health,
-                                        breaker=breaker), **dump_kw)
+                                        breaker=breaker, devices=devices),
+                          **dump_kw)
 
     def reset(self):
         self.counters = {}

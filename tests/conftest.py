@@ -13,6 +13,7 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 # The axon sitecustomize registers the TPU plugin at interpreter start and
 # overrides JAX_PLATFORMS, so the env var alone is not enough: force CPU via
@@ -20,3 +21,18 @@ import jax  # noqa: E402
 # not correctly rounded, while tests validate exact-IEEE numerics.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+@pytest.fixture
+def device_mesh():
+    """N>=4 virtual-device CPU mesh for distributed-failure-domain
+    tests. The XLA_FLAGS above normally guarantee 8 virtual devices,
+    but a backend that ignores the flag (a real accelerator plugin
+    that won the platform race, or a host pinned to 1 device) must
+    skip rather than fail — device-loss tests are meaningless with
+    nothing to steal onto."""
+    devices = jax.devices()
+    if len(devices) < 4:
+        pytest.skip(f"need >=4 devices for fleet failure-domain tests, "
+                    f"have {len(devices)} ({devices[0].platform})")
+    return devices

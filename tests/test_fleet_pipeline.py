@@ -381,3 +381,221 @@ def test_prewarm_holds_cache_and_batcher_locks():
         eng.drain()
     assert_no_violations(cache_violations)
     assert_no_violations(batcher_violations)
+
+
+# -- distributed failure domains (fleetmesh, ISSUE 6) ----------------
+
+
+from pint_tpu.parallel import (CollectiveTimeout, DeviceLost,  # noqa: E402
+                               FleetMesh)
+
+
+@pytest.fixture(scope="module")
+def fleet_psrs():
+    """wls-structure + gls-structure pulsars (2 buckets, 4 pulsars)."""
+    ms, ts = _spin_pulsars(2)
+    mn, tn = _noise_pulsars(2)
+    return ms + mn, ts + tn
+
+
+@pytest.fixture(scope="module")
+def fleet_ref(fleet_psrs):
+    """Healthy FleetMesh fit every chaos test compares against."""
+    models, toas_list = fleet_psrs
+    fm = FleetMesh(models, toas_list, collective_timeout_s=None)
+    xs, chi2s, covs = fm.fit(method="auto", maxiter=2)
+    assert fm.snapshot()["lost_lanes"] == []
+    return ([np.asarray(x) for x in xs], np.asarray(chi2s),
+            [np.asarray(c) for c in covs])
+
+
+def _assert_matches_ref(ref, got, rel_tol=0.0):
+    rx, rc, rcov = ref
+    gx, gc, gcov = got
+    np.testing.assert_array_equal(rc, np.asarray(gc))
+    for i in range(len(rx)):
+        if rel_tol == 0.0:
+            np.testing.assert_array_equal(rx[i], np.asarray(gx[i]))
+            np.testing.assert_array_equal(rcov[i], np.asarray(gcov[i]))
+        else:
+            denom = np.maximum(np.abs(rx[i]), 1e-30)
+            rel = float(np.max(np.abs(np.asarray(gx[i]) - rx[i]) / denom))
+            assert rel <= rel_tol, rel
+
+
+def test_fleetmesh_healthy_bitwise_matches_ptafleet(fleet_psrs,
+                                                    fleet_ref,
+                                                    device_mesh):
+    """Sharding the fleet over per-device lanes is pure scheduling:
+    same buckets, same programs, bitwise-identical results to the
+    single-placement PTAFleet path."""
+    models, toas_list = fleet_psrs
+    fleet = PTAFleet(models, toas_list)
+    xf, cf, covf = fleet.fit(method="auto", maxiter=2)
+    _assert_matches_ref(fleet_ref,
+                        ([np.asarray(x) for x in np.asarray(xf)],
+                         np.asarray(cf),
+                         [np.asarray(c) for c in np.asarray(covf)]))
+
+
+def test_fleetmesh_device_loss_completes_on_survivors(fleet_psrs,
+                                                      fleet_ref,
+                                                      device_mesh):
+    """The acceptance criterion: an N>=4-lane fleet with one lane
+    killed mid-fit completes on the survivors, parameters within
+    1e-15 relative of the healthy run (bitwise on CPU: the stolen
+    bucket re-runs the identical program on another device)."""
+    models, toas_list = fleet_psrs
+    assert len(device_mesh) >= 4
+    fm = FleetMesh(models, toas_list, collective_timeout_s=None)
+    with inject(FaultPoint("device_loss", rate=1.0,
+                           payload={"lane": 0})):
+        got = fm.fit(method="auto", maxiter=2)
+    _assert_matches_ref(fleet_ref, got, rel_tol=1e-15)
+    _assert_matches_ref(fleet_ref, got)  # and in fact bitwise
+    snap = fm.snapshot()
+    assert snap["lost_lanes"] == [0]
+    assert snap["alive_lanes"] == len(device_mesh) - 1
+    assert snap["stolen_buckets"] >= 1
+    assert snap["quarantined_pulsars"] == []
+
+
+def test_fleetmesh_work_steal_deterministic(fleet_psrs, device_mesh):
+    """Reassignment after a lane loss is a pure function of the
+    (bucket set, survivor set): two identical chaos runs produce the
+    same reassignment ledger and bitwise-equal results."""
+    models, toas_list = fleet_psrs
+
+    def chaos_run():
+        fm = FleetMesh(models, toas_list, collective_timeout_s=None)
+        got = fm.fit(method="auto", maxiter=2)
+        return got, fm.snapshot()
+
+    with inject(FaultPoint("device_loss", rate=1.0,
+                           payload={"lane": 0})):
+        got1, snap1 = chaos_run()
+    with inject(FaultPoint("device_loss", rate=1.0,
+                           payload={"lane": 0})):
+        got2, snap2 = chaos_run()
+    assert snap1["reassignments"] == snap2["reassignments"]
+    assert snap1["lost_lanes"] == snap2["lost_lanes"]
+    _assert_matches_ref((got1[0], got1[1], got1[2]), got2)
+
+
+def test_fleetmesh_collective_timeout_trips_breaker(fleet_psrs,
+                                                    fleet_ref,
+                                                    device_mesh):
+    """A collective that hangs past the watchdog raises a catchable
+    CollectiveTimeout, strikes the lane's breaker, and after
+    breaker_threshold strikes the lane is quarantined and its buckets
+    stolen — the fit still completes, matching the healthy run. The
+    hang is simulated through the injected sleep: no real waiting."""
+    models, toas_list = fleet_psrs
+    slept = []
+    fm = FleetMesh(models, toas_list, collective_timeout_s=30.0,
+                   sleep=slept.append, breaker_threshold=2)
+    with inject(FaultPoint("collective_timeout", rate=1.0, count=2,
+                           payload={"lane": 0, "hang_s": 60.0})):
+        got = fm.fit(method="auto", maxiter=2)
+    _assert_matches_ref(fleet_ref, got)
+    snap = fm.snapshot()
+    assert snap["lost_lanes"] == [0]
+    assert snap["stolen_buckets"] >= 1
+    # the watchdog waited its full bound (simulated), twice
+    assert slept.count(30.0) == 2
+
+
+def test_fleetmesh_late_collective_is_absorbed(fleet_psrs, fleet_ref,
+                                               device_mesh):
+    """A hang SHORTER than the watchdog bound is a slow-but-ok
+    collective: no timeout, no strike, no lane loss."""
+    models, toas_list = fleet_psrs
+    slept = []
+    fm = FleetMesh(models, toas_list, collective_timeout_s=30.0,
+                   sleep=slept.append)
+    with inject(FaultPoint("collective_timeout", rate=1.0, count=1,
+                           payload={"lane": 0, "hang_s": 5.0})):
+        got = fm.fit(method="auto", maxiter=2)
+    _assert_matches_ref(fleet_ref, got)
+    assert fm.snapshot()["lost_lanes"] == []
+    assert 5.0 in slept
+
+
+def test_fleetmesh_straggler_slows_without_failing(fleet_psrs,
+                                                   fleet_ref,
+                                                   device_mesh):
+    """straggler_delay stalls one lane's bucket dispatch (recorded in
+    its health flush window) but nothing fails and nothing is
+    stolen."""
+    models, toas_list = fleet_psrs
+    slept = []
+    fm = FleetMesh(models, toas_list, collective_timeout_s=None,
+                   sleep=slept.append)
+    with inject(FaultPoint("straggler_delay", rate=1.0, count=1,
+                           payload={"lane": 0, "delay_s": 7.5})):
+        got = fm.fit(method="auto", maxiter=2)
+    _assert_matches_ref(fleet_ref, got)
+    snap = fm.snapshot()
+    assert snap["lost_lanes"] == [] and snap["stolen_buckets"] == 0
+    assert 7.5 in slept
+
+
+def test_pipelined_straggler_stays_bitwise():
+    """The pipelined executor's straggler site delays one bucket's
+    dispatch; finalize order is unchanged, so results stay bitwise
+    equal to the sequential path."""
+    fleet = _mixed_fleet(pipeline=True)
+    xs, c2s, covs, div_s = _fit_arrays(fleet, method="auto", maxiter=2,
+                                       pipeline=False)
+    fp = FaultPoint("straggler_delay", rate=1.0, count=1,
+                    payload={"delay_s": 0.0})
+    with inject(fp):
+        xp, c2p, covp, div_p = _fit_arrays(fleet, method="auto",
+                                           maxiter=2, pipeline=True)
+    assert fp.fires == 1  # the chaos actually landed
+    assert np.array_equal(xs, xp)
+    assert np.array_equal(c2s, c2p)
+    assert np.array_equal(covs, covp)
+    assert div_s == div_p == []
+
+
+def test_fleetmesh_resume_after_device_loss_bitwise(tmp_path,
+                                                    fleet_psrs,
+                                                    fleet_ref,
+                                                    device_mesh):
+    """Kill the whole fleet mid-fit (every lane dies when touched,
+    after the first bucket checkpointed), restart from the
+    checkpoint: the restored + re-fit parameters are bitwise equal to
+    an uninterrupted run's."""
+    models, toas_list = fleet_psrs
+    fm1 = FleetMesh(models, toas_list, collective_timeout_s=None)
+    with inject(FaultPoint("device_loss", rate=1.0, after=1)):
+        with pytest.raises(DeviceLost):
+            fm1.fit(method="auto", maxiter=2,
+                    checkpoint_dir=str(tmp_path))
+    from pint_tpu.checkpoint import FitCheckpointer
+
+    saved = FitCheckpointer(tmp_path).restore("fleetmesh")
+    assert saved is not None and len(saved["done"]) == 1  # mid-fleet
+
+    fm2 = FleetMesh(models, toas_list, collective_timeout_s=None)
+    got = fm2.fit(method="auto", maxiter=2,
+                  checkpoint_dir=str(tmp_path))
+    _assert_matches_ref(fleet_ref, got)
+    assert fm2.snapshot()["lost_lanes"] == []
+
+
+def test_fleetmesh_foreign_checkpoint_warns_and_restarts(tmp_path,
+                                                         fleet_psrs,
+                                                         device_mesh):
+    """A checkpoint taken for a different fit configuration must not
+    be silently half-applied: warn and restart from scratch."""
+    models, toas_list = fleet_psrs
+    fm1 = FleetMesh(models, toas_list, collective_timeout_s=None)
+    fm1.fit(method="auto", maxiter=2, checkpoint_dir=str(tmp_path))
+    fm2 = FleetMesh(models, toas_list, collective_timeout_s=None)
+    with pytest.warns(UserWarning,
+                      match="different fleet/fit configuration"):
+        got = fm2.fit(method="auto", maxiter=1,
+                      checkpoint_dir=str(tmp_path))
+    assert all(np.isfinite(np.asarray(c)).all() for c in got[1:2])

@@ -459,6 +459,65 @@ def test_fault_point_unfired_flags_dead_registry_entry(tmp_path):
     assert live(fs, "fault-point-unknown") == []
 
 
+DEVICE_FAULT_REGISTRY = """
+    POINTS = ("toa_nan", "device_loss", "straggler_delay")
+    DEVICE_POINTS = ("device_loss", "straggler_delay")
+
+    def fire(point):
+        return point in POINTS
+"""
+
+DEVICE_FAULT_USER = """
+    from faultreg import fire
+
+    def go():
+        fire("toa_nan")
+        fire("device_loss")
+        fire("straggler_delay")
+"""
+
+
+def test_fault_point_untested_flags_unarmed_device_point(tmp_path):
+    test = """
+        from faultreg import FaultPoint, inject
+
+        def test_device_loss():
+            with inject(FaultPoint("device_loss", rate=1.0)):
+                pass
+    """
+    fs = lint(tmp_path, {"faultreg.py": DEVICE_FAULT_REGISTRY,
+                         "user.py": DEVICE_FAULT_USER,
+                         "tests/test_chaos.py": test}, _fault_cfg())
+    untested = live(fs, "fault-point-untested")
+    # straggler_delay is fired by the package but never armed by the
+    # test; device_loss is armed (both FaultPoint() and inject() count)
+    assert len(untested) == 1, untested
+    assert "straggler_delay" in untested[0].message
+
+
+def test_fault_point_untested_quiet_when_all_armed(tmp_path):
+    test = """
+        from faultreg import FaultPoint, inject
+
+        def test_chaos():
+            with inject(FaultPoint("device_loss", rate=1.0),
+                        FaultPoint("straggler_delay", rate=1.0)):
+                pass
+    """
+    fs = lint(tmp_path, {"faultreg.py": DEVICE_FAULT_REGISTRY,
+                         "user.py": DEVICE_FAULT_USER,
+                         "tests/test_chaos.py": test}, _fault_cfg())
+    assert live(fs, "fault-point-untested") == []
+
+
+def test_fault_point_untested_quiet_without_tests_in_scope(tmp_path):
+    # package-only scan: the rule cannot tell armed from unarmed, so
+    # it must stay silent instead of flagging every device point
+    fs = lint(tmp_path, {"faultreg.py": DEVICE_FAULT_REGISTRY,
+                         "user.py": DEVICE_FAULT_USER}, _fault_cfg())
+    assert live(fs, "fault-point-untested") == []
+
+
 # -- timing-no-block -------------------------------------------------
 
 
@@ -593,6 +652,19 @@ def test_tree_has_zero_unsuppressed_findings():
     suppression comment — this test is the enforcement point."""
     findings = run([PKG], config=LintConfig.default())
     bad = unsuppressed(findings)
+    assert bad == [], text_report(findings)
+
+
+def test_tree_device_faults_are_armed_by_tests():
+    """Every device-level fault point in the live registry must be
+    armed by at least one test: the quarantine / work-steal / resume
+    recovery ladder only exists if CI can actually trigger it. Scans
+    package + tests filtered to the one rule — the broader tests tree
+    is not held to the package's zero-findings bar."""
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    findings = run([PKG, tests_dir], config=LintConfig.default())
+    bad = [f for f in unsuppressed(findings)
+           if f.rule == "fault-point-untested"]
     assert bad == [], text_report(findings)
 
 

@@ -616,3 +616,175 @@ def test_chaos_stream_contract():
     assert report["max_rel_diff_vs_clean"] == 0.0
     assert report["health_state"] == "healthy"
     assert report["unexpected_recompiles"] == 0
+
+
+# -- breaker/health checkpoint serialization (ISSUE 6 satellite) -----
+
+
+def test_breaker_state_roundtrip_reanchors_cooldown():
+    """An open key's cooldown serializes as REMAINING seconds and
+    re-anchors on the restoring clock: a restarted process (fresh
+    monotonic epoch) keeps the breaker open for exactly the time the
+    crashed process had left."""
+    clock = FakeClock()
+    br = CircuitBreaker(threshold=2, cooldown_s=10.0, clock=clock)
+    br.record_failure(("lane", 0))
+    br.record_failure(("lane", 0))  # trips
+    clock.advance(4.0)  # 6 s of cooldown left at snapshot time
+    state = br.state_dict()
+
+    clock2 = FakeClock()
+    clock2.advance(12345.0)  # unrelated monotonic epoch
+    br2 = CircuitBreaker(threshold=2, cooldown_s=10.0, clock=clock2)
+    assert br2.load_state_dict(state)
+    assert br2.state(("lane", 0)) == "open"
+    assert br2.retry_after_s(("lane", 0)) == pytest.approx(6.0)
+    assert br2.snapshot()["trips"] == 1
+    clock2.advance(6.1)
+    assert br2.state(("lane", 0)) == "half_open"
+
+
+def test_breaker_state_version_mismatch_warns_and_resets():
+    br = CircuitBreaker(clock=FakeClock())
+    with pytest.warns(UserWarning, match="version/kind mismatch"):
+        assert not br.load_state_dict(
+            {"version": 99, "kind": "circuit_breaker"})
+    assert br.state("anything") == "closed"  # left reset, not guessed
+
+
+def test_health_state_roundtrip_preserves_standing():
+    clock = FakeClock()
+    h = HealthMonitor(clock=clock, window=8, min_events=4,
+                      degraded_shed_rate=0.25, recovery_s=10.0)
+    for _ in range(3):
+        h.note_request("ok")
+    h.note_request("shed")
+    assert h.state == "degraded"
+    state = h.state_dict()
+
+    clock2 = FakeClock()
+    clock2.advance(777.0)
+    h2 = HealthMonitor(clock=clock2, window=8, min_events=4,
+                       degraded_shed_rate=0.25, recovery_s=10.0)
+    assert h2.load_state_dict(state)
+    assert h2.state == "degraded" and "shed_rate" in h2.reasons
+    # hysteresis survives the restart: recovery still needs the
+    # configured quiet period on the NEW clock
+    for _ in range(8):
+        h2.note_request("ok")
+    clock2.advance(10.1)
+    h2.note_request("ok")
+    clock2.advance(10.1)
+    h2.note_request("ok")
+    assert h2.state == "healthy"
+
+
+def test_health_state_version_mismatch_warns_and_resets():
+    h = HealthMonitor(clock=FakeClock())
+    with pytest.warns(UserWarning, match="version/kind mismatch"):
+        assert not h.load_state_dict(
+            {"version": 0, "kind": "health_monitor", "state": "healthy"})
+    assert h.state == "healthy"
+
+
+def test_resilience_state_checkpoint_roundtrip(tmp_path):
+    clock = FakeClock()
+    br = CircuitBreaker(threshold=1, cooldown_s=30.0, clock=clock)
+    br.record_failure(("lane", 2))  # threshold=1: trips immediately
+    h = HealthMonitor(clock=clock, window=8, min_events=2,
+                      degraded_shed_rate=0.25)
+    h.note_request("shed")
+    h.note_request("shed")
+    assert h.state != "healthy"
+    ckpt_mod.save_resilience_state(tmp_path, breaker=br, health=h)
+
+    br2 = CircuitBreaker(threshold=1, cooldown_s=30.0,
+                         clock=FakeClock())
+    h2 = HealthMonitor(clock=FakeClock(), window=8, min_events=2,
+                       degraded_shed_rate=0.25)
+    restored = ckpt_mod.restore_resilience_state(
+        tmp_path, breaker=br2, health=h2)
+    assert restored == {"breaker", "health"}
+    assert br2.state(("lane", 2)) == "open"
+    assert h2.state == h.state
+
+
+def test_resilience_state_rotation_falls_back_to_prev(tmp_path):
+    """Breaker state rides FitCheckpointer's CRC + <tag>.prev
+    machinery: a torn write of the current snapshot falls back to the
+    previous one instead of silently resetting every breaker."""
+    clock = FakeClock()
+    br = CircuitBreaker(threshold=1, cooldown_s=30.0, clock=clock)
+    br.record_failure(("lane", 1))
+    ckpt = ckpt_mod.save_resilience_state(tmp_path, breaker=br)
+    br.record_failure(("lane", 3))
+    ckpt_mod.save_resilience_state(ckpt, breaker=br)  # rotates .prev
+    ckpt._corrupt_snapshot("resilience")
+    br2 = CircuitBreaker(threshold=1, cooldown_s=30.0,
+                         clock=FakeClock())
+    with pytest.warns(UserWarning,
+                      match="unreadable or corrupt|integrity"):
+        restored = ckpt_mod.restore_resilience_state(
+            tmp_path, breaker=br2)
+    assert restored == {"breaker"}
+    # the .prev snapshot predates lane 3's trip
+    assert br2.state(("lane", 1)) == "open"
+    assert br2.state(("lane", 3)) == "closed"
+
+
+def test_resilience_state_layout_version_mismatch(tmp_path, monkeypatch):
+    br = CircuitBreaker(threshold=1, clock=FakeClock())
+    br.record_failure(("lane", 0))
+    ckpt_mod.save_resilience_state(tmp_path, breaker=br)
+    monkeypatch.setattr(ckpt_mod, "RESILIENCE_STATE_VERSION", 2)
+    br2 = CircuitBreaker(threshold=1, clock=FakeClock())
+    with pytest.warns(UserWarning, match="layout version"):
+        restored = ckpt_mod.restore_resilience_state(
+            tmp_path, breaker=br2)
+    assert restored == set()
+    assert br2.state(("lane", 0)) == "closed"  # reset, not guessed
+
+
+# -- device-level fault points (ISSUE 6) -----------------------------
+
+
+def test_device_points_registered_and_classified():
+    from pint_tpu.parallel import CollectiveTimeout, DeviceLost
+    from pint_tpu.resilience import DEVICE_POINTS
+    from pint_tpu.resilience.retry import is_retryable
+
+    assert set(DEVICE_POINTS) == {"device_loss", "collective_timeout",
+                                  "straggler_delay"}
+    for p in DEVICE_POINTS:
+        FaultPoint(p)  # every device point is a registered point
+    # a hung collective is transient (the retry loop may try another
+    # lane); a lost device is not — it must quarantine, not retry
+    assert is_retryable(CollectiveTimeout("psum hung after 60 s"))
+    assert not is_retryable(DeviceLost("lane 3 lost"))
+
+
+def test_serve_device_loss_quarantines_and_reroutes(two_pulsars,
+                                                    device_mesh):
+    """A device_loss during a flush quarantines that DeviceLane and
+    re-routes the slot to the next alive lane inline: the requests on
+    the dead chip still complete, and results match a fault-free
+    engine bitwise."""
+    import jax
+
+    (m0, t0), (m1, t1) = two_pulsars
+    eng_ok, _ = _fake_engine(max_batch=1)
+    clean = [eng_ok.submit(FitRequest(copy.deepcopy(m), t, maxiter=2))
+             for m, t in [(m0, t0), (m1, t1)]]
+
+    eng, _ = _fake_engine(max_batch=1, devices=jax.devices())
+    with inject(FaultPoint("device_loss", rate=1.0, count=1)):
+        chaos = [eng.submit(FitRequest(copy.deepcopy(m), t, maxiter=2))
+                 for m, t in [(m0, t0), (m1, t1)]]
+    assert all(r.status == "ok" for r in chaos)
+    for rc, rl in zip(clean, chaos):
+        np.testing.assert_array_equal(np.asarray(rc.value["x"]),
+                                      np.asarray(rl.value["x"]))
+    assert eng.telemetry.counters.get("device_lost", 0) == 1
+    snap = eng.snapshot()
+    assert len(snap["devices"]["lost_lanes"]) == 1
+    assert snap["devices"]["alive_lanes"] == len(jax.devices()) - 1
