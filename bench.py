@@ -21,14 +21,15 @@ any same-shape PTA batch; a cold end-to-end run is compile_s + refit).
 import json
 import os
 import sys
-import time
 import warnings
 
 warnings.simplefilter("ignore")
 
 import numpy as np
 
-_T0 = time.time()
+from pint_tpu.obs import clock as obs_clock
+
+_T0 = obs_clock.now()
 
 # set when the full-scale mixed pass's daemon thread outlives its
 # budget (still stuck in a device wait): main() must os._exit past it
@@ -37,7 +38,7 @@ _MIXED_THREAD_ALIVE = False
 
 def _stage(msg):
     # progress to stderr; stdout stays the single JSON line
-    print(f"[bench +{time.time() - _T0:7.1f}s] {msg}", file=sys.stderr,
+    print(f"[bench +{obs_clock.now() - _T0:7.1f}s] {msg}", file=sys.stderr,
           flush=True)
 
 
@@ -271,16 +272,16 @@ def _full_scale_stage(meta):
                                           fleet.batches.values())]
 
     cache_path = _mode_cache_path(bucket_mode)
-    t0 = time.time()
+    t0 = obs_clock.now()
     entries = _load_entries(cache_path)
     if entries is not None:
         _stage(f"full-scale pack cache hit "
-               f"({time.time() - t0:.1f}s load)")
+               f"({obs_clock.now() - t0:.1f}s load)")
     models = toas_list = None
     if entries is None:
         _stage(f"full-scale host prep: 68 ragged pulsars, "
                f"{counts.sum()} TOAs (~minutes, cached afterwards)")
-        t0 = time.time()
+        t0 = obs_clock.now()
         models, toas_list = [], []
         rng = np.random.default_rng(1)
         for i, n in enumerate(counts):
@@ -306,12 +307,12 @@ def _full_scale_stage(meta):
                 f["f"] = "L-wide"
             models.append(m)
             toas_list.append(t)
-        host_s = time.time() - t0
+        host_s = obs_clock.now() - t0
         _stage(f"full-scale host prep done ({host_s:.0f}s); packing "
                f"({bucket_mode} bucketing)")
-        t0 = time.time()
+        t0 = obs_clock.now()
         fleet = PTAFleet(models, toas_list, toa_bucket=toa_bucket)
-        pack_s = time.time() - t0
+        pack_s = obs_clock.now() - t0
         _stage(f"packed {len(fleet.batches)} buckets ({pack_s:.0f}s, "
                f"padding x{fleet.padding_ratio:.2f}); caching pack")
         entries = _fleet_entries(fleet, models)
@@ -319,10 +320,10 @@ def _full_scale_stage(meta):
         batches = list(fleet.batches.values())
         rebuild_s = pack_s
     else:
-        t0 = time.time()
+        t0 = obs_clock.now()
         batches = [PTABatch.from_packed(get_model(par), st)
                    for par, _, st in entries]
-        rebuild_s = time.time() - t0
+        rebuild_s = obs_clock.now() - t0
     bucket_idxs = [idxs for _, idxs, _ in entries]
     # actually-packed count, not counts.sum(): epoch clustering floors
     # each pulsar to a multiple of 4 TOAs
@@ -338,7 +339,7 @@ def _full_scale_stage(meta):
     # concurrent wall is what a cold start actually pays now.
     from pint_tpu.parallel import fleet_aot_compile
 
-    t0 = time.time()
+    t0 = obs_clock.now()
     infos, compile_concurrent_s = fleet_aot_compile(
         [(b, {"method": "gls", "maxiter": 2}) for b in batches])
     trace_s = sum(i["trace_s"] for i in infos)
@@ -347,31 +348,31 @@ def _full_scale_stage(meta):
     xla_flops = (sum(i["flops"] for i in infos) if flops_known else 0.0)
     for b in batches:
         b.gls_fit(maxiter=2)  # warm-up execution (buffers, transfers)
-    compile_s = time.time() - t0
+    compile_s = obs_clock.now() - t0
     # cold end-to-end: packed-state rebuild + concurrent compile +
     # first full fit (everything a cold process pays after the pack
     # cache; the r05 baseline paid 23.6s of SERIAL compile here)
     cold_e2e_s = rebuild_s + compile_s
-    t0 = time.time()
+    t0 = obs_clock.now()
     chi2s = []
     x64s = []
     for b in batches:
         x64, chi2, _ = b.gls_fit(maxiter=2)
         x64s.append(np.asarray(x64))
         chi2s.append(np.asarray(chi2))
-    refit_s = time.time() - t0
+    refit_s = obs_clock.now() - t0
     # pipelined executor vs the sequential per-bucket loop, warm:
     # dispatch-all + finalize-in-order overlaps each bucket's host
     # unpack with the next bucket's queued device work
     fleet_all = PTAFleet.from_batches(batches)
-    t0 = time.time()
+    t0 = obs_clock.now()
     xs_seq, chi_seq, _ = fleet_all.fit(method="gls", maxiter=2,
                                        pipeline=False)
-    fleet_seq_s = time.time() - t0
-    t0 = time.time()
+    fleet_seq_s = obs_clock.now() - t0
+    t0 = obs_clock.now()
     xs_pipe, chi_pipe, _ = fleet_all.fit(method="gls", maxiter=2,
                                          pipeline=True)
-    fleet_pipe_s = time.time() - t0
+    fleet_pipe_s = obs_clock.now() - t0
     pipeline_bitwise = bool(
         np.array_equal(chi_seq, chi_pipe)
         and all(np.array_equal(a, b)
@@ -385,14 +386,14 @@ def _full_scale_stage(meta):
     # their backend compiles resolve as jax_compilation_cache_dir hits
     warm_e2e_s = None
     try:
-        t0 = time.time()
+        t0 = obs_clock.now()
         batches2 = [PTABatch.from_packed(get_model(par), st)
                     for par, _, st in entries]
         fleet_aot_compile(
             [(b, {"method": "gls", "maxiter": 2}) for b in batches2])
         for b in batches2:
             b.gls_fit(maxiter=2)
-        warm_e2e_s = time.time() - t0
+        warm_e2e_s = obs_clock.now() - t0
         del batches2
     except Exception as e:
         _stage(f"full-scale warm-cache rerun failed "
@@ -455,19 +456,19 @@ def _full_scale_stage(meta):
                            "pow2 ladder")
                     pow2_batches = [PTABatch.from_packed(get_model(p), st)
                                     for p, _, st in pow2_entries]
-                    t0 = time.time()
+                    t0 = obs_clock.now()
                     fleet_aot_compile(
                         [(b, {"method": "gls", "maxiter": 2})
                          for b in pow2_batches])
                     for b in pow2_batches:
                         b.gls_fit(maxiter=2)
-                    pow2_compile_s = time.time() - t0
-                    t0 = time.time()
+                    pow2_compile_s = obs_clock.now() - t0
+                    t0 = obs_clock.now()
                     xps = []
                     for b in pow2_batches:
                         xp_, cp_, _ = b.gls_fit(maxiter=2)
                         xps.append(np.asarray(xp_))
-                    pow2_refit_s = time.time() - t0
+                    pow2_refit_s = obs_clock.now() - t0
                     p_real = sum(int(np.sum(b.n_toas))
                                  for b in pow2_batches)
                     p_pad = sum(int(b.batch.tdb_sec.shape[0]
@@ -552,12 +553,12 @@ def _full_scale_stage(meta):
                 # mixed+f64 double-fit as the "mixed" wall time
                 with _warnings.catch_warnings(record=True) as wlist:
                     _warnings.simplefilter("always")
-                    t0 = time.time()
+                    t0 = obs_clock.now()
                     for b in batches:
                         _, cmx, _ = b.gls_fit(maxiter=2,
                                               precision="mixed")
                         jax.block_until_ready(cmx)
-                    wall = time.time() - t0
+                    wall = obs_clock.now() - t0
                 fell = any("refitting in f64" in str(w.message)
                            for w in wlist)
                 # publish LAST and all-or-nothing (join-timeout racers
@@ -659,17 +660,17 @@ def _timed_refit(fit, arg, **kw):
     and their gap a live contention diagnostic."""
     import jax
 
-    t0 = time.time()
+    t0 = obs_clock.now()
     x, chi2, cov = fit(maxiter=arg, **kw)
     jax.block_until_ready(chi2)
-    compile_s = time.time() - t0
+    compile_s = obs_clock.now() - t0
     runs = 3
     times = []
     for _ in range(runs):
-        t0 = time.time()
+        t0 = obs_clock.now()
         x, chi2, cov = fit(maxiter=arg, **kw)
         jax.block_until_ready(chi2)
-        times.append(time.time() - t0)
+        times.append(obs_clock.now() - t0)
     stats = {"mean": sum(times) / runs, "min": min(times),
              "median": sorted(times)[runs // 2], "runs": runs}
     return compile_s, stats
@@ -783,18 +784,18 @@ def main():
                             f"{full_timeout:.0f}s (wedged device?)")
 
     _stage(f"building {n_psr}x{n_toa} synthetic PTA batch on host")
-    t0 = time.time()
+    t0 = obs_clock.now()
     models, toas_list = build_batch(n_psr, n_toa)
-    host_prep_s = time.time() - t0
+    host_prep_s = obs_clock.now() - t0
     # actual counts (epoch clustering floors n_toa to a multiple of 4)
     n_toa = len(toas_list[0])
 
     _stage(f"host prep done ({host_prep_s:.1f}s); acquiring devices")
     n_dev = len(jax.devices())
     mesh = make_mesh(min(n_dev, n_psr))
-    t0 = time.time()
+    t0 = obs_clock.now()
     pta = PTABatch(models, toas_list, mesh=mesh)
-    pack_s = time.time() - t0
+    pack_s = obs_clock.now() - t0
 
     _stage(f"packed ({pack_s:.1f}s) on {n_dev} {jax.devices()[0].platform} "
            "device(s); AOT-compiling GLS (trace/XLA split)")
@@ -857,11 +858,11 @@ def main():
                                    rng.uniform(0, 1, 3 * n_ph // 4)])
             phot_dev = jax.device_put(jnp.asarray(phot))
             h = float(hm(phot_dev, m=20))  # compile + warm
-            t0 = time.time()
+            t0 = obs_clock.now()
             for _ in range(3):
                 h = float(hm(phot_dev, m=20))
             htest_h = h
-            htest_s = (time.time() - t0) / 3  # set LAST: completion marker
+            htest_s = (obs_clock.now() - t0) / 3  # set LAST: completion marker
         except Exception as e:  # report the skip; headline unaffected
             _stage(f"H-test stage failed ({type(e).__name__}: {e}); "
                    "headline JSON unaffected")
@@ -1078,6 +1079,73 @@ def main():
                    f"unsuppressed, {lint_report['suppressed']} "
                    f"suppressed {lint_report['counts_by_rule']}")
 
+    # ------------------------------------------------------------------
+    # obs stage: tracing-overhead accounting on a warm fleet refit.
+    # Times the same warm fit with spans off and on: obs_overhead_pct
+    # is the ENABLED-tracing tax (the disabled-path tax is bounded
+    # separately by tests/test_obs.py), obs_spans_per_fit the span
+    # volume one traced refit emits. PINT_TPU_BENCH_TRACE_OUT=path
+    # additionally exports the traced refit as Chrome trace-event JSON
+    # (chrome://tracing / Perfetto). Same optional posture: daemon
+    # thread + join timeout, skip with PINT_TPU_BENCH_SKIP_OBS=1.
+    obs_report = None
+
+    def _obs_stage():
+        nonlocal obs_report
+        try:
+            from pint_tpu import obs
+            from pint_tpu.obs.export import write_chrome_trace
+            from pint_tpu.parallel import PTAFleet
+            from pint_tpu.scripts.pint_serve_bench import build_serve_fleet
+
+            omodels, otoas = build_serve_fleet(sizes=(48,),
+                                               per_combo=2, seed=5)
+            fl = PTAFleet(omodels, otoas, toa_bucket="pow2",
+                          bucket_floor=64, pipeline=True)
+            fl.fit(method="auto", maxiter=3)  # compile + warm
+            off_s = float("inf")
+            for _ in range(3):
+                t0 = obs_clock.now()
+                fl.fit(method="auto", maxiter=3)
+                off_s = min(off_s, obs_clock.now() - t0)
+            obs.enable()
+            try:
+                on_s = float("inf")
+                n_spans = 0
+                for _ in range(3):
+                    obs.reset()
+                    t0 = obs_clock.now()
+                    fl.fit(method="auto", maxiter=3)
+                    on_s = min(on_s, obs_clock.now() - t0)
+                    n_spans = len(obs.spans())
+                trace_out = os.environ.get("PINT_TPU_BENCH_TRACE_OUT")
+                if trace_out:
+                    write_chrome_trace(trace_out)
+            finally:
+                obs.disable()
+            obs_report = {  # set LAST: completion marker
+                "obs_overhead_pct": round(
+                    100.0 * (on_s - off_s) / off_s, 2),
+                "obs_spans_per_fit": n_spans,
+            }
+        except Exception as e:
+            _stage(f"obs stage failed ({type(e).__name__}: {e}); "
+                   "headline JSON unaffected")
+
+    if os.environ.get("PINT_TPU_BENCH_SKIP_OBS") == "1":
+        _stage("obs stage skipped (PINT_TPU_BENCH_SKIP_OBS=1)")
+    else:
+        _stage("obs: traced vs untraced warm fleet refit overhead")
+        to = threading.Thread(target=_obs_stage, daemon=True)
+        to.start()
+        to.join(timeout=600)
+        if to.is_alive():
+            obs_report = None  # snapshot: late finish must not race
+            _stage("obs stage timed out; headline JSON unaffected")
+        elif obs_report is not None:
+            _stage(f"obs: overhead {obs_report['obs_overhead_pct']}% "
+                   f"({obs_report['obs_spans_per_fit']} spans/fit)")
+
     total_toas = n_psr * n_toa
     rate = total_toas / gls_refit_s  # TOAs GLS-refit per second
     projected_670k = gls_refit_s * (670_000 / total_toas)
@@ -1203,6 +1271,10 @@ def main():
                                    if fleet_report else None),
         "fleet_buckets": (fleet_report["fleet_buckets"]
                           if fleet_report else None),
+        "obs_overhead_pct": (obs_report["obs_overhead_pct"]
+                             if obs_report else None),
+        "obs_spans_per_fit": (obs_report["obs_spans_per_fit"]
+                              if obs_report else None),
         "pintlint_unsuppressed": (lint_report["unsuppressed"]
                                   if lint_report else None),
         "pintlint_suppressed": (lint_report["suppressed"]

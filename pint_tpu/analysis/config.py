@@ -143,7 +143,38 @@ SYNC_CALLS = frozenset({
 TIMER_CALLS = frozenset({
     "time.perf_counter", "time.monotonic", "time.time",
     "perf_counter", "monotonic", "self.clock", "clock",
+    # the obs clock (pint_tpu.obs.clock) opens timing windows too —
+    # instrumented modules import it as obs_clock
+    "obs_clock.now", "obs_clock.walltime",
 })
+
+# -- observability -----------------------------------------------------
+
+# Modules (normalized "/"-prefixed path suffixes) instrumented with
+# the obs tracing layer (pint_tpu.obs): raw wall-clock READS there
+# must go through pint_tpu.obs.clock (obs_clock.now / Stopwatch) or a
+# span, so every timing number on the instrumented surface shares one
+# clock and shows up in exported timelines and flight dumps.
+# time.sleep is a delay, not a measurement, and stays legal; timer
+# REFERENCES used as injectable defaults (clock=time.monotonic) are
+# not calls and are never flagged.
+OBS_INSTRUMENTED_MODULES = (
+    "/fitter.py", "/parallel/pta.py", "/parallel/fleetmesh.py",
+    "/serve/engine.py", "/serve/excache.py", "/serve/batcher.py",
+    "/serve/metrics.py", "/resilience/retry.py", "/bench.py",
+    "/benchmarks/profile_harness.py", "/scripts/pint_serve_bench.py",
+)
+
+# Raw timer call names timing-untraced flags in instrumented modules.
+OBS_RAW_TIMER_CALLS = frozenset({
+    "time.time", "time.perf_counter", "time.monotonic",
+    "time.perf_counter_ns", "time.monotonic_ns",
+    "perf_counter", "monotonic",
+})
+
+# Path markers never checked: the obs package IS the clock, and tests
+# drive fake clocks on purpose.
+OBS_ALLOWED_PATH_MARKERS = ("/obs/", "/tests/", "/test_")
 
 # Names that mark a value as a NaN-signalling convergence diagnostic:
 # comparing one of these with ``>`` (False under NaN) silently
@@ -165,6 +196,9 @@ class LintConfig:
     fault_registry_suffix: str = FAULT_REGISTRY_SUFFIX
     test_path_markers: tuple = TEST_PATH_MARKERS
     nan_diag_pattern: str = NAN_DIAG_PATTERN
+    obs_instrumented_modules: tuple = ()
+    obs_raw_timer_calls: frozenset = OBS_RAW_TIMER_CALLS
+    obs_allowed_path_markers: tuple = OBS_ALLOWED_PATH_MARKERS
 
     @classmethod
     def default(cls):
@@ -172,4 +206,5 @@ class LintConfig:
                    locked_classes=dict(LOCKED_CLASSES),
                    locked_globals=dict(LOCKED_GLOBALS),
                    serve_pad_modules=SERVE_PAD_MODULES,
-                   bucket_allowed_modules=BUCKET_ALLOWED_MODULES)
+                   bucket_allowed_modules=BUCKET_ALLOWED_MODULES,
+                   obs_instrumented_modules=OBS_INSTRUMENTED_MODULES)

@@ -442,16 +442,18 @@ def aot_lower(fn, *args):
     compiler, sharded_gls_fit — shares one timing convention: tracing
     is Python/GIL-bound and must be timed on the calling thread, while
     the XLA backend compile releases the GIL and can run concurrently."""
-    import time
-
     import jax
+
+    from .obs import clock as obs_clock
+    from .obs import trace as obs_trace
 
     if not hasattr(fn, "lower"):
         fn = jax.jit(fn)
-    t0 = time.perf_counter()
-    lowered = fn.lower(*args)
-    return {"lowered": lowered,
-            "trace_s": round(time.perf_counter() - t0, 3)}
+    with obs_trace.span("aot.trace"):
+        t0 = obs_clock.now()
+        lowered = fn.lower(*args)
+        trace_s = obs_clock.now() - t0
+    return {"lowered": lowered, "trace_s": round(trace_s, 3)}
 
 
 def aot_backend_compile(lowered):
@@ -462,11 +464,13 @@ def aot_backend_compile(lowered):
     Safe to call from a worker thread: XLA compilation releases the
     GIL, which is what makes the fleet's concurrent multi-bucket
     compile an actual wall-clock win rather than a GIL convoy."""
-    import time
+    from .obs import clock as obs_clock
+    from .obs import trace as obs_trace
 
-    t0 = time.perf_counter()
-    compiled = lowered.compile()
-    backend_s = time.perf_counter() - t0
+    with obs_trace.span("aot.backend_compile"):
+        t0 = obs_clock.now()
+        compiled = lowered.compile()
+        backend_s = obs_clock.now() - t0
     flops = bytes_ac = None
     try:
         cost = compiled.cost_analysis()
@@ -781,7 +785,7 @@ def fit_metrics(t_start, prep_s, iter_s, toas, model):
     """The uniform per-fit metrics dict (SURVEY section 5) — single
     home shared by the single-pulsar fitters (PTABatch has its own
     batch-shaped variant, _record_metrics)."""
-    import time
+    from .obs import clock as obs_clock
 
     import jax
 
@@ -789,7 +793,7 @@ def fit_metrics(t_start, prep_s, iter_s, toas, model):
         "backend": jax.default_backend(),
         "prepare_s": round(prep_s, 4),
         "iteration_s": [round(s, 4) for s in iter_s],
-        "total_s": round(time.perf_counter() - t_start, 4),
+        "total_s": round(obs_clock.now() - t_start, 4),
         "n_toas": len(toas),
         "n_free": len(model.free_params),
         "device_bytes_in_use": device_memory_stats(),
@@ -883,7 +887,7 @@ class WLSFitter(Fitter):
     """
 
     def fit_toas(self, maxiter=2, threshold=1e-12):
-        import time
+        from .obs import clock as obs_clock
 
         import jax
         import jax.numpy as jnp
@@ -894,9 +898,9 @@ class WLSFitter(Fitter):
             raise CorrelatedErrors(corr)
         _reject_free_dmjump(self.model)
         _warn_degraded_once()
-        t_start = time.perf_counter()
+        t_start = obs_clock.now()
         prepared = self.model.prepare(self.toas)
-        prep_s = time.perf_counter() - t_start
+        prep_s = obs_clock.now() - t_start
         resid_fn = prepared.residual_vector_fn(track_mode=self._track_mode())
         dm_fn, labels = prepared.designmatrix_fn()
         noff = _n_offset(labels)
@@ -919,7 +923,7 @@ class WLSFitter(Fitter):
         best = (chi2, x, None)
         first_cov = None
         for _ in range(maxiter):
-            t_it = time.perf_counter()
+            t_it = obs_clock.now()
             M = dm_fn(x)
             Mw = (M / f0) / sigma_s[:, None]
             dx_all, covn, norm = wls_step(Mw, rw, threshold)
@@ -928,7 +932,7 @@ class WLSFitter(Fitter):
             x = x - dx_all[noff:]
             rw, sigma_s = whitened(x)
             chi2 = float(jnp.sum(jnp.square(rw)))
-            iter_s.append(time.perf_counter() - t_it)
+            iter_s.append(obs_clock.now() - t_it)
             if chi2 < best[0]:
                 best = (chi2, x, (covn, norm))
         if chi2 - best[0] > 1e-6 * max(1.0, best[0]):
@@ -958,7 +962,7 @@ class DownhillWLSFitter(WLSFitter):
 
     def fit_toas(self, maxiter=20, threshold=1e-12, min_lambda=1e-3, tol=1e-10,
                  raise_maxiter=False):
-        import time
+        from .obs import clock as obs_clock
 
         import jax.numpy as jnp
 
@@ -967,9 +971,9 @@ class DownhillWLSFitter(WLSFitter):
             raise CorrelatedErrors(corr)
         _reject_free_dmjump(self.model)
         _warn_degraded_once()
-        t_start = time.perf_counter()
+        t_start = obs_clock.now()
         prepared = self.model.prepare(self.toas)
-        prep_s = time.perf_counter() - t_start
+        prep_s = obs_clock.now() - t_start
         resid_fn = prepared.residual_vector_fn(track_mode=self._track_mode())
         dm_fn, labels = prepared.designmatrix_fn()
         noff = _n_offset(labels)
@@ -984,7 +988,7 @@ class DownhillWLSFitter(WLSFitter):
         best_chi2 = chi2_of(x)
         covn = norm = None
         for it in range(maxiter):
-            t_it = time.perf_counter()
+            t_it = obs_clock.now()
             r = resid_fn(x)
             sigma_s = prepared.scaled_sigma_us(prepared.params_with_vector(x)) * 1e-6
             M = dm_fn(x)
@@ -1003,7 +1007,7 @@ class DownhillWLSFitter(WLSFitter):
                     x = x - lam * dx
                     break
                 lam *= 0.5
-            iter_s.append(time.perf_counter() - t_it)
+            iter_s.append(obs_clock.now() - t_it)
             if lam < min_lambda or not improved:
                 break
         else:
@@ -1058,15 +1062,15 @@ class GLSFitter(Fitter):
 
     def fit_toas(self, maxiter=2, threshold=1e-12, tol=0.0,
                  precision="f64"):
-        import time
+        from .obs import clock as obs_clock
 
         _maybe_inject_solver_diverge("gls")
         _reject_free_dmjump(self.model)
         _warn_degraded_once()
         check_precision(precision)
-        t_start = time.perf_counter()
+        t_start = obs_clock.now()
         prepared = self.model.prepare(self.toas)
-        prep_s = time.perf_counter() - t_start
+        prep_s = obs_clock.now() - t_start
         resid_fn = prepared.residual_vector_fn(track_mode=self._track_mode())
         dm_fn, labels = prepared.designmatrix_fn()
         noff = _n_offset(labels)
@@ -1094,7 +1098,7 @@ class GLSFitter(Fitter):
         nparam = None
         last_chi2 = None
         for _ in range(maxiter):
-            t_it = time.perf_counter()
+            t_it = obs_clock.now()
             M = dm_fn(x) / f0
             Mfull, sqrt_phi_inv, nparam = stack_noise_bases(M, bases)
             # shared whitened/normalized/prior-weighted eigh solve (see
@@ -1112,7 +1116,7 @@ class GLSFitter(Fitter):
             x = x - dx[noff:nparam]
             r, sigma_s, bases = state_at(x)
             chi2 = marginalized_chi2(r, sigma_s, bases, threshold)
-            iter_s.append(time.perf_counter() - t_it)
+            iter_s.append(obs_clock.now() - t_it)
             if chi2 < best[0]:
                 best = (chi2, x, cov, noise_ampls)
             if (tol and last_chi2 is not None
@@ -1293,17 +1297,17 @@ class WidebandTOAFitter(GLSFitter):
         return float(fn(prepared.vector_from_params()))
 
     def fit_toas(self, maxiter=2, threshold=1e-12, precision="f64"):
-        import time
+        from .obs import clock as obs_clock
 
         _warn_degraded_once()
         check_precision(precision)
         _reject_free_dm_noise(self.model)
-        t_start = time.perf_counter()
+        t_start = obs_clock.now()
         iter_s = []
         chi2 = None
         best = None  # (actual chi2, prepared, x0) of the best state seen
         for _ in range(maxiter):
-            t_it = time.perf_counter()
+            t_it = obs_clock.now()
             prepared, combined, r, sigma, noff, x0, bases = \
                 self._wideband_system()
             chi2_act = marginalized_chi2(r, sigma, bases, threshold)
@@ -1319,7 +1323,7 @@ class WidebandTOAFitter(GLSFitter):
             cov_all = cov_from_normalized(*cov)
             self._set_uncertainties(prepared, cov_all[noff:nparam,
                                                       noff:nparam])
-            iter_s.append(time.perf_counter() - t_it)
+            iter_s.append(obs_clock.now() - t_it)
         # best-iterate safeguard (see GLSFitter.fit_toas): compare the
         # final state's actual marginalized chi2 — SAME threshold as the
         # in-loop evaluations — against the best one and revert if an
@@ -1367,15 +1371,15 @@ class WidebandDownhillFitter(WidebandTOAFitter):
 
     def fit_toas(self, maxiter=15, threshold=1e-12, min_lambda=1e-3,
                  tol=1e-9, raise_maxiter=False, precision="f64"):
-        import time
+        from .obs import clock as obs_clock
 
         check_precision(precision)
         _reject_free_dm_noise(self.model)
-        t_start = time.perf_counter()
+        t_start = obs_clock.now()
         iter_s = []
         best_chi2 = None
         for it in range(maxiter):
-            t_it = time.perf_counter()
+            t_it = obs_clock.now()
             prepared, combined, r, sigma, noff, x0, bases = \
                 self._wideband_system()
             # one jitted GLS objective per outer iteration; line-search
@@ -1406,7 +1410,7 @@ class WidebandDownhillFitter(WidebandTOAFitter):
             cov_all = cov_from_normalized(*cov)
             self._set_uncertainties(prepared, cov_all[noff:nparam,
                                                       noff:nparam])
-            iter_s.append(time.perf_counter() - t_it)
+            iter_s.append(obs_clock.now() - t_it)
             if lam < min_lambda or not improved:
                 break
         else:
@@ -1434,18 +1438,18 @@ class WidebandLMFitter(WidebandTOAFitter):
 
     def fit_toas(self, maxiter=20, threshold=1e-12, lm_lambda0=1e-3,
                  tol=1e-9, precision="f64"):
-        import time
+        from .obs import clock as obs_clock
 
         import jax.numpy as jnp
 
         check_precision(precision)
         _reject_free_dm_noise(self.model)
-        t_start = time.perf_counter()
+        t_start = obs_clock.now()
         iter_s = []
         lm = lm_lambda0
         best_chi2 = self._wideband_chi2(threshold)
         for _ in range(maxiter):
-            t_it = time.perf_counter()
+            t_it = obs_clock.now()
             prepared, combined, r, sigma, noff, x0, bases = \
                 self._wideband_system()
             Mfull, sqrt_phi_inv, nparam = stack_noise_bases(
@@ -1489,7 +1493,7 @@ class WidebandLMFitter(WidebandTOAFitter):
             dx = (dxn / norm)[noff:nparam]
             self._sync_model_from_vector(prepared, x0 - dx)
             chi2 = self._wideband_chi2(threshold)
-            iter_s.append(time.perf_counter() - t_it)
+            iter_s.append(obs_clock.now() - t_it)
             if chi2 <= best_chi2 + 1e-12:
                 accepted = chi2 < best_chi2 - tol * max(1.0, best_chi2)
                 best_chi2 = min(best_chi2, chi2)
@@ -1539,15 +1543,15 @@ class PowellFitter(Fitter):
     """
 
     def fit_toas(self, maxiter=2000, xtol=1e-8):
-        import time
+        from .obs import clock as obs_clock
 
         import jax.numpy as jnp
         from scipy.optimize import minimize
 
         _reject_free_dmjump(self.model)
-        t_start = time.perf_counter()
+        t_start = obs_clock.now()
         prepared = self.model.prepare(self.toas)
-        prep_s = time.perf_counter() - t_start
+        prep_s = obs_clock.now() - t_start
         resid_fn = prepared.residual_vector_fn(track_mode=self._track_mode())
         dm_fn, labels = prepared.designmatrix_fn()
         noff = _n_offset(labels)

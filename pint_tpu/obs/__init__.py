@@ -1,0 +1,66 @@
+"""pint_tpu.obs: unified tracing spans, metrics, and flight recorder.
+
+One observability surface for the whole stack (ISSUE 7): the fleet
+pipeline, mesh lanes, serve flush path, AOT compile split, and
+retry/bisect ladder all emit :func:`span`\\ s; counters and latency
+histograms aggregate in :data:`metricsreg.REGISTRY`; the
+:data:`recorder.RECORDER` flight recorder keeps a bounded ring of
+recent spans + fault firings and dumps it to JSON on DeviceLost /
+CollectiveTimeout / breaker-trip / checkpoint-restart.
+
+Quick start::
+
+    from pint_tpu import obs
+
+    obs.enable()                       # spans on (off by default)
+    xs, chi2, meta = fleet.fit()
+    obs.write_chrome_trace("fleet.json")   # -> ui.perfetto.dev
+
+Tracing is off by default and a disabled ``span(...)`` call is one
+attribute check — the instrumented hot paths cost effectively nothing
+until tracing is enabled, and enabling it never touches device code
+(traced fits stay bitwise identical; tests/test_obs.py pins both).
+
+CLI: ``python -m pint_tpu.obs`` (traced fleet demo, flight-dump ->
+Perfetto conversion, Prometheus rendering).
+"""
+
+from . import clock  # noqa: F401
+from .trace import (  # noqa: F401
+    NOOP_SPAN,
+    TRACER,
+    Span,
+    Tracer,
+    current_trace_id,
+    disable,
+    enable,
+    enabled,
+    reset,
+    span,
+    spans,
+)
+from .metricsreg import (  # noqa: F401
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    percentile,
+    prometheus_text,
+    summary,
+)
+from .recorder import RECORDER, FlightRecorder, configure  # noqa: F401
+from .export import (  # noqa: F401
+    chrome_trace,
+    flight_spans,
+    write_chrome_trace,
+)
+
+__all__ = [
+    "NOOP_SPAN", "RECORDER", "REGISTRY", "TRACER", "Counter",
+    "FlightRecorder", "Gauge", "Histogram", "Registry", "Span",
+    "Tracer", "chrome_trace", "clock", "configure",
+    "current_trace_id", "disable", "enable", "enabled",
+    "flight_spans", "percentile", "prometheus_text", "reset", "span",
+    "spans", "summary", "write_chrome_trace",
+]

@@ -1,0 +1,133 @@
+"""pint_trace: the observability CLI (``python -m pint_tpu.obs``).
+
+Subcommands:
+
+- ``fleet``   — run a traced N-pulsar fleet refit and export the span
+  timeline as Chrome trace-event JSON (open in ui.perfetto.dev). The
+  default settings reproduce the ISSUE 7 acceptance artifact: a
+  68-pulsar traced refit whose span tree covers host prep, pack,
+  compile, and execute per bucket.
+- ``convert`` — turn a flight-recorder dump (or a raw span-list JSON)
+  into a Chrome trace-event file.
+- ``prom``    — render a metrics snapshot JSON (or the dump's embedded
+  metrics block) as Prometheus text exposition format.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _cmd_fleet(args):
+    import numpy as np
+
+    from .. import obs
+    from ..parallel import PTAFleet
+    from ..scripts.pint_serve_bench import build_serve_fleet
+
+    # 9 structure x size combos -> per_combo ~ n_psr / 9
+    per_combo = max(1, -(-args.n_psr // 9))
+    models, toas_list = build_serve_fleet(
+        sizes=tuple(args.sizes), per_combo=per_combo, seed=args.seed)
+    models, toas_list = models[:args.n_psr], toas_list[:args.n_psr]
+    # trace from construction on so the timeline covers the whole
+    # cold path — host prep, pack, compile — not just the refit
+    obs.enable(capacity=args.capacity,
+               jax_annotations=args.jax_annotations)
+    obs.reset()
+    print(f"[pint_trace] fleet of {len(models)} pulsars; traced cold "
+          "fit (host prep + pack + compile + execute) ...",
+          file=sys.stderr)
+    fleet = PTAFleet(models, toas_list, bucket_floor=args.bucket_floor,
+                     pipeline=not args.no_pipeline)
+    fleet.fit(method=args.method, maxiter=args.maxiter)
+    print("[pint_trace] traced warm refit ...", file=sys.stderr)
+    xs, chi2, meta = fleet.fit(method=args.method, maxiter=args.maxiter)
+    obs.disable()
+
+    spans = obs.spans()
+    out = obs.write_chrome_trace(args.out, spans)
+    phases = sorted({s["name"] for s in spans})
+    print(json.dumps({
+        "pulsars": len(models),
+        "buckets": len(fleet.batches),
+        "chi2_total": float(np.sum([np.sum(c) for c in chi2])),
+        "spans": len(spans),
+        "phases": phases,
+        "trace_out": out,
+    }, indent=1))
+    return 0
+
+
+def _cmd_convert(args):
+    from . import export
+
+    with open(args.dump) as fh:
+        doc = json.load(fh)
+    if isinstance(doc, dict) and "events" in doc:      # flight dump
+        spans = export.flight_spans(doc)
+    elif isinstance(doc, dict) and "traceEvents" in doc:
+        print("input is already a Chrome trace", file=sys.stderr)
+        return 1
+    else:                                              # raw span list
+        spans = doc
+    out = export.write_chrome_trace(args.out, spans)
+    print(json.dumps({"spans": len(spans), "trace_out": out}))
+    return 0
+
+
+def _cmd_prom(args):
+    from . import metricsreg
+
+    with open(args.snapshot) as fh:
+        doc = json.load(fh)
+    if isinstance(doc, dict) and "metrics" in doc:     # flight dump
+        doc = doc["metrics"]
+    sys.stdout.write(metricsreg.prometheus_text(doc))
+    return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m pint_tpu.obs",
+        description="pint_trace: traced fleet timelines, flight-dump "
+                    "conversion, Prometheus rendering")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    f = sub.add_parser("fleet", help="traced fleet refit -> Chrome "
+                                     "trace JSON")
+    f.add_argument("--n-psr", type=int, default=68)
+    f.add_argument("--sizes", type=int, nargs="+",
+                   default=[48, 96, 180])
+    f.add_argument("--seed", type=int, default=0)
+    f.add_argument("--method", default="gls",
+                   choices=("wls", "gls"))
+    f.add_argument("--maxiter", type=int, default=2)
+    f.add_argument("--bucket-floor", type=int, default=64)
+    f.add_argument("--no-pipeline", action="store_true",
+                   help="sequential fit (fewer phases in the trace)")
+    f.add_argument("--capacity", type=int, default=65536)
+    f.add_argument("--jax-annotations", action="store_true",
+                   help="also emit jax.profiler TraceAnnotations")
+    f.add_argument("--out", default="pint_fleet_trace.json")
+    f.set_defaults(fn=_cmd_fleet)
+
+    c = sub.add_parser("convert", help="flight dump / span list -> "
+                                       "Chrome trace JSON")
+    c.add_argument("dump")
+    c.add_argument("--out", default="pint_trace.json")
+    c.set_defaults(fn=_cmd_convert)
+
+    m = sub.add_parser("prom", help="metrics snapshot -> Prometheus "
+                                    "text format")
+    m.add_argument("snapshot")
+    m.set_defaults(fn=_cmd_prom)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

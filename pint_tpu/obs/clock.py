@@ -1,0 +1,57 @@
+"""The one timing primitive every instrumented module shares.
+
+All host-side timing in pint_tpu — span durations, bench stage
+timers, serve phase latencies — goes through :func:`now` so there is
+exactly one clock to reason about (monotonic, sub-microsecond,
+immune to NTP steps) and so the ``timing-untraced`` pintlint rule can
+tell sanctioned timing from ad-hoc ``time.time()`` scattered through
+instrumented modules. Import idiom (the lint registries key on it)::
+
+    from pint_tpu.obs import clock as obs_clock
+    t0 = obs_clock.now()
+    ...
+    elapsed = obs_clock.now() - t0
+
+Classes that take an injectable ``clock=`` collaborator (ServeEngine,
+HealthMonitor, ...) keep doing so; this module is the default they
+should be handed, not a replacement for injection.
+"""
+
+from __future__ import annotations
+
+import time
+
+# Monotonic high-resolution process clock. An alias, not a wrapper:
+# the disabled-tracing hot path and the bench timing loops pay zero
+# indirection over calling time.perf_counter directly.
+now = time.perf_counter
+
+# Wall-clock (UNIX epoch) — ONLY for timestamping exported artifacts
+# (flight-recorder dumps, trace files); never for measuring durations.
+walltime = time.time
+
+
+class Stopwatch:
+    """Restartable elapsed-time meter over :func:`now`.
+
+    ``lap()`` returns the time since construction (or the previous
+    lap) and restarts, which is the bench.py stage-timer pattern;
+    ``elapsed()`` peeks without restarting.
+    """
+
+    __slots__ = ("t0",)
+
+    def __init__(self):
+        self.t0 = now()
+
+    def elapsed(self):
+        return now() - self.t0
+
+    def lap(self):
+        t = now()
+        dt = t - self.t0
+        self.t0 = t
+        return dt
+
+    def restart(self):
+        self.t0 = now()

@@ -1,0 +1,225 @@
+"""Metrics registry: counters / gauges / histograms in one snapshot.
+
+This is the pull-model half of the observability layer: hot paths
+keep their existing cheap bookkeeping (ServeTelemetry counters,
+ExecutableCache hit/miss ints, HealthMonitor state) and the registry
+*absorbs* those into one named snapshot at export time — so adding
+metrics costs the serve flush path nothing. Histograms own the one
+nearest-rank :func:`percentile` implementation the serve layer, bench
+stage summaries, and the profile harness all previously duplicated.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+
+
+def percentile(values, q):
+    """Nearest-rank percentile (q in [0, 100]); None on empty input.
+    Nearest-rank, not interpolated: at serving sample counts the p99
+    should be an actually-observed latency, not an average of two."""
+    if not values:
+        return None
+    v = sorted(float(x) for x in values)
+    idx = min(len(v) - 1, max(0, -(-int(q) * len(v) // 100) - 1))
+    return v[idx]
+
+
+def summary(values, quantiles=(50, 90, 99)):
+    """count/mean/min/max plus nearest-rank quantiles of a sample —
+    the shared shape bench stage stats and latency reports render."""
+    vals = [float(x) for x in values]
+    out = {"count": len(vals)}
+    if vals:
+        out.update(mean=sum(vals) / len(vals), min=min(vals),
+                   max=max(vals))
+    else:
+        out.update(mean=None, min=None, max=None)
+    for q in quantiles:
+        out["p%d" % q] = percentile(vals, q)
+    return out
+
+
+class Counter:
+    __slots__ = ("_lock", "value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def inc(self, n=1):
+        with self._lock:
+            self.value += n
+        return self
+
+
+class Gauge:
+    __slots__ = ("_lock", "value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = None
+
+    def set(self, value):
+        with self._lock:
+            self.value = value
+        return self
+
+
+class Histogram:
+    """Bounded raw-sample histogram with nearest-rank quantiles. Raw
+    samples (not pre-bucketed counts) because serving sample counts
+    are small and the nearest-rank contract needs the actual values."""
+
+    __slots__ = ("_lock", "_values")
+
+    def __init__(self, capacity=4096):
+        import collections
+
+        self._lock = threading.Lock()
+        self._values = collections.deque(maxlen=capacity)
+
+    def record(self, value):
+        with self._lock:
+            self._values.append(float(value))
+        return self
+
+    def values(self):
+        with self._lock:
+            return list(self._values)
+
+    def percentile(self, q):
+        return percentile(self.values(), q)
+
+    def summary(self, quantiles=(50, 90, 99)):
+        return summary(self.values(), quantiles)
+
+
+class Registry:
+    """Named metric store; one process-global instance (REGISTRY)
+    plus throwaway instances in tests."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters = {}
+        self._gauges = {}
+        self._histograms = {}
+
+    def counter(self, name):
+        with self._lock:
+            m = self._counters.get(name)
+            if m is None:
+                m = self._counters[name] = Counter()
+        return m
+
+    def gauge(self, name):
+        with self._lock:
+            m = self._gauges.get(name)
+            if m is None:
+                m = self._gauges[name] = Gauge()
+        return m
+
+    def histogram(self, name, capacity=4096):
+        with self._lock:
+            m = self._histograms.get(name)
+            if m is None:
+                m = self._histograms[name] = Histogram(capacity)
+        return m
+
+    def absorb(self, mapping, prefix=""):
+        """Fold a flat or nested dict of numbers into the registry:
+        ints become counters, floats/None become gauges, lists become
+        histograms, dicts recurse with a dotted prefix. This is how
+        ServeTelemetry counters and health/breaker/device census
+        dicts land in one exportable snapshot without the serve layer
+        pushing metrics on its hot path."""
+        for key, val in mapping.items():
+            name = "%s%s" % (prefix, key)
+            if isinstance(val, dict):
+                self.absorb(val, prefix=name + ".")
+            elif isinstance(val, bool):
+                self.gauge(name).set(int(val))
+            elif isinstance(val, int):
+                c = self.counter(name)
+                with c._lock:
+                    c.value = val
+            elif isinstance(val, (list, tuple)):
+                h = self.histogram(name)
+                for v in val:
+                    if isinstance(v, (int, float)):
+                        h.record(v)
+            elif isinstance(val, float) or val is None:
+                self.gauge(name).set(val)
+            # non-numeric leaves (strings, objects) are not metrics
+        return self
+
+    def snapshot(self):
+        with self._lock:
+            counters = {k: m.value for k, m in self._counters.items()}
+            gauges = {k: m.value for k, m in self._gauges.items()}
+            hists = dict(self._histograms)
+        return {
+            "counters": dict(sorted(counters.items())),
+            "gauges": dict(sorted(gauges.items())),
+            "histograms": {k: hists[k].summary()
+                           for k in sorted(hists)},
+        }
+
+    def to_json(self, **dump_kw):
+        return json.dumps(self.snapshot(), **dump_kw)
+
+    def reset(self):
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+
+REGISTRY = Registry()
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def prom_name(name, prefix="pint_tpu_"):
+    return prefix + _PROM_BAD.sub("_", name)
+
+
+def prometheus_text(registry=None, prefix="pint_tpu_"):
+    """Render a registry snapshot in the Prometheus text exposition
+    format (one `# TYPE` header per metric; histograms exported as
+    summaries with nearest-rank quantile labels)."""
+    reg = REGISTRY if registry is None else registry
+    snap = reg.snapshot() if isinstance(reg, Registry) else reg
+    lines = []
+    for name, val in snap.get("counters", {}).items():
+        pn = prom_name(name, prefix)
+        lines.append("# TYPE %s counter" % pn)
+        lines.append("%s %s" % (pn, _prom_value(val)))
+    for name, val in snap.get("gauges", {}).items():
+        pn = prom_name(name, prefix)
+        lines.append("# TYPE %s gauge" % pn)
+        lines.append("%s %s" % (pn, _prom_value(val)))
+    for name, summ in snap.get("histograms", {}).items():
+        pn = prom_name(name, prefix)
+        lines.append("# TYPE %s summary" % pn)
+        for q in (50, 90, 99):
+            lines.append('%s{quantile="0.%02d"} %s'
+                         % (pn, q, _prom_value(summ.get("p%d" % q))))
+        lines.append("%s_count %s" % (pn, _prom_value(summ["count"])))
+        mean = summ.get("mean")
+        total = (mean * summ["count"]
+                 if mean is not None and summ["count"] else 0)
+        lines.append("%s_sum %s" % (pn, _prom_value(total)))
+    return "\n".join(lines) + "\n"
+
+
+def _prom_value(v):
+    if v is None:
+        return "NaN"
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    return repr(float(v))

@@ -56,6 +56,8 @@ import zlib
 
 import numpy as np
 
+from ..obs import trace as obs_trace
+from ..obs.recorder import RECORDER as _flight
 from ..resilience import faultinject
 from ..resilience.faultinject import FaultInjected
 from ..resilience.health import HealthMonitor
@@ -243,15 +245,21 @@ class FleetMesh:
         survivors = self._survivors()
         if not survivors:
             return
-        pending = [k for k in self.bucket_order
-                   if k not in completed
-                   and self.assignment[k] == lane.index]
-        for j, key in enumerate(pending):
-            to = survivors[j % len(survivors)]
-            self.reassignments.append((repr(key), lane.index, to.index))
-            self.assignment[key] = to.index
-            to.stolen += 1
-            self.stolen += 1
+        with obs_trace.span("mesh.steal", from_lane=lane.index):
+            pending = [k for k in self.bucket_order
+                       if k not in completed
+                       and self.assignment[k] == lane.index]
+            tid = obs_trace.current_trace_id()
+            for j, key in enumerate(pending):
+                to = survivors[j % len(survivors)]
+                self.reassignments.append((repr(key), lane.index,
+                                           to.index))
+                self.assignment[key] = to.index
+                to.stolen += 1
+                self.stolen += 1
+                _flight.note("work_steal", bucket=repr(key),
+                             from_lane=lane.index, to_lane=to.index,
+                             trace=tid)
 
     def _lane_for(self, key, completed):
         """The bucket's assigned lane, stealing first when the owner
@@ -324,6 +332,12 @@ class FleetMesh:
         CollectiveTimeout for device-level failures (handled by the
         caller via quarantine + stealing); other exceptions mean the
         bucket itself is bad (bisected by the caller)."""
+        with obs_trace.span("mesh.bucket", bucket=oi, lane=lane.index,
+                            method=method):
+            return self._run_bucket_traced(lane, oi, key, method,
+                                           maxiter, **kw)
+
+    def _run_bucket_traced(self, lane, oi, key, method, maxiter, **kw):
         t0 = self.clock()
         fault = faultinject.fire("straggler_delay", bucket=oi)
         if fault and int(fault.get("lane", lane.index)) == lane.index:
@@ -371,8 +385,21 @@ class FleetMesh:
             lane.health.note_breakers(lane.breaker.open_count(), tripped)
             if tripped:
                 lane.lost = True
+        n_before = len(self.reassignments)
         if not lane.alive():
             self._steal_from(lane, completed)
+            # post-mortem artifact: which lane died, which fault point
+            # killed it, and where its pending buckets went
+            _flight.dump(
+                "device_lost" if isinstance(exc, DeviceLost)
+                else "collective_timeout",
+                source="fleetmesh", lane=lane.index,
+                fault_point=("device_loss" if isinstance(exc, DeviceLost)
+                             else "collective_timeout"),
+                error=str(exc),
+                resharded=[list(r)
+                           for r in self.reassignments[n_before:]],
+                trace=obs_trace.current_trace_id())
 
     def _fit_bucket_isolated(self, lane, oi, key, idxs, method, maxiter,
                              depth, **kw):
@@ -504,6 +531,13 @@ class FleetMesh:
         (completed buckets restore bitwise from the snapshot, the
         rest re-run the same programs in the same canonical order).
         """
+        with obs_trace.span("mesh.fit", n_psr=self.n,
+                            n_buckets=len(self.bucket_order),
+                            n_lanes=len(self.lanes), method=method):
+            return self._fit_traced(method, maxiter, checkpoint_dir,
+                                    tag, **kw)
+
+    def _fit_traced(self, method, maxiter, checkpoint_dir, tag, **kw):
         xs = [None] * self.n
         chi2s = np.zeros(self.n)
         covs = [None] * self.n
@@ -532,6 +566,13 @@ class FleetMesh:
                         state[f"b{oi}_x"] = saved[f"b{oi}_x"]
                         state[f"b{oi}_chi2"] = saved[f"b{oi}_chi2"]
                         state[f"b{oi}_cov"] = saved[f"b{oi}_cov"]
+                    # a resume IS a recovery event: leave the ring's
+                    # recent history in a dump before it scrolls away
+                    _flight.dump(
+                        "checkpoint_restart", source="fleetmesh",
+                        tag=tag,
+                        restored_buckets=sorted(completed.values()),
+                        trace=obs_trace.current_trace_id())
         for oi, key in enumerate(self.bucket_order):
             idxs = self.group_indices[key]
             if key in completed:

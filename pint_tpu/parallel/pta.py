@@ -19,6 +19,8 @@ import threading
 import numpy as np
 
 from ..models.timing_model import PreparedTiming
+from ..obs import clock as obs_clock
+from ..obs import trace as obs_trace
 
 _EXCLUDE_KEYS = ("T_ld", "pepoch_day", "pepoch_sec")
 _STATIC_KEYS = ("orb_mode_fb", "planet_shapiro", "obliquity",
@@ -845,12 +847,10 @@ class PTABatch:
         fleet can dispatch every bucket before any bucket's blocking
         host pull (PTAFleet.fit(pipeline=True)). Returns a handle for
         :meth:`_finalize_wls`; wls_fit == finalize(dispatch)."""
-        import time
-
         import jax
 
         key, fit_one = self._build_wls(maxiter, threshold)
-        t0 = time.perf_counter()
+        t0 = obs_clock.now()
         warm = key in self._fns
         if not warm:
             self._fns[key] = jax.jit(jax.vmap(fit_one))
@@ -890,8 +890,6 @@ class PTABatch:
         """Per-fit metrics surface (SURVEY section 5): wall time
         (compile included when warm=False), batch shape, device
         memory."""
-        import time
-
         import jax
 
         from ..fitter import device_memory_stats
@@ -899,7 +897,7 @@ class PTABatch:
         self.metrics = {
             "method": method,
             "backend": jax.default_backend(),
-            "fit_wall_s": round(time.perf_counter() - t0, 4),
+            "fit_wall_s": round(obs_clock.now() - t0, 4),
             "includes_compile": not warm,
             "maxiter": maxiter,
             "n_pulsars": self.n_pulsars,
@@ -1453,8 +1451,6 @@ class PTABatch:
         keyed on (structure, shapes, fit options); the compiled
         programs stay in self._fns so the probe work is not wasted.
         Explicit "f64"/"mixed" pass through untouched."""
-        import time
-
         import jax
 
         from ..fitter import check_precision, relres_failed
@@ -1486,9 +1482,9 @@ class PTABatch:
             if mode == "mixed":
                 relres = jax.device_get(out[2][2])
                 mixed_failed = relres_failed(relres)
-            t0 = time.perf_counter()
+            t0 = obs_clock.now()
             jax.block_until_ready(self._fns[key](*args))
-            timings[mode] = time.perf_counter() - t0
+            timings[mode] = obs_clock.now() - t0
         choice = self._precision_verdict(timings, mixed_failed)
         with _PRECISION_AUTO_LOCK:
             choice = _PRECISION_AUTO_CACHE.setdefault(cache_key, choice)
@@ -1503,15 +1499,13 @@ class PTABatch:
         """Dispatch the GLS program WITHOUT pulling results (see
         _dispatch_wls); gls_fit == finalize(dispatch). Resolves
         precision="auto" to the measured per-structure winner first."""
-        import time
-
         import jax
 
         precision = self._resolve_precision(precision, maxiter,
                                             threshold, ecorr_mode)
         key, fit_one = self._build_gls(maxiter, threshold, ecorr_mode,
                                        precision)
-        t0 = time.perf_counter()
+        t0 = obs_clock.now()
         warm = key in self._fns
         if not warm:
             self._fns[key] = jax.jit(jax.vmap(fit_one))
@@ -1775,19 +1769,28 @@ def fleet_aot_compile(jobs, max_workers=None):
     sum(trace_s + backend_compile_s) for the concurrency win.
     """
     import os
-    import time
     from concurrent.futures import ThreadPoolExecutor
 
-    t0 = time.perf_counter()
-    lowered = [batch.aot_lower(**kw) for batch, kw in jobs]
+    t0 = obs_clock.now()
+    with obs_trace.span("fleet.compile", phase="trace", n_jobs=len(jobs)):
+        lowered = [batch.aot_lower(**kw) for batch, kw in jobs]
     if not lowered:
         return [], 0.0
+    tid = obs_trace.current_trace_id()
+
+    def _compile_one(pair):
+        # pool thread: join the caller's trace explicitly (span stacks
+        # are thread-local, so the parent link cannot be implicit)
+        batch, low = pair
+        with obs_trace.span("fleet.compile", trace_id=tid, phase="xla",
+                            bucket=low["key"][0]):
+            return batch._aot_backend_compile(low)
+
     workers = max_workers or min(len(lowered), os.cpu_count() or 1)
     with ThreadPoolExecutor(max_workers=workers) as pool:
-        infos = list(pool.map(
-            lambda pair: pair[0]._aot_backend_compile(pair[1]),
-            zip([b for b, _ in jobs], lowered)))
-    return infos, time.perf_counter() - t0
+        infos = list(pool.map(_compile_one,
+                              zip([b for b, _ in jobs], lowered)))
+    return infos, obs_clock.now() - t0
 
 
 def fleet_pipeline_metrics(fleet, method="auto", maxiter=3, repeats=2,
@@ -1810,8 +1813,6 @@ def fleet_pipeline_metrics(fleet, method="auto", maxiter=3, repeats=2,
     - fleet_pipeline_bitwise: pipelined results identical to
       sequential (np.array_equal on every x/chi2/cov).
     """
-    import time
-
     infos, concurrent_s = fleet.precompile(method=method,
                                            maxiter=maxiter,
                                            max_workers=max_workers)
@@ -1831,12 +1832,12 @@ def fleet_pipeline_metrics(fleet, method="auto", maxiter=3, repeats=2,
         and all(np.array_equal(a, b) for a, b in zip(cov_s, cov_p)))
     seq_s = pipe_s = float("inf")
     for _ in range(max(1, repeats)):
-        t0 = time.perf_counter()
+        t0 = obs_clock.now()
         fleet.fit(method=method, maxiter=maxiter, pipeline=False, **kw)
-        seq_s = min(seq_s, time.perf_counter() - t0)
-        t0 = time.perf_counter()
+        seq_s = min(seq_s, obs_clock.now() - t0)
+        t0 = obs_clock.now()
         fleet.fit(method=method, maxiter=maxiter, pipeline=True, **kw)
-        pipe_s = min(pipe_s, time.perf_counter() - t0)
+        pipe_s = min(pipe_s, obs_clock.now() - t0)
     return {
         "fleet_compile_serial_s": (round(serial_s, 3)
                                    if serial_s is not None else None),
@@ -2049,19 +2050,30 @@ class PTAFleet:
             import os
             from concurrent.futures import ThreadPoolExecutor
 
+            tid = obs_trace.current_trace_id()
+
+            def _build(key, ms, ts, bkw):
+                # pool thread: join the constructor's trace explicitly
+                # (span stacks are thread-local)
+                with obs_trace.span("fleet.host_prep", trace_id=tid,
+                                    bucket=key, n=len(ms)):
+                    return PTABatch(ms, ts, mesh=mesh, **bkw)
+
             self._prep_pool = ThreadPoolExecutor(
                 max_workers=min(len(groups), os.cpu_count() or 1))
             for key, idxs in groups.items():
                 self._batch_futures[key] = self._prep_pool.submit(
-                    PTABatch, [models[i] for i in idxs],
-                    [toas_list[i] for i in idxs], mesh=mesh,
-                    **build_kwargs.get(key, {}))
+                    _build, key, [models[i] for i in idxs],
+                    [toas_list[i] for i in idxs],
+                    build_kwargs.get(key, {}))
         else:
             for key, idxs in groups.items():
-                self.batches[key] = PTABatch([models[i] for i in idxs],
-                                             [toas_list[i] for i in idxs],
-                                             mesh=mesh,
-                                             **build_kwargs.get(key, {}))
+                with obs_trace.span("fleet.host_prep", bucket=key,
+                                    n=len(idxs)):
+                    self.batches[key] = PTABatch(
+                        [models[i] for i in idxs],
+                        [toas_list[i] for i in idxs], mesh=mesh,
+                        **build_kwargs.get(key, {}))
         self.n = len(models)
         real = sum(len(t) for t in toas_list)
         if toa_bucket == "plan":
@@ -2085,7 +2097,11 @@ class PTAFleet:
         with self._lock:
             batch = self.batches.get(key)
             if batch is None:
-                batch = self._batch_futures.pop(key).result()
+                # fleet.pack = the blocking wait for this bucket's
+                # deferred pack to land (the pack work itself is the
+                # worker's fleet.host_prep span)
+                with obs_trace.span("fleet.pack", bucket=key):
+                    batch = self._batch_futures.pop(key).result()
                 self.batches[key] = batch
                 if not self._batch_futures and self._prep_pool is not None:
                     self._prep_pool.shutdown(wait=False)
@@ -2157,22 +2173,31 @@ class PTAFleet:
         """
         if pipeline is None:
             pipeline = self.pipeline
+        with obs_trace.span("fleet.fit", n_psr=self.n,
+                            n_buckets=len(self.group_indices),
+                            method=method, pipeline=bool(pipeline)):
+            if not pipeline:
+                return self._fit_sequential(method, maxiter, **kw)
+            return self._fit_pipelined(method, maxiter, max_workers,
+                                       **kw)
+
+    def _fit_sequential(self, method, maxiter, **kw):
         xs = [None] * self.n
         chi2s = np.zeros(self.n)
         covs = [None] * self.n
         self.diverged = []
         self.fit_metrics = {}
-        if not pipeline:
-            for key, idxs in self.group_indices.items():
-                batch = self._resolve(key)
-                fit = (batch.gls_fit if self._use_gls(batch, method)
-                       else batch.wls_fit)
+        for key, idxs in self.group_indices.items():
+            batch = self._resolve(key)
+            fit = (batch.gls_fit if self._use_gls(batch, method)
+                   else batch.wls_fit)
+            with obs_trace.span("fleet.execute", bucket=key,
+                                n=len(idxs)):
                 x, chi2, cov = fit(maxiter=maxiter, **kw)
-                self._scatter(xs, chi2s, covs, idxs, x, chi2, cov)
-                self.diverged.extend(idxs[j] for j in batch.diverged)
-                self.fit_metrics[key] = batch.metrics
-            return xs, chi2s, covs
-        return self._fit_pipelined(method, maxiter, max_workers, **kw)
+            self._scatter(xs, chi2s, covs, idxs, x, chi2, cov)
+            self.diverged.extend(idxs[j] for j in batch.diverged)
+            self.fit_metrics[key] = batch.metrics
+        return xs, chi2s, covs
 
     def _fit_pipelined(self, method, maxiter, max_workers, **kw):
         import os
@@ -2225,19 +2250,31 @@ class PTAFleet:
         pool = None
         if cold:
             lowered = []
-            for key, batch, use_gls, bkw in cold:
-                lkw = {"method": "gls" if use_gls else "wls",
-                       "maxiter": maxiter,
-                       "threshold": bkw.get("threshold", 1e-12)}
-                if use_gls:
-                    lkw["ecorr_mode"] = bkw.get("ecorr_mode", "auto")
-                    lkw["precision"] = bkw.get("precision", "f64")
-                lowered.append((key, batch, batch.aot_lower(**lkw)))
+            with obs_trace.span("fleet.compile", phase="trace",
+                                n_jobs=len(cold)):
+                for key, batch, use_gls, bkw in cold:
+                    lkw = {"method": "gls" if use_gls else "wls",
+                           "maxiter": maxiter,
+                           "threshold": bkw.get("threshold", 1e-12)}
+                    if use_gls:
+                        lkw["ecorr_mode"] = bkw.get("ecorr_mode",
+                                                    "auto")
+                        lkw["precision"] = bkw.get("precision", "f64")
+                    lowered.append((key, batch,
+                                    batch.aot_lower(**lkw)))
+            tid = obs_trace.current_trace_id()
+
+            def _compile_one(key, batch, low):
+                # pool thread: join the fit's trace explicitly
+                with obs_trace.span("fleet.compile", trace_id=tid,
+                                    phase="xla", bucket=key):
+                    return batch._aot_backend_compile(low)
+
             pool = ThreadPoolExecutor(
                 max_workers=max_workers
                 or min(len(cold), os.cpu_count() or 1))
             compile_futs = {
-                key: pool.submit(batch._aot_backend_compile, low)
+                key: pool.submit(_compile_one, key, batch, low)
                 for key, batch, low in lowered}
         try:
             # 3) dispatch every bucket before pulling anything (JAX
@@ -2260,14 +2297,16 @@ class PTAFleet:
                     import time as _time
 
                     _time.sleep(float(fault.get("delay_s", 0.0)))
-                if use_gls:
-                    h = batch._dispatch_gls(
-                        maxiter, bkw.get("threshold", 1e-12),
-                        bkw.get("ecorr_mode", "auto"),
-                        bkw.get("precision", "f64"))
-                else:
-                    h = batch._dispatch_wls(
-                        maxiter, bkw.get("threshold", 1e-12))
+                with obs_trace.span("fleet.dispatch", bucket=bi,
+                                    n=len(idxs)):
+                    if use_gls:
+                        h = batch._dispatch_gls(
+                            maxiter, bkw.get("threshold", 1e-12),
+                            bkw.get("ecorr_mode", "auto"),
+                            bkw.get("precision", "f64"))
+                    else:
+                        h = batch._dispatch_wls(
+                            maxiter, bkw.get("threshold", 1e-12))
                 handles.append((key, idxs, batch, use_gls, h))
             # 4) finalize in the SAME bucket order as the sequential
             # path — the host unpack of bucket i overlaps device
@@ -2279,7 +2318,9 @@ class PTAFleet:
             for key, idxs, batch, use_gls, h in handles:
                 fin = (batch._finalize_gls if use_gls
                        else batch._finalize_wls)
-                x, chi2, cov = fin(h)
+                with obs_trace.span("fleet.execute", bucket=key,
+                                    n=len(idxs)):
+                    x, chi2, cov = fin(h)
                 self._scatter(xs, chi2s, covs, idxs, x, chi2, cov)
                 self.diverged.extend(idxs[j] for j in batch.diverged)
                 self.fit_metrics[key] = batch.metrics

@@ -94,15 +94,25 @@ class BackoffPolicy:
 
 
 def with_retries(fn, policy=None, sleep=time.sleep,
-                 retryable=is_retryable, on_retry=None):
+                 retryable=is_retryable, on_retry=None, trace_id=None):
     """Call ``fn()`` with up to ``policy.max_attempts`` attempts.
     Non-retryable exceptions (per ``retryable``) and the final
     attempt's exception propagate; ``on_retry(attempt, exc, delay_s)``
-    is invoked before each backoff sleep (telemetry hook)."""
+    is invoked before each backoff sleep (telemetry hook).
+
+    ``trace_id`` threads an obs trace through the whole retry ladder:
+    every attempt's span joins the caller's trace (rather than each
+    re-run starting a fresh one), so a flight-recorder dump after a
+    failed slot shows the original attempt and its retries as one
+    timeline."""
+    from ..obs import trace as obs_trace
+
     policy = policy or BackoffPolicy()
     for attempt in range(policy.max_attempts):
         try:
-            return fn()
+            with obs_trace.span("retry.attempt", trace_id=trace_id,
+                                attempt=attempt):
+                return fn()
         except Exception as e:
             last_attempt = attempt >= policy.max_attempts - 1
             if last_attempt or not retryable(e):
@@ -188,6 +198,7 @@ class CircuitBreaker:
                 e["opened_at"] = self.clock()
                 e["trial"] = False
                 self.trips += 1
+                self._flight_dump(key, "failure_streak")
                 return True
             return False
 
@@ -203,8 +214,22 @@ class CircuitBreaker:
             e["trial"] = False
             if not already_open:
                 self.trips += 1
+                self._flight_dump(key, "forced")
                 return True
             return False
+
+    def _flight_dump(self, key, why):
+        """Breaker trips are one of the flight recorder's auto-dump
+        triggers: snapshot the recent span/fault ring the moment a
+        slot goes dark, while the evidence is still in the ring.
+        Lazy import keeps the resilience -> obs edge out of module
+        import time (obs.recorder imports this package's faultinject)."""
+        from ..obs import trace as obs_trace
+        from ..obs.recorder import RECORDER
+
+        RECORDER.dump("breaker_trip", key=str(key), why=why,
+                      trips=self.trips,
+                      trace=obs_trace.current_trace_id())
 
     def open_count(self):
         with self._lock:

@@ -20,6 +20,8 @@ import sys
 
 import numpy as np
 
+from pint_tpu.obs import clock as obs_clock
+
 
 def build_serve_fleet(sizes=(48, 96, 180), per_combo=3, seed=0):
     """(models, toas_list) spanning 3 model structures x len(sizes)
@@ -80,7 +82,6 @@ def run_serve_stream(n_requests=216, max_batch=8, max_latency_s=0.05,
     pulsars. concurrent_prewarm=True warms the cache through
     ServeEngine.prewarm_concurrent (trace-serial / XLA-concurrent,
     the fleet executor's compile path) instead of serial flushes."""
-    import time as _time
 
     from pint_tpu.serve import FitRequest, ServeEngine
 
@@ -98,13 +99,13 @@ def run_serve_stream(n_requests=216, max_batch=8, max_latency_s=0.05,
                           maxiter=maxiter, precision=precision)
 
     # one request per pulsar covers every (structure, bucket) slot
-    t_warm = _time.perf_counter()
+    t_warm = obs_clock.now()
     if concurrent_prewarm:
         warm_compiles = eng.prewarm_concurrent(
             [req(i) for i in range(n_pulsars)])
     else:
         warm_compiles = eng.prewarm([req(i) for i in range(n_pulsars)])
-    prewarm_wall_s = _time.perf_counter() - t_warm
+    prewarm_wall_s = obs_clock.now() - t_warm
     results = eng.run_stream([req(i) for i in range(n_requests)])
     snap = eng.snapshot()
     statuses = {}
@@ -137,14 +138,14 @@ def run_serve_stream(n_requests=216, max_batch=8, max_latency_s=0.05,
         # warm sequential-vs-pipelined executor comparison on the same
         # fleet: the programs are compiled now, so the delta is pure
         # scheduling (dispatch-all + overlapped host unpack)
-        t0 = _time.perf_counter()
+        t0 = obs_clock.now()
         xs_s, chi_s, _ = fleet.fit(method="auto", maxiter=maxiter,
                                    pipeline=False)
-        seq_s = _time.perf_counter() - t0
-        t0 = _time.perf_counter()
+        seq_s = obs_clock.now() - t0
+        t0 = obs_clock.now()
         xs_p, chi_p, _ = fleet.fit(method="auto", maxiter=maxiter,
                                    pipeline=True)
-        pipe_s = _time.perf_counter() - t0
+        pipe_s = obs_clock.now() - t0
         report["fleet_fit_sequential_s"] = round(seq_s, 4)
         report["fleet_fit_pipelined_s"] = round(pipe_s, 4)
         report["fleet_pipeline_overlap_pct"] = round(
@@ -442,7 +443,28 @@ def main(argv=None) -> int:
     p.add_argument("--devices", type=int, default=None,
                    help="device-chaos only: cap the lane count "
                         "(default: every jax device)")
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="enable obs tracing for the run and export "
+                        "the span timeline as Chrome trace-event "
+                        "JSON (chrome://tracing / Perfetto)")
     args = p.parse_args(argv)
+
+    if args.trace_out:
+        from pint_tpu import obs
+        obs.enable()
+
+    def _finish(rc):
+        # export whatever the run traced (serve flush/pack/compile
+        # spans, retry attempts, chaos re-shards) before exiting
+        if args.trace_out:
+            from pint_tpu import obs
+            from pint_tpu.obs.export import write_chrome_trace
+
+            write_chrome_trace(args.trace_out)
+            obs.disable()
+            print(f"trace written to {args.trace_out}",
+                  file=sys.stderr)
+        return rc
 
     if args.chaos:
         from pint_tpu.resilience import DEVICE_POINTS
@@ -462,7 +484,7 @@ def main(argv=None) -> int:
                       f"fleet_rel="
                       f"{report['fleet_max_rel_diff_vs_healthy']})",
                       file=sys.stderr)
-            return 0 if report["ok"] else 1
+            return _finish(0 if report["ok"] else 1)
         report = run_chaos_stream(
             n_requests=args.requests, fault_rate=args.fault_rate,
             fault_point=args.fault_point, max_batch=args.max_batch,
@@ -477,7 +499,7 @@ def main(argv=None) -> int:
                   f"unexpected_recompiles="
                   f"{report['unexpected_recompiles']})",
                   file=sys.stderr)
-        return 0 if report["ok"] else 1
+        return _finish(0 if report["ok"] else 1)
 
     report = run_serve_stream(
         n_requests=args.requests, max_batch=args.max_batch,
@@ -494,7 +516,7 @@ def main(argv=None) -> int:
               f"{report['recompiles_after_warmup']}, "
               f"hit_rate={hit_rate:.3f} "
               f"(threshold {args.hit_threshold})", file=sys.stderr)
-    return 0 if ok else 1
+    return _finish(0 if ok else 1)
 
 
 if __name__ == "__main__":

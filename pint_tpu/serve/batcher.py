@@ -26,6 +26,8 @@ from __future__ import annotations
 
 import threading
 
+from ..obs import trace as obs_trace
+
 
 def pow2_bucket(n, floor=256):
     """Smallest power-of-two >= n, starting at ``floor`` (PTAFleet's
@@ -91,8 +93,14 @@ class MicroBatcher:
 
     def take(self, key):
         """Remove and return a slot's queued entries."""
-        with self._lock:
-            return self._slots.pop(key, [])
+        with obs_trace.span("serve.take", slot=key) as sp:
+            with self._lock:
+                entries = self._slots.pop(key, [])
+                if sp is not obs_trace.NOOP_SPAN:  # attrs cost only when tracing
+                    sp.set(n=len(entries),
+                           queued=sum(len(v)
+                                      for v in self._slots.values()))
+                return entries
 
     def pending_keys(self):
         with self._lock:

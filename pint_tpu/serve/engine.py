@@ -39,6 +39,8 @@ import zlib
 
 import numpy as np
 
+from ..obs import trace as obs_trace
+from ..obs.recorder import RECORDER as _flight
 from ..resilience import faultinject
 from ..resilience.faultinject import FaultInjected
 from ..resilience.health import HealthMonitor
@@ -274,6 +276,22 @@ class ServeEngine:
         snap["queue_depth"] = self.batcher.depth()
         return snap
 
+    def export_metrics(self, registry=None, prefix="serve."):
+        """Absorb this engine's full snapshot — request telemetry,
+        cache counters, health, breaker census, per-lane device
+        state — into the obs metrics registry, from which
+        ``obs.prometheus_text()`` renders one service-wide exposition.
+        Pull-model: call at scrape/report time; the flush path never
+        pushes."""
+        lanes = ([ln.snapshot() for ln in self.device_lanes]
+                 if self.device_lanes is not None else None)
+        reg = self.telemetry.export_to_registry(
+            registry=registry, prefix=prefix, cache=self.cache,
+            health=self.health, breaker=self.breaker, devices=lanes)
+        reg.absorb({"executables_compiled": self.executables_compiled,
+                    "queue_depth": self.batcher.depth()}, prefix=prefix)
+        return reg
+
     # -- execution ---------------------------------------------------
 
     def _exec_key(self, slot_key, lanes, pta):
@@ -461,30 +479,33 @@ class ServeEngine:
         return self.cache.prefill(entries)
 
     def _flush(self, key):
-        entries = self.batcher.take(key)
-        if not entries:
-            return
-        self.telemetry.incr("flushes")
-        now = self.clock()
-        live = []
-        for req, res, t_sub in entries:
-            if policy.expired(req, t_sub, now):
-                res.status = "shed"
-                res.reason = "deadline"
-                res.telemetry = policy.rejection(
-                    "deadline", waited_s=now - t_sub,
-                    deadline_s=req.deadline_s,
-                    request_id=req.request_id)
-                self.telemetry.incr("shed_deadline")
-                self.telemetry.record(request_id=req.request_id,
-                                      status="shed", reason="deadline",
-                                      queue_wait_s=now - t_sub)
-                self.health.note_request("shed")
-            else:
-                live.append((req, res, t_sub))
-        if live:
-            self._execute(key, live, flush_start=now)
-            self.health.note_flush(self.clock() - now)
+        with obs_trace.span("serve.flush", slot=key) as fsp:
+            entries = self.batcher.take(key)
+            if not entries:
+                return
+            self.telemetry.incr("flushes")
+            now = self.clock()
+            live = []
+            for req, res, t_sub in entries:
+                if policy.expired(req, t_sub, now):
+                    res.status = "shed"
+                    res.reason = "deadline"
+                    res.telemetry = policy.rejection(
+                        "deadline", waited_s=now - t_sub,
+                        deadline_s=req.deadline_s,
+                        request_id=req.request_id)
+                    self.telemetry.incr("shed_deadline")
+                    self.telemetry.record(request_id=req.request_id,
+                                          status="shed",
+                                          reason="deadline",
+                                          queue_wait_s=now - t_sub)
+                    self.health.note_request("shed")
+                else:
+                    live.append((req, res, t_sub))
+            fsp.set(n_live=len(live), shed=len(entries) - len(live))
+            if live:
+                self._execute(key, live, flush_start=now)
+                self.health.note_flush(self.clock() - now)
 
     def _fail(self, live, kind, exc):
         reason = f"{type(exc).__name__}: {exc}"
@@ -516,15 +537,22 @@ class ServeEngine:
             poisoned = with_retries(
                 lambda: self._execute_batch(slot_key, live, flush_start),
                 policy=self.backoff, sleep=self._sleep,
-                on_retry=self._on_retry)
+                on_retry=self._on_retry,
+                trace_id=obs_trace.current_trace_id())
         except Exception as e:
             if len(live) > 1 and depth < self.bisect_depth:
                 self.telemetry.incr("flush_bisects")
+                _flight.note("serve_bisect", slot=str(slot_key),
+                             depth=depth, n=len(live),
+                             trace=obs_trace.current_trace_id(),
+                             error=type(e).__name__)
                 mid = len(live) // 2
-                self._execute(slot_key, live[:mid], flush_start,
-                              depth + 1)
-                self._execute(slot_key, live[mid:], flush_start,
-                              depth + 1)
+                with obs_trace.span("serve.bisect", depth=depth,
+                                    n=len(live)):
+                    self._execute(slot_key, live[:mid], flush_start,
+                                  depth + 1)
+                    self._execute(slot_key, live[mid:], flush_start,
+                                  depth + 1)
                 return
             self._fail(live, kind, e)
             tripped = self.breaker.record_failure(slot_key)
@@ -568,7 +596,14 @@ class ServeEngine:
                 # lane — the flush proceeds there, no request fails
                 dev_lane.quarantine()
                 self.telemetry.incr("device_lost")
+                lost_index = dev_lane.index
                 dev_lane = self._route_lane(slot_key)
+                _flight.dump(
+                    "device_lost", source="serve", lane=lost_index,
+                    fault_point="device_loss", slot=str(slot_key),
+                    rerouted_lane=(None if dev_lane is None
+                                   else dev_lane.index),
+                    trace=obs_trace.current_trace_id())
             if dev_lane is None:
                 from ..parallel.fleetmesh import DeviceLost
 
@@ -576,10 +611,11 @@ class ServeEngine:
                     f"no alive device lane for slot {slot_key!r} "
                     f"({len(self.device_lanes)} lanes quarantined)")
         t0 = self.clock()
-        pta = self._padded_batch(bucket,
-                                 [req.model for req, _, _ in live],
-                                 [req.toas for req, _, _ in live],
-                                 lane=dev_lane)
+        with obs_trace.span("serve.pack", bucket=bucket, n=n_live):
+            pta = self._padded_batch(bucket,
+                                     [req.model for req, _, _ in live],
+                                     [req.toas for req, _, _ in live],
+                                     lane=dev_lane)
         pack_s = self.clock() - t0
         exec_key = self._exec_key(slot_key, lanes, pta)
         if dev_lane is not None:
@@ -602,8 +638,10 @@ class ServeEngine:
                 # (cold) flush explicitly instead of smeared into its
                 # execute time
                 t0 = self.clock()
-                pta.aot_compile(method, maxiter=maxiter,
-                                precision=precision)
+                with obs_trace.span("serve.compile", bucket=bucket,
+                                    method=method):
+                    pta.aot_compile(method, maxiter=maxiter,
+                                    precision=precision)
                 compile_s = self.clock() - t0
             self.executables_compiled += 1
             self.cache.insert(exec_key, pta._fns)
@@ -630,51 +668,53 @@ class ServeEngine:
 
         degraded = False
         t0 = self.clock()
-        if kind == "fit":
-            with warnings.catch_warnings(record=True) as caught:
-                warnings.simplefilter("always")
-                if method == "gls":
-                    x, chi2, cov = pta.gls_fit(maxiter=maxiter,
-                                               precision=precision)
-                else:
-                    x, chi2, cov = pta.wls_fit(maxiter=maxiter)
-            degraded = policy.mixed_fell_back(caught)
-            # the fallback is accounted as degradation; everything
-            # else (divergence reports etc.) is re-emitted
-            for w in caught:
-                if policy.MIXED_FALLBACK_MARK not in str(w.message):
-                    warnings.warn_explicit(w.message, w.category,
-                                           w.filename, w.lineno)
-            x, chi2, cov = (np.asarray(x), np.asarray(chi2),
-                            np.asarray(cov))
-            names = [n for n, _, _ in pta.free_map()]
-            diverged = set(pta.diverged)
-            poisoned = {i for i in range(n_live)
-                        if i in diverged
-                        or not (np.all(np.isfinite(x[i]))
-                                and np.isfinite(chi2[i]))}
+        with obs_trace.span("serve.run", kind=kind,
+                            bucket=bucket, cold=cold):
+            if kind == "fit":
+                with warnings.catch_warnings(record=True) as caught:
+                    warnings.simplefilter("always")
+                    if method == "gls":
+                        x, chi2, cov = pta.gls_fit(maxiter=maxiter,
+                                                   precision=precision)
+                    else:
+                        x, chi2, cov = pta.wls_fit(maxiter=maxiter)
+                degraded = policy.mixed_fell_back(caught)
+                # the fallback is accounted as degradation; everything
+                # else (divergence reports etc.) is re-emitted
+                for w in caught:
+                    if policy.MIXED_FALLBACK_MARK not in str(w.message):
+                        warnings.warn_explicit(w.message, w.category,
+                                               w.filename, w.lineno)
+                x, chi2, cov = (np.asarray(x), np.asarray(chi2),
+                                np.asarray(cov))
+                names = [n for n, _, _ in pta.free_map()]
+                diverged = set(pta.diverged)
+                poisoned = {i for i in range(n_live)
+                            if i in diverged
+                            or not (np.all(np.isfinite(x[i]))
+                                    and np.isfinite(chi2[i]))}
 
-            def value_of(i):
-                return {"x": x[i], "chi2": float(chi2[i]),
-                        "cov": cov[i], "free_names": names}
-        elif kind == "resid":
-            r, _ = pta.time_residuals()
-            r = np.asarray(r)
-            poisoned = {i for i in range(n_live)
-                        if not np.all(np.isfinite(
-                            r[i, :len(live[i][0].toas)]))}
+                def value_of(i):
+                    return {"x": x[i], "chi2": float(chi2[i]),
+                            "cov": cov[i], "free_names": names}
+            elif kind == "resid":
+                r, _ = pta.time_residuals()
+                r = np.asarray(r)
+                poisoned = {i for i in range(n_live)
+                            if not np.all(np.isfinite(
+                                r[i, :len(live[i][0].toas)]))}
 
-            def value_of(i):
-                return {"resid_s": r[i, :len(live[i][0].toas)]}
-        else:  # "phase" (policy.resolve rejected everything else)
-            ph, _ = pta.phases()
-            ph = np.asarray(ph)
-            poisoned = {i for i in range(n_live)
-                        if not np.all(np.isfinite(
-                            ph[i, :len(live[i][0].toas)]))}
+                def value_of(i):
+                    return {"resid_s": r[i, :len(live[i][0].toas)]}
+            else:  # "phase" (policy.resolve rejected everything else)
+                ph, _ = pta.phases()
+                ph = np.asarray(ph)
+                poisoned = {i for i in range(n_live)
+                            if not np.all(np.isfinite(
+                                ph[i, :len(live[i][0].toas)]))}
 
-            def value_of(i):
-                return {"phase": ph[i, :len(live[i][0].toas)]}
+                def value_of(i):
+                    return {"phase": ph[i, :len(live[i][0].toas)]}
         execute_s = self.clock() - t0
         if poisoned:
             return poisoned

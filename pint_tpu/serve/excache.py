@@ -21,6 +21,8 @@ from __future__ import annotations
 import threading
 from collections import OrderedDict
 
+from ..obs import trace as obs_trace
+
 
 class ExecutableCache:
     """Thread-safe: prewarm_concurrent inserts from worker threads
@@ -50,14 +52,17 @@ class ExecutableCache:
     def lookup(self, key):
         """The fns table for key (LRU-refreshed) or None; counts
         hit/miss."""
-        with self._lock:
-            fns = self._entries.get(key)
-            if fns is None:
-                self.misses += 1
-                return None
-            self._entries.move_to_end(key)
-            self.hits += 1
-            return fns
+        with obs_trace.span("excache.lookup", key=key) as sp:
+            with self._lock:
+                fns = self._entries.get(key)
+                if fns is None:
+                    self.misses += 1
+                    sp.set(outcome="miss")
+                    return None
+                self._entries.move_to_end(key)
+                self.hits += 1
+                sp.set(outcome="hit")
+                return fns
 
     def insert(self, key, fns):
         """Insert (or refresh) an executable table, evicting
@@ -65,12 +70,13 @@ class ExecutableCache:
         drops the only strong reference to its compiled programs, so
         evicted XLA executables are actually freed, not just
         forgotten."""
-        with self._lock:
-            self._entries[key] = fns
-            self._entries.move_to_end(key)
-            while len(self._entries) > self.capacity:
-                self._entries.popitem(last=False)
-                self.evictions += 1
+        with obs_trace.span("excache.insert", key=key):
+            with self._lock:
+                self._entries[key] = fns
+                self._entries.move_to_end(key)
+                while len(self._entries) > self.capacity:
+                    self._entries.popitem(last=False)
+                    self.evictions += 1
 
     def prefill(self, entries):
         """Warm-start bulk insert of (key, fns) pairs —

@@ -12,16 +12,10 @@ from __future__ import annotations
 
 import json
 
-
-def percentile(values, q):
-    """Nearest-rank percentile (q in [0, 100]); None on empty input.
-    Nearest-rank, not interpolated: at serving sample counts the p99
-    should be an actually-observed latency, not an average of two."""
-    if not values:
-        return None
-    v = sorted(float(x) for x in values)
-    idx = min(len(v) - 1, max(0, -(-int(q) * len(v) // 100) - 1))
-    return v[idx]
+# The one nearest-rank implementation lives with the obs histogram
+# primitives now; re-exported here so serve-layer callers (and bench)
+# keep their import path.
+from ..obs.metricsreg import percentile  # noqa: F401
 
 
 class ServeTelemetry:
@@ -89,6 +83,27 @@ class ServeTelemetry:
         return json.dumps(self.snapshot(cache=cache, health=health,
                                         breaker=breaker, devices=devices),
                           **dump_kw)
+
+    def export_to_registry(self, registry=None, prefix="serve.",
+                           **snapshot_kw):
+        """Absorb this telemetry's snapshot (counters, request census,
+        per-phase quantiles, plus any cache/health/breaker/devices
+        blocks) into an obs metrics registry — the bridge that puts
+        serve metrics, mesh health, and breaker state into ONE
+        Prometheus-exportable snapshot. Pull-model: called at export
+        time, costs the flush path nothing."""
+        from ..obs import metricsreg
+
+        reg = metricsreg.REGISTRY if registry is None else registry
+        snap = self.snapshot(**snapshot_kw)
+        lanes = snap.get("devices", {}).pop("lanes", None)
+        reg.absorb(snap, prefix=prefix)
+        if lanes is not None:
+            for lane in lanes:
+                reg.absorb(lane,
+                           prefix="%slane.%s." % (prefix,
+                                                  lane.get("index")))
+        return reg
 
     def reset(self):
         self.counters = {}

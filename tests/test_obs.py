@@ -1,0 +1,459 @@
+"""Unified observability layer (pint_tpu.obs): tracer core + thread
+semantics, nearest-rank percentile byte-compat, registry absorb,
+Prometheus / Chrome trace-event golden formats, the flight recorder's
+auto-dump on injected device loss and breaker trips, trace-id
+threading through retries, and the two product contracts — a traced
+fleet fit is bitwise identical to an untraced one, and the disabled
+span path is a sub-percent tax on a warm fit."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from pint_tpu import obs
+from pint_tpu.models import get_model
+from pint_tpu.obs import clock as obs_clock
+from pint_tpu.obs import recorder as obs_recorder
+from pint_tpu.obs import trace as obs_trace
+from pint_tpu.obs.export import chrome_trace, flight_spans
+from pint_tpu.obs.metricsreg import (Registry, percentile, prom_name,
+                                     prometheus_text, summary)
+from pint_tpu.resilience import FaultPoint, inject
+from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    """Every test starts and ends with tracing off, empty rings, and
+    no dump directory (module-global tracer/recorder state)."""
+    obs.disable()
+    obs.reset()
+    obs_recorder.RECORDER.reset()
+    obs_recorder.RECORDER.dump_dir = None
+    yield
+    obs.disable()
+    obs.reset()
+    obs_recorder.RECORDER.reset()
+    obs_recorder.RECORDER.dump_dir = None
+
+
+# -- tracer core -----------------------------------------------------
+
+
+def test_disabled_span_is_shared_noop():
+    assert not obs.enabled()
+    sp = obs_trace.span("anything", key=("won't", "be", "seen"))
+    assert sp is obs_trace.NOOP_SPAN
+    with sp as inner:
+        assert inner is obs_trace.NOOP_SPAN
+        inner.set(extra=1)  # no-op, no error
+    assert obs.spans() == []
+    assert obs_trace.current_trace_id() is None
+
+
+def test_span_nesting_parent_child_and_trace():
+    obs.enable()
+    with obs_trace.span("root") as r:
+        with obs_trace.span("child") as c:
+            assert c.trace_id == r.trace_id
+            assert c.parent_id == r.span_id
+            assert obs_trace.current_trace_id() == r.trace_id
+    recs = {s["name"]: s for s in obs.spans()}
+    assert recs["child"]["parent"] == recs["root"]["span"]
+    assert recs["child"]["trace"] == recs["root"]["trace"]
+    assert recs["child"]["t1"] >= recs["child"]["t0"]
+    assert recs["root"]["status"] == "ok"
+
+
+def test_span_error_status_and_attr():
+    obs.enable()
+    with pytest.raises(ValueError):
+        with obs_trace.span("boom"):
+            raise ValueError("nope")
+    (rec,) = obs.spans()
+    assert rec["status"] == "error"
+    assert rec["attrs"]["error"] == "ValueError"
+
+
+def test_cross_thread_trace_adoption():
+    obs.enable()
+    seen = {}
+
+    def worker(tid):
+        with obs_trace.span("worker", trace_id=tid):
+            seen["tid"] = obs_trace.current_trace_id()
+
+    with obs_trace.span("root") as r:
+        th = threading.Thread(target=worker, args=(r.trace_id,))
+        th.start()
+        th.join()
+    assert seen["tid"] == r.trace_id
+    recs = {s["name"]: s for s in obs.spans()}
+    assert recs["worker"]["trace"] == recs["root"]["trace"]
+    # a worker WITHOUT the explicit id starts a fresh trace
+    th2 = threading.Thread(target=lambda: worker(None))
+    th2.start()
+    th2.join()
+    assert seen["tid"] != recs["root"]["trace"]
+
+
+def test_ring_capacity_bounds_spans():
+    obs.enable(capacity=4)
+    for i in range(10):
+        with obs_trace.span("s%d" % i):
+            pass
+    names = [s["name"] for s in obs.spans()]
+    assert names == ["s6", "s7", "s8", "s9"]
+    obs.enable(capacity=8192)  # restore the default ring for peers
+
+
+# -- percentile / summary byte-compat --------------------------------
+
+
+def _nearest_rank_reference(values, q):
+    """The exact expression serve/metrics.py shipped before the obs
+    unification — the contract the shared helper must preserve."""
+    if not values:
+        return None
+    v = sorted(values)
+    idx = min(len(v) - 1, max(0, -(-int(q) * len(v) // 100) - 1))
+    return v[idx]
+
+
+def test_percentile_matches_old_serve_implementation():
+    rng = np.random.default_rng(7)
+    for n in (1, 2, 3, 7, 50, 100, 101):
+        vals = [float(x) for x in rng.uniform(0, 10, n)]
+        for q in (0, 1, 50, 90, 99, 100):
+            assert percentile(vals, q) == \
+                _nearest_rank_reference(vals, q)
+    assert percentile([], 50) is None
+
+
+def test_serve_metrics_percentile_is_the_shared_helper():
+    from pint_tpu.serve import metrics as serve_metrics
+
+    assert serve_metrics.percentile is percentile
+
+
+def test_summary_shape():
+    s = summary([3.0, 1.0, 2.0])
+    assert s["count"] == 3 and s["min"] == 1.0 and s["max"] == 3.0
+    assert s["p50"] == 2.0 and s["p99"] == 3.0
+    empty = summary([])
+    assert empty["count"] == 0 and empty["p50"] is None
+
+
+# -- metrics registry ------------------------------------------------
+
+
+def test_registry_absorb_types_and_snapshot():
+    reg = Registry()
+    reg.absorb({"requests": 12, "hit_rate": 0.75, "alive": True,
+                "lat_s": [0.1, 0.2, 0.3],
+                "cache": {"hits": 9, "misses": 3}}, prefix="serve.")
+    snap = reg.snapshot()
+    assert snap["counters"]["serve.requests"] == 12
+    assert snap["counters"]["serve.cache.hits"] == 9
+    assert snap["gauges"]["serve.hit_rate"] == 0.75
+    assert snap["gauges"]["serve.alive"] == 1
+    assert snap["histograms"]["serve.lat_s"]["count"] == 3
+    assert snap["histograms"]["serve.lat_s"]["p50"] == 0.2
+    json.loads(reg.to_json())  # snapshot is JSON-clean
+
+
+def test_serve_telemetry_exports_to_registry():
+    from pint_tpu.serve.metrics import ServeTelemetry
+
+    tel = ServeTelemetry()
+    tel.incr("flushes", 3)
+    tel.record(status="ok", total_s=0.05, queue_wait_s=0.01,
+               pack_s=0.01, compile_s=None, execute_s=0.03)
+    reg = Registry()
+    tel.export_to_registry(registry=reg)
+    snap = reg.snapshot()
+    assert snap["counters"]["serve.counters.flushes"] == 3
+    assert snap["counters"]["serve.requests"] == 1
+
+
+def test_prometheus_text_golden_format():
+    reg = Registry()
+    reg.counter("serve.requests").inc(5)
+    reg.gauge("mesh.alive lanes").set(None)
+    h = reg.histogram("serve.total_s")
+    for v in (0.1, 0.2, 0.4):
+        h.record(v)
+    text = prometheus_text(registry=reg)
+    lines = text.splitlines()
+    assert "# TYPE pint_tpu_serve_requests counter" in lines
+    assert "pint_tpu_serve_requests 5" in lines
+    # name sanitization + None -> NaN
+    assert "# TYPE pint_tpu_mesh_alive_lanes gauge" in lines
+    assert "pint_tpu_mesh_alive_lanes NaN" in lines
+    assert "# TYPE pint_tpu_serve_total_s summary" in lines
+    assert 'pint_tpu_serve_total_s{quantile="0.50"} 0.2' in lines
+    assert "pint_tpu_serve_total_s_count 3" in lines
+    assert text.endswith("\n")
+    assert prom_name("a.b-c d") == "pint_tpu_a_b_c_d"
+
+
+# -- chrome trace exporter -------------------------------------------
+
+
+def test_chrome_trace_golden_format(tmp_path):
+    obs.enable()
+    with obs_trace.span("fleet.fit", n_psr=2):
+        with obs_trace.span("fleet.pack", bucket=("k", 256)):
+            pass
+    doc = chrome_trace(obs.spans())
+    assert doc["displayTimeUnit"] == "ms"
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    ms = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert {e["name"] for e in xs} == {"fleet.fit", "fleet.pack"}
+    assert any(e["name"] == "process_name" for e in ms)
+    assert any(e["name"] == "thread_name" for e in ms)
+    for e in xs:
+        assert e["pid"] == 1 and e["ts"] >= 0 and e["dur"] >= 0
+    pack = next(e for e in xs if e["name"] == "fleet.pack")
+    assert pack["args"]["parent"] is not None
+    # the file round-trip must survive tuple-valued attrs (raw site
+    # values, stringified only at export)
+    path = obs.write_chrome_trace(str(tmp_path / "trace.json"))
+    loaded = json.load(open(path))
+    assert loaded["traceEvents"]
+
+
+# -- flight recorder -------------------------------------------------
+
+
+def test_fault_firings_land_in_flight_ring():
+    from pint_tpu.resilience import faultinject
+
+    with inject(FaultPoint("toa_nan", rate=1.0)):
+        assert faultinject.fire("toa_nan", request=3) is not None
+    faults = [e for e in obs_recorder.RECORDER.events()
+              if e["kind"] == "fault"]
+    assert faults and faults[-1]["point"] == "toa_nan"
+    assert faults[-1]["ctx"]["request"] == 3
+
+
+def test_dump_noop_without_dir_but_event_noted():
+    path = obs_recorder.RECORDER.dump("breaker_trip", key="k")
+    assert path is None
+    evs = [e for e in obs_recorder.RECORDER.events()
+           if e["kind"] == "event" and e["what"] == "dump"]
+    assert evs and evs[-1]["reason"] == "breaker_trip"
+
+
+def test_breaker_trip_writes_flight_dump(tmp_path):
+    from pint_tpu.resilience.retry import CircuitBreaker
+
+    obs_recorder.configure(dump_dir=str(tmp_path))
+    br = CircuitBreaker(threshold=1, cooldown_s=10.0)
+    assert br.record_failure(("slot", 256)) is True
+    dumps = obs_recorder.RECORDER.dumps
+    assert len(dumps) == 1 and "breaker_trip" in dumps[0]
+    doc = json.load(open(dumps[0]))
+    assert doc["reason"] == "breaker_trip"
+    assert doc["context"]["key"] == "('slot', 256)"
+    assert doc["context"]["why"] == "failure_streak"
+
+
+def test_flight_dump_contains_recent_spans_and_roundtrips(tmp_path):
+    obs.enable()
+    obs_recorder.configure(dump_dir=str(tmp_path))
+    with obs_trace.span("serve.flush", slot=("a", 1)):
+        pass
+    path = obs_recorder.RECORDER.dump("device_lost", lane=2)
+    doc = json.load(open(path))
+    spans = flight_spans(doc)
+    assert [s["name"] for s in spans] == ["serve.flush"]
+    assert chrome_trace(spans)["traceEvents"]  # converter accepts it
+
+
+# -- trace-id threading through retries ------------------------------
+
+
+def test_with_retries_joins_callers_trace():
+    from pint_tpu.resilience.retry import BackoffPolicy, with_retries
+
+    obs.enable()
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 2:
+            raise TimeoutError("transient")
+        return "ok"
+
+    with obs_trace.span("serve.flush") as root:
+        out = with_retries(flaky, BackoffPolicy(max_attempts=3, seed=1),
+                           sleep=lambda s: None,
+                           trace_id=obs_trace.current_trace_id())
+    assert out == "ok"
+    attempts = [s for s in obs.spans() if s["name"] == "retry.attempt"]
+    assert [a["attrs"]["attempt"] for a in attempts] == [0, 1]
+    assert {a["trace"] for a in attempts} == {root.trace_id}
+    assert attempts[0]["status"] == "error"
+    assert attempts[1]["status"] == "ok"
+
+
+# -- product contracts on a real fleet -------------------------------
+
+
+def _tiny_fleet_pulsars():
+    """2 structures (spin-only -> WLS, EFAC/EQUAD/ECORR -> GLS)."""
+    rng = np.random.default_rng(0)
+    models, toas_list = [], []
+    for i in range(2):
+        par = (f"PSR OB{i}\nRAJ 1{i}:00:00.0\nDECJ {4 + i}:30:00.0\n"
+               f"F0 {150 + 10 * i}.5 1\nF1 -{2 + i}e-16 1\n"
+               f"PEPOCH 55500\nDM {9 + i}.5 1\n")
+        m = get_model(par)
+        mjds = np.sort(rng.uniform(55000, 56000, 24 + 4 * i))
+        toas_list.append(make_fake_toas_fromMJDs(
+            mjds, m, error_us=1.0, freq_mhz=1400.0, obs="gbt",
+            add_noise=True, seed=i))
+        models.append(m)
+    for i in range(2):
+        par = (f"PSR OBN{i}\nRAJ 0{2 * i}:30:00.0\n"
+               f"DECJ {7 + i}:00:00.0\n"
+               f"F0 {310 + 4 * i}.25 1\nF1 -{2 + i}e-16 1\n"
+               f"PEPOCH 55500\nDM {12 + i}.3 1\n"
+               "EFAC -f L-wide 1.2\nEQUAD -f L-wide 0.5\n"
+               "ECORR -f L-wide 0.9\n")
+        m = get_model(par)
+        epoch_days = np.linspace(55000, 56000, 10 + 2 * i)
+        mjds = np.concatenate(
+            [d + np.arange(3) * 0.5 / 86400.0 for d in epoch_days])
+        t = make_fake_toas_fromMJDs(
+            mjds, m, error_us=1.0, freq_mhz=np.full(len(mjds), 1400.0),
+            obs="gbt", add_noise=True, seed=100 + i)
+        for f in t.flags:
+            f["f"] = "L-wide"
+        models.append(m)
+        toas_list.append(t)
+    return models, toas_list
+
+
+@pytest.fixture(scope="module")
+def tiny_fleet():
+    from pint_tpu.parallel import PTAFleet
+
+    models, toas_list = _tiny_fleet_pulsars()
+    fleet = PTAFleet(models, toas_list, pipeline=True)
+    fleet.fit(method="auto", maxiter=2)  # compile + warm
+    return fleet
+
+
+def test_traced_fleet_fit_bitwise_equal_and_phases(tiny_fleet):
+    x0, c0, v0 = tiny_fleet.fit(method="auto", maxiter=2)
+    obs.enable()
+    try:
+        x1, c1, v1 = tiny_fleet.fit(method="auto", maxiter=2)
+    finally:
+        obs.disable()
+    np.testing.assert_array_equal(np.asarray(c0), np.asarray(c1))
+    for a, b in zip(x0, x1):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(v0, v1):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    names = {s["name"] for s in obs.spans()}
+    # warm fit: dispatch + execute per bucket under one fleet.fit root
+    assert {"fleet.fit", "fleet.dispatch", "fleet.execute"} <= names
+    fits = [s for s in obs.spans() if s["name"] == "fleet.fit"]
+    execs = [s for s in obs.spans() if s["name"] == "fleet.execute"]
+    assert len(execs) == len(tiny_fleet.group_indices)
+    assert {e["trace"] for e in execs} == {fits[0]["trace"]}
+
+
+def test_cold_traced_fleet_covers_all_phases(tmp_path):
+    from pint_tpu.parallel import PTAFleet
+
+    models, toas_list = _tiny_fleet_pulsars()
+    obs.enable()
+    try:
+        fleet = PTAFleet(models, toas_list, pipeline=True)
+        fleet.fit(method="auto", maxiter=2)
+    finally:
+        obs.disable()
+    names = {s["name"] for s in obs.spans()}
+    assert {"fleet.host_prep", "fleet.pack", "fleet.compile",
+            "fleet.dispatch", "fleet.execute", "fleet.fit",
+            "aot.trace", "aot.backend_compile"} <= names
+    # the exported timeline is valid Chrome trace-event JSON with one
+    # row per participating thread (prep pool, compile pool, caller)
+    path = obs.write_chrome_trace(str(tmp_path / "fleet.json"))
+    doc = json.load(open(path))
+    threads = {e["tid"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert len(threads) >= 2
+
+
+def test_disabled_span_overhead_under_one_percent(tiny_fleet):
+    """The disabled-path contract: span() call sites cost so little
+    that the spans a warm fleet fit would emit amount to < 1% of the
+    fit wall. Measured as (per-call disabled span cost) x (spans one
+    traced fit emits) vs the untraced fit wall — the product form is
+    robust to CI timer jitter where diffing two fit walls is not."""
+    assert not obs.enabled()
+    n_calls = 20000
+    t0 = obs_clock.now()
+    for _ in range(n_calls):
+        with obs_trace.span("x", a=1):
+            pass
+    per_call = (obs_clock.now() - t0) / n_calls
+
+    fit_s = float("inf")
+    for _ in range(2):
+        t0 = obs_clock.now()
+        tiny_fleet.fit(method="auto", maxiter=2)
+        fit_s = min(fit_s, obs_clock.now() - t0)
+
+    obs.reset()
+    obs.enable()
+    try:
+        tiny_fleet.fit(method="auto", maxiter=2)
+        spans_per_fit = len(obs.spans())
+    finally:
+        obs.disable()
+    assert spans_per_fit > 0
+    overhead = per_call * spans_per_fit
+    assert overhead < 0.01 * fit_s, (per_call, spans_per_fit, fit_s)
+
+
+def test_fleetmesh_device_loss_writes_flight_dump(tmp_path,
+                                                  device_mesh):
+    """The acceptance artifact: an injected device_loss chaos run
+    leaves a flight dump naming the lost lane, the fault point, and
+    the re-sharded buckets, with the fault firing in the ring."""
+    from pint_tpu.parallel import FleetMesh
+
+    obs_recorder.configure(dump_dir=str(tmp_path))
+    obs.enable()
+    try:
+        models, toas_list = _tiny_fleet_pulsars()
+        fm = FleetMesh(models, toas_list, collective_timeout_s=None)
+        with inject(FaultPoint("device_loss", rate=1.0,
+                               payload={"lane": 0})):
+            fm.fit(method="auto", maxiter=2)
+    finally:
+        obs.disable()
+    dumps = [p for p in obs_recorder.RECORDER.dumps
+             if "device_lost" in p]
+    assert dumps, obs_recorder.RECORDER.dumps
+    doc = json.load(open(dumps[0]))
+    assert doc["reason"] == "device_lost"
+    ctx = doc["context"]
+    assert ctx["source"] == "fleetmesh"
+    assert ctx["lane"] == 0
+    assert ctx["fault_point"] == "device_loss"
+    assert ctx["resharded"], ctx  # the stolen buckets are named
+    kinds = {e["kind"] for e in doc["events"]}
+    assert "fault" in kinds      # the injected firing itself
+    assert "event" in kinds      # the work_steal ledger entries
+    steals = [e for e in doc["events"]
+              if e["kind"] == "event" and e.get("what") == "work_steal"]
+    assert steals and steals[0]["from_lane"] == 0

@@ -561,6 +561,88 @@ def test_timing_no_block_quiet_with_block(tmp_path):
     assert live(fs, "timing-no-block") == []
 
 
+def test_timing_no_block_sees_obs_clock_windows(tmp_path):
+    # the sanctioned obs clock opens timing windows too: migrating a
+    # bench from time.perf_counter to obs_clock.now must not blind
+    # the async-dispatch check
+    bad = """
+        import jax
+
+        from pint_tpu.obs import clock as obs_clock
+
+        def bench():
+            def step(x):
+                return x * 2.0
+
+            g = jax.jit(step)
+            t0 = obs_clock.now()
+            out = g(1.0)  # async enqueue, nothing waits
+            dt = obs_clock.now() - t0
+            return out, dt
+    """
+    fs = lint(tmp_path, {"m.py": bad}, LintConfig())
+    assert len(live(fs, "timing-no-block")) == 1
+
+
+# -- timing-untraced -------------------------------------------------
+
+
+OBS_CFG = LintConfig(obs_instrumented_modules=("/engine.py",))
+
+
+def test_timing_untraced_flags_raw_reads(tmp_path):
+    bad = """
+        import time
+
+        def flush():
+            t0 = time.perf_counter()
+            wall = time.time()
+            return time.perf_counter() - t0, wall
+    """
+    fs = lint(tmp_path, {"engine.py": bad}, OBS_CFG)
+    assert len(live(fs, "timing-untraced")) == 3
+
+
+def test_timing_untraced_quiet_on_obs_clock_and_sleep(tmp_path):
+    good = """
+        import time
+
+        from pint_tpu.obs import clock as obs_clock
+
+        def flush(clock=time.monotonic):  # reference, not a call
+            t0 = obs_clock.now()
+            time.sleep(0.0)  # a delay, not a measurement
+            return obs_clock.now() - t0
+    """
+    fs = lint(tmp_path, {"engine.py": good}, OBS_CFG)
+    assert live(fs, "timing-untraced") == []
+
+
+def test_timing_untraced_quiet_outside_instrumented_modules(tmp_path):
+    src = """
+        import time
+
+        def helper():
+            return time.perf_counter()
+    """
+    fs = lint(tmp_path, {"other.py": src}, OBS_CFG)
+    assert live(fs, "timing-untraced") == []
+
+
+def test_timing_untraced_allows_obs_package_and_tests(tmp_path):
+    src = """
+        import time
+
+        def probe():
+            return time.perf_counter()
+    """
+    cfg = LintConfig(obs_instrumented_modules=("/clock.py",
+                                               "/test_engine.py"))
+    fs = lint(tmp_path, {"obs/clock.py": src,
+                         "tests/test_engine.py": src}, cfg)
+    assert live(fs, "timing-untraced") == []
+
+
 # -- suppression grammar ---------------------------------------------
 
 
