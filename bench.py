@@ -106,23 +106,25 @@ def _ragged_counts(n_psr=68, total=670_000, seed=7):
 # FLOP/s) which overrides the table for every platform.
 
 
-def _cpu_peak_flops():
-    return (os.cpu_count() or 1) * 2.5e9 * 16
+# The peak table moved into pint_tpu.obs.costmodel (one denominator
+# shared by bench headlines, fleet execute spans, and the profile
+# harness); these names stay as the bench-facing aliases. costmodel
+# additionally guarantees a non-null peak for ANY platform (nominal
+# fallback spec) — the BENCH_r05 null-MFU bug was this table missing
+# the running platform and every consumer silently nulling out.
+from pint_tpu.obs import costmodel as _costmodel
 
+_cpu_peak_flops = _costmodel._cpu_peak_flops
 
-PEAK_FLOPS = {"tpu": 1.97e14, "cpu": _cpu_peak_flops()}
+PEAK_FLOPS = {k: v["peak_flops"]
+              for k, v in _costmodel.DEVICE_SPECS.items()}
 
 
 def _peak_flops(platform):
     """MFU denominator for ``platform``: the PINT_TPU_PEAK_FLOPS env
-    override when set (and parseable), else the PEAK_FLOPS table."""
-    env = os.environ.get("PINT_TPU_PEAK_FLOPS")
-    if env:
-        try:
-            return float(env)
-        except ValueError:
-            pass  # fall through to the table rather than die mid-bench
-    return PEAK_FLOPS.get(platform)
+    override when set (and parseable), else the costmodel table
+    (nominal fallback for unknown platforms — never None)."""
+    return _costmodel.peak_flops(platform)
 
 # Dense-system column count of the bench GLS workload: 1 offset column
 # + 3 free params (F0, F1, DM — fixed by build_batch's par) + 2*30
@@ -146,12 +148,9 @@ def gls_model_flops(counts, maxiter=2, k=K_DENSE):
 
 
 def _mfu(flops, wall_s, platform):
-    """Model FLOPs utilization [%] against _peak_flops, or None when
-    the platform has no recorded peak or flops are unknown."""
-    peak = _peak_flops(platform)
-    if not flops or not wall_s or not peak:
-        return None
-    return round(100.0 * flops / wall_s / peak, 4)
+    """Model FLOPs utilization [%] against _peak_flops, or None only
+    when flops/wall are unknown (the peak itself always resolves)."""
+    return _costmodel.mfu_pct(flops, wall_s, platform)
 
 
 def _reexec_cpu(reason):
@@ -356,10 +355,13 @@ def _full_scale_stage(meta):
     t0 = obs_clock.now()
     chi2s = []
     x64s = []
+    bucket_walls = []
     for b in batches:
+        tb = obs_clock.now()
         x64, chi2, _ = b.gls_fit(maxiter=2)
         x64s.append(np.asarray(x64))
         chi2s.append(np.asarray(chi2))
+        bucket_walls.append(obs_clock.now() - tb)
     refit_s = obs_clock.now() - t0
     # pipelined executor vs the sequential per-bucket loop, warm:
     # dispatch-all + finalize-in-order overlaps each bucket's host
@@ -597,6 +599,49 @@ def _full_scale_stage(meta):
             _MIXED_THREAD_ALIVE = True
     model_fl = gls_model_flops(
         np.concatenate([np.asarray(b.n_toas) for b in batches]))
+    # per-program roofline attribution: each bucket's compiled
+    # executable reported its own FLOPs / bytes accessed at the AOT
+    # split (infos is in batches order), and the timed refit loop
+    # recorded each bucket's wall — so every shape-plan program gets
+    # an arithmetic intensity, a roofline ceiling, and an attributed
+    # MFU, rolled up into the measured_670k_* headline keys below.
+    programs = []
+    for bi, (b, wall) in enumerate(zip(batches, bucket_walls)):
+        info = infos[bi] if bi < len(infos) else {}
+        attr = _costmodel.attribute(info.get("flops"),
+                                    info.get("bytes_accessed"),
+                                    wall_s=wall, platform=platform)
+        programs.append({
+            "bucket": bi,
+            "n_psr": int(b.batch.tdb_sec.shape[0]),
+            "width": int(b.batch.tdb_sec.shape[1]),
+            "wall_s": round(wall, 4),
+            "flops": attr["flops"],
+            "bytes_accessed": attr["bytes_accessed"],
+            "intensity_flops_per_byte": attr["intensity_flops_per_byte"],
+            "roofline_ceiling_flops": attr["roofline_ceiling_flops"],
+            "roofline_pct": attr["roofline_pct"],
+            "mfu_pct": attr["mfu_pct"],
+            "bound": attr["bound"],
+        })
+    bytes_known = all(p["bytes_accessed"] is not None for p in programs)
+    total_bytes = (sum(p["bytes_accessed"] for p in programs)
+                   if programs and bytes_known else None)
+    agg = _costmodel.attribute(xla_flops if flops_known else None,
+                               total_bytes, wall_s=refit_s,
+                               platform=platform)
+    meta.update({
+        "measured_670k_programs": programs,
+        "measured_670k_program_mfu_pct": [p["mfu_pct"]
+                                          for p in programs],
+        "measured_670k_bytes_accessed": total_bytes,
+        "measured_670k_intensity_flops_per_byte":
+            agg["intensity_flops_per_byte"],
+        "measured_670k_roofline_ceiling_flops":
+            agg["roofline_ceiling_flops"],
+        "measured_670k_roofline_pct": agg["roofline_pct"],
+        "measured_670k_bound": agg["bound"],
+    })
     meta.update({
         "measured_670k_gls_refit_s": round(refit_s, 3),
         "measured_670k_total_toas": real_toas,
@@ -1080,6 +1125,51 @@ def main():
                    f"suppressed {lint_report['counts_by_rule']}")
 
     # ------------------------------------------------------------------
+    # regress stage: the perf-observatory gate over the repo's own
+    # BENCH_r0*.json trajectory (pint_tpu.obs.baseline — the same
+    # check `python -m pint_tpu.obs regress` runs in CI). Recorded as
+    # regress_* meta keys so every bench round carries its own verdict
+    # against the prior rounds; pure JSON file reads, no device work.
+    # Same optional posture: daemon thread + join timeout, skip with
+    # PINT_TPU_BENCH_SKIP_REGRESS=1.
+    regress_report = None
+
+    def _regress_stage():
+        nonlocal regress_report
+        try:
+            from pint_tpu.obs import baseline
+
+            root = os.path.dirname(os.path.abspath(__file__))
+            report = baseline.run_regress(root=root)
+            regress_report = {  # set LAST: completion marker
+                "regress_ok": report["ok"],
+                "regress_rounds": report["n_rounds"],
+                "regress_checked": len(report.get("checked", [])),
+                "regress_violations": [
+                    v["detail"] for v in
+                    (report.get("budget_violations", [])
+                     + report.get("regressions", []))] or None,
+            }
+        except Exception as e:
+            _stage(f"regress stage failed ({type(e).__name__}: {e}); "
+                   "headline JSON unaffected")
+
+    if os.environ.get("PINT_TPU_BENCH_SKIP_REGRESS") == "1":
+        _stage("regress stage skipped (PINT_TPU_BENCH_SKIP_REGRESS=1)")
+    else:
+        _stage("regress: budget + trajectory gate over BENCH_r*.json")
+        tr = threading.Thread(target=_regress_stage, daemon=True)
+        tr.start()
+        tr.join(timeout=60)
+        if tr.is_alive():
+            regress_report = None
+            _stage("regress stage timed out; headline JSON unaffected")
+        elif regress_report is not None:
+            _stage(f"regress: ok={regress_report['regress_ok']} over "
+                   f"{regress_report['regress_rounds']} rounds "
+                   f"({regress_report['regress_checked']} keys checked)")
+
+    # ------------------------------------------------------------------
     # obs stage: tracing-overhead accounting on a warm fleet refit.
     # Times the same warm fit with spans off and on: obs_overhead_pct
     # is the ENABLED-tracing tax (the disabled-path tax is bounded
@@ -1178,6 +1268,15 @@ def main():
         "gls_model_flops": headline_model_fl,
         "gls_mfu_pct": _mfu(gls_aot["flops"], gls_refit_s, platform),
         "gls_mfu_model_pct": _mfu(headline_model_fl, gls_refit_s, platform),
+        "gls_bytes_accessed": gls_aot.get("bytes_accessed"),
+        "gls_intensity_flops_per_byte": gls_aot.get(
+            "intensity_flops_per_byte"),
+        "gls_roofline_ceiling_flops": gls_aot.get(
+            "roofline_ceiling_flops"),
+        "gls_roofline_pct": _costmodel.attribute(
+            gls_aot["flops"], gls_aot.get("bytes_accessed"),
+            wall_s=gls_refit_s, platform=platform)["roofline_pct"],
+        "gls_bound": gls_aot.get("bound"),
         "gls_cold_e2e_s": round(host_prep_s + pack_s + gls_compile_s, 2),
         "gls_mixed_refit_wall_s": round(mixed_stats["min"], 4),
         "gls_mixed_refit_median_s": round(mixed_stats["median"], 4),
@@ -1196,6 +1295,8 @@ def main():
         "wls_refit_median_s": round(wls_stats["median"], 4),
         "wls_toas_per_sec": round(total_toas / wls_refit_s, 1),
         "peak_flops_assumed": _peak_flops(platform),
+        "peak_bytes_per_s_assumed": _costmodel.peak_bytes_per_s(
+            platform),
         "htest_4M_photons_s": (round(htest_done_s, 4)
                                if htest_done_s is not None else None),
         "htest_photons_per_sec": (round(n_ph / htest_done_s, 0)
@@ -1281,6 +1382,14 @@ def main():
                                 if lint_report else None),
         "pintlint_counts_by_rule": (lint_report["counts_by_rule"]
                                     if lint_report else None),
+        "regress_ok": (regress_report["regress_ok"]
+                       if regress_report else None),
+        "regress_rounds": (regress_report["regress_rounds"]
+                           if regress_report else None),
+        "regress_checked": (regress_report["regress_checked"]
+                            if regress_report else None),
+        "regress_violations": (regress_report["regress_violations"]
+                               if regress_report else None),
         "platform": platform,
     }
     meta.update(full_meta)

@@ -196,13 +196,57 @@ def _shapeplan_workload(n_psr, n_toas):
     return report
 
 
+def _roofline_workload(n_psr, n_toas, iters):
+    """One GLS program through the instrumented jit().lower()/.compile()
+    split, then a warm refit timed and attributed against the platform
+    roofline: arithmetic intensity, attainable ceiling, roofline_pct,
+    mfu_pct. Asserts the perf-observatory contract — whenever XLA
+    reports a FLOP count, attribution is non-null (the peak table's
+    nominal fallback guarantees a denominator on every platform)."""
+    import warnings
+
+    warnings.simplefilter("ignore")
+    import jax
+
+    from bench import build_batch
+    from pint_tpu.obs import costmodel
+    from pint_tpu.parallel import PTABatch
+
+    models, toas_list = build_batch(n_psr, n_toas)
+    pta = PTABatch(models, toas_list)
+    aot = pta.aot_compile(method="gls", maxiter=3)
+    walls = []
+    for _ in range(max(1, iters)):
+        t0 = obs_clock.now()
+        jax.block_until_ready(pta.gls_fit(maxiter=3)[1])
+        walls.append(obs_clock.now() - t0)
+    wall = float(np.median(walls))
+    platform = jax.default_backend()
+    report = {
+        "trace_s": round(aot["trace_s"], 4),
+        "backend_compile_s": round(aot["backend_compile_s"], 4),
+        "refit_median_s": round(wall, 6),
+        "memory": aot.get("memory"),
+        "device_memory": costmodel.device_memory_stats(),
+    }
+    report.update(costmodel.attribute(aot.get("flops"),
+                                      aot.get("bytes_accessed"),
+                                      wall_s=wall, platform=platform))
+    if report["flops"] is not None:
+        assert report["mfu_pct"] is not None, \
+            "XLA reported FLOPs but roofline attribution nulled MFU"
+        assert report["roofline_ceiling_flops"], \
+            "roofline ceiling missing despite a resolved platform spec"
+    return report
+
+
 def main(argv=None):
     import jax
 
     p = argparse.ArgumentParser()
     p.add_argument("--workload", choices=("wls", "pta", "serve",
                                           "chaos", "fleet_pipeline",
-                                          "shapeplan"),
+                                          "shapeplan", "roofline"),
                    default="wls")
     p.add_argument("--n-toas", type=int, default=5000)
     p.add_argument("--n-psr", type=int, default=8)
@@ -215,6 +259,15 @@ def main(argv=None):
                    help="injection rate for --workload chaos")
     p.add_argument("--trace", help="jax.profiler trace output dir")
     args = p.parse_args(argv)
+
+    if args.workload == "roofline":
+        t0 = obs_clock.now()
+        report = _roofline_workload(args.n_psr, args.n_toas, args.iters)
+        report.update({"workload": "roofline",
+                       "platform": jax.default_backend(),
+                       "wall_s": round(obs_clock.now() - t0, 3)})
+        print(json.dumps(report, default=float))
+        return 0
 
     if args.workload == "shapeplan":
         t0 = obs_clock.now()

@@ -15,8 +15,9 @@ from .config import LintConfig
 from .core import (Finding, Rule, RULES, all_rules, counts_by_rule,
                    register, run, unsuppressed)
 # importing the rule modules populates the registry
-from . import (rules_bench, rules_bucket, rules_faults,  # noqa: F401
-               rules_locks, rules_obs, rules_precision, rules_retrace)
+from . import (rules_bench, rules_bucket, rules_budget,  # noqa: F401
+               rules_faults, rules_locks, rules_obs, rules_precision,
+               rules_retrace)
 from .report import json_report, text_report
 
 __all__ = [

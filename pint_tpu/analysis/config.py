@@ -176,6 +176,14 @@ OBS_RAW_TIMER_CALLS = frozenset({
 # drive fake clocks on purpose.
 OBS_ALLOWED_PATH_MARKERS = ("/obs/", "/tests/", "/test_")
 
+# -- budget coverage ---------------------------------------------------
+
+# Modules (normalized "/"-prefixed path suffixes) whose measured_*/
+# serve_* dict-literal keys must be registered in the budget file
+# (pint_tpu/obs/budgets.json) so the bench regression gate sees every
+# headline number from the round it first appears.
+BUDGET_META_MODULES = ("/bench.py",)
+
 # Names that mark a value as a NaN-signalling convergence diagnostic:
 # comparing one of these with ``>`` (False under NaN) silently
 # swallows a diverged fit. ADVICE.md round 5 found three variants of
@@ -199,12 +207,25 @@ class LintConfig:
     obs_instrumented_modules: tuple = ()
     obs_raw_timer_calls: frozenset = OBS_RAW_TIMER_CALLS
     obs_allowed_path_markers: tuple = OBS_ALLOWED_PATH_MARKERS
+    budget_meta_modules: tuple = ()
+    budgeted_meta_keys: frozenset = None  # None -> rule is inert
 
     @classmethod
     def default(cls):
+        # The budget-file key set loads lazily and tolerantly: lint
+        # must keep working when the optional data file is missing
+        # (the meta-key rule goes inert rather than erroring).
+        try:
+            from ..obs import baseline
+
+            budgeted = frozenset(baseline.registered_keys())
+        except Exception:
+            budgeted = None
         return cls(f64_critical=dict(F64_CRITICAL),
                    locked_classes=dict(LOCKED_CLASSES),
                    locked_globals=dict(LOCKED_GLOBALS),
                    serve_pad_modules=SERVE_PAD_MODULES,
                    bucket_allowed_modules=BUCKET_ALLOWED_MODULES,
-                   obs_instrumented_modules=OBS_INSTRUMENTED_MODULES)
+                   obs_instrumented_modules=OBS_INSTRUMENTED_MODULES,
+                   budget_meta_modules=BUDGET_META_MODULES,
+                   budgeted_meta_keys=budgeted)

@@ -456,35 +456,55 @@ def aot_lower(fn, *args):
     return {"lowered": lowered, "trace_s": round(trace_s, 3)}
 
 
-def aot_backend_compile(lowered):
+def aot_backend_compile(lowered, label=None):
     """XLA-compile a lowered module, timing the backend compile and
     reading the executable's own cost model (best-effort). Returns
-    {"compiled", "backend_compile_s", "flops", "bytes_accessed"}.
+    {"compiled", "backend_compile_s", "flops", "bytes_accessed",
+    "memory", "intensity_flops_per_byte", "roofline_ceiling_flops",
+    "bound"}.
+
+    This is where the perf observatory captures per-executable
+    telemetry: XLA's cost analysis (FLOPs, bytes accessed) and memory
+    analysis (temp/argument/output watermark bytes) are read once at
+    compile time, attached to the ``aot.backend_compile`` span, and —
+    when a ``label`` is given — recorded in ``costmodel.LEDGER`` so
+    execute-time spans can attribute wall times back to the program.
+    All of it degrades to None fields: the timing split never depends
+    on the cost model.
 
     Safe to call from a worker thread: XLA compilation releases the
     GIL, which is what makes the fleet's concurrent multi-bucket
     compile an actual wall-clock win rather than a GIL convoy."""
     from .obs import clock as obs_clock
+    from .obs import costmodel
     from .obs import trace as obs_trace
 
-    with obs_trace.span("aot.backend_compile"):
+    with obs_trace.span("aot.backend_compile") as sp:
         t0 = obs_clock.now()
         compiled = lowered.compile()
         backend_s = obs_clock.now() - t0
-    flops = bytes_ac = None
-    try:
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):  # older jax: per-device list
-            cost = cost[0] if cost else {}
-        f = cost.get("flops")
-        b = cost.get("bytes accessed")
-        flops = float(f) if f is not None else None
-        bytes_ac = float(b) if b is not None else None
-    except Exception:
-        pass  # cost analysis is best-effort; the timing split is not
+        cost = costmodel.executable_cost(compiled)
+        attr = costmodel.attribute(cost["flops"], cost["bytes_accessed"])
+        sp.set(flops=cost["flops"],
+               bytes_accessed=cost["bytes_accessed"],
+               intensity_flops_per_byte=attr["intensity_flops_per_byte"],
+               roofline_ceiling_flops=attr["roofline_ceiling_flops"],
+               bound=attr["bound"])
+        if label is not None:
+            sp.set(program=label)
+        if cost["memory"] is not None:
+            sp.set(**{"memory_" + k: v
+                      for k, v in cost["memory"].items()})
+    if label is not None:
+        costmodel.LEDGER.record(label, cost)
     return {"compiled": compiled,
             "backend_compile_s": round(backend_s, 3),
-            "flops": flops, "bytes_accessed": bytes_ac}
+            "flops": cost["flops"],
+            "bytes_accessed": cost["bytes_accessed"],
+            "memory": cost["memory"],
+            "intensity_flops_per_byte": attr["intensity_flops_per_byte"],
+            "roofline_ceiling_flops": attr["roofline_ceiling_flops"],
+            "bound": attr["bound"]}
 
 
 def gls_gram(Mn, q, precision="f64"):

@@ -21,11 +21,22 @@ attribute check — the instrumented hot paths cost effectively nothing
 until tracing is enabled, and enabling it never touches device code
 (traced fits stay bitwise identical; tests/test_obs.py pins both).
 
+On top of the raw telemetry sits the perf observatory (ISSUE 8):
+:mod:`costmodel` captures per-executable XLA cost/memory analysis at
+the AOT compile split and attributes roofline MFU per program,
+:mod:`baseline` gates the BENCH_r0*.json trajectory against the
+machine-readable ``budgets.json``, and :mod:`slo` runs dual-window
+burn-rate alerts over serve telemetry.
+
 CLI: ``python -m pint_tpu.obs`` (traced fleet demo, flight-dump ->
-Perfetto conversion, Prometheus rendering).
+Perfetto conversion, Prometheus rendering, the ``regress`` perf gate,
+and offline ``slo`` replay).
 """
 
+from . import baseline  # noqa: F401
 from . import clock  # noqa: F401
+from . import costmodel  # noqa: F401
+from . import slo  # noqa: F401
 from .trace import (  # noqa: F401
     NOOP_SPAN,
     TRACER,
@@ -50,6 +61,15 @@ from .metricsreg import (  # noqa: F401
     summary,
 )
 from .recorder import RECORDER, FlightRecorder, configure  # noqa: F401
+from .costmodel import (  # noqa: F401
+    LEDGER,
+    ProgramLedger,
+    attribute,
+    device_spec,
+    executable_cost,
+    mfu_pct,
+)
+from .slo import BurnRateMonitor, SLOSpec, serve_slos  # noqa: F401
 from .export import (  # noqa: F401
     chrome_trace,
     flight_spans,
@@ -57,10 +77,12 @@ from .export import (  # noqa: F401
 )
 
 __all__ = [
-    "NOOP_SPAN", "RECORDER", "REGISTRY", "TRACER", "Counter",
-    "FlightRecorder", "Gauge", "Histogram", "Registry", "Span",
-    "Tracer", "chrome_trace", "clock", "configure",
-    "current_trace_id", "disable", "enable", "enabled",
-    "flight_spans", "percentile", "prometheus_text", "reset", "span",
-    "spans", "summary", "write_chrome_trace",
+    "BurnRateMonitor", "Counter", "FlightRecorder", "Gauge",
+    "Histogram", "LEDGER", "NOOP_SPAN", "ProgramLedger", "RECORDER",
+    "REGISTRY", "Registry", "SLOSpec", "Span", "TRACER", "Tracer",
+    "attribute", "baseline", "chrome_trace", "clock", "configure",
+    "costmodel", "current_trace_id", "device_spec", "disable",
+    "enable", "enabled", "executable_cost", "flight_spans", "mfu_pct",
+    "percentile", "prometheus_text", "reset", "serve_slos", "slo",
+    "span", "spans", "summary", "write_chrome_trace",
 ]

@@ -11,6 +11,14 @@ Subcommands:
   into a Chrome trace-event file.
 - ``prom``    — render a metrics snapshot JSON (or the dump's embedded
   metrics block) as Prometheus text exposition format.
+- ``regress`` — the perf-observatory gate: check the latest
+  BENCH_r0*.json round against the machine-readable budgets and the
+  robust median+MAD regression tolerances (exit 1 on any violation —
+  this is the CI hook, and bench.py runs the same check as its
+  ``regress_*`` meta stage).
+- ``slo``     — replay serve snapshot JSON files through the
+  dual-window burn-rate monitor and report per-SLO burn / alert state
+  (exit 1 when any SLO is alerting at the end of the replay).
 """
 
 from __future__ import annotations
@@ -89,6 +97,50 @@ def _cmd_prom(args):
     return 0
 
 
+def _cmd_regress(args):
+    from . import baseline
+
+    report = baseline.run_regress(root=args.root,
+                                  budgets_path=args.budgets)
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print("regress: %s over %d rounds (latest %s)"
+              % ("OK" if report["ok"] else "FAIL",
+                 report["n_rounds"], report.get("latest")))
+        for v in report.get("budget_violations", []):
+            print("  BUDGET  %s" % v["detail"], file=sys.stderr)
+        for r in report.get("regressions", []):
+            print("  REGRESS %s" % r["detail"], file=sys.stderr)
+        if report.get("error"):
+            print("  ERROR   %s" % report["error"], file=sys.stderr)
+        checked = report.get("checked", [])
+        skipped = report.get("skipped", {})
+        print("  checked: %s" % (", ".join(checked) or "(none)"))
+        if skipped:
+            print("  skipped: %s"
+                  % ", ".join("%s [%s]" % kv
+                              for kv in sorted(skipped.items())))
+    return 0 if report["ok"] else 1
+
+
+def _cmd_slo(args):
+    from . import slo
+
+    mon = slo.BurnRateMonitor(
+        specs=slo.serve_slos(latency_limit_s=args.latency_limit))
+    for i, path in enumerate(args.snapshots):
+        with open(path) as fh:
+            doc = json.load(fh)
+        if isinstance(doc, dict) and "snapshot" in doc:
+            doc = doc["snapshot"]
+        t = doc.get("walltime") if isinstance(doc, dict) else None
+        mon.ingest(doc, t=t if t is not None else float(i * args.step))
+    out = {"slos": mon.snapshot(), "alerting": mon.alerting()}
+    print(json.dumps(out, indent=1))
+    return 1 if out["alerting"] else 0
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         prog="python -m pint_tpu.obs",
@@ -124,6 +176,29 @@ def main(argv=None):
                                     "text format")
     m.add_argument("snapshot")
     m.set_defaults(fn=_cmd_prom)
+
+    r = sub.add_parser("regress", help="bench-trajectory budget + "
+                                       "regression gate (CI exit code)")
+    r.add_argument("--root", default=None,
+                   help="directory holding BENCH_r*.json "
+                        "(default: cwd, else the repo root)")
+    r.add_argument("--budgets", default=None,
+                   help="budget spec path (default: the packaged "
+                        "pint_tpu/obs/budgets.json)")
+    r.add_argument("--json", action="store_true",
+                   help="emit the full machine-readable report")
+    r.set_defaults(fn=_cmd_regress)
+
+    s = sub.add_parser("slo", help="replay serve snapshots through "
+                                   "the burn-rate monitor")
+    s.add_argument("snapshots", nargs="+",
+                   help="serve snapshot JSON files, in time order")
+    s.add_argument("--latency-limit", type=float, default=0.25,
+                   help="p99 latency SLO limit in seconds")
+    s.add_argument("--step", type=float, default=60.0,
+                   help="assumed seconds between snapshots lacking a "
+                        "walltime field")
+    s.set_defaults(fn=_cmd_slo)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -1,0 +1,267 @@
+"""Bench-trajectory store + budget regression gate.
+
+The repo carries its own perf history — ``BENCH_r0*.json`` /
+``MULTICHIP_r0*.json``, one file per driver round — and ERRORBUDGET.md
+carries the bounds those numbers must honor. Until now both were
+compared by humans. This module makes the comparison executable:
+
+- :func:`load_history` ingests the round files into one trajectory
+  (the headline metric plus every scalar ``detail`` key, flattened).
+- ``budgets.json`` (next to this module) is the machine-readable
+  derivation of ERRORBUDGET.md's instrumentation / padded-FLOP rows:
+  absolute ``budgets`` (bind whenever the key is present in the
+  latest round), curated ``regressions`` keys (gated against history
+  with robust median+MAD tolerances), and a ``tracked`` allowlist
+  (emitted, deliberately not gated — compile walls depend on XLA
+  cache state, so gating them would alias cache temperature into
+  perf verdicts). pintlint's ``meta-key-unbudgeted`` rule closes the
+  loop: a new ``measured_*``/``serve_*`` bench key must appear in one
+  of the three sections before it can ship.
+- :func:`run_regress` is the gate: ``python -m pint_tpu.obs regress``
+  exits nonzero on any budget violation or regression, and bench.py
+  runs the same check as its ``regress_*`` meta stage.
+
+Regression detection: for each curated key with at least
+``min_prior`` recorded rounds, the latest value must stay within
+``max(rel_floor, k_mad * 1.4826 * MAD / |median|)`` of the prior
+median, direction-aware (a *faster* wall or *higher* throughput is
+never flagged). MAD (vs stddev) keeps one historic outlier round from
+inflating the tolerance; the relative floor keeps a suspiciously
+quiet history from flagging benign jitter.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+
+
+BUDGETS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "budgets.json")
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+
+def load_budgets(path=None):
+    """The machine-readable budget spec (see module docstring)."""
+    with open(path or BUDGETS_PATH) as fh:
+        return json.load(fh)
+
+
+def registered_keys(spec=None):
+    """Every meta key the budget file knows about — budgets,
+    regression-gated, and tracked. The pintlint meta-key-unbudgeted
+    rule checks bench.py's literal keys against this set."""
+    if spec is None:
+        spec = load_budgets()
+    keys = set(spec.get("budgets", {}))
+    keys.update(spec.get("regressions", {}))
+    keys.update(spec.get("tracked", []))
+    return keys
+
+
+def _flatten(mapping, prefix=""):
+    """Scalar numeric leaves of a nested dict, dotted keys. Bools and
+    non-numerics are not trajectory points; lists are skipped (the
+    per-program rollups are inspected by humans, not gated)."""
+    out = {}
+    for key, val in mapping.items():
+        name = "%s%s" % (prefix, key)
+        if isinstance(val, dict):
+            out.update(_flatten(val, prefix=name + "."))
+        elif isinstance(val, bool):
+            continue
+        elif isinstance(val, (int, float)):
+            out[name] = float(val)
+    return out
+
+
+def load_history(root):
+    """The round-by-round trajectory: a sorted list of
+    {"round", "path", "values"} where values maps metric key ->
+    float. The headline parsed metric lands under its own name
+    (``pta_gls_refit_toas_per_sec``); MULTICHIP round files
+    contribute ``multichip_rc`` / ``multichip_ok`` /
+    ``multichip_n_devices``."""
+    rounds = {}
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        m = _ROUND_RE.search(path)
+        if not m:
+            continue
+        rnd = int(m.group(1))
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        parsed = doc.get("parsed") or {}
+        values = _flatten(parsed.get("detail") or {})
+        metric = parsed.get("metric")
+        if metric and isinstance(parsed.get("value"), (int, float)):
+            values[str(metric)] = float(parsed["value"])
+        rounds.setdefault(rnd, {"round": "r%02d" % rnd, "values": {}})
+        rounds[rnd]["values"].update(values)
+        rounds[rnd]["path"] = path
+    for path in sorted(glob.glob(os.path.join(root,
+                                              "MULTICHIP_r*.json"))):
+        m = _ROUND_RE.search(path)
+        if not m:
+            continue
+        rnd = int(m.group(1))
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        entry = rounds.setdefault(rnd, {"round": "r%02d" % rnd,
+                                        "values": {}})
+        entry["values"]["multichip_ok"] = float(bool(doc.get("ok")))
+        if isinstance(doc.get("rc"), (int, float)):
+            entry["values"]["multichip_rc"] = float(doc["rc"])
+        if isinstance(doc.get("n_devices"), (int, float)):
+            entry["values"]["multichip_n_devices"] = float(
+                doc["n_devices"])
+    return [rounds[k] for k in sorted(rounds)]
+
+
+def _median(vals):
+    v = sorted(vals)
+    n = len(v)
+    if n == 0:
+        return None
+    mid = n // 2
+    return v[mid] if n % 2 else 0.5 * (v[mid - 1] + v[mid])
+
+
+def robust_tolerance(prior, rel_floor, k_mad):
+    """Relative tolerance from the prior rounds: the MAD-derived
+    robust sigma scaled by k_mad, floored at rel_floor."""
+    med = _median(prior)
+    if not med:
+        return rel_floor, med
+    mad = _median([abs(x - med) for x in prior])
+    sigma = 1.4826 * mad
+    return max(rel_floor, k_mad * sigma / abs(med)), med
+
+
+def check_budgets(latest_values, spec):
+    """Absolute-budget violations in the latest round. A budget binds
+    only when its key is present (the serve/plan stages are optional:
+    an absent key is a skipped stage, not a violation)."""
+    violations = []
+    for key, bound in spec.get("budgets", {}).items():
+        val = latest_values.get(key)
+        if val is None:
+            continue
+        if "max" in bound and val > float(bound["max"]):
+            violations.append({
+                "key": key, "value": val, "budget_max": bound["max"],
+                "source": bound.get("source"),
+                "detail": "%s = %g exceeds budget max %g"
+                          % (key, val, bound["max"])})
+        if "min" in bound and val < float(bound["min"]):
+            violations.append({
+                "key": key, "value": val, "budget_min": bound["min"],
+                "source": bound.get("source"),
+                "detail": "%s = %g below budget min %g"
+                          % (key, val, bound["min"])})
+    return violations
+
+
+def check_regressions(history, spec):
+    """(regressions, checked_keys, skipped) over the curated
+    regression keys. Direction-aware: "lower" keys flag only an
+    increase, "higher" keys only a decrease."""
+    defaults = spec.get("defaults", {})
+    rel_floor = float(defaults.get("rel_floor", 0.10))
+    k_mad = float(defaults.get("k_mad", 4.0))
+    min_prior = int(defaults.get("min_prior", 3))
+    regressions, checked, skipped = [], [], {}
+    if not history:
+        return regressions, checked, skipped
+    latest = history[-1]["values"]
+    prior_rounds = history[:-1]
+    for key, conf in spec.get("regressions", {}).items():
+        direction = conf.get("direction", "lower")
+        floor = float(conf.get("rel_floor", rel_floor))
+        need = int(conf.get("min_prior", min_prior))
+        latest_val = latest.get(key)
+        if latest_val is None:
+            skipped[key] = "missing_in_latest"
+            continue
+        prior = [r["values"][key] for r in prior_rounds
+                 if r["values"].get(key) is not None]
+        if len(prior) < need:
+            skipped[key] = "insufficient_history (%d < %d)" % (
+                len(prior), need)
+            continue
+        tol, med = robust_tolerance(prior, floor, k_mad)
+        checked.append(key)
+        if med is None or med == 0:
+            continue
+        ratio = latest_val / med
+        if direction == "lower" and ratio > 1.0 + tol:
+            regressions.append({
+                "key": key, "latest": latest_val, "median": med,
+                "ratio": round(ratio, 4), "tolerance": round(tol, 4),
+                "direction": direction,
+                "detail": "%s regressed: %g vs median %g (x%.3f, "
+                          "tol %.1f%%)" % (key, latest_val, med,
+                                           ratio, 100 * tol)})
+        elif direction == "higher" and ratio < 1.0 - tol:
+            regressions.append({
+                "key": key, "latest": latest_val, "median": med,
+                "ratio": round(ratio, 4), "tolerance": round(tol, 4),
+                "direction": direction,
+                "detail": "%s regressed: %g vs median %g (x%.3f, "
+                          "tol %.1f%%)" % (key, latest_val, med,
+                                           ratio, 100 * tol)})
+    return regressions, checked, skipped
+
+
+def find_root(root=None):
+    """Directory holding the BENCH_r*.json trajectory: the explicit
+    argument, else the cwd when it has round files, else the repo
+    root this package is installed from."""
+    if root:
+        return root
+    cwd = os.getcwd()
+    if glob.glob(os.path.join(cwd, "BENCH_r*.json")):
+        return cwd
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_regress(root=None, budgets_path=None, history=None, spec=None):
+    """The full gate: load history + budgets, check both, return the
+    report. ``ok`` is False on any budget violation or regression —
+    the CLI and bench stage key their exit status off it."""
+    if spec is None:
+        spec = load_budgets(budgets_path)
+    root = find_root(root)
+    if history is None:
+        history = load_history(root)
+    report = {
+        "root": root,
+        "rounds": [h["round"] for h in history],
+        "n_rounds": len(history),
+        "latest": history[-1]["round"] if history else None,
+    }
+    if not history:
+        report.update(ok=False, error="no BENCH_r*.json history found",
+                      regressions=[], budget_violations=[],
+                      checked=[], skipped={})
+        return report
+    latest_values = history[-1]["values"]
+    violations = check_budgets(latest_values, spec)
+    regressions, checked, skipped = check_regressions(history, spec)
+    report.update(
+        ok=not violations and not regressions,
+        budget_violations=violations,
+        regressions=regressions,
+        checked=checked,
+        skipped=skipped,
+    )
+    return report

@@ -1,0 +1,264 @@
+"""Executable cost model: XLA cost/memory capture + roofline MFU.
+
+This is the *judging* half of the telemetry the AOT compile split
+already produces: :func:`executable_cost` reads a compiled
+executable's own cost analysis (FLOPs, bytes accessed) and memory
+analysis (temp/argument/output bytes — the device watermark the
+program will demand), and :func:`attribute` turns (flops, bytes,
+wall) into the roofline verdict — arithmetic intensity, the ceiling
+``min(peak_flops, intensity * peak_bandwidth)``, compute- vs
+memory-bound, MFU against peak and against the attributed ceiling.
+
+The per-platform peak table lives HERE (bench.py delegates to it)
+so every consumer — bench headline keys, fleet execute spans, the
+profile harness roofline workload — shares one denominator. The
+table never returns None for a known-or-unknown platform: an
+unrecorded platform gets the nominal fallback spec (flagged
+``nominal=True``) rather than silently nulling every MFU figure,
+which is exactly the BENCH_r05 failure mode this module retires.
+
+Env overrides (floats, applied to every platform):
+
+- ``PINT_TPU_PEAK_FLOPS``       — peak FLOP/s denominator
+- ``PINT_TPU_PEAK_BYTES_PER_S`` — peak memory bandwidth (bytes/s)
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+
+def _cpu_peak_flops():
+    """Nominal vector-f64 CPU peak: cores x 2.5 GHz x 16 f64
+    FLOP/cycle (one AVX-512 FMA per cycle, or two AVX2 FMAs — the
+    same number either way). An order-of-magnitude denominator so CPU
+    rounds report a real MFU instead of null."""
+    return (os.cpu_count() or 1) * 2.5e9 * 16
+
+
+# Per-platform peak FLOP/s and memory bandwidth. TPU v5e: 197 TFLOP/s
+# bf16 MXU peak (the honest headline denominator for the emulated-f64
+# GLS pipeline — see bench.py's MFU note) and 819 GB/s HBM. CPU: the
+# nominal vector peak above and a nominal ~50 GB/s DDR stream
+# bandwidth per socket. GPU entry is a placeholder A100-class figure
+# so a CUDA backend still attributes rather than nulling.
+DEVICE_SPECS = {
+    "tpu": {"peak_flops": 1.97e14, "peak_bytes_per_s": 8.19e11},
+    "cpu": {"peak_flops": _cpu_peak_flops(),
+            "peak_bytes_per_s": 5.0e10},
+    "gpu": {"peak_flops": 1.95e13, "peak_bytes_per_s": 1.55e12},
+}
+
+# Fallback for platforms not in the table: MFU must degrade to a
+# clearly-nominal number, never to None (null MFU is unactionable).
+NOMINAL_SPEC = {"peak_flops": 1.0e12, "peak_bytes_per_s": 1.0e11,
+                "nominal": True}
+
+
+def _env_float(name):
+    val = os.environ.get(name)
+    if val:
+        try:
+            return float(val)
+        except ValueError:
+            pass  # fall through to the table rather than die mid-run
+    return None
+
+
+def device_spec(platform=None):
+    """The peak-rate spec dict for ``platform`` (default: the live
+    jax backend), env overrides applied. Always returns both rates."""
+    if platform is None:
+        platform = default_platform()
+    spec = dict(DEVICE_SPECS.get(platform, NOMINAL_SPEC))
+    env_fl = _env_float("PINT_TPU_PEAK_FLOPS")
+    if env_fl is not None:
+        spec["peak_flops"] = env_fl
+    env_bw = _env_float("PINT_TPU_PEAK_BYTES_PER_S")
+    if env_bw is not None:
+        spec["peak_bytes_per_s"] = env_bw
+    spec["platform"] = platform
+    return spec
+
+
+def default_platform():
+    """Platform string of the default jax backend ("cpu" when jax is
+    unavailable — the spec table degrades gracefully either way)."""
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:
+        return "cpu"
+
+
+def peak_flops(platform=None):
+    return device_spec(platform)["peak_flops"]
+
+
+def peak_bytes_per_s(platform=None):
+    return device_spec(platform)["peak_bytes_per_s"]
+
+
+def mfu_pct(flops, wall_s, platform=None):
+    """Model FLOPs utilization [%] against the platform peak. None
+    only when flops/wall are unknown — the peak itself always
+    resolves (table, env override, or nominal fallback)."""
+    if not flops or not wall_s:
+        return None
+    return round(100.0 * flops / wall_s / peak_flops(platform), 4)
+
+
+def arithmetic_intensity(flops, bytes_accessed):
+    """FLOPs per byte moved, or None when either input is unknown."""
+    if not flops or not bytes_accessed:
+        return None
+    return flops / bytes_accessed
+
+
+def roofline_ceiling_flops(intensity, platform=None):
+    """Attainable FLOP/s under the naive roofline: the compute peak,
+    capped by bandwidth x intensity when the program is memory-bound."""
+    spec = device_spec(platform)
+    if not intensity:
+        return spec["peak_flops"]
+    return min(spec["peak_flops"],
+               intensity * spec["peak_bytes_per_s"])
+
+
+def attribute(flops, bytes_accessed, wall_s=None, platform=None):
+    """Full roofline attribution of one executed program.
+
+    Returns a JSON-safe dict: flops / bytes_accessed echoed back,
+    ``intensity_flops_per_byte``, the per-platform peaks, the
+    attributed ``roofline_ceiling_flops``, ``bound`` ("compute" |
+    "memory" | None when intensity is unknown), and — when a wall
+    time is given — ``achieved_flops_per_s``, ``mfu_pct`` (vs peak)
+    and ``roofline_pct`` (vs the attributed ceiling, i.e. how much of
+    the *attainable* rate the program reached)."""
+    spec = device_spec(platform)
+    intensity = arithmetic_intensity(flops, bytes_accessed)
+    ceiling = roofline_ceiling_flops(intensity, platform)
+    bound = None
+    if intensity is not None:
+        knee = spec["peak_flops"] / spec["peak_bytes_per_s"]
+        bound = "compute" if intensity >= knee else "memory"
+    out = {
+        "flops": flops,
+        "bytes_accessed": bytes_accessed,
+        "intensity_flops_per_byte": (round(intensity, 4)
+                                     if intensity is not None else None),
+        "peak_flops": spec["peak_flops"],
+        "peak_bytes_per_s": spec["peak_bytes_per_s"],
+        "roofline_ceiling_flops": ceiling,
+        "bound": bound,
+        "platform": spec["platform"],
+    }
+    if wall_s and flops:
+        achieved = flops / wall_s
+        out["achieved_flops_per_s"] = achieved
+        out["mfu_pct"] = round(100.0 * achieved / spec["peak_flops"], 4)
+        out["roofline_pct"] = (round(100.0 * achieved / ceiling, 4)
+                               if ceiling else None)
+    else:
+        out["achieved_flops_per_s"] = None
+        out["mfu_pct"] = None
+        out["roofline_pct"] = None
+    return out
+
+
+def executable_cost(compiled):
+    """Best-effort cost + memory analysis of a compiled executable:
+    {"flops", "bytes_accessed", "memory": {...} | None}. The memory
+    block carries XLA's per-executable watermark fields
+    (temp/argument/output/generated-code bytes) where the backend
+    reports them; every field degrades to None independently — the
+    compile-timing split must never depend on the cost model."""
+    flops = bytes_ac = None
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):  # older jax: per-device list
+            cost = cost[0] if cost else {}
+        f = cost.get("flops")
+        b = cost.get("bytes accessed")
+        flops = float(f) if f is not None else None
+        bytes_ac = float(b) if b is not None else None
+    except Exception:
+        pass
+    memory = None
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            fields = {}
+            for attr in ("temp_size_in_bytes", "argument_size_in_bytes",
+                         "output_size_in_bytes", "alias_size_in_bytes",
+                         "generated_code_size_in_bytes"):
+                val = getattr(ma, attr, None)
+                if val is not None:
+                    fields[attr] = int(val)
+            memory = fields or None
+    except Exception:
+        pass
+    return {"flops": flops, "bytes_accessed": bytes_ac,
+            "memory": memory}
+
+
+def device_memory_stats(device=None):
+    """Live device-memory watermark {bytes_in_use, peak_bytes_in_use,
+    bytes_limit} where the backend exposes memory_stats() (TPU/GPU;
+    None on CPU). Best-effort: telemetry, not control flow."""
+    try:
+        if device is None:
+            import jax
+
+            device = jax.devices()[0]
+        stats = device.memory_stats()
+        if not stats:
+            return None
+        return {k: stats[k] for k in ("bytes_in_use",
+                                      "peak_bytes_in_use",
+                                      "bytes_limit") if k in stats}
+    except Exception:
+        return None
+
+
+class ProgramLedger:
+    """Thread-safe label -> cost record map: every AOT backend
+    compile registers its executable's cost here, so execute-time
+    consumers (fleet execute spans, the bench rollup, the CLI) can
+    attribute a wall time to the program that produced it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._programs = {}
+
+    def record(self, label, cost):
+        with self._lock:
+            self._programs[label] = dict(cost)
+        return self
+
+    def get(self, label):
+        with self._lock:
+            rec = self._programs.get(label)
+        return dict(rec) if rec is not None else None
+
+    def attribute(self, label, wall_s=None, platform=None):
+        """Roofline attribution of a recorded program (None when the
+        label was never compiled through the AOT split)."""
+        rec = self.get(label)
+        if rec is None:
+            return None
+        return attribute(rec.get("flops"), rec.get("bytes_accessed"),
+                         wall_s=wall_s, platform=platform)
+
+    def snapshot(self):
+        with self._lock:
+            return {k: dict(v) for k, v in self._programs.items()}
+
+    def reset(self):
+        with self._lock:
+            self._programs.clear()
+
+
+LEDGER = ProgramLedger()

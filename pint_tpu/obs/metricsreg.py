@@ -71,20 +71,56 @@ class Gauge:
 class Histogram:
     """Bounded raw-sample histogram with nearest-rank quantiles. Raw
     samples (not pre-bucketed counts) because serving sample counts
-    are small and the nearest-rank contract needs the actual values."""
+    are small and the nearest-rank contract needs the actual values.
 
-    __slots__ = ("_lock", "_values")
+    Overflow semantics: below ``capacity`` every sample is kept and
+    quantiles are exact (byte-compatible with the unbounded case).
+    Past capacity the buffer becomes a uniform reservoir (Algorithm
+    R, deterministic seed): each of the ``n`` samples observed so far
+    has equal probability capacity/n of being in the buffer, so
+    quantiles stay an unbiased estimate of the whole stream instead
+    of silently narrowing to the most recent window. ``observed``
+    and ``sum`` always cover the full stream — Prometheus ``_count``
+    / ``_sum`` stay exact either way."""
 
-    def __init__(self, capacity=4096):
-        import collections
+    __slots__ = ("_lock", "_capacity", "_values", "_observed",
+                 "_sum", "_rng")
+
+    def __init__(self, capacity=4096, seed=0):
+        import random
 
         self._lock = threading.Lock()
-        self._values = collections.deque(maxlen=capacity)
+        self._capacity = int(capacity)
+        self._values = []
+        self._observed = 0
+        self._sum = 0.0
+        self._rng = random.Random(seed)
 
     def record(self, value):
+        val = float(value)
         with self._lock:
-            self._values.append(float(value))
+            self._observed += 1
+            self._sum += val
+            if len(self._values) < self._capacity:
+                self._values.append(val)
+            else:
+                j = self._rng.randrange(self._observed)
+                if j < self._capacity:
+                    self._values[j] = val
         return self
+
+    @property
+    def observed(self):
+        """Total samples ever recorded (>= len(values()) once the
+        reservoir saturates)."""
+        with self._lock:
+            return self._observed
+
+    @property
+    def sum(self):
+        """Exact running sum over the full stream."""
+        with self._lock:
+            return self._sum
 
     def values(self):
         with self._lock:
@@ -94,7 +130,11 @@ class Histogram:
         return percentile(self.values(), q)
 
     def summary(self, quantiles=(50, 90, 99)):
-        return summary(self.values(), quantiles)
+        out = summary(self.values(), quantiles)
+        with self._lock:
+            out["observed"] = self._observed
+            out["sum"] = self._sum
+        return out
 
 
 class Registry:
@@ -188,29 +228,42 @@ def prom_name(name, prefix="pint_tpu_"):
 
 def prometheus_text(registry=None, prefix="pint_tpu_"):
     """Render a registry snapshot in the Prometheus text exposition
-    format (one `# TYPE` header per metric; histograms exported as
-    summaries with nearest-rank quantile labels)."""
+    format: one `# TYPE` header per sanitized metric name (deduped —
+    two registry names that sanitize to the same exposition name get
+    one header), histograms exported as summaries with nearest-rank
+    quantile labels, `_count`/`_sum` covering the full observed
+    stream when the snapshot carries reservoir totals."""
     reg = REGISTRY if registry is None else registry
     snap = reg.snapshot() if isinstance(reg, Registry) else reg
     lines = []
+    typed = set()
+
+    def _type(pn, kind):
+        if pn not in typed:
+            typed.add(pn)
+            lines.append("# TYPE %s %s" % (pn, kind))
+
     for name, val in snap.get("counters", {}).items():
         pn = prom_name(name, prefix)
-        lines.append("# TYPE %s counter" % pn)
+        _type(pn, "counter")
         lines.append("%s %s" % (pn, _prom_value(val)))
     for name, val in snap.get("gauges", {}).items():
         pn = prom_name(name, prefix)
-        lines.append("# TYPE %s gauge" % pn)
+        _type(pn, "gauge")
         lines.append("%s %s" % (pn, _prom_value(val)))
     for name, summ in snap.get("histograms", {}).items():
         pn = prom_name(name, prefix)
-        lines.append("# TYPE %s summary" % pn)
+        _type(pn, "summary")
         for q in (50, 90, 99):
             lines.append('%s{quantile="0.%02d"} %s'
                          % (pn, q, _prom_value(summ.get("p%d" % q))))
-        lines.append("%s_count %s" % (pn, _prom_value(summ["count"])))
-        mean = summ.get("mean")
-        total = (mean * summ["count"]
-                 if mean is not None and summ["count"] else 0)
+        count = summ.get("observed", summ["count"])
+        lines.append("%s_count %s" % (pn, _prom_value(count)))
+        total = summ.get("sum")
+        if total is None:
+            mean = summ.get("mean")
+            total = (mean * summ["count"]
+                     if mean is not None and summ["count"] else 0)
         lines.append("%s_sum %s" % (pn, _prom_value(total)))
     return "\n".join(lines) + "\n"
 
@@ -222,4 +275,11 @@ def _prom_value(v):
         return "1" if v else "0"
     if isinstance(v, int):
         return str(v)
-    return repr(float(v))
+    v = float(v)
+    if v != v:
+        return "NaN"
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    return repr(v)

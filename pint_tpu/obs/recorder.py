@@ -10,7 +10,10 @@ whenever tracing is enabled. When a catastrophic event fires —
 checkpoint restart — the owning site calls :meth:`FlightRecorder.dump`
 and the ring is written to ``<dump_dir>/flight_<seq>_<reason>.json``
 (no-op when no dump dir is configured, so tests and production opt in
-via :func:`configure` or the ``PINT_TPU_FLIGHT_DIR`` env var).
+via :func:`configure` or the ``PINT_TPU_FLIGHT_DIR`` env var). The
+dump directory is rotated: at most ``max_dumps`` files are kept
+(oldest deleted first; default 32, ``PINT_TPU_FLIGHT_MAX`` env
+override, <= 0 disables rotation).
 """
 
 from __future__ import annotations
@@ -24,13 +27,20 @@ from . import clock as obs_clock
 
 
 class FlightRecorder:
-    def __init__(self, capacity=512, dump_dir=None):
+    def __init__(self, capacity=512, dump_dir=None, max_dumps=None):
         import collections
 
         self._lock = threading.Lock()
         self._events = collections.deque(maxlen=capacity)
         self._dump_seq = itertools.count(1)
         self.dump_dir = dump_dir
+        if max_dumps is None:
+            try:
+                max_dumps = int(os.environ.get("PINT_TPU_FLIGHT_MAX",
+                                               32))
+            except ValueError:
+                max_dumps = 32
+        self.max_dumps = max_dumps
         self.dumps = []           # paths written this process
 
     # -- event intake --------------------------------------------------
@@ -89,7 +99,33 @@ class FlightRecorder:
             json.dump(doc, fh, indent=1, default=str)
         with self._lock:
             self.dumps.append(path)
+        self._rotate(ddir)
         return path
+
+    def _rotate(self, ddir):
+        """Cap on-disk dump count at ``max_dumps`` (oldest deleted;
+        the zero-padded sequence makes lexical order dump order;
+        max_dumps <= 0 disables rotation). A crashing fleet can dump
+        on every retry-ladder rung — without a cap that fills the
+        artifact volume before the post-mortem starts."""
+        limit = self.max_dumps
+        if not limit or limit <= 0:
+            return
+        try:
+            existing = sorted(
+                f for f in os.listdir(ddir)
+                if f.startswith("flight_") and f.endswith(".json"))
+        except OSError:
+            return
+        for stale in existing[:-limit] if len(existing) > limit else []:
+            path = os.path.join(ddir, stale)
+            try:
+                os.remove(path)
+            except OSError:
+                continue
+            with self._lock:
+                if path in self.dumps:
+                    self.dumps.remove(path)
 
     def reset(self):
         with self._lock:
@@ -115,9 +151,10 @@ def _jsonable(obj):
 RECORDER = FlightRecorder(dump_dir=os.environ.get("PINT_TPU_FLIGHT_DIR"))
 
 
-def configure(dump_dir=None, capacity=None):
+def configure(dump_dir=None, capacity=None, max_dumps=None):
     """Point the process flight recorder at a dump directory (and
-    optionally resize its ring). Returns the recorder."""
+    optionally resize its ring / cap its on-disk dump count).
+    Returns the recorder."""
     import collections
 
     rec = RECORDER
@@ -127,6 +164,8 @@ def configure(dump_dir=None, capacity=None):
         with rec._lock:
             rec._events = collections.deque(rec._events,
                                             maxlen=capacity)
+    if max_dumps is not None:
+        rec.max_dumps = max_dumps
     return rec
 
 

@@ -388,6 +388,13 @@ class FleetMesh:
         n_before = len(self.reassignments)
         if not lane.alive():
             self._steal_from(lane, completed)
+            # lane census into the metrics registry: the SLO burn-rate
+            # monitor and Prometheus scrapes watch lane losses by name
+            from ..obs import metricsreg
+
+            metricsreg.REGISTRY.counter("mesh.lanes_lost").inc()
+            metricsreg.REGISTRY.gauge("mesh.alive_lanes").set(
+                sum(1 for ln in self.lanes if ln.alive()))
             # post-mortem artifact: which lane died, which fault point
             # killed it, and where its pending buckets went
             _flight.dump(

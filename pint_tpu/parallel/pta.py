@@ -544,6 +544,7 @@ class PTABatch:
             self.prep = shard_batch(self.prep, mesh, n_toa=n_max)
             self.batch = shard_batch(self.batch, mesh, n_toa=n_max)
         self._fns = {}
+        self._costs = {}  # program key -> executable cost record
         self._ecorr_marg_ok = None  # lazy host check, cached (gls_fit)
 
     # -- single-pulsar kernel (closed over static config only) --
@@ -657,6 +658,7 @@ class PTABatch:
             self.prep = shard_batch(self.prep, mesh, n_toa=n_max)
             self.batch = shard_batch(self.batch, mesh, n_toa=n_max)
         self._fns = {}
+        self._costs = {}
         self._ecorr_marg_ok = None
         return self
 
@@ -1639,11 +1641,18 @@ class PTABatch:
         """XLA backend compile of an :meth:`aot_lower` handle; thread-
         safe (pure XLA, releases the GIL) so a fleet can run many
         buckets' compiles concurrently. Installs the executable in the
-        fit cache and returns the aot_compile info dict."""
+        fit cache, records the executable's cost model in ``_costs``
+        (keyed like ``_fns``) for execute-time roofline attribution,
+        and returns the aot_compile info dict."""
         from .. import fitter
 
-        info = fitter.aot_backend_compile(low["lowered"])
+        info = fitter.aot_backend_compile(low["lowered"],
+                                          label=str(low["key"]))
         self._fns[low["key"]] = info.pop("compiled")
+        self._costs[low["key"]] = {
+            "flops": info.get("flops"),
+            "bytes_accessed": info.get("bytes_accessed"),
+            "memory": info.get("memory")}
         return {"method": low["method"], "trace_s": low["trace_s"],
                 **info}
 
@@ -1783,8 +1792,16 @@ def fleet_aot_compile(jobs, max_workers=None):
         # are thread-local, so the parent link cannot be implicit)
         batch, low = pair
         with obs_trace.span("fleet.compile", trace_id=tid, phase="xla",
-                            bucket=low["key"][0]):
-            return batch._aot_backend_compile(low)
+                            bucket=low["key"][0]) as sp:
+            info = batch._aot_backend_compile(low)
+            sp.set(flops=info.get("flops"),
+                   bytes_accessed=info.get("bytes_accessed"),
+                   intensity_flops_per_byte=info.get(
+                       "intensity_flops_per_byte"),
+                   roofline_ceiling_flops=info.get(
+                       "roofline_ceiling_flops"),
+                   bound=info.get("bound"))
+            return info
 
     workers = max_workers or min(len(lowered), os.cpu_count() or 1)
     with ThreadPoolExecutor(max_workers=workers) as pool:
@@ -2181,6 +2198,44 @@ class PTAFleet:
             return self._fit_pipelined(method, maxiter, max_workers,
                                        **kw)
 
+    @staticmethod
+    def _annotate_execute(sp, batch, use_gls, maxiter, bkw, wall_s,
+                          pkey=None):
+        """Best-effort roofline attribution of one bucket's execute
+        span: look up the program's compile-time cost record in
+        ``batch._costs`` and attach mfu_pct / roofline ceiling /
+        bound. Called only when tracing is enabled; never raises —
+        attribution is telemetry, the fit result is not."""
+        try:
+            from ..obs import costmodel
+
+            if pkey is None:
+                if use_gls:
+                    pkey = batch.program_key(
+                        "gls", maxiter, bkw.get("threshold", 1e-12),
+                        bkw.get("ecorr_mode", "auto"),
+                        bkw.get("precision", "f64"))
+                else:
+                    pkey = batch.program_key(
+                        "wls", maxiter, bkw.get("threshold", 1e-12))
+            cost = getattr(batch, "_costs", {}).get(pkey)
+            if not cost:
+                return
+            attr = costmodel.attribute(cost.get("flops"),
+                                       cost.get("bytes_accessed"),
+                                       wall_s=wall_s)
+            sp.set(wall_s=round(wall_s, 6),
+                   program=str(pkey),
+                   flops=attr["flops"],
+                   intensity_flops_per_byte=attr[
+                       "intensity_flops_per_byte"],
+                   roofline_ceiling_flops=attr["roofline_ceiling_flops"],
+                   roofline_pct=attr["roofline_pct"],
+                   mfu_pct=attr["mfu_pct"],
+                   bound=attr["bound"])
+        except Exception:
+            pass
+
     def _fit_sequential(self, method, maxiter, **kw):
         xs = [None] * self.n
         chi2s = np.zeros(self.n)
@@ -2189,11 +2244,16 @@ class PTAFleet:
         self.fit_metrics = {}
         for key, idxs in self.group_indices.items():
             batch = self._resolve(key)
-            fit = (batch.gls_fit if self._use_gls(batch, method)
-                   else batch.wls_fit)
+            use_gls = self._use_gls(batch, method)
+            fit = batch.gls_fit if use_gls else batch.wls_fit
             with obs_trace.span("fleet.execute", bucket=key,
-                                n=len(idxs)):
+                                n=len(idxs)) as sp:
+                traced = obs_trace.enabled()
+                t0 = obs_clock.now() if traced else None
                 x, chi2, cov = fit(maxiter=maxiter, **kw)
+                if traced:
+                    self._annotate_execute(sp, batch, use_gls, maxiter,
+                                           kw, obs_clock.now() - t0)
             self._scatter(xs, chi2s, covs, idxs, x, chi2, cov)
             self.diverged.extend(idxs[j] for j in batch.diverged)
             self.fit_metrics[key] = batch.metrics
@@ -2267,8 +2327,14 @@ class PTAFleet:
             def _compile_one(key, batch, low):
                 # pool thread: join the fit's trace explicitly
                 with obs_trace.span("fleet.compile", trace_id=tid,
-                                    phase="xla", bucket=key):
-                    return batch._aot_backend_compile(low)
+                                    phase="xla", bucket=key) as csp:
+                    info = batch._aot_backend_compile(low)
+                    csp.set(flops=info.get("flops"),
+                            bytes_accessed=info.get("bytes_accessed"),
+                            roofline_ceiling_flops=info.get(
+                                "roofline_ceiling_flops"),
+                            bound=info.get("bound"))
+                    return info
 
             pool = ThreadPoolExecutor(
                 max_workers=max_workers
@@ -2307,7 +2373,7 @@ class PTAFleet:
                     else:
                         h = batch._dispatch_wls(
                             maxiter, bkw.get("threshold", 1e-12))
-                handles.append((key, idxs, batch, use_gls, h))
+                handles.append((key, idxs, batch, use_gls, h, pkey))
             # 4) finalize in the SAME bucket order as the sequential
             # path — the host unpack of bucket i overlaps device
             # execution of buckets i+1.. still queued, and the
@@ -2315,12 +2381,21 @@ class PTAFleet:
             # exactly (bitwise guarantee)
             self.diverged = []
             self.fit_metrics = {}
-            for key, idxs, batch, use_gls, h in handles:
+            for key, idxs, batch, use_gls, h, pkey in handles:
                 fin = (batch._finalize_gls if use_gls
                        else batch._finalize_wls)
                 with obs_trace.span("fleet.execute", bucket=key,
-                                    n=len(idxs)):
+                                    n=len(idxs)) as sp:
+                    traced = obs_trace.enabled()
+                    t0 = obs_clock.now() if traced else None
                     x, chi2, cov = fin(h)
+                    if traced:
+                        # wall includes queue wait (pipeline mode) —
+                        # the attributed MFU is a lower bound here
+                        self._annotate_execute(sp, batch, use_gls,
+                                               maxiter, {},
+                                               obs_clock.now() - t0,
+                                               pkey=pkey)
                 self._scatter(xs, chi2s, covs, idxs, x, chi2, cov)
                 self.diverged.extend(idxs[j] for j in batch.diverged)
                 self.fit_metrics[key] = batch.metrics

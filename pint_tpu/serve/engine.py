@@ -110,6 +110,33 @@ class ServeEngine:
         # executable on the SAME lane breaks the contract.
         self._slot_exec_keys = {}
         self._slot_recompiles = {}
+        self._slo_monitor = None  # attach_slo() opt-in
+
+    # -- SLO burn-rate monitoring ------------------------------------
+
+    def attach_slo(self, specs=None, registry=None, recorder=None,
+                   **slo_kw):
+        """Opt in to dual-window SLO burn-rate monitoring (obs.slo):
+        every :meth:`export_metrics` (and explicit :meth:`slo_check`)
+        feeds the engine snapshot to a BurnRateMonitor on this
+        engine's clock; alert transitions flow through the flight
+        recorder and the ``slo.*`` gauges ride the same Prometheus
+        exposition as the serve counters. Returns the monitor."""
+        from ..obs import slo as obs_slo
+
+        self._slo_monitor = obs_slo.BurnRateMonitor(
+            specs=(specs if specs is not None
+                   else obs_slo.serve_slos(**slo_kw)),
+            clock=self.clock, registry=registry, recorder=recorder)
+        return self._slo_monitor
+
+    def slo_check(self, t=None):
+        """Ingest the current snapshot into the attached burn-rate
+        monitor (no-op without attach_slo). Returns the per-SLO state
+        list, or None when monitoring is not attached."""
+        if self._slo_monitor is None:
+            return None
+        return self._slo_monitor.ingest(self.snapshot(), t=t)
 
     # -- intake ------------------------------------------------------
 
@@ -290,6 +317,11 @@ class ServeEngine:
             health=self.health, breaker=self.breaker, devices=lanes)
         reg.absorb({"executables_compiled": self.executables_compiled,
                     "queue_depth": self.batcher.depth()}, prefix=prefix)
+        if self._slo_monitor is not None:
+            # scrape-time SLO evaluation: the monitor exports its
+            # slo.* gauges into its own registry (the process REGISTRY
+            # unless attach_slo was given one)
+            self._slo_monitor.ingest(self.snapshot())
         return reg
 
     # -- execution ---------------------------------------------------

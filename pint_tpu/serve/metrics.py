@@ -22,6 +22,12 @@ class ServeTelemetry:
     PHASES = ("queue_wait_s", "pack_s", "compile_s", "execute_s",
               "total_s")
 
+    # Always present in snapshots (0 until first increment): the SLO
+    # burn-rate monitor and Prometheus scrapes read these by name, so
+    # they must exist from the first scrape, not appear on first shed.
+    STANDING_COUNTERS = ("shed_queue_full", "rejected_circuit_open",
+                         "errors")
+
     def __init__(self):
         self.counters = {}
         self.records = []
@@ -49,13 +55,15 @@ class ServeTelemetry:
         devices: list of DeviceLane.snapshot() dicts (the engine's
         per-device failure domains); summarized into a ``devices``
         block with alive/lost census alongside the per-lane detail."""
+        counters = {name: 0 for name in self.STANDING_COUNTERS}
+        counters.update(self.counters)
         snap = {
             "requests": len(self.records),
             "requests_ok": sum(1 for r in self.records
                                if r.get("status") == "ok"),
             "requests_rejected": sum(1 for r in self.records
                                      if r.get("status") == "rejected"),
-            "counters": dict(sorted(self.counters.items())),
+            "counters": dict(sorted(counters.items())),
         }
         for phase in self.PHASES:
             vals = self.latencies(phase)

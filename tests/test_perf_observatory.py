@@ -1,0 +1,749 @@
+"""Perf observatory (pint_tpu.obs costmodel / baseline / slo):
+roofline attribution math and the null-MFU fix, executable cost
+capture on the AOT spans, per-program MFU on fleet execute spans, the
+bench-trajectory regression gate (real history passes, an injected
+20% slowdown fails loudly), SLO dual-window burn-rate alerts with
+flight-dump plumbing, flight-recorder dump rotation, histogram
+reservoir semantics, Prometheus exposition conformance, and the
+pintlint meta-key-unbudgeted rule."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from pint_tpu import obs
+from pint_tpu.obs import baseline, costmodel
+from pint_tpu.obs import recorder as obs_recorder
+from pint_tpu.obs import slo as obs_slo
+from pint_tpu.obs.metricsreg import (Histogram, Registry, percentile,
+                                     prom_name, prometheus_text)
+from pint_tpu.obs.recorder import FlightRecorder
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    """Tracing off, empty rings, no dump dir around every test
+    (module-global tracer/recorder state)."""
+    obs.disable()
+    obs.reset()
+    obs_recorder.RECORDER.reset()
+    obs_recorder.RECORDER.dump_dir = None
+    yield
+    obs.disable()
+    obs.reset()
+    obs_recorder.RECORDER.reset()
+    obs_recorder.RECORDER.dump_dir = None
+
+
+# -- cost model / roofline math --------------------------------------
+
+
+def test_peak_table_never_null(monkeypatch):
+    """The BENCH_r05 null-MFU failure mode: an unrecorded platform
+    must fall back to the nominal spec, not null every consumer."""
+    monkeypatch.delenv("PINT_TPU_PEAK_FLOPS", raising=False)
+    monkeypatch.delenv("PINT_TPU_PEAK_BYTES_PER_S", raising=False)
+    for platform in ("cpu", "tpu", "gpu", "some_future_backend"):
+        spec = costmodel.device_spec(platform)
+        assert spec["peak_flops"] > 0
+        assert spec["peak_bytes_per_s"] > 0
+        assert costmodel.mfu_pct(1e9, 1.0, platform) is not None
+    assert costmodel.device_spec("some_future_backend").get("nominal")
+    assert not costmodel.device_spec("cpu").get("nominal")
+
+
+def test_env_override_pins_mfu(monkeypatch):
+    """The pinned synthetic MFU figure: peak 1e12, 1e10 FLOPs in
+    0.1 s -> exactly 10% MFU on every platform."""
+    monkeypatch.setenv("PINT_TPU_PEAK_FLOPS", "1e12")
+    assert costmodel.mfu_pct(1e10, 0.1, "cpu") == 10.0
+    assert costmodel.mfu_pct(1e10, 0.1, "unknown") == 10.0
+    # unknown flops/wall are the ONLY null cases
+    assert costmodel.mfu_pct(None, 0.1, "cpu") is None
+    assert costmodel.mfu_pct(1e10, None, "cpu") is None
+
+
+def test_bench_mfu_delegation_non_null(monkeypatch):
+    """bench.py's MFU helpers delegate to the costmodel table, so a
+    CPU round reports real numbers instead of the r05 nulls."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO)
+    monkeypatch.delenv("PINT_TPU_PEAK_FLOPS", raising=False)
+    assert bench._peak_flops("cpu") == costmodel.peak_flops("cpu")
+    assert bench._mfu(1e10, 0.5, "cpu") is not None
+    monkeypatch.setenv("PINT_TPU_PEAK_FLOPS", "1e12")
+    assert bench._mfu(1e10, 0.1, "cpu") == 10.0
+
+
+def test_attribute_roofline_math(monkeypatch):
+    monkeypatch.setenv("PINT_TPU_PEAK_FLOPS", "1e12")
+    monkeypatch.setenv("PINT_TPU_PEAK_BYTES_PER_S", "1e11")
+    # knee = 10 FLOP/byte. intensity 2 -> memory-bound, ceiling 2e11
+    a = costmodel.attribute(2e9, 1e9, wall_s=0.1)
+    assert a["intensity_flops_per_byte"] == 2.0
+    assert a["bound"] == "memory"
+    assert a["roofline_ceiling_flops"] == pytest.approx(2e11)
+    assert a["achieved_flops_per_s"] == pytest.approx(2e10)
+    assert a["mfu_pct"] == pytest.approx(2.0)
+    assert a["roofline_pct"] == pytest.approx(10.0)
+    # intensity 20 -> compute-bound, ceiling = the flat peak
+    b = costmodel.attribute(2e10, 1e9)
+    assert b["bound"] == "compute"
+    assert b["roofline_ceiling_flops"] == pytest.approx(1e12)
+    assert b["mfu_pct"] is None  # no wall given
+    # unknown bytes: no intensity/bound, ceiling degrades to the peak
+    c = costmodel.attribute(2e9, None, wall_s=0.1)
+    assert c["intensity_flops_per_byte"] is None
+    assert c["bound"] is None
+    assert c["roofline_ceiling_flops"] == pytest.approx(1e12)
+    assert c["mfu_pct"] is not None
+
+
+def test_program_ledger_roundtrip():
+    led = costmodel.ProgramLedger()
+    led.record("prog", {"flops": 1e9, "bytes_accessed": 1e9})
+    attr = led.attribute("prog", wall_s=1.0, platform="cpu")
+    assert attr["mfu_pct"] is not None
+    assert led.attribute("never_compiled") is None
+    assert "prog" in led.snapshot()
+    led.reset()
+    assert led.snapshot() == {}
+
+
+# -- AOT compile split: cost capture on spans ------------------------
+
+
+def test_aot_backend_compile_span_carries_cost_and_roofline():
+    import jax.numpy as jnp
+
+    from pint_tpu import fitter
+
+    def f(x):
+        return jnp.dot(x, x)
+
+    low = fitter.aot_lower(f, jnp.arange(64, dtype=jnp.float64))
+    obs.enable()
+    info = fitter.aot_backend_compile(low["lowered"], label="test_prog")
+    obs.disable()
+    assert info["flops"] and info["flops"] > 0
+    assert info["backend_compile_s"] >= 0
+    (rec,) = [s for s in obs.spans()
+              if s["name"] == "aot.backend_compile"]
+    attrs = rec["attrs"]
+    assert float(attrs["flops"]) > 0
+    assert float(attrs["roofline_ceiling_flops"]) > 0
+    assert attrs["program"] == "test_prog"
+    # the ledger lets execute-time consumers attribute this program
+    led = costmodel.LEDGER.attribute("test_prog", wall_s=1.0)
+    assert led is not None and led["mfu_pct"] is not None
+
+
+def _tiny_wls_fleet():
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    rng = np.random.default_rng(7)
+    models, toas_list = [], []
+    for i in range(2):
+        par = (f"PSR OBS{i}\nRAJ 6:{10 + i}:00\nDECJ 12:00:00\n"
+               f"F0 {310 + i}.9 1\nF1 -4e-16 1\nPEPOCH 55500\n"
+               f"DM {11 + i}.3 1\n")
+        m = get_model(par)
+        mjds = np.sort(rng.uniform(55000, 56000, 40))
+        t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0,
+                                    freq_mhz=1400.0, obs="gbt",
+                                    add_noise=True, seed=i,
+                                    iterations=0)
+        models.append(m)
+        toas_list.append(t)
+    return models, toas_list
+
+
+def test_fleet_execute_span_mfu_non_null_on_cpu():
+    """The acceptance criterion: a traced fleet fit's execute spans
+    carry non-null mfu_pct with an attributed roofline ceiling, on
+    CPU, in both the pipelined and the precompiled sequential path."""
+    from pint_tpu.parallel import PTAFleet
+
+    models, toas_list = _tiny_wls_fleet()
+    obs.enable()
+    try:
+        fleet = PTAFleet(models, toas_list, toa_bucket="pow2",
+                         bucket_floor=16, pipeline=True)
+        fleet.fit(method="wls", maxiter=2)
+        execs = [s for s in obs.spans() if s["name"] == "fleet.execute"]
+        assert execs, "no fleet.execute spans recorded"
+        for s in execs:
+            assert s["attrs"]["mfu_pct"] is not None
+            assert float(s["attrs"]["mfu_pct"]) > 0
+            assert float(s["attrs"]["roofline_ceiling_flops"]) > 0
+            assert s["attrs"]["bound"] in ("compute", "memory")
+        obs.reset()
+        # sequential path: AOT-precompile installs the cost records,
+        # then the plain fit loop attributes against them
+        seq = PTAFleet(models, toas_list, toa_bucket="pow2",
+                       bucket_floor=16, pipeline=False)
+        seq.precompile(method="wls", maxiter=2)
+        seq.fit(method="wls", maxiter=2)
+        execs = [s for s in obs.spans() if s["name"] == "fleet.execute"]
+        assert execs
+        assert all(s["attrs"]["mfu_pct"] is not None for s in execs)
+    finally:
+        obs.disable()
+
+
+# -- bench-trajectory store + regression gate ------------------------
+
+
+def test_regress_passes_on_real_history():
+    report = baseline.run_regress(root=REPO)
+    assert report["n_rounds"] >= 3
+    assert report["ok"], (report["budget_violations"],
+                          report["regressions"])
+    assert report["checked"], "regression gate checked zero keys"
+
+
+def _write_rounds(tmp_path, walls, key="wls_refit_wall_s",
+                  extra_latest=None):
+    """Synthetic BENCH_r0*.json trajectory with one detail key."""
+    for i, wall in enumerate(walls, start=1):
+        detail = {key: wall}
+        if extra_latest and i == len(walls):
+            detail.update(extra_latest)
+        doc = {"parsed": {"metric": "pta_gls_refit_toas_per_sec",
+                          "value": 1e5, "detail": detail}}
+        (tmp_path / ("BENCH_r%02d.json" % i)).write_text(
+            json.dumps(doc))
+    return str(tmp_path)
+
+
+def test_regress_fails_on_injected_20pct_slowdown(tmp_path):
+    """A stable 5-round history then a 20% slower latest round: the
+    10% relative floor dominates the MAD tolerance, so the gate must
+    fail loudly and name the key."""
+    root = _write_rounds(tmp_path,
+                         [1.00, 1.002, 0.998, 1.001, 0.999, 1.20])
+    report = baseline.run_regress(root=root)
+    assert not report["ok"]
+    keys = [r["key"] for r in report["regressions"]]
+    assert "wls_refit_wall_s" in keys
+    (viol,) = [r for r in report["regressions"]
+               if r["key"] == "wls_refit_wall_s"]
+    assert viol["ratio"] > 1.15
+    assert "regressed" in viol["detail"]
+
+
+def test_regress_direction_aware(tmp_path):
+    # a FASTER wall is an improvement, never a regression
+    root = _write_rounds(tmp_path,
+                         [1.00, 1.002, 0.998, 1.001, 0.999, 0.50])
+    report = baseline.run_regress(root=root)
+    assert report["ok"], report["regressions"]
+
+
+def test_regress_min_prior_gate(tmp_path):
+    # 2 prior rounds < min_prior 3: skipped, not guessed at
+    root = _write_rounds(tmp_path, [1.0, 1.0, 5.0])
+    report = baseline.run_regress(root=root)
+    assert report["ok"]
+    assert "insufficient_history" in \
+        report["skipped"]["wls_refit_wall_s"]
+
+
+def test_regress_budget_violation_binds_when_present(tmp_path):
+    root = _write_rounds(
+        tmp_path, [1.0, 1.0, 1.0, 1.0],
+        extra_latest={"measured_670k_plan_padding_ratio": 1.50})
+    report = baseline.run_regress(root=root)
+    assert not report["ok"]
+    (viol,) = report["budget_violations"]
+    assert viol["key"] == "measured_670k_plan_padding_ratio"
+    assert "exceeds budget max" in viol["detail"]
+
+
+def test_robust_tolerance_mad_beats_outlier():
+    # one historic outlier must not inflate the tolerance the way a
+    # stddev would: MAD of [1,1,1,1,10] is 0
+    tol, med = baseline.robust_tolerance([1.0, 1.0, 1.0, 1.0, 10.0],
+                                         rel_floor=0.10, k_mad=4.0)
+    assert med == 1.0
+    assert tol == 0.10  # the floor, not an outlier-inflated band
+
+
+def test_registered_keys_cover_all_sections():
+    keys = baseline.registered_keys()
+    assert "measured_670k_mfu_pct" in keys        # regressions
+    assert "measured_670k_padding_ratio" in keys  # budgets
+    assert "serve_cache_hit_rate" in keys         # tracked
+
+
+def test_regress_cli_exit_codes(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    (tmp_path / "good").mkdir()
+    good = _write_rounds(tmp_path / "good",
+                         [1.0, 1.001, 0.999, 1.0, 1.0])
+    proc = subprocess.run(
+        [sys.executable, "-m", "pint_tpu.obs", "regress",
+         "--root", good, "--json"],
+        capture_output=True, text=True, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr
+    assert json.loads(proc.stdout)["ok"] is True
+    (tmp_path / "bad").mkdir()
+    bad = _write_rounds(tmp_path / "bad",
+                        [1.0, 1.001, 0.999, 1.0, 1.3])
+    proc = subprocess.run(
+        [sys.executable, "-m", "pint_tpu.obs", "regress",
+         "--root", bad],
+        capture_output=True, text=True, env=env, cwd=REPO)
+    assert proc.returncode == 1
+    assert "wls_refit_wall_s" in proc.stderr + proc.stdout
+
+
+# -- SLO burn-rate monitor -------------------------------------------
+
+
+def _snap(requests, ok, shed=0, breaker=0, p99=0.01, lost=()):
+    return {
+        "requests": requests,
+        "requests_ok": ok,
+        "counters": {"shed_queue_full": shed,
+                     "rejected_circuit_open": breaker, "errors": 0},
+        "total_s": {"p50": p99 / 2, "p99": p99, "max": p99},
+        "devices": {"n_lanes": 4,
+                    "alive_lanes": 4 - len(lost),
+                    "lost_lanes": list(lost)},
+    }
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_slo_spec_validation():
+    with pytest.raises(ValueError):
+        obs_slo.SLOSpec("x", budget=0.0, bad="counters.errors")
+    with pytest.raises(ValueError):
+        obs_slo.SLOSpec("x", budget=0.01)  # neither bad nor value
+    with pytest.raises(ValueError):
+        obs_slo.SLOSpec("x", budget=0.01, bad="a", value="b")
+
+
+def test_serve_slos_alerts_are_reachable():
+    """Max possible burn is 1/budget (every sample bad); each default
+    budget must leave that above the fast factor or the alert can
+    never fire."""
+    for spec in obs_slo.serve_slos():
+        assert 1.0 / spec.budget > spec.fast_burn, spec.name
+
+
+def test_slo_dual_window_alert_flight_dump_and_gauges(tmp_path):
+    clock = FakeClock()
+    rec = FlightRecorder(dump_dir=str(tmp_path))
+    reg = Registry()
+    mon = obs_slo.BurnRateMonitor(
+        specs=obs_slo.serve_slos(fast_window_s=300.0,
+                                 slow_window_s=3600.0),
+        clock=clock, registry=reg, recorder=rec)
+    # healthy hour of traffic: nothing alerts
+    n = 0
+    for _ in range(30):
+        clock.t += 120.0
+        n += 100
+        mon.ingest(_snap(requests=n, ok=n))
+    assert mon.alerting() == []
+    # then a hard availability cliff: every new request fails
+    ok = n
+    for _ in range(31):
+        clock.t += 120.0
+        n += 100
+        states = mon.ingest(_snap(requests=n, ok=ok))
+    assert "availability" in mon.alerting()
+    assert mon.alerts_fired >= 1
+    avail = [s for s in states if s["name"] == "availability"][0]
+    assert avail["burn_fast"] >= 14.4
+    assert avail["burn_slow"] >= 6.0
+    # the alert dumped flight context and exported gauges
+    assert any("slo_burn_availability" in p for p in rec.dumps)
+    assert reg.gauge("slo.availability.alerting").value == 1
+    assert reg.gauge("slo.availability.burn_fast").value >= 14.4
+    assert reg.counter("slo.alerts_fired").value == mon.alerts_fired
+    events = [e for e in rec.events() if e.get("what") == "slo_alert"]
+    assert any(e.get("slo") == "availability" for e in events)
+    # recovery: new requests all succeed (the bad count freezes), so
+    # both windows drain and the alert clears with a resolved event
+    bad_total = n - ok
+    for _ in range(40):
+        clock.t += 120.0
+        n += 100
+        mon.ingest(_snap(requests=n, ok=n - bad_total))
+    assert "availability" not in mon.alerting()
+    assert any(e.get("what") == "slo_resolved" for e in rec.events())
+
+
+def test_slo_fast_only_spike_stays_quiet(tmp_path):
+    """A short cliff lights the fast window but not the slow one:
+    no page — the multi-window rule exists to absorb transients."""
+    clock = FakeClock()
+    rec = FlightRecorder(dump_dir=str(tmp_path))
+    mon = obs_slo.BurnRateMonitor(
+        specs=obs_slo.serve_slos(), clock=clock,
+        registry=Registry(), recorder=rec)
+    n = 0
+    for _ in range(60):  # two hours of clean traffic
+        clock.t += 120.0
+        n += 100
+        mon.ingest(_snap(requests=n, ok=n))
+    # one 2-minute total outage: the fast window burns hot, but
+    # 100 bad out of ~3000 slow-window requests stays under 6x
+    ok = n
+    clock.t += 120.0
+    n += 100
+    states = {s["name"]: s
+              for s in mon.ingest(_snap(requests=n, ok=ok))}
+    assert states["availability"]["burn_fast"] >= 14.4
+    assert states["availability"]["burn_slow"] < 6.0
+    assert mon.alerting() == []
+    assert rec.dumps == []
+
+
+def test_slo_threshold_mode_latency_and_lanes(tmp_path):
+    clock = FakeClock()
+    mon = obs_slo.BurnRateMonitor(
+        specs=obs_slo.serve_slos(latency_limit_s=0.25),
+        clock=clock, registry=Registry(),
+        recorder=FlightRecorder(dump_dir=str(tmp_path)))
+    n = 0
+    for _ in range(40):  # every check violates p99 AND has a lost lane
+        clock.t += 120.0
+        n += 100
+        mon.ingest(_snap(requests=n, ok=n, p99=0.9, lost=[2]))
+    alerting = mon.alerting()
+    assert "latency_p99" in alerting
+    assert "lane_loss" in alerting
+    assert "availability" not in alerting
+
+
+def test_slo_snapshot_shape():
+    mon = obs_slo.BurnRateMonitor(specs=obs_slo.serve_slos(),
+                                  clock=FakeClock(),
+                                  registry=Registry(),
+                                  recorder=FlightRecorder())
+    snap = mon.snapshot()  # before any ingest: all-quiet zeros
+    assert set(snap) == {"availability", "shed", "breaker",
+                         "latency_p99", "lane_loss"}
+    for st in snap.values():
+        assert st == {"burn_fast": 0.0, "burn_slow": 0.0,
+                      "alerting": False, "budget": st["budget"]}
+    json.dumps(snap)  # JSON-safe by contract
+
+
+def test_serve_standing_counters_present_from_first_snapshot():
+    """The SLO monitor and Prometheus read shed/breaker counters by
+    name: they must exist (as 0) before the first increment."""
+    from pint_tpu.serve.metrics import ServeTelemetry
+
+    snap = ServeTelemetry().snapshot()
+    for name in ("shed_queue_full", "rejected_circuit_open", "errors"):
+        assert snap["counters"][name] == 0
+
+
+# -- flight recorder rotation ----------------------------------------
+
+
+def test_flight_dump_rotation_caps_on_disk_dumps(tmp_path):
+    rec = FlightRecorder(dump_dir=str(tmp_path), max_dumps=3)
+    for i in range(5):
+        rec.note("event", i=i)
+        rec.dump("reason%d" % i)
+    files = sorted(os.listdir(tmp_path))
+    assert len(files) == 3
+    # the newest three survive, lexical order == dump order
+    assert files == ["flight_003_reason2.json",
+                     "flight_004_reason3.json",
+                     "flight_005_reason4.json"]
+    # the in-process dump list is pruned with the files
+    assert [os.path.basename(p) for p in rec.dumps] == files
+    # surviving dumps still parse
+    with open(tmp_path / files[-1]) as fh:
+        assert json.load(fh)["reason"] == "reason4"
+
+
+def test_flight_max_env_override_and_disable(tmp_path, monkeypatch):
+    monkeypatch.setenv("PINT_TPU_FLIGHT_MAX", "2")
+    rec = FlightRecorder(dump_dir=str(tmp_path / "a"))
+    assert rec.max_dumps == 2
+    for i in range(4):
+        rec.dump("r%d" % i)
+    assert len(os.listdir(tmp_path / "a")) == 2
+    # unparseable env value falls back to the default, never raises
+    monkeypatch.setenv("PINT_TPU_FLIGHT_MAX", "lots")
+    assert FlightRecorder().max_dumps == 32
+    # <= 0 disables rotation entirely
+    monkeypatch.delenv("PINT_TPU_FLIGHT_MAX")
+    rec0 = FlightRecorder(dump_dir=str(tmp_path / "b"), max_dumps=0)
+    for i in range(5):
+        rec0.dump("r%d" % i)
+    assert len(os.listdir(tmp_path / "b")) == 5
+
+
+def test_configure_sets_max_dumps(tmp_path):
+    before = obs_recorder.RECORDER.max_dumps
+    try:
+        rec = obs_recorder.configure(dump_dir=str(tmp_path),
+                                     max_dumps=7)
+        assert rec.max_dumps == 7
+    finally:
+        obs_recorder.configure(max_dumps=before)
+
+
+# -- histogram reservoir semantics -----------------------------------
+
+
+def test_histogram_exact_below_capacity():
+    """Below capacity the quantiles must be byte-compatible with the
+    unbounded nearest-rank implementation."""
+    h = Histogram(capacity=100)
+    vals = [float(v) for v in np.random.default_rng(3).uniform(
+        0, 10, 80)]
+    for v in vals:
+        h.record(v)
+    for q in (50, 90, 99):
+        assert h.percentile(q) == percentile(vals, q)
+    summ = h.summary()
+    assert summ["count"] == 80
+    assert summ["observed"] == 80
+    assert summ["sum"] == pytest.approx(sum(vals))
+
+
+def test_histogram_reservoir_past_capacity():
+    h = Histogram(capacity=100, seed=0)
+    rng = np.random.default_rng(5)
+    stream = rng.normal(50.0, 5.0, 10_000)
+    for v in stream:
+        h.record(v)
+    assert len(h.values()) == 100          # bounded memory
+    assert h.observed == 10_000            # full-stream count
+    assert h.sum == pytest.approx(float(stream.sum()))
+    # an unbiased uniform sample: p50 lands near the true median,
+    # which a keep-the-last-window buffer would not guarantee for a
+    # drifting stream
+    assert abs(h.percentile(50) - float(np.median(stream))) < 2.5
+    # every buffered value came from the stream
+    stream_set = set(float(v) for v in stream)
+    assert all(v in stream_set for v in h.values())
+
+
+def test_histogram_reservoir_deterministic():
+    a, b = Histogram(capacity=10), Histogram(capacity=10)
+    for i in range(1000):
+        a.record(i)
+        b.record(i)
+    assert a.values() == b.values()
+
+
+def test_histogram_empty_and_singleton():
+    h = Histogram(capacity=4)
+    assert h.percentile(50) is None
+    summ = h.summary()
+    assert summ["count"] == 0 and summ["observed"] == 0
+    assert summ["sum"] == 0.0
+    h.record(3.5)
+    assert h.percentile(50) == 3.5
+    assert h.percentile(99) == 3.5
+    assert h.summary()["observed"] == 1
+
+
+# -- Prometheus exposition conformance -------------------------------
+
+
+class TestPrometheusConformance:
+    def _reg(self):
+        reg = Registry()
+        reg.counter("serve.requests").inc(7)
+        reg.gauge("fleet.overlap_pct").set(61.5)
+        h = reg.histogram("serve.total_s", capacity=8)
+        for v in (0.1, 0.2, 0.3, 0.4):
+            h.record(v)
+        return reg
+
+    def test_type_line_per_metric_and_valid_names(self):
+        text = prometheus_text(registry=self._reg())
+        lines = text.strip().split("\n")
+        types = [ln for ln in lines if ln.startswith("# TYPE ")]
+        assert "# TYPE pint_tpu_serve_requests counter" in types
+        assert "# TYPE pint_tpu_fleet_overlap_pct gauge" in types
+        assert "# TYPE pint_tpu_serve_total_s summary" in types
+        name_re = __import__("re").compile(
+            r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+        for ln in lines:
+            if ln.startswith("#"):
+                continue
+            metric = ln.split("{")[0].split(" ")[0]
+            assert name_re.match(metric), metric
+
+    def test_histogram_count_sum_cover_full_stream(self):
+        reg = Registry()
+        h = reg.histogram("lat", capacity=4)
+        for v in range(100):
+            h.record(float(v))
+        text = prometheus_text(registry=reg)
+        assert "pint_tpu_lat_count 100" in text
+        assert "pint_tpu_lat_sum 4950.0" in text
+        assert 'pint_tpu_lat{quantile="0.50"}' in text
+
+    def test_nan_and_inf_value_formats(self):
+        reg = Registry()
+        reg.gauge("g.none").set(None)
+        reg.gauge("g.nan").set(float("nan"))
+        reg.gauge("g.pinf").set(float("inf"))
+        reg.gauge("g.ninf").set(float("-inf"))
+        text = prometheus_text(registry=reg)
+        assert "pint_tpu_g_none NaN" in text
+        assert "pint_tpu_g_nan NaN" in text
+        assert "pint_tpu_g_pinf +Inf" in text
+        assert "pint_tpu_g_ninf -Inf" in text
+        # every exposed VALUE is a float literal or NaN/+Inf/-Inf —
+        # never Python's "inf"/"Infinity" spellings
+        for ln in text.strip().split("\n"):
+            if ln.startswith("#"):
+                continue
+            val = ln.rsplit(" ", 1)[1]
+            assert val in ("NaN", "+Inf", "-Inf") or \
+                float(val) == float(val)
+
+    def test_colliding_sanitized_names_share_one_type_line(self):
+        reg = Registry()
+        reg.gauge("a.b").set(1.0)
+        reg.gauge("a/b").set(2.0)  # sanitizes to the same name
+        assert prom_name("a.b") == prom_name("a/b")
+        text = prometheus_text(registry=reg)
+        assert text.count("# TYPE pint_tpu_a_b gauge") == 1
+        assert text.count("pint_tpu_a_b ") >= 2
+
+    def test_slo_gauges_flow_into_exposition(self, tmp_path):
+        reg = Registry()
+        mon = obs_slo.BurnRateMonitor(
+            specs=[obs_slo.SLOSpec("avail", 0.01,
+                                   bad="bad", total="total")],
+            clock=FakeClock(), registry=reg,
+            recorder=FlightRecorder())
+        mon.ingest({"bad": 0, "total": 100})
+        text = prometheus_text(registry=reg)
+        assert "pint_tpu_slo_avail_burn_fast" in text
+        assert "pint_tpu_slo_avail_alerting 0" in text
+        assert "pint_tpu_slo_alerts_fired 0" in text
+
+
+# -- pintlint meta-key-unbudgeted rule -------------------------------
+
+
+def _lint(tmp_path, rel, src, cfg):
+    import textwrap
+
+    from pint_tpu.analysis import run, unsuppressed
+
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    return [f for f in unsuppressed(run([str(p)], config=cfg))
+            if f.rule == "meta-key-unbudgeted"]
+
+
+def test_meta_key_rule_flags_unregistered_key(tmp_path):
+    from pint_tpu.analysis import LintConfig
+
+    cfg = LintConfig(budget_meta_modules=("/bench.py",),
+                     budgeted_meta_keys=frozenset({"serve_known"}))
+    bad = """
+        meta.update({"measured_670k_shiny_new_s": 1.0,
+                     "serve_known": 2.0,
+                     "not_a_meta_key": 3.0})
+    """
+    (finding,) = _lint(tmp_path, "bench.py", bad, cfg)
+    assert "measured_670k_shiny_new_s" in finding.message
+    assert "budgets.json" in finding.message
+
+
+def test_meta_key_rule_ignores_reads_and_other_modules(tmp_path):
+    from pint_tpu.analysis import LintConfig
+
+    cfg = LintConfig(budget_meta_modules=("/bench.py",),
+                     budgeted_meta_keys=frozenset())
+    # a subscript READ of another report dict is not a definition
+    ok = 'x = report["serve_p99_latency_s"]\n'
+    assert _lint(tmp_path, "bench.py", ok, cfg) == []
+    # an unregistered key outside the governed modules is not flagged
+    bad = 'meta = {"measured_rogue": 1}\n'
+    assert _lint(tmp_path, "other.py", bad, cfg) == []
+
+
+def test_meta_key_rule_inert_without_budget_file(tmp_path):
+    from pint_tpu.analysis import LintConfig
+
+    cfg = LintConfig(budget_meta_modules=("/bench.py",),
+                     budgeted_meta_keys=None)
+    bad = 'meta = {"measured_rogue": 1}\n'
+    assert _lint(tmp_path, "bench.py", bad, cfg) == []
+
+
+def test_default_config_binds_real_budget_registry():
+    from pint_tpu.analysis import LintConfig
+
+    cfg = LintConfig.default()
+    assert "/bench.py" in cfg.budget_meta_modules
+    assert cfg.budgeted_meta_keys is not None
+    assert "measured_670k_mfu_pct" in cfg.budgeted_meta_keys
+
+
+def test_real_bench_meta_keys_all_registered(tmp_path):
+    """The shipped bench.py must lint clean under the rule — every
+    measured_*/serve_* key it emits is in budgets.json."""
+    from pint_tpu.analysis import (LintConfig, run, unsuppressed)
+
+    findings = run([os.path.join(REPO, "bench.py")],
+                   config=LintConfig.default())
+    bad = [f for f in unsuppressed(findings)
+           if f.rule == "meta-key-unbudgeted"]
+    assert bad == [], [f.message for f in bad]
+
+
+# -- SLO CLI ----------------------------------------------------------
+
+
+def test_slo_cli_exit_codes(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    quiet = tmp_path / "quiet.json"
+    quiet.write_text(json.dumps(_snap(requests=100, ok=100)))
+    proc = subprocess.run(
+        [sys.executable, "-m", "pint_tpu.obs", "slo", str(quiet)],
+        capture_output=True, text=True, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["alerting"] == []
+    # a sustained total outage across fast+slow windows pages
+    paths = []
+    n = 0
+    for i in range(40):
+        n += 100
+        p = tmp_path / ("s%02d.json" % i)
+        p.write_text(json.dumps(_snap(requests=n, ok=0)))
+        paths.append(str(p))
+    proc = subprocess.run(
+        [sys.executable, "-m", "pint_tpu.obs", "slo",
+         "--step", "120", *paths],
+        capture_output=True, text=True, env=env, cwd=REPO)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "availability" in json.loads(proc.stdout)["alerting"]
