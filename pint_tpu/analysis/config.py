@@ -184,6 +184,29 @@ OBS_ALLOWED_PATH_MARKERS = ("/obs/", "/tests/", "/test_")
 # headline number from the round it first appears.
 BUDGET_META_MODULES = ("/bench.py",)
 
+# -- fit-quality signal coverage --------------------------------------
+
+# Modules (normalized "/"-prefixed path suffixes) on the fit path
+# where numerical quality signals are computed: any function there
+# that evaluates a signal (a relres_failed verdict, a chi2_whitened
+# assignment) must also feed the numerics observatory
+# (pint_tpu.obs.fitquality) or carry an explicit suppression — a
+# computed-then-dropped quality signal is telemetry the fleet
+# dashboards silently never see.
+QUALITY_SIGNAL_MODULES = (
+    "/fitter.py", "/parallel/pta.py", "/parallel/toa_shard.py",
+    "/serve/engine.py",
+)
+
+# Identifier pattern marking a quality-signal computation.
+QUALITY_SIGNAL_PATTERN = r"relres_failed|chi2_whitened"
+
+# Identifier pattern marking that the enclosing function routes the
+# signal into the observatory (the fitquality ledger / the per-batch
+# quality summary).
+QUALITY_RECORD_PATTERN = (
+    r"quality|FITQ|obs_fitq|record_fit_batch|note_fallback")
+
 # Names that mark a value as a NaN-signalling convergence diagnostic:
 # comparing one of these with ``>`` (False under NaN) silently
 # swallows a diverged fit. ADVICE.md round 5 found three variants of
@@ -209,6 +232,9 @@ class LintConfig:
     obs_allowed_path_markers: tuple = OBS_ALLOWED_PATH_MARKERS
     budget_meta_modules: tuple = ()
     budgeted_meta_keys: frozenset = None  # None -> rule is inert
+    quality_signal_modules: tuple = ()
+    quality_signal_pattern: str = QUALITY_SIGNAL_PATTERN
+    quality_record_pattern: str = QUALITY_RECORD_PATTERN
 
     @classmethod
     def default(cls):
@@ -228,4 +254,5 @@ class LintConfig:
                    bucket_allowed_modules=BUCKET_ALLOWED_MODULES,
                    obs_instrumented_modules=OBS_INSTRUMENTED_MODULES,
                    budget_meta_modules=BUDGET_META_MODULES,
-                   budgeted_meta_keys=budgeted)
+                   budgeted_meta_keys=budgeted,
+                   quality_signal_modules=QUALITY_SIGNAL_MODULES)

@@ -717,6 +717,13 @@ def gls_solve(Mfull, r, sigma, sqrt_phi_inv, threshold=1e-12,
             warnings.warn(
                 f"mixed-precision GLS refinement did not converge "
                 f"(rel resid {float(rel_resid):.2e}); refitting in f64")
+            if _fitquality_enabled():
+                # count at the DECISION: the f64 redo re-records these
+                # probes, so this is the fitq_fallback numerator's one
+                # home on the single-pulsar path
+                from .obs import fitquality as obs_fitq
+
+                obs_fitq.FITQ.note_fallback(["gls_solve"])
             A = gls_gram(Mn, q, "f64")
             dxn, covn = gls_eigh_solve(A, b, threshold)
     else:
@@ -725,6 +732,41 @@ def gls_solve(Mfull, r, sigma, sqrt_phi_inv, threshold=1e-12,
     rw2 = jnp.sum(jnp.square(z))
     chi2 = float(rw2 - b @ dxn)
     return dx, (covn, norm), chi2
+
+
+def _fitquality_enabled():
+    """One attribute check when probes are off — call sites guard on
+    this before materializing anything (e.g. whitened residuals)."""
+    from .obs import fitquality as obs_fitq
+
+    return obs_fitq.enabled()
+
+
+def _record_fit_quality(fitter, chi2, n_toa, nparam, cov=None, rw=None,
+                        method="gls", precision="f64", maxiter=None):
+    """Single-pulsar fit-quality probes: chi2 z-score, conditioning
+    from the normalized covariance, and — unique to this path, where
+    whitened residuals already exist host-side — residual moments.
+    Pure host post-processing of already-computed arrays; the fit
+    result is untouched. Callers gate on :func:`_fitquality_enabled`."""
+    from .obs import fitquality as obs_fitq
+
+    if not obs_fitq.enabled():
+        return None
+    psr = getattr(fitter.model, "PSR", None)
+    label = (psr.value if psr is not None and getattr(psr, "value", None)
+             else type(fitter).__name__)
+    covn = None if cov is None else np.asarray(cov[0])[None]
+    summary = obs_fitq.record_fit_batch(
+        [label], [float(chi2)], [float(n_toa - nparam)], covn=covn,
+        method=method, precision=precision, maxiter=maxiter,
+        source="fitter." + method)
+    if rw is not None:
+        obs_fitq.FITQ.annotate(
+            label,
+            residual_moments=obs_fitq.residual_moments(
+                np.asarray(rw, dtype=np.float64)))
+    return summary
 
 
 def stack_noise_bases(M, bases):
@@ -1163,6 +1205,13 @@ class GLSFitter(Fitter):
         self._attach_noise_resids()
         self.converged = True
         self.chi2_whitened = chi2
+        if _fitquality_enabled() and nparam is not None:
+            # r/sigma_s hold the latest evaluated state (== the best
+            # iterate except in the warned chi2-increase case)
+            _record_fit_quality(
+                self, chi2, int(np.asarray(r).shape[0]), nparam,
+                cov=cov, rw=np.asarray(r) / np.asarray(sigma_s),
+                method="gls", precision=precision, maxiter=maxiter)
         self._update_model_stats()
         self.metrics = fit_metrics(t_start, prep_s, iter_s, self.toas,
                                    self.model)
@@ -1374,6 +1423,12 @@ class WidebandTOAFitter(GLSFitter):
         self._attach_noise_resids()
         self.converged = True
         self.chi2_whitened = chi2
+        if _fitquality_enabled() and iter_s:
+            _record_fit_quality(
+                self, chi2, int(np.asarray(r).shape[0]), nparam,
+                cov=cov, rw=np.asarray(r) / np.asarray(sigma),
+                method="wideband_gls", precision=precision,
+                maxiter=maxiter)
         self._update_model_stats()
         # wideband re-prepares inside each iteration, so prepare time is
         # folded into iteration_s rather than reported separately
@@ -1444,6 +1499,11 @@ class WidebandDownhillFitter(WidebandTOAFitter):
         self._attach_noise_resids()
         self.converged = True
         self.chi2_whitened = best_chi2
+        if _fitquality_enabled():
+            _record_fit_quality(
+                self, best_chi2, int(np.asarray(r).shape[0]), nparam,
+                cov=cov, method="wideband_downhill",
+                precision=precision, maxiter=maxiter)
         self._update_model_stats()
         self.metrics = fit_metrics(t_start, 0.0, iter_s, self.toas,
                                    self.model)
@@ -1503,6 +1563,10 @@ class WidebandLMFitter(WidebandTOAFitter):
                         f"mixed-precision LM refinement did not "
                         f"converge (rel resid {float(relres):.2e}); "
                         "solving this step with the f64 Gram")
+                    if _fitquality_enabled():
+                        from .obs import fitquality as obs_fitq
+
+                        obs_fitq.FITQ.note_fallback(["wideband_lm"])
                     A = gls_gram(Mn, q, "f64")
                     A_damped = A + lm * jnp.diag(jnp.diag(A))
                     dxn = jnp.linalg.solve(A_damped, b)
@@ -1547,6 +1611,16 @@ class WidebandLMFitter(WidebandTOAFitter):
         self._attach_noise_resids()
         self.converged = True
         self.chi2_whitened = best_chi2
+        if _fitquality_enabled():
+            # (covn, norm) exist exactly when a step was accepted —
+            # the lazy conditional keeps the f64 path NameError-free
+            _record_fit_quality(
+                self, best_chi2, int(np.asarray(r).shape[0]), nparam,
+                cov=((covn, norm)
+                     if getattr(self, "_lm_cov", None) is not None
+                     else None),
+                method="wideband_lm", precision=precision,
+                maxiter=maxiter)
         self._update_model_stats()
         self.metrics = fit_metrics(t_start, 0.0, iter_s, self.toas,
                                    self.model)

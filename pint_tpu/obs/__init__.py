@@ -36,6 +36,8 @@ and offline ``slo`` replay).
 from . import baseline  # noqa: F401
 from . import clock  # noqa: F401
 from . import costmodel  # noqa: F401
+from . import drift  # noqa: F401
+from . import fitquality  # noqa: F401
 from . import slo  # noqa: F401
 from .trace import (  # noqa: F401
     NOOP_SPAN,
@@ -70,6 +72,12 @@ from .costmodel import (  # noqa: F401
     mfu_pct,
 )
 from .slo import BurnRateMonitor, SLOSpec, serve_slos  # noqa: F401
+from .drift import CUSUM, EWMA, DriftBoard, DriftSentinel  # noqa: F401
+from .fitquality import (  # noqa: F401
+    FITQ,
+    FitQualityLedger,
+    fit_quality_slos,
+)
 from .export import (  # noqa: F401
     chrome_trace,
     flight_spans,
@@ -77,12 +85,15 @@ from .export import (  # noqa: F401
 )
 
 __all__ = [
-    "BurnRateMonitor", "Counter", "FlightRecorder", "Gauge",
-    "Histogram", "LEDGER", "NOOP_SPAN", "ProgramLedger", "RECORDER",
-    "REGISTRY", "Registry", "SLOSpec", "Span", "TRACER", "Tracer",
-    "attribute", "baseline", "chrome_trace", "clock", "configure",
-    "costmodel", "current_trace_id", "device_spec", "disable",
-    "enable", "enabled", "executable_cost", "flight_spans", "mfu_pct",
-    "percentile", "prometheus_text", "reset", "serve_slos", "slo",
-    "span", "spans", "summary", "write_chrome_trace",
+    "BurnRateMonitor", "CUSUM", "Counter", "DriftBoard",
+    "DriftSentinel", "EWMA", "FITQ", "FitQualityLedger",
+    "FlightRecorder", "Gauge", "Histogram", "LEDGER", "NOOP_SPAN",
+    "ProgramLedger", "RECORDER", "REGISTRY", "Registry", "SLOSpec",
+    "Span", "TRACER", "Tracer", "attribute", "baseline",
+    "chrome_trace", "clock", "configure", "costmodel",
+    "current_trace_id", "device_spec", "disable", "drift", "enable",
+    "enabled", "executable_cost", "fit_quality_slos", "fitquality",
+    "flight_spans", "mfu_pct", "percentile", "prometheus_text",
+    "reset", "serve_slos", "slo", "span", "spans", "summary",
+    "write_chrome_trace",
 ]

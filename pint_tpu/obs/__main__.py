@@ -19,6 +19,12 @@ Subcommands:
 - ``slo``     — replay serve snapshot JSON files through the
   dual-window burn-rate monitor and report per-SLO burn / alert state
   (exit 1 when any SLO is alerting at the end of the replay).
+- ``fitq``    — the numerics observatory: check a fit-quality ledger /
+  engine snapshot JSON against the probe limits, or (with no file)
+  run a probed fleet refit and report the live ledger (exit 1 on any
+  probe violation).
+- ``doctor``  — one CI entry point: regress + (optional) slo replay +
+  (optional) fitq snapshot check; exit non-zero on ANY violation.
 """
 
 from __future__ import annotations
@@ -141,6 +147,96 @@ def _cmd_slo(args):
     return 1 if out["alerting"] else 0
 
 
+def _cmd_fitq(args):
+    from . import fitquality
+
+    if args.snapshot:
+        with open(args.snapshot) as fh:
+            snap = json.load(fh)
+    else:
+        # no snapshot: run a probed fleet refit and report the live
+        # ledger (the fitq twin of the `fleet` demo)
+        from ..parallel import PTAFleet
+        from ..scripts.pint_serve_bench import build_serve_fleet
+
+        per_combo = max(1, -(-args.n_psr // 9))
+        models, toas_list = build_serve_fleet(
+            sizes=tuple(args.sizes), per_combo=per_combo,
+            seed=args.seed)
+        models, toas_list = models[:args.n_psr], toas_list[:args.n_psr]
+        print(f"[pint_trace] probed fleet refit of {len(models)} "
+              "pulsars ...", file=sys.stderr)
+        fitquality.reset()
+        fitquality.enable()
+        try:
+            fleet = PTAFleet(models, toas_list,
+                             bucket_floor=args.bucket_floor)
+            fleet.fit(method=args.method, maxiter=args.maxiter)
+        finally:
+            fitquality.disable()
+        snap = fitquality.FITQ.snapshot()
+    report = fitquality.check_report(
+        snap, chi2_z_limit=args.chi2_z_limit,
+        condition_limit=args.condition_limit)
+    ledger = {k: v for k, v in fitquality._fq(snap).items()
+              if k != "pulsars"}
+    print(json.dumps({"report": report, "ledger": ledger}, indent=1,
+                     default=float))
+    return 0 if report["ok"] else 1
+
+
+def _cmd_doctor(args):
+    from . import baseline, fitquality, slo
+
+    failures = []
+    sections = {}
+    regress = baseline.run_regress(root=args.root,
+                                   budgets_path=args.budgets)
+    sections["regress"] = regress
+    if not regress["ok"]:
+        failures.append("regress")
+    if args.slo_snapshots:
+        mon = slo.BurnRateMonitor(specs=slo.serve_slos())
+        for i, path in enumerate(args.slo_snapshots):
+            with open(path) as fh:
+                doc = json.load(fh)
+            if isinstance(doc, dict) and "snapshot" in doc:
+                doc = doc["snapshot"]
+            t = doc.get("walltime") if isinstance(doc, dict) else None
+            mon.ingest(doc, t=t if t is not None else float(i * 60.0))
+        alerting = mon.alerting()
+        sections["slo"] = {"ok": not alerting, "alerting": alerting}
+        if alerting:
+            failures.append("slo")
+    if args.fitq_snapshot:
+        with open(args.fitq_snapshot) as fh:
+            doc = json.load(fh)
+        fitq = fitquality.check_report(doc)
+        sections["fitq"] = fitq
+        if not fitq["ok"]:
+            failures.append("fitq")
+    out = {"ok": not failures, "failures": failures,
+           "sections": sections}
+    if args.json:
+        print(json.dumps(out, indent=1, default=float))
+    else:
+        print("doctor: %s" % ("OK" if out["ok"] else
+                              "FAIL (%s)" % ", ".join(failures)))
+        for name, sect in sections.items():
+            ok = sect.get("ok", True)
+            print("  %-8s %s" % (name, "ok" if ok else "FAIL"))
+            for v in sect.get("violations", []):
+                print("    FITQ    %s" % json.dumps(v),
+                      file=sys.stderr)
+            for v in sect.get("budget_violations", []):
+                print("    BUDGET  %s" % v["detail"], file=sys.stderr)
+            for r in sect.get("regressions", []):
+                print("    REGRESS %s" % r["detail"], file=sys.stderr)
+            for a in sect.get("alerting", []) or []:
+                print("    SLO     %s alerting" % a, file=sys.stderr)
+    return 0 if out["ok"] else 1
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         prog="python -m pint_tpu.obs",
@@ -199,6 +295,38 @@ def main(argv=None):
                    help="assumed seconds between snapshots lacking a "
                         "walltime field")
     s.set_defaults(fn=_cmd_slo)
+
+    q = sub.add_parser("fitq", help="fit-quality probe report / gate "
+                                    "(numerics observatory)")
+    q.add_argument("snapshot", nargs="?", default=None,
+                   help="ledger or engine snapshot JSON; omitted -> "
+                        "run a probed fleet refit")
+    q.add_argument("--n-psr", type=int, default=27)
+    q.add_argument("--sizes", type=int, nargs="+", default=[48])
+    q.add_argument("--seed", type=int, default=0)
+    q.add_argument("--method", default="gls", choices=("wls", "gls"))
+    q.add_argument("--maxiter", type=int, default=2)
+    q.add_argument("--bucket-floor", type=int, default=64)
+    q.add_argument("--chi2-z-limit", type=float, default=6.0)
+    q.add_argument("--condition-limit", type=float, default=1e12)
+    q.set_defaults(fn=_cmd_fitq)
+
+    d = sub.add_parser("doctor", help="regress + slo + fitq in one "
+                                      "CI gate (exit !=0 on any "
+                                      "violation)")
+    d.add_argument("--root", default=None,
+                   help="directory holding BENCH_r*.json")
+    d.add_argument("--budgets", default=None,
+                   help="budget spec path (default packaged)")
+    d.add_argument("--slo-snapshots", nargs="*", default=None,
+                   help="serve snapshot JSONs to replay through the "
+                        "burn-rate monitor")
+    d.add_argument("--fitq-snapshot", default=None,
+                   help="fit-quality ledger / engine snapshot JSON "
+                        "to gate")
+    d.add_argument("--json", action="store_true",
+                   help="emit the machine-readable report")
+    d.set_defaults(fn=_cmd_doctor)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -97,12 +97,20 @@ def load_history(root):
         except (OSError, ValueError):
             continue
         parsed = doc.get("parsed") or {}
-        values = _flatten(parsed.get("detail") or {})
+        detail = parsed.get("detail") or {}
+        values = _flatten(detail)
         metric = parsed.get("metric")
         if metric and isinstance(parsed.get("value"), (int, float)):
             values[str(metric)] = float(parsed["value"])
-        rounds.setdefault(rnd, {"round": "r%02d" % rnd, "values": {}})
+        rounds.setdefault(rnd, {"round": "r%02d" % rnd, "values": {},
+                                "null_reasons": {}})
         rounds[rnd]["values"].update(values)
+        # bench.py's reason-coded nulls ride along so the regression
+        # gate can tell a deliberate skip from missing history
+        nulls = detail.get("null_reasons")
+        if isinstance(nulls, dict):
+            rounds[rnd]["null_reasons"].update(
+                {str(k): str(v) for k, v in nulls.items()})
         rounds[rnd]["path"] = path
     for path in sorted(glob.glob(os.path.join(root,
                                               "MULTICHIP_r*.json"))):
@@ -182,6 +190,7 @@ def check_regressions(history, spec):
     if not history:
         return regressions, checked, skipped
     latest = history[-1]["values"]
+    latest_nulls = history[-1].get("null_reasons") or {}
     prior_rounds = history[:-1]
     for key, conf in spec.get("regressions", {}).items():
         direction = conf.get("direction", "lower")
@@ -189,7 +198,11 @@ def check_regressions(history, spec):
         need = int(conf.get("min_prior", min_prior))
         latest_val = latest.get(key)
         if latest_val is None:
-            skipped[key] = "missing_in_latest"
+            # a reason-coded null is the bench saying "skipped on
+            # purpose" — record the reason, not a missing-history alarm
+            reason = latest_nulls.get(key)
+            skipped[key] = ("null: %s" % reason if reason
+                            else "missing_in_latest")
             continue
         prior = [r["values"][key] for r in prior_rounds
                  if r["values"].get(key) is not None]

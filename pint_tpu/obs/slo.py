@@ -158,6 +158,20 @@ class BurnRateMonitor:
         self._alerting = {s.name: False for s in self.specs}
         self.alerts_fired = 0
 
+    def add_specs(self, specs):
+        """Extend a live monitor with more SLOs (e.g. the fit_quality
+        five-pack joining an already-attached serve monitor). Existing
+        names are replaced wholesale — their window history restarts,
+        which is the honest reading of 'the objective changed'."""
+        with self._lock:
+            for spec in specs:
+                self.specs = ([s for s in self.specs
+                               if s.name != spec.name] + [spec])
+                self._samples[spec.name] = collections.deque()
+                self._threshold_state[spec.name] = [0, 0]
+                self._alerting[spec.name] = False
+        return self
+
     def _registry(self):
         return (metricsreg.REGISTRY if self.registry is None
                 else self.registry)

@@ -229,6 +229,7 @@ class FleetMesh:
         self.stolen = 0
         self.diverged = []
         self.quarantined = []  # pulsar indices bisected out
+        self.fit_quality = {}  # bucket repr -> fitquality summary
 
     # -- lane selection / work stealing -----------------------------
 
@@ -333,9 +334,13 @@ class FleetMesh:
         caller via quarantine + stealing); other exceptions mean the
         bucket itself is bad (bisected by the caller)."""
         with obs_trace.span("mesh.bucket", bucket=oi, lane=lane.index,
-                            method=method):
-            return self._run_bucket_traced(lane, oi, key, method,
-                                           maxiter, **kw)
+                            method=method) as sp:
+            out = self._run_bucket_traced(lane, oi, key, method,
+                                          maxiter, **kw)
+            q = self.fit_quality.get(repr(key))
+            if q:
+                sp.set(**q)
+            return out
 
     def _run_bucket_traced(self, lane, oi, key, method, maxiter, **kw):
         t0 = self.clock()
@@ -365,6 +370,10 @@ class FleetMesh:
             pull, lane, what=f"bucket {oi} fit on lane {lane.index}")
         idxs = self.group_indices[key]
         self.diverged.extend(idxs[j] for j in batch.diverged)
+        if batch.quality:
+            # per-segment probes were already extracted from the one
+            # packed pull above — no extra device round-trip
+            self.fit_quality[repr(key)] = batch.quality
         lane.health.note_flush(self.clock() - t0)
         lane.health.note_request("ok")
         lane.breaker.record_success(lane.key)
@@ -550,6 +559,7 @@ class FleetMesh:
         covs = [None] * self.n
         self.diverged = []
         self.quarantined = []
+        self.fit_quality = {}
         ckpt = None
         state = {}
         completed = {}
@@ -622,5 +632,7 @@ class FleetMesh:
             "stolen_buckets": int(self.stolen),
             "reassignments": [list(r) for r in self.reassignments],
             "quarantined_pulsars": list(self.quarantined),
+            "fit_quality": {k: dict(v)
+                            for k, v in self.fit_quality.items()},
             "lanes": [ln.snapshot() for ln in self.lanes],
         }

@@ -20,6 +20,7 @@ import numpy as np
 
 from ..models.timing_model import PreparedTiming
 from ..obs import clock as obs_clock
+from ..obs import fitquality as obs_fitq
 from ..obs import trace as obs_trace
 
 _EXCLUDE_KEYS = ("T_ld", "pepoch_day", "pepoch_sec")
@@ -546,6 +547,7 @@ class PTABatch:
         self._fns = {}
         self._costs = {}  # program key -> executable cost record
         self._ecorr_marg_ok = None  # lazy host check, cached (gls_fit)
+        self.quality = None  # fitquality bucket summary when enabled
 
     # -- single-pulsar kernel (closed over static config only) --
 
@@ -758,6 +760,40 @@ class PTABatch:
             chi2[int(lane) % n] = np.nan
         return chi2
 
+    def _pulsar_labels(self):
+        """Per-pulsar display labels in original batch order (same
+        convention as _isolate_diverged's warning: PSR name when the
+        model has one, global index otherwise)."""
+        off = getattr(self, "_pulsar_offset", 0)
+        return [getattr(m, "PSR", None) and m.PSR.value or f"#{off + i}"
+                for i, m in enumerate(self.models)]
+
+    def _record_quality(self, method, handle, x, chi2, covn,
+                        relres=None):
+        """Fit-quality probes over the finalize's already-pulled host
+        arrays (no device interaction — the fit stays bitwise
+        identical; tests/test_fitquality.py pins it). dof is the
+        design-matrix count: TOAs minus free params minus the offset
+        column; noise amplitudes are marginalized, not subtracted."""
+        n_free = int(np.asarray(x).shape[1])
+        # distributed fleets hold only a local model slice of a global
+        # result; probe just the rows this process owns
+        off = getattr(self, "_pulsar_offset", 0)
+        labels = self._pulsar_labels()
+        sl = slice(off, off + len(labels))
+        n_toas = np.asarray(self.n_toas, np.float64).reshape(-1)
+        dof = n_toas[sl] - (n_free + 1)
+        self.quality = obs_fitq.record_fit_batch(
+            labels, np.asarray(chi2)[sl], dof,
+            covn=np.asarray(covn)[sl],
+            relres=None if relres is None else np.asarray(relres)[sl],
+            method=method, precision=handle.get("precision", "f64"),
+            maxiter=handle["maxiter"],
+            fell_back=self.__dict__.pop("_fitq_fell_back", False),
+            diverged=[i - off for i in self.diverged
+                      if 0 <= i - off < len(labels)],
+            source="pta." + method)
+
     def _isolate_diverged(self, x0, x, chi2):
         """Per-pulsar fault isolation (SURVEY section 5 "failure
         detection"): a diverged lane (non-finite chi2 or params) must
@@ -877,6 +913,10 @@ class PTABatch:
         x, chi2 = self._isolate_diverged(handle["x0"], x, chi2)
         self._record_metrics("wls", handle["t0"], handle["maxiter"],
                              warm=handle["warm"])
+        if obs_fitq.enabled():
+            self._record_quality("wls", handle, x, chi2, covn)
+        else:
+            self.quality = None
         return x, chi2, cov
 
     def wls_fit(self, maxiter=3, threshold=1e-12):
@@ -1483,6 +1523,11 @@ class PTABatch:
             jax.block_until_ready(out)
             if mode == "mixed":
                 relres = jax.device_get(out[2][2])
+                # probe diagnostic, not a production fit: these warm-up
+                # fits pick a precision mode and are re-run (and then
+                # recorded) by the real dispatch; ledgering them would
+                # double-count every auto-resolved bucket
+                # pintlint: disable=quality-signal-dropped
                 mixed_failed = relres_failed(relres)
             t0 = obs_clock.now()
             jax.block_until_ready(self._fns[key](*args))
@@ -1550,6 +1595,11 @@ class PTABatch:
                 f"mixed-precision GLS refinement did not converge "
                 f"(max rel resid {float(np.max(relres)):.2e}); "
                 "refitting in f64")
+            if obs_fitq.enabled():
+                # count the fallback at the decision; flag the f64
+                # re-run's probes so the ledger shows both
+                obs_fitq.FITQ.note_fallback(self._pulsar_labels())
+                self._fitq_fell_back = True
             return self.gls_fit(maxiter=handle["maxiter"],
                                 threshold=handle["threshold"],
                                 ecorr_mode=handle["ecorr_mode"],
@@ -1559,6 +1609,11 @@ class PTABatch:
         x, chi2 = self._isolate_diverged(handle["x0"], x, chi2)
         self._record_metrics("gls", handle["t0"], handle["maxiter"],
                              warm=handle["warm"])
+        if obs_fitq.enabled():
+            self._record_quality("gls", handle, x, chi2, covn,
+                                 relres=relres)
+        else:
+            self.quality = None
         return x, chi2, cov
 
     def gls_fit(self, maxiter=2, threshold=1e-12, ecorr_mode="auto",
@@ -2242,6 +2297,7 @@ class PTAFleet:
         covs = [None] * self.n
         self.diverged = []
         self.fit_metrics = {}
+        self.fit_quality = {}
         for key, idxs in self.group_indices.items():
             batch = self._resolve(key)
             use_gls = self._use_gls(batch, method)
@@ -2254,9 +2310,13 @@ class PTAFleet:
                 if traced:
                     self._annotate_execute(sp, batch, use_gls, maxiter,
                                            kw, obs_clock.now() - t0)
+                if traced and batch.quality:
+                    sp.set(**batch.quality)
             self._scatter(xs, chi2s, covs, idxs, x, chi2, cov)
             self.diverged.extend(idxs[j] for j in batch.diverged)
             self.fit_metrics[key] = batch.metrics
+            if batch.quality:
+                self.fit_quality[key] = batch.quality
         return xs, chi2s, covs
 
     def _fit_pipelined(self, method, maxiter, max_workers, **kw):
@@ -2381,6 +2441,7 @@ class PTAFleet:
             # exactly (bitwise guarantee)
             self.diverged = []
             self.fit_metrics = {}
+            self.fit_quality = {}
             for key, idxs, batch, use_gls, h, pkey in handles:
                 fin = (batch._finalize_gls if use_gls
                        else batch._finalize_wls)
@@ -2396,9 +2457,13 @@ class PTAFleet:
                                                maxiter, {},
                                                obs_clock.now() - t0,
                                                pkey=pkey)
+                    if traced and batch.quality:
+                        sp.set(**batch.quality)
                 self._scatter(xs, chi2s, covs, idxs, x, chi2, cov)
                 self.diverged.extend(idxs[j] for j in batch.diverged)
                 self.fit_metrics[key] = batch.metrics
+                if batch.quality:
+                    self.fit_quality[key] = batch.quality
         finally:
             if pool is not None:
                 pool.shutdown(wait=False)

@@ -111,6 +111,7 @@ class ServeEngine:
         self._slot_exec_keys = {}
         self._slot_recompiles = {}
         self._slo_monitor = None  # attach_slo() opt-in
+        self._fitq_board = None  # attach_fit_quality() opt-in
 
     # -- SLO burn-rate monitoring ------------------------------------
 
@@ -137,6 +138,72 @@ class ServeEngine:
         if self._slo_monitor is None:
             return None
         return self._slo_monitor.ingest(self.snapshot(), t=t)
+
+    # -- fit-quality / drift monitoring ------------------------------
+
+    def attach_fit_quality(self, board=None, slo=False, registry=None,
+                           recorder=None, ledger=None, **board_kw):
+        """Opt in to numerical-health monitoring: enables the
+        fit-quality probes (obs.fitquality — every flushed fit then
+        records chi2 z-scores, conditioning, and fallback flags in
+        the process ledger) and feeds each committed fit lane's
+        parameters/uncertainties/reduced-chi2 to a
+        :class:`obs.drift.DriftBoard` across successive refits, so a
+        drifting pulsar raises a ``fit_anomaly`` flight dump naming
+        the probe and its baseline. With ``slo=True`` the fit_quality
+        SLO five-pack joins the attached BurnRateMonitor (attaching
+        one with the serve defaults first when none exists). The
+        board's baselines ride :meth:`state_dict` checkpoints.
+        Returns the board."""
+        from ..obs import drift as obs_drift
+        from ..obs import fitquality as obs_fitq
+
+        obs_fitq.enable()
+        self._fitq_board = (board if board is not None
+                            else obs_drift.DriftBoard(
+                                ledger=ledger, recorder=recorder,
+                                **board_kw))
+        if slo:
+            if self._slo_monitor is None:
+                self.attach_slo(registry=registry, recorder=recorder)
+            self._slo_monitor.add_specs(obs_fitq.fit_quality_slos())
+        return self._fitq_board
+
+    @staticmethod
+    def _fit_label(req):
+        """Drift-series identity for one request's pulsar: the PSR
+        name when the model carries one (successive refits of the same
+        pulsar must land on the same sentinel), else the request id."""
+        psr = getattr(req.model, "PSR", None)
+        return getattr(psr, "value", None) or f"req:{req.request_id}"
+
+    # -- checkpointable engine state ---------------------------------
+
+    STATE_KIND = "ServeEngineState"
+    STATE_VERSION = 1
+
+    def state_dict(self):
+        """Versioned JSON-safe restartable state. Today that is the
+        drift board's per-(pulsar, probe) EWMA baselines — telemetry,
+        caches, and executables are rebuildable and deliberately not
+        carried. See obs.drift for the re-anchor contract (no alarm
+        storm after a restore)."""
+        return {"kind": self.STATE_KIND, "version": self.STATE_VERSION,
+                "drift": (None if self._fitq_board is None
+                          else self._fitq_board.state_dict())}
+
+    def load_state_dict(self, state):
+        if (state.get("kind") != self.STATE_KIND
+                or state.get("version") != self.STATE_VERSION):
+            raise ValueError(
+                "not a %s v%d state: %r" % (
+                    self.STATE_KIND, self.STATE_VERSION,
+                    {k: state.get(k) for k in ("kind", "version")}))
+        drift_state = state.get("drift")
+        if drift_state is not None:
+            if self._fitq_board is None:
+                self.attach_fit_quality()
+            self._fitq_board.load_state_dict(drift_state)
 
     # -- intake ------------------------------------------------------
 
@@ -301,6 +368,14 @@ class ServeEngine:
                                        devices=lanes)
         snap["executables_compiled"] = self.executables_compiled
         snap["queue_depth"] = self.batcher.depth()
+        from ..obs import fitquality as obs_fitq
+
+        if self._fitq_board is not None or obs_fitq.enabled():
+            fq = obs_fitq.FITQ.snapshot()
+            fq.pop("pulsars", None)  # gauge surface stays O(1)
+            if self._fitq_board is not None:
+                fq["drift"] = self._fitq_board.snapshot()
+            snap["fit_quality"] = fq
         return snap
 
     def export_metrics(self, registry=None, prefix="serve."):
@@ -317,6 +392,13 @@ class ServeEngine:
             health=self.health, breaker=self.breaker, devices=lanes)
         reg.absorb({"executables_compiled": self.executables_compiled,
                     "queue_depth": self.batcher.depth()}, prefix=prefix)
+        from ..obs import fitquality as obs_fitq
+
+        if self._fitq_board is not None or obs_fitq.enabled():
+            obs_fitq.export_metrics(registry=reg)
+            if self._fitq_board is not None:
+                reg.absorb(self._fitq_board.snapshot(),
+                           prefix="fitq.drift.")
         if self._slo_monitor is not None:
             # scrape-time SLO evaluation: the monitor exports its
             # slo.* gauges into its own registry (the process REGISTRY
@@ -752,6 +834,27 @@ class ServeEngine:
             return poisoned
         if degraded:
             self.telemetry.incr("degraded_mixed", n_live)
+        if kind == "fit" and self._fitq_board is not None:
+            # drift sentinels over the lanes being COMMITTED (poisoned
+            # attempts return above — a diverged lane is the
+            # divergence probe's business, not a drift observation);
+            # pure host post-processing of the arrays already pulled
+            from ..obs import drift as obs_drift
+            from ..obs import fitquality as obs_fitq
+
+            t0 = self.clock()
+            with np.errstate(invalid="ignore"):
+                sig = np.sqrt(np.maximum(
+                    np.diagonal(cov, axis1=-2, axis2=-1), 0.0))
+            tid = obs_trace.current_trace_id()
+            for i, (req, _, _) in enumerate(live):
+                dof = max(1.0, len(req.toas) - x.shape[1] - 1)
+                self._fitq_board.observe(
+                    self._fit_label(req),
+                    obs_drift.fit_drift_values(
+                        x[i], sig[i], float(chi2[i]) / dof, names),
+                    slot=str(slot_key), trace=tid)
+            obs_fitq.FITQ.note_probe_wall(self.clock() - t0)
         done = self.clock()
         for i, (req, res, t_sub) in enumerate(live):
             res.status = "ok"

@@ -1,0 +1,536 @@
+"""Numerics observatory (pint_tpu.obs.fitquality + obs.drift): probe
+math units, ledger accounting, the fit_quality SLO five-pack and its
+check_report gate, the pinned synthetic drift fixture (alarm round is
+deterministic), checkpoint/restore re-anchor semantics, and the two
+product contracts — a probed 68-pulsar fleet refit is bitwise
+identical to an unprobed one with <1% warm-refit overhead, and an
+injected solver divergence produces a correctly-attributed
+``fit_anomaly`` flight dump naming the pulsar, the probe, and the
+baseline it violated."""
+
+import json
+import math
+import warnings
+
+import numpy as np
+import pytest
+
+warnings.simplefilter("ignore")
+
+from pint_tpu import obs
+from pint_tpu.obs import drift as obs_drift
+from pint_tpu.obs import fitquality
+from pint_tpu.obs import recorder as obs_recorder
+from pint_tpu.obs.fitquality import (FitQualityLedger, chi2_zscore,
+                                     check_report, condition_from_covn,
+                                     fit_quality_slos,
+                                     record_fit_batch, residual_moments)
+from pint_tpu.resilience import FaultPoint, inject
+
+
+@pytest.fixture(autouse=True)
+def _clean_fitq():
+    """Every test starts and ends with probing off, an empty process
+    ledger, tracing off, and no flight-dump directory (module-global
+    state, same hygiene as tests/test_obs.py)."""
+    fitquality.disable()
+    fitquality.reset()
+    obs.disable()
+    obs.reset()
+    obs_recorder.RECORDER.reset()
+    obs_recorder.RECORDER.dump_dir = None
+    yield
+    fitquality.disable()
+    fitquality.reset()
+    obs.disable()
+    obs.reset()
+    obs_recorder.RECORDER.reset()
+    obs_recorder.RECORDER.dump_dir = None
+
+
+# -- probe math ------------------------------------------------------
+
+
+def test_chi2_zscore_center_tail_and_guards():
+    # chi2 == dof sits near the distribution center
+    assert abs(chi2_zscore(100.0, 100)) < 0.2
+    # a 2x-inflated chi2 is far out in the tail
+    assert chi2_zscore(200.0, 100) > 5.0
+    # deflated chi2 goes negative
+    assert chi2_zscore(40.0, 100) < -4.0
+    # vectorized, with NaN guards for dof<=0 and non-finite chi2
+    z = chi2_zscore([100.0, np.nan, 50.0], [100.0, 100.0, 0.0])
+    assert abs(z[0]) < 0.2
+    assert math.isnan(z[1]) and math.isnan(z[2])
+
+
+def test_condition_from_covn_eigenvalue_spread():
+    # covn is the inverse normalized Gram: eigenvalue ratio IS the
+    # Gram's condition number. diag(1, 4) -> 4.
+    assert condition_from_covn(np.diag([1.0, 4.0])) == pytest.approx(4.0)
+    # stacked (P, k, k) input -> per-pulsar vector
+    stack = np.stack([np.eye(2), np.diag([1.0, 100.0])])
+    cond = condition_from_covn(stack)
+    assert cond.shape == (2,)
+    assert cond[0] == pytest.approx(1.0)
+    assert cond[1] == pytest.approx(100.0)
+    # semidefinite block -> inf; non-finite lane -> NaN
+    assert math.isinf(condition_from_covn(np.diag([1.0, 0.0])))
+    assert math.isnan(condition_from_covn(np.full((2, 2), np.nan)))
+
+
+def test_residual_moments_known_vectors():
+    m = residual_moments(np.array([1.0, -1.0]))
+    assert m["n"] == 2
+    assert m["mean"] == pytest.approx(0.0)
+    assert m["std"] == pytest.approx(1.0)
+    assert m["n_outliers"] == 0
+    # one 10-sigma point is an outlier at the default 3.5 threshold
+    rw = np.concatenate([np.zeros(50), [10.0]])
+    assert residual_moments(rw)["n_outliers"] == 1
+    # non-finite entries are dropped, not folded in
+    assert residual_moments([np.nan, np.inf, 0.5])["n"] == 1
+    empty = residual_moments([])
+    assert empty["n"] == 0 and empty["mean"] is None
+
+
+# -- ledger ----------------------------------------------------------
+
+
+def test_ledger_counters_latest_wins_and_worst_case():
+    led = FitQualityLedger()
+    led.record("A", {"chi2_z": -2.0, "condition": 10.0, "relres": 1e-8})
+    led.record("B", {"chi2_z": 1.0, "condition": 300.0,
+                     "diverged": True})
+    # re-record of A: latest record wins, counters accumulate
+    led.record("A", {"chi2_z": 0.5, "condition": 20.0})
+    snap = led.snapshot()
+    assert snap["counters"]["fits"] == 3
+    assert snap["counters"]["diverged"] == 1
+    assert snap["n_pulsars"] == 2
+    assert snap["max_abs_chi2_z"] == pytest.approx(2.0)
+    assert snap["max_condition"] == pytest.approx(300.0)
+    assert snap["max_relres"] == pytest.approx(1e-8)
+    assert led.get("A")["chi2_z"] == 0.5
+    # non-finite values never fold into the worst-case aggregates
+    led.record("C", {"chi2_z": np.nan, "condition": np.inf})
+    assert led.snapshot()["max_condition"] == pytest.approx(300.0)
+
+
+def test_ledger_annotate_and_fallback_accounting():
+    led = FitQualityLedger()
+    led.record("A", {"chi2_z": 0.1, "fell_back": False})
+    led.annotate("A", moments={"n": 24, "n_outliers": 0})
+    rec = led.get("A")
+    assert rec["moments"]["n"] == 24
+    assert rec["chi2_z"] == 0.1  # annotate merges, never replaces
+    # fallbacks count at the DECISION, once per affected label --
+    # record()ing the f64 re-run must not double-book
+    led.note_fallback(["A", "B"])
+    led.record("A", {"chi2_z": 0.1, "fell_back": True})
+    snap = led.snapshot()
+    assert snap["counters"]["fallbacks"] == 2
+    assert snap["counters"]["fits"] == 2
+    led.reset()
+    empty = led.snapshot()
+    assert empty["counters"]["fits"] == 0 and empty["n_pulsars"] == 0
+
+
+def test_record_fit_batch_probes_divergence_and_dump(tmp_path):
+    rec = obs_recorder.FlightRecorder(dump_dir=str(tmp_path))
+    led = FitQualityLedger()
+    summary = record_fit_batch(
+        ["P0", "P1", "P2"], [44.0, np.nan, 90.0], 44.0,
+        covn=np.stack([np.eye(3)] * 3), relres=2e-9,
+        method="gls", precision="mixed", maxiter=2,
+        diverged=[1], ledger=led, source="unit", recorder=rec)
+    assert summary["fitq_n"] == 3
+    assert summary["fitq_diverged"] == 1
+    assert summary["fitq_max_abs_chi2_z"] > 3.0  # P2's inflated chi2
+    p0, p1 = led.get("P0"), led.get("P1")
+    assert p0["dof"] == 44.0 and p0["reduced_chi2"] == pytest.approx(1.0)
+    assert p0["relres"] == pytest.approx(2e-9)  # scalar broadcast
+    assert p0["method"] == "gls" and p0["precision"] == "mixed"
+    # the NaN lane stays None (not a huge finite impostor) + diverged
+    assert p1["chi2"] is None and p1["chi2_z"] is None
+    assert p1["diverged"] is True
+    assert led.snapshot()["counters"]["diverged"] == 1
+    assert led.snapshot()["probe_wall_s"] > 0.0
+    # the diverged lane dumped a fit_anomaly naming pulsar + probe +
+    # the baseline a healthy whitened chi2 should have sat at
+    dumps = sorted(tmp_path.glob("flight_*_fit_anomaly.json"))
+    assert len(dumps) == 1
+    doc = json.load(open(dumps[0]))
+    assert doc["reason"] == "fit_anomaly"
+    ctx = doc["context"]
+    assert ctx["pulsar"] == "P1"
+    assert ctx["probe"] == "chi2_whitened"
+    assert ctx["baseline"] == 44.0
+    assert ctx["source"] == "unit"
+
+
+# -- SLO five-pack + report gate -------------------------------------
+
+
+def _healthy_snapshot(**over):
+    snap = {"counters": {"fits": 100, "fallbacks": 1, "diverged": 0,
+                         "drift_alarms": 0},
+            "max_abs_chi2_z": 2.1, "max_condition": 5e4,
+            "max_relres": 3e-9, "probe_wall_s": 0.001,
+            "n_pulsars": 68, "pulsars": {}}
+    snap.update(over)
+    return snap
+
+
+def test_fit_quality_slo_five_pack_reads_both_snapshot_shapes():
+    specs = {s.name: s for s in fit_quality_slos()}
+    assert set(specs) == {"fitq_chi2_z", "fitq_fallback",
+                          "fitq_divergence", "fitq_condition",
+                          "fitq_drift"}
+    bare = _healthy_snapshot()
+    engine = {"requests": 10, "fit_quality": bare}  # serve snapshot
+    for snap in (bare, engine):
+        assert specs["fitq_chi2_z"].value(snap) == 2.1
+        assert specs["fitq_condition"].value(snap) == 5e4
+        assert specs["fitq_fallback"].bad(snap) == 1
+        assert specs["fitq_fallback"].total(snap) == 100
+        assert specs["fitq_drift"].bad(snap) == 0
+    # every budget must stay alertable by the fast burn window
+    for s in fit_quality_slos():
+        assert 1.0 / s.budget > 14.0
+
+
+def test_check_report_pass_and_violations():
+    ok = check_report(_healthy_snapshot())
+    assert ok["ok"] and ok["violations"] == []
+    assert ok["checked"]["fits"] == 100
+    # vacuous pass: nothing ran, nothing degraded
+    assert check_report({})["ok"]
+    # chi2 inflation -> chi2_z violation (the doctor-fail fixture)
+    bad = check_report(_healthy_snapshot(max_abs_chi2_z=42.0))
+    assert not bad["ok"]
+    assert [v["probe"] for v in bad["violations"]] == ["chi2_z"]
+    # excess fallback rate and any drift alarm each trip their check
+    rates = check_report(_healthy_snapshot(
+        counters={"fits": 100, "fallbacks": 30, "diverged": 5,
+                  "drift_alarms": 2}))
+    probes = {v["probe"] for v in rates["violations"]}
+    assert probes == {"fallback_rate", "divergence_rate",
+                      "drift_alarms"}
+    # engine-shaped snapshots gate identically
+    assert not check_report(
+        {"fit_quality": _healthy_snapshot(max_condition=1e15)})["ok"]
+
+
+# -- drift sentinels -------------------------------------------------
+
+# The pinned synthetic drift fixture: 10 rounds at 1.0 then a step to
+# 5.0. With min_n=4 the EWMA is ready from round 4 on, the constant
+# series keeps z == 0 (sigma has a relative floor, so no 0/0), and
+# round 10's step is the first non-zero z -- a huge one -> the alarm
+# round is exactly 10, deterministically.
+PINNED_STEP_ROUND = 10
+
+
+def test_pinned_drift_fixture_alarm_round(tmp_path):
+    obs_recorder.RECORDER.dump_dir = str(tmp_path)
+    led = FitQualityLedger()
+    board = obs_drift.DriftBoard(min_n=4, ledger=led)
+    alarm_rounds = []
+    for rnd in range(PINNED_STEP_ROUND + 2):
+        val = 1.0 if rnd < PINNED_STEP_ROUND else 5.0
+        alarms = board.observe("J0030+0451", {"reduced_chi2": val},
+                              slot="s0")
+        if alarms:
+            alarm_rounds.append(rnd)
+    assert alarm_rounds[0] == PINNED_STEP_ROUND
+    alarm = board.observe("J0030+0451", {"reduced_chi2": 5.0})
+    # EWMA keeps adapting toward the new level; the episode alarmed
+    assert board.alarms >= 1
+    assert led.snapshot()["counters"]["drift_alarms"] == board.alarms
+    # each alarm dumped a fit_anomaly naming pulsar/probe/baseline
+    dumps = sorted(tmp_path.glob("flight_*_fit_anomaly.json"))
+    assert dumps
+    ctx = json.load(open(dumps[0]))["context"]
+    assert ctx["pulsar"] == "J0030+0451"
+    assert ctx["probe"] == "reduced_chi2"
+    assert ctx["baseline"] == pytest.approx(1.0)
+    assert ctx["observed"] == 5.0
+    assert ctx["source"] == "drift"
+    assert ctx["slot"] == "s0"
+    del alarm
+
+
+def test_constant_series_never_alarms():
+    # successive refits of identical data are bitwise-constant; the
+    # sigma floor keeps that from collapsing to zero variance and
+    # alarming on the first ulp of float noise
+    board = obs_drift.DriftBoard(min_n=4, ledger=FitQualityLedger())
+    for _ in range(50):
+        assert board.observe("A", {"param.F0": 150.318}) == []
+    assert board.alarms == 0
+
+
+def test_drift_state_roundtrip_reanchors_without_alarm_storm():
+    led = FitQualityLedger()
+    board = obs_drift.DriftBoard(min_n=4, k=0.5, h=6.0, ledger=led)
+    # warmup with real spread so the sentinel learns sigma ~0.01
+    for v in (1.01, 0.99, 1.02, 0.98, 1.00, 1.01, 0.99, 1.00):
+        assert board.observe("A", {"reduced_chi2": v}) == []
+    # half-accumulated simmer: same-signed ~1.5-sigma steps build
+    # CUSUM evidence (S+ ~ 1.9) without firing
+    for _ in range(4):
+        assert board.observe("A", {"reduced_chi2": 1.015}) == []
+    assert board.alarms == 0
+    sent = board._sentinels[("A", "reduced_chi2")]
+    assert sent.cusum.pos > 1.0  # evidence really is mid-accumulation
+    state = json.loads(json.dumps(board.state_dict()))  # JSON-safe
+    restored = obs_drift.DriftBoard(ledger=led)
+    restored.load_state_dict(state)
+    assert restored.snapshot()["series"] == 1
+    # a restore must NOT replay the half-accumulated evidence: steady
+    # observations near the learned baseline stay quiet
+    for _ in range(20):
+        assert restored.observe("A", {"reduced_chi2": 1.005}) == []
+    assert restored.alarms == 0
+    # ... but a real persisting drift still fires after the restore
+    fired = False
+    for _ in range(30):
+        if restored.observe("A", {"reduced_chi2": 1.06}):
+            fired = True
+            break
+    assert fired
+
+
+def test_drift_state_kind_version_validation():
+    sent = obs_drift.DriftSentinel()
+    with pytest.raises(ValueError):
+        sent.load_state_dict({"kind": "Banana", "version": 1})
+    with pytest.raises(ValueError):
+        sent.load_state_dict({"kind": "DriftSentinel", "version": 99})
+    board = obs_drift.DriftBoard()
+    with pytest.raises(ValueError):
+        board.load_state_dict(sent.state_dict())  # wrong kind
+
+
+def test_drift_board_series_cap_and_fit_drift_values():
+    board = obs_drift.DriftBoard(max_series=2,
+                                 ledger=FitQualityLedger())
+    board.observe("A", {"p0": 1.0, "p1": 2.0, "p2": 3.0})
+    snap = board.snapshot()
+    assert snap["series"] == 2
+    assert snap["dropped_series"] == 1
+    vals = obs_drift.fit_drift_values(
+        [1.5, -2e-16], [0.1, 1e-18], 1.02, names=["F0", "F1"])
+    assert vals["reduced_chi2"] == 1.02
+    assert vals["param.F0"] == 1.5 and vals["sigma.F1"] == 1e-18
+    # None / non-finite probe values are skipped, not crashed on
+    assert board.observe("A", {"p0": None, "p1": np.nan}) == []
+
+
+# -- fleet contract: bitwise + <1% overhead (ISSUE acceptance) -------
+
+
+def test_fleet_refit_bitwise_with_probes_and_under_1pct_overhead():
+    """The traced-fleet product contract at realistic scale: probing a
+    68-pulsar batched GLS refit changes NOTHING (bitwise-identical
+    parameters, chi2, covariance) and its self-timed probe wall stays
+    under 1% of the warm refit. Probe cost scales with pulsar count
+    (host numpy per pulsar) while fit wall scales with TOA count, so
+    the contract is pinned here at 68x400 -- toy fleets (6x48) would
+    show probe/fit ratios the contract never promises."""
+    import sys
+
+    import jax
+
+    sys.path.insert(0, "/root/repo")
+    try:
+        from bench import build_batch
+    finally:
+        sys.path.remove("/root/repo")
+    from pint_tpu.parallel import PTABatch
+
+    models, toas = build_batch(68, 400)
+    pta = PTABatch(models, toas)
+    pta.gls_fit(maxiter=2)  # compile + warm
+    off = float("inf")
+    for _ in range(3):
+        t0 = obs.clock.now()
+        x, chi2, cov = pta.gls_fit(maxiter=2)
+        jax.block_until_ready(chi2)
+        off = min(off, obs.clock.now() - t0)
+    fitquality.reset()
+    fitquality.enable()
+    try:
+        n_probed = 3
+        probe_walls = []
+        prev_wall = 0.0
+        for _ in range(n_probed):
+            x2, chi2_2, cov2 = pta.gls_fit(maxiter=2)
+            jax.block_until_ready(chi2_2)
+            wall = fitquality.FITQ.snapshot()["probe_wall_s"]
+            probe_walls.append(wall - prev_wall)
+            prev_wall = wall
+        snap = fitquality.FITQ.snapshot()
+    finally:
+        fitquality.disable()
+    assert np.array_equal(np.asarray(x), np.asarray(x2))
+    assert np.array_equal(np.asarray(chi2), np.asarray(chi2_2))
+    assert np.array_equal(np.asarray(cov), np.asarray(cov2))
+    assert snap["counters"]["fits"] == 68 * n_probed
+    assert snap["n_pulsars"] == 68
+    # min-of-3 on both sides: the steady-state probe tax vs the warm
+    # refit, neither contaminated by one-off warmup or scheduler noise
+    probe = min(probe_walls)
+    assert probe < 0.01 * off, (
+        "probe wall %.6fs is %.2f%% of the %.4fs warm refit"
+        % (probe, 100 * probe / off, off))
+    # the probes saw real numbers, not placeholder Nones
+    assert snap["max_abs_chi2_z"] is not None
+    assert snap["max_condition"] is not None
+
+
+def test_solver_diverge_chaos_dumps_attributed_anomaly(tmp_path):
+    """Injected solver divergence (the resilience fault point that
+    NaNs a lane's chi2 exactly where a real blow-up surfaces) must
+    produce a fit_anomaly flight dump attributing the divergence to
+    the right pulsar, probe, and baseline."""
+    from pint_tpu.parallel import PTAFleet
+    from pint_tpu.scripts.pint_serve_bench import build_serve_fleet
+
+    models, toas = build_serve_fleet(sizes=(48,), per_combo=2, seed=5)
+    fleet = PTAFleet(models, toas, bucket_floor=32)
+    obs_recorder.RECORDER.dump_dir = str(tmp_path)
+    fitquality.reset()
+    fitquality.enable()
+    try:
+        with inject(FaultPoint("solver_diverge", count=1,
+                               payload={"lanes": [1]})):
+            fleet.fit(method="gls", maxiter=2)
+    finally:
+        fitquality.disable()
+    snap = fitquality.FITQ.snapshot()
+    assert snap["counters"]["diverged"] >= 1
+    dumps = sorted(tmp_path.glob("flight_*_fit_anomaly.json"))
+    assert dumps, "injected divergence produced no flight dump"
+    ctx = json.load(open(dumps[0]))["context"]
+    assert ctx["pulsar"] == "SRV1"
+    assert ctx["probe"] == "chi2_whitened"
+    # 48 TOAs - 3 free params - offset column = 44 expected chi2
+    assert ctx["baseline"] == 44.0
+    assert ctx["source"].startswith("pta.")
+    # the diverged pulsar's ledger record carries the flag
+    assert fitquality.FITQ.get("SRV1")["diverged"] is True
+
+
+# -- serve engine integration ----------------------------------------
+
+
+def _serve_pulsar(i, n_toa=24):
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    par = (f"PSR SRVQ{i}\nRAJ 12:0{i}:00.0\nDECJ 10:00:00.0\n"
+           f"F0 3{i}1.25 1\nF1 -4e-16 1\nPEPOCH 55500\nDM 12.{i} 1\n")
+    m = get_model(par)
+    rng = np.random.default_rng(7 + i)
+    mjds = np.sort(rng.uniform(54500, 56500, n_toa))
+    t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0,
+                                freq_mhz=1400.0, obs="gbt",
+                                add_noise=True, seed=7 + i,
+                                iterations=0)
+    return m, t
+
+
+def test_serve_attach_fit_quality_snapshot_state_and_slo(tmp_path):
+    from pint_tpu.serve import FitRequest, ServeEngine
+
+    pulsars = [_serve_pulsar(0), _serve_pulsar(1)]
+    eng = ServeEngine(max_batch=2, max_latency_s=1e9, bucket_floor=32)
+    board = eng.attach_fit_quality(slo=True, min_n=3)
+    assert fitquality.enabled()
+    # the fit_quality five-pack joined the burn-rate monitor
+    names = {s.name for s in eng._slo_monitor.specs}
+    assert {"fitq_chi2_z", "fitq_fallback", "fitq_divergence",
+            "fitq_condition", "fitq_drift"} <= names
+    for _ in range(5):  # successive refits feed the drift sentinels
+        r0 = eng.submit(FitRequest(*pulsars[0], maxiter=2))
+        r1 = eng.submit(FitRequest(*pulsars[1], maxiter=2))
+        assert r0.status == "ok" and r1.status == "ok"
+    snap = eng.snapshot()
+    fq = snap["fit_quality"]
+    assert fq["counters"]["fits"] >= 10
+    assert fq["drift"]["series"] > 0
+    assert fq["drift"]["alarms"] == 0  # boring fleet stays boring
+    # checkpoint -> JSON -> restore into a FRESH engine: the board
+    # re-anchors (baselines carried, CUSUM evidence not) and further
+    # steady refits raise no alarm storm
+    state = json.loads(json.dumps(eng.state_dict()))
+    assert state["kind"] == "ServeEngineState"
+    eng2 = ServeEngine(max_batch=2, max_latency_s=1e9,
+                       bucket_floor=32)
+    eng2.load_state_dict(state)
+    assert (eng2._fitq_board.snapshot()["series"]
+            == board.snapshot()["series"])
+    for _ in range(3):
+        eng2.submit(FitRequest(*pulsars[0], maxiter=2))
+        eng2.submit(FitRequest(*pulsars[1], maxiter=2))
+    assert eng2._fitq_board.alarms == 0
+    with pytest.raises(ValueError):
+        eng2.load_state_dict({"kind": "Nope", "version": 1})
+    # Prometheus exposition carries the fitq gauges
+    reg = eng2.export_metrics()
+    text = obs.prometheus_text(registry=reg)
+    assert "fitq_counters_fits" in text
+    assert "fitq_drift_series" in text
+
+
+# -- CLI: fitq + doctor ----------------------------------------------
+
+
+def test_fitq_cli_gates_on_snapshot(tmp_path, capsys):
+    from pint_tpu.obs.__main__ import main
+
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_healthy_snapshot()))
+    assert main(["fitq", str(good)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["report"]["ok"]
+    assert out["ledger"]["counters"]["fits"] == 100
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(_healthy_snapshot(max_abs_chi2_z=42.0)))
+    assert main(["fitq", str(bad)]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert [v["probe"] for v in out["report"]["violations"]] \
+        == ["chi2_z"]
+
+
+def test_doctor_cli_passes_on_repo_history(capsys):
+    """doctor over the repo's real BENCH trajectory: the shipped
+    budgets must hold on the shipped history (regress section), and
+    with no fitq snapshot the fitq section simply doesn't run."""
+    from pint_tpu.obs.__main__ import main
+
+    rc = main(["doctor", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0, out["failures"]
+    assert out["ok"]
+    assert out["sections"]["regress"]["ok"]
+    assert "fitq" not in out["sections"]
+
+
+def test_doctor_cli_fails_on_chi2_inflation_fixture(tmp_path,
+                                                    capsys):
+    from pint_tpu.obs.__main__ import main
+
+    fixture = tmp_path / "inflated.json"
+    fixture.write_text(json.dumps(
+        _healthy_snapshot(max_abs_chi2_z=42.0)))
+    rc = main(["doctor", "--json", "--fitq-snapshot", str(fixture)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["failures"] == ["fitq"]
+    assert not out["sections"]["fitq"]["ok"]
+    probes = [v["probe"]
+              for v in out["sections"]["fitq"]["violations"]]
+    assert probes == ["chi2_z"]

@@ -643,6 +643,86 @@ def test_timing_untraced_allows_obs_package_and_tests(tmp_path):
     assert live(fs, "timing-untraced") == []
 
 
+# -- quality-signal-dropped ------------------------------------------
+
+
+QUALITY_CFG = LintConfig(quality_signal_modules=("/fitter.py",))
+
+
+def test_quality_signal_dropped_flags_unrecorded_verdict(tmp_path):
+    bad = """
+        def gls_solve(relres):
+            if relres_failed(relres):
+                return "f64"
+            return "mixed"
+    """
+    fs = lint(tmp_path, {"fitter.py": bad}, QUALITY_CFG)
+    assert len(live(fs, "quality-signal-dropped")) == 1
+
+
+def test_quality_signal_dropped_flags_unrecorded_chi2(tmp_path):
+    bad = """
+        class F:
+            def fit_toas(self, chi2):
+                self.chi2_whitened = chi2
+                return chi2
+    """
+    fs = lint(tmp_path, {"fitter.py": bad}, QUALITY_CFG)
+    assert len(live(fs, "quality-signal-dropped")) == 1
+
+
+def test_quality_signal_dropped_quiet_when_recorded(tmp_path):
+    good = """
+        from pint_tpu.obs import fitquality as obs_fitq
+
+        def gls_solve(relres):
+            if relres_failed(relres):
+                obs_fitq.FITQ.note_fallback(["gls_solve"])
+                return "f64"
+            return "mixed"
+
+        class F:
+            def fit_toas(self, chi2):
+                self.chi2_whitened = chi2
+                self._record_fit_quality(chi2)
+                return chi2
+    """
+    fs = lint(tmp_path, {"fitter.py": good}, QUALITY_CFG)
+    assert live(fs, "quality-signal-dropped") == []
+
+
+def test_quality_signal_dropped_ignores_guard_def_and_reads(tmp_path):
+    good = """
+        def relres_failed(rel, tol=1e-8):
+            return not (rel <= tol)
+
+        def report(fitter):
+            return getattr(fitter, "chi2_whitened", None)
+    """
+    fs = lint(tmp_path, {"fitter.py": good}, QUALITY_CFG)
+    assert live(fs, "quality-signal-dropped") == []
+
+
+def test_quality_signal_dropped_scoped_and_suppressible(tmp_path):
+    bad = """
+        def gls_solve(relres):
+            return relres_failed(relres)
+    """
+    # outside the registered modules: quiet
+    fs = lint(tmp_path, {"other.py": bad}, QUALITY_CFG)
+    assert live(fs, "quality-signal-dropped") == []
+    suppressed = """
+        def gls_solve(relres):
+            # probe diagnostic, recorded by the caller
+            # pintlint: disable=quality-signal-dropped
+            return relres_failed(relres)
+    """
+    fs = lint(tmp_path, {"fitter.py": suppressed}, QUALITY_CFG)
+    assert live(fs, "quality-signal-dropped") == []
+    assert any(f.rule == "quality-signal-dropped" and f.suppressed
+               for f in fs)
+
+
 # -- suppression grammar ---------------------------------------------
 
 
@@ -756,5 +836,8 @@ def test_tree_suppressions_stay_bounded():
     here and forces a review of this test."""
     findings = run([PKG], config=LintConfig.default())
     suppressed = [f for f in findings if f.suppressed]
-    assert len(suppressed) <= 2, text_report(findings,
+    # 1 serve-unpadded-batch (canonical pad-compute site) + 2 seeded
+    # quality-signal-dropped (precision-auto probe diagnostic, sharded
+    # single-pulsar path) — each carries its justification in place
+    assert len(suppressed) <= 3, text_report(findings,
                                              show_suppressed=True)
