@@ -1036,6 +1036,54 @@ def main():
                    f"{device_chaos_report['fleet_stolen_buckets']} "
                    "buckets stolen)")
 
+    # crash-recovery side metric: SIGKILL a real serving subprocess
+    # mid-flush at every journal/cache kill site, restart it, and
+    # assert the crash-safety contract — zero lost or duplicated
+    # committed requests, bit-identical replay vs the fault-free
+    # reference, and cold-start-to-first-result within 2x a warm
+    # refit off the persisted executable cache. Same posture as the
+    # other chaos stages: optional, daemon thread + join timeout,
+    # skip with PINT_TPU_BENCH_SKIP_KILLCHAOS=1.
+    kill_chaos_report = None
+
+    def _kill_chaos_stage():
+        nonlocal kill_chaos_report
+        try:
+            from pint_tpu.scripts.pint_serve_bench import \
+                run_kill_chaos
+
+            rep = run_kill_chaos()
+            kill_chaos_report = rep  # set LAST: completion marker
+        except Exception as e:
+            _stage(f"kill-chaos stage failed ({type(e).__name__}: "
+                   f"{e}); headline JSON unaffected")
+
+    kill_chaos_wedged = False
+    if os.environ.get("PINT_TPU_BENCH_SKIP_KILLCHAOS") == "1":
+        _stage("kill-chaos stage skipped "
+               "(PINT_TPU_BENCH_SKIP_KILLCHAOS=1)")
+    else:
+        _stage("kill-chaos: SIGKILL serving subprocess mid-flush at "
+               "each kill site, restart, assert exactly-once replay")
+        tk = threading.Thread(target=_kill_chaos_stage, daemon=True)
+        tk.start()
+        tk.join(timeout=900)
+        kill_chaos_wedged = tk.is_alive()
+        if kill_chaos_wedged:
+            kill_chaos_report = None  # snapshot: no late-finish race
+            _stage("kill-chaos stage timed out; headline JSON "
+                   "unaffected")
+        elif kill_chaos_report is not None:
+            _stage(f"kill-chaos: ok={kill_chaos_report['ok']} "
+                   f"({kill_chaos_report['n_sites']} sites, lost "
+                   f"{kill_chaos_report.get('lost')}, duplicated "
+                   f"{kill_chaos_report.get('duplicated')}, "
+                   f"cold/warm "
+                   f"{kill_chaos_report.get('cold_vs_warm_ratio')})")
+            if not kill_chaos_report["ok"]:
+                _stage("kill-chaos: CONTRACT VIOLATED — committed "
+                       "results must survive SIGKILL exactly once")
+
     # fleet-pipeline side metric: a mixed-structure fleet (3 model
     # structures x 2 TOA buckets) through fleet_pipeline_metrics —
     # cold concurrent-vs-serial compile and warm pipelined-vs-
@@ -1432,6 +1480,26 @@ def main():
         "chaos_device_fleet_rel_diff": (
             device_chaos_report["fleet_max_rel_diff_vs_healthy"]
             if device_chaos_report else None),
+        "chaos_kill_ok": (kill_chaos_report["ok"]
+                          if kill_chaos_report else None),
+        "chaos_kill_sites": (kill_chaos_report["n_sites"]
+                             if kill_chaos_report else None),
+        "chaos_kill_lost": (kill_chaos_report.get("lost")
+                            if kill_chaos_report else None),
+        "chaos_kill_duplicated": (
+            kill_chaos_report.get("duplicated")
+            if kill_chaos_report else None),
+        "chaos_kill_replayed": (kill_chaos_report.get("replayed")
+                                if kill_chaos_report else None),
+        "chaos_kill_digest_mismatches": (
+            kill_chaos_report.get("digest_mismatches")
+            if kill_chaos_report else None),
+        "chaos_kill_cold_vs_warm_ratio": (
+            kill_chaos_report.get("cold_vs_warm_ratio")
+            if kill_chaos_report else None),
+        "cold_start_recovered_s": (
+            kill_chaos_report.get("cold_start_recovered_s")
+            if kill_chaos_report else None),
         "fleet_compile_serial_s": (fleet_report["fleet_compile_serial_s"]
                                    if fleet_report else None),
         "fleet_compile_concurrent_s": (
@@ -1508,9 +1576,12 @@ def main():
          [k for k in meta if k.startswith("serve_")]),
         ("PINT_TPU_BENCH_SKIP_CHAOS", chaos_report,
          [k for k in meta if k.startswith("chaos_")
-          and not k.startswith("chaos_device_")]),
+          and not k.startswith(("chaos_device_", "chaos_kill_"))]),
         ("PINT_TPU_BENCH_SKIP_CHAOS", device_chaos_report,
          [k for k in meta if k.startswith("chaos_device_")]),
+        ("PINT_TPU_BENCH_SKIP_KILLCHAOS", kill_chaos_report,
+         [k for k in meta if k.startswith("chaos_kill_")]
+         + ["cold_start_recovered_s"]),
         ("PINT_TPU_BENCH_SKIP_FLEET", fleet_report,
          [k for k in meta if k.startswith("fleet_")]),
         ("PINT_TPU_BENCH_SKIP_OBS", obs_report,

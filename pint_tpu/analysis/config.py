@@ -176,6 +176,21 @@ OBS_RAW_TIMER_CALLS = frozenset({
 # drive fake clocks on purpose.
 OBS_ALLOWED_PATH_MARKERS = ("/obs/", "/tests/", "/test_")
 
+# -- durable-artifact write discipline --------------------------------
+
+# Modules (normalized "/"-prefixed path suffixes) that own
+# crash-surviving artifacts: checkpoint snapshots, the write-ahead
+# request journal, the persisted executable cache, flight-recorder
+# dumps. Truncating open() there must go through pint_tpu.durable's
+# atomic writers — a crash mid-`open(path, "w")` tears the previous
+# good artifact, the exact loss these modules exist to prevent.
+# pint_tpu/durable.py itself is NOT listed: its temp-file write IS
+# the atomic implementation.
+DURABLE_ARTIFACT_MODULES = (
+    "/checkpoint.py", "/obs/recorder.py", "/serve/journal.py",
+    "/serve/excache.py",
+)
+
 # -- budget coverage ---------------------------------------------------
 
 # Modules (normalized "/"-prefixed path suffixes) whose measured_*/
@@ -230,6 +245,7 @@ class LintConfig:
     obs_instrumented_modules: tuple = ()
     obs_raw_timer_calls: frozenset = OBS_RAW_TIMER_CALLS
     obs_allowed_path_markers: tuple = OBS_ALLOWED_PATH_MARKERS
+    durable_artifact_modules: tuple = ()
     budget_meta_modules: tuple = ()
     budgeted_meta_keys: frozenset = None  # None -> rule is inert
     quality_signal_modules: tuple = ()
@@ -253,6 +269,7 @@ class LintConfig:
                    serve_pad_modules=SERVE_PAD_MODULES,
                    bucket_allowed_modules=BUCKET_ALLOWED_MODULES,
                    obs_instrumented_modules=OBS_INSTRUMENTED_MODULES,
+                   durable_artifact_modules=DURABLE_ARTIFACT_MODULES,
                    budget_meta_modules=BUDGET_META_MODULES,
                    budgeted_meta_keys=budgeted,
                    quality_signal_modules=QUALITY_SIGNAL_MODULES)

@@ -8,13 +8,19 @@ iterations, with a plain-npz fallback, so a preempted multi-hour PTA
 run resumes instead of restarting.)
 
 Integrity: every save records a CRC32 over the packed numeric arrays
-(key + dtype + shape + raw bytes, keys sorted) in the JSON sidecar,
-and every save first rotates the existing snapshot to ``<tag>.prev``.
-restore() verifies the checksum and, when the latest snapshot is
-unreadable or fails verification, falls back to the rotated previous
-one — a torn write at preemption time costs one checkpoint interval,
-not the whole run. Snapshots written before this scheme (no checksum
-record) restore as before.
+(key + dtype + shape + raw bytes, keys sorted) EMBEDDED in the
+snapshot itself (a ``__meta_json__`` uint8 array riding the saved
+tree), and every save first rotates the existing snapshot to
+``<tag>.prev``. Embedding makes a snapshot ONE artifact — one npz
+file (written through durable.atomic_write_bytes) or one orbax
+directory — so the rotation is a single ``os.replace`` and a process
+kill can never leave ``.prev`` mixing a sidecar from one generation
+with data from another. restore() verifies the checksum and, when
+the latest snapshot is unreadable or fails verification, falls back
+to the rotated previous one — a torn write at preemption time costs
+one checkpoint interval, not the whole run. Snapshots written before
+this scheme (sidecar ``.meta.json``, or no checksum record at all)
+restore as before.
 """
 
 from __future__ import annotations
@@ -26,11 +32,16 @@ import zlib
 
 import numpy as np
 
+from .durable import atomic_write_bytes
 from .resilience import faultinject
 
-# reserved sidecar key carrying the snapshot checksum (never a state
+# reserved meta key carrying the snapshot checksum (never a state
 # key: save() would have stringified it)
 INTEGRITY_KEY = "__integrity__"
+# reserved tree key carrying the JSON-encoded meta (string-valued
+# state + the integrity record) as a uint8 array, so the whole
+# snapshot — data AND checksum — is one atomic write unit
+META_EMBED_KEY = "__meta_json__"
 
 
 def _integrity_crc(numeric: dict) -> int:
@@ -77,26 +88,36 @@ class FitCheckpointer:
     def _rotate(self, tag):
         """Move the current snapshot of ``tag`` (all backends' files)
         to ``<tag>.prev``, replacing any older .prev — the fallback
-        restore() reaches for when the latest snapshot is damaged."""
+        restore() reaches for when the latest snapshot is damaged.
+
+        The old .prev is cleared as a UNIT before anything moves: a
+        kill mid-rotation must never leave .prev mixing generations
+        (a stale legacy sidecar next to newer data would fail the CRC
+        check and poison the fallback). New-style snapshots are a
+        single artifact, so their rotation is one atomic
+        ``os.replace``; the multi-file window only ever applies to
+        legacy sidecar snapshots."""
         prev = f"{tag}.prev"
         for suffix in ("", ".npz", ".meta.json"):
-            src = self._path(tag) + suffix
             dst = self._path(prev) + suffix
-            present = (os.path.isdir(src) if suffix == ""
-                       else os.path.exists(src))
-            if not present:
-                continue
             if os.path.isdir(dst):
                 shutil.rmtree(dst)
             elif os.path.exists(dst):
                 os.remove(dst)
-            os.replace(src, dst)
+        for suffix in ("", ".npz", ".meta.json"):
+            src = self._path(tag) + suffix
+            present = (os.path.isdir(src) if suffix == ""
+                       else os.path.exists(src))
+            if present:
+                os.replace(src, self._path(prev) + suffix)
 
     def save(self, tag, state: dict):
         """state: dict of arrays/scalars (e.g. {"x": ..., "iter": i,
-        "chi2": ...}). String-valued entries (parameter names) go to a
-        JSON sidecar — orbax/tensorstore has no string dtype. The
-        sidecar also records the CRC32 of the numeric arrays."""
+        "chi2": ...}). String-valued entries (parameter names) ride a
+        JSON-encoded uint8 array inside the saved tree —
+        orbax/tensorstore has no string dtype — alongside the CRC32
+        of the numeric arrays, so the snapshot is one atomic unit
+        rather than a data file plus a sidecar that can tear apart."""
         import json
 
         state = {k: np.asarray(v) for k, v in state.items()}
@@ -104,25 +125,26 @@ class FitCheckpointer:
                 if np.asarray(v).dtype.kind in "US"}
         numeric = {k: v for k, v in state.items()
                    if np.asarray(v).dtype.kind not in "US"}
-        self._rotate(tag)
         meta[INTEGRITY_KEY] = _integrity_crc(numeric)
-        meta_path = self._path(tag) + ".meta.json"
-        tmp = meta_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(meta, f)
-        os.replace(tmp, meta_path)
+        tree = dict(numeric)
+        tree[META_EMBED_KEY] = np.frombuffer(
+            json.dumps(meta, sort_keys=True).encode(),
+            dtype=np.uint8).copy()
+        self._rotate(tag)
         if self._ocp is not None:
             import jax
 
             path = os.path.abspath(self._path(tag))
             ckptr = self._ocp.PyTreeCheckpointer()
-            ckptr.save(path, jax.tree_util.tree_map(np.asarray, numeric),
+            ckptr.save(path, jax.tree_util.tree_map(np.asarray, tree),
                        force=True)
         else:
+            import io
+
             path = self._path(tag) + ".npz"
-            tmp = path + ".tmp.npz"
-            np.savez(tmp, **numeric)
-            os.replace(tmp, path)
+            buf = io.BytesIO()
+            np.savez(buf, **tree)
+            atomic_write_bytes(path, buf.getvalue())
         fault = faultinject.fire("checkpoint_corrupt", tag=str(tag))
         if fault:
             self._corrupt_snapshot(tag)
@@ -178,6 +200,20 @@ class FitCheckpointer:
         if out is None:
             return None, None
         crc = None
+        embedded = out.pop(META_EMBED_KEY, None)
+        if embedded is not None:
+            # new-style snapshot: meta + CRC ride inside the tree.
+            # An unreadable embedded record means the snapshot is
+            # damaged as a whole (it was written as one unit), so it
+            # counts as 'no snapshot here', not 'restore unverified'.
+            try:
+                meta = json.loads(np.asarray(embedded, dtype=np.uint8)
+                                  .tobytes().decode())
+                crc = meta.pop(INTEGRITY_KEY, None)
+                out.update({k: np.asarray(v) for k, v in meta.items()})
+            except (ValueError, UnicodeDecodeError):
+                return None, None
+            return out, crc
         meta_path = self._path(tag) + ".meta.json"
         if os.path.exists(meta_path):
             try:

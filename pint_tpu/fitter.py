@@ -507,6 +507,70 @@ def aot_backend_compile(lowered, label=None):
             "bound": attr["bound"]}
 
 
+def aot_serialize(compiled):
+    """Serialize an AOT-compiled executable to a picklable payload
+    dict, or None when ``compiled`` is not a serializable
+    jax.stages.Compiled (plain jit wrappers, platforms without
+    executable serialization).
+
+    Third stage of the AOT split (lower -> backend-compile ->
+    serialize): the payload is what the persisted executable cache
+    writes to disk so a fresh process skips the backend compile
+    entirely. The inverse is :func:`aot_deserialize`."""
+    import jax
+
+    if not isinstance(compiled, jax.stages.Compiled):
+        return None
+    try:
+        from jax.experimental import serialize_executable
+        payload, in_tree, out_tree = serialize_executable.serialize(
+            compiled)
+    except Exception:
+        return None
+    return {"payload": payload, "in_tree": in_tree,
+            "out_tree": out_tree}
+
+
+_DESERIALIZE_PRIMED = False
+
+
+def _prime_custom_call_handlers():
+    """Force jaxlib's lazy LAPACK FFI handler registration before any
+    deserialized executable runs.
+
+    A deserialized XLA:CPU executable calls its linalg custom-call
+    targets (lapack_*_ffi) by name through the FFI registry, but
+    jaxlib only registers that handler family when a linalg op is
+    COMPILED in the process. A fresh process that skips its compiles
+    via the persisted executable cache — the entire point of the
+    cache — would call an unregistered target and die with SIGSEGV,
+    not a catchable error. One throwaway 2x2 cholesky compile
+    (milliseconds, once per process) registers the whole family."""
+    global _DESERIALIZE_PRIMED
+    if _DESERIALIZE_PRIMED:
+        return
+    import jax
+    import jax.numpy as jnp
+
+    jax.jit(jnp.linalg.cholesky).lower(jnp.eye(2)).compile()
+    _DESERIALIZE_PRIMED = True
+
+
+def aot_deserialize(doc):
+    """Rehydrate an executable from :func:`aot_serialize`'s payload.
+    Returns a callable jax.stages.Compiled; raises on any mismatch
+    (wrong platform, incompatible jax) — callers treat that as a
+    cache miss and recompile."""
+    from .obs import trace as obs_trace
+
+    from jax.experimental import serialize_executable
+
+    with obs_trace.span("aot.deserialize"):
+        _prime_custom_call_handlers()
+        return serialize_executable.deserialize_and_load(
+            doc["payload"], doc["in_tree"], doc["out_tree"])
+
+
 def gls_gram(Mn, q, precision="f64"):
     """Normal-equation matrix A = Mn^T Mn + diag(q^2) at the requested
     Gram precision.

@@ -246,6 +246,50 @@ class FitQualityLedger:
             self.max_condition = None
             self.max_relres = None
 
+    # -- checkpointable state -----------------------------------------
+
+    STATE_KIND = "FitQualityLedger"
+    STATE_VERSION = 1
+
+    def state_dict(self):
+        """Versioned JSON-safe restartable state: the cumulative
+        counters, worst-case aggregates, and latest per-pulsar probes
+        — everything a recovered serving process needs so its quality
+        SLOs and dashboards resume instead of forgetting history."""
+        with self._lock:
+            return {"kind": self.STATE_KIND,
+                    "version": self.STATE_VERSION,
+                    "counters": {"fits": self.fits,
+                                 "fallbacks": self.fallbacks,
+                                 "diverged": self.diverged,
+                                 "drift_alarms": self.drift_alarms},
+                    "probe_wall_s": self.probe_wall_s,
+                    "max_abs_chi2_z": self.max_abs_chi2_z,
+                    "max_condition": self.max_condition,
+                    "max_relres": self.max_relres,
+                    "pulsars": {k: dict(v)
+                                for k, v in self._pulsars.items()}}
+
+    def load_state_dict(self, state):
+        if (state.get("kind") != self.STATE_KIND
+                or state.get("version") != self.STATE_VERSION):
+            raise ValueError(
+                "not a %s v%d state: %r" % (
+                    self.STATE_KIND, self.STATE_VERSION,
+                    {k: state.get(k) for k in ("kind", "version")}))
+        counters = state.get("counters", {})
+        with self._lock:
+            self._pulsars = {str(k): dict(v)
+                             for k, v in state.get("pulsars", {}).items()}
+            self.fits = int(counters.get("fits", 0))
+            self.fallbacks = int(counters.get("fallbacks", 0))
+            self.diverged = int(counters.get("diverged", 0))
+            self.drift_alarms = int(counters.get("drift_alarms", 0))
+            self.probe_wall_s = float(state.get("probe_wall_s", 0.0))
+            self.max_abs_chi2_z = state.get("max_abs_chi2_z")
+            self.max_condition = state.get("max_condition")
+            self.max_relres = state.get("max_relres")
+
 
 FITQ = FitQualityLedger()
 

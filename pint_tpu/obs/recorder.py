@@ -93,10 +93,13 @@ class FlightRecorder:
             "events": events,
             "metrics": metricsreg.REGISTRY.snapshot(),
         }
+        from ..durable import atomic_write_text
+
         os.makedirs(ddir, exist_ok=True)
         path = os.path.join(ddir, "flight_%03d_%s.json" % (seq, reason))
-        with open(path, "w") as fh:
-            json.dump(doc, fh, indent=1, default=str)
+        # atomic publish: a flight dump is written BECAUSE something
+        # is going wrong — a half-written post-mortem is worthless
+        atomic_write_text(path, json.dumps(doc, indent=1, default=str))
         with self._lock:
             self.dumps.append(path)
         self._rotate(ddir)

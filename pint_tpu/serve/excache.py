@@ -14,14 +14,31 @@ the same table). On a miss the new batch's own table is inserted and
 whatever it compiles becomes warm for the next same-signature flush —
 including programs compiled later through the same table, e.g. the
 f64 fallback a degraded mixed fit adds.
+
+The optional persistent layer (:class:`PersistentExecutableCache`)
+extends the same signatures to disk: AOT-compiled programs are
+serialized (fitter.aot_serialize) into CRC-checked, identity-stamped
+files, so a FRESH PROCESS reaches first-result without paying the
+backend compile — the ROADMAP's "kill the host: zero cold-start"
+contract. Any mismatch (CRC, format version, platform, jax version,
+key) warns and recompiles; a corrupt cache can cost time, never
+correctness.
 """
 
 from __future__ import annotations
 
+import hashlib
+import os
+import pickle
+import struct
 import threading
+import warnings
+import zlib
 from collections import OrderedDict
 
+from ..durable import atomic_write_bytes
 from ..obs import trace as obs_trace
+from ..resilience import faultinject
 
 
 class ExecutableCache:
@@ -30,16 +47,18 @@ class ExecutableCache:
     LRU map and its counters holds ``_lock`` (an RLock — prefill
     re-enters through insert)."""
 
-    def __init__(self, capacity=32):
+    def __init__(self, capacity=32, persistent=None):
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
         self.capacity = int(capacity)
         self._lock = threading.RLock()
         self._entries = OrderedDict()  # key -> shared _fns table
+        self.persistent = persistent  # PersistentExecutableCache or None
         self.hits = 0
         self.misses = 0
         self.evictions = 0
         self.prefilled = 0
+        self.disk_hits = 0
 
     def __len__(self):
         with self._lock:
@@ -57,6 +76,15 @@ class ExecutableCache:
                 fns = self._entries.get(key)
                 if fns is None:
                     self.misses += 1
+                    if self.persistent is not None:
+                        fns = self.persistent.load(key)
+                        if fns is not None:
+                            # rehydrated from disk: adopt into the LRU
+                            # without re-persisting what we just read
+                            self.disk_hits += 1
+                            self.insert(key, fns, persist=False)
+                            sp.set(outcome="disk_hit")
+                            return fns
                     sp.set(outcome="miss")
                     return None
                 self._entries.move_to_end(key)
@@ -64,12 +92,13 @@ class ExecutableCache:
                 sp.set(outcome="hit")
                 return fns
 
-    def insert(self, key, fns):
+    def insert(self, key, fns, persist=True):
         """Insert (or refresh) an executable table, evicting
         least-recently-used entries over capacity. Dropping an entry
         drops the only strong reference to its compiled programs, so
         evicted XLA executables are actually freed, not just
-        forgotten."""
+        forgotten. Writes through to the persistent layer (when one is
+        attached) so the programs survive the process."""
         with obs_trace.span("excache.insert", key=key):
             with self._lock:
                 self._entries[key] = fns
@@ -77,6 +106,8 @@ class ExecutableCache:
                 while len(self._entries) > self.capacity:
                     self._entries.popitem(last=False)
                     self.evictions += 1
+                if persist and self.persistent is not None:
+                    self.persistent.store(key, fns)
 
     def prefill(self, entries):
         """Warm-start bulk insert of (key, fns) pairs —
@@ -100,8 +131,284 @@ class ExecutableCache:
     def counters(self):
         with self._lock:
             total = self.hits + self.misses
-            return {"hits": self.hits, "misses": self.misses,
-                    "evictions": self.evictions,
-                    "size": len(self._entries),
-                    "prefilled": self.prefilled,
-                    "hit_rate": (self.hits / total) if total else None}
+            out = {"hits": self.hits, "misses": self.misses,
+                   "evictions": self.evictions,
+                   "size": len(self._entries),
+                   "prefilled": self.prefilled,
+                   "disk_hits": self.disk_hits,
+                   "hit_rate": (self.hits / total) if total else None}
+            if self.persistent is not None:
+                out["disk"] = self.persistent.counters()
+            return out
+
+
+# -- persistent layer --------------------------------------------------
+
+PERSIST_MAGIC = b"PTEX"
+PERSIST_FORMAT_VERSION = 1
+_PERSIST_HEADER = struct.Struct("<II")  # payload length, crc32
+
+
+class PersistentExecutableCache:
+    """Disk cache of serialized AOT executables, one identity-stamped
+    file per executable signature.
+
+    File format mirrors the journal's framing: ``PTEX | u32 len |
+    u32 crc32 | payload`` where the payload is a pickled document
+    {"identity": {...}, "programs": {program_key: aot_serialize doc}}.
+    The identity embeds the repr of the cache key, the backend
+    platform, the jax version, and the format version — any mismatch
+    is a STALE executable (the ``executable_cache_corrupt`` fault
+    injects the bitrot case), handled by warn + delete + recompile.
+    Only jax.stages.Compiled entries persist; plain jit wrappers
+    (resid/phase tables) are skipped and lazily recompiled, which is
+    cheap — the fit programs carry the 20 s+ compile ladder.
+    """
+
+    def __init__(self, directory):
+        self.directory = os.fspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._lock = threading.RLock()
+        self._prewarmed = {}  # path -> deserialized fns table
+        self._prewarm_thread = None
+        self.stores = 0
+        self.loads = 0
+        self.load_misses = 0
+        self.corrupt = 0
+        self.stale = 0
+        self.prewarm_hits = 0
+
+    def identity(self, key):
+        import jax
+
+        return {"format": PERSIST_FORMAT_VERSION,
+                "key_repr": repr(key),
+                "platform": jax.default_backend(),
+                "jax_version": jax.__version__}
+
+    def _path(self, key):
+        ident = self.identity(key)
+        digest = hashlib.sha256(
+            "|".join(str(ident[k]) for k in sorted(ident))
+            .encode()).hexdigest()[:32]
+        return os.path.join(self.directory, digest + ".pex")
+
+    def store(self, key, fns):
+        """Serialize every AOT-compiled program of ``fns`` to disk
+        atomically; returns the number of programs persisted (0 means
+        nothing serializable — no file is written)."""
+        from .. import fitter
+
+        programs = {}
+        for prog_key, fn in fns.items():
+            doc = fitter.aot_serialize(fn)
+            if doc is not None:
+                programs[prog_key] = doc
+        if not programs:
+            return 0
+        with obs_trace.span("excache.persist_store", key=key,
+                            programs=len(programs)):
+            payload = pickle.dumps(
+                {"identity": self.identity(key), "programs": programs})
+            blob = PERSIST_MAGIC + _PERSIST_HEADER.pack(
+                len(payload), zlib.crc32(payload)) + payload
+            path = self._path(key)
+            with self._lock:
+                # die before the atomic publish: the entry is simply
+                # absent on recovery and gets recompiled
+                faultinject.fire_kill("excache_store", key=repr(key))
+                atomic_write_bytes(path, blob)
+                self.stores += 1
+                hit = faultinject.fire("executable_cache_corrupt",
+                                       key=repr(key))
+                if hit is not None:
+                    self._damage(path, int(hit.get("offset", 0)))
+        return len(programs)
+
+    def _damage(self, path, offset=0):
+        """Flip one payload byte in place (fault-injection helper) —
+        the on-disk bitrot the CRC exists to catch."""
+        size = os.path.getsize(path)
+        pos = (len(PERSIST_MAGIC) + _PERSIST_HEADER.size
+               + offset) % max(size, 1)
+        with open(path, "r+b") as fh:
+            fh.seek(pos)
+            byte = fh.read(1)
+            fh.seek(pos)
+            fh.write(bytes([byte[0] ^ 0xFF]))
+
+    def prewarm(self, background=True):
+        """Start deserializing every persisted executable into a
+        staging map BEFORE the first lookup needs one. XLA's
+        deserialize cost is a fixed per-program tax (~0.5 s for a GLS
+        fit table) that would otherwise sit on the cold-start critical
+        path; run on a background thread it overlaps the restart work
+        a fresh process does anyway (journal scan, state restore,
+        request intake, input packing). ``load`` joins the worker
+        before consulting disk, so a half-finished prewarm is never
+        raced — the first lookup pays only whatever tax is left.
+
+        No-op (returns None) when the directory holds no entries;
+        otherwise returns the worker thread (already-finished work is
+        not redone). ``background=False`` runs inline, for tests."""
+        with self._lock:
+            t = self._prewarm_thread
+            if t is not None and t.is_alive():
+                return t
+            try:
+                names = sorted(n for n in os.listdir(self.directory)
+                               if n.endswith(".pex"))
+            except OSError:
+                names = []
+            if not names:
+                return None
+
+        def work():
+            from .. import fitter
+
+            for name in names:
+                path = os.path.join(self.directory, name)
+                with self._lock:
+                    if path in self._prewarmed:
+                        continue
+                try:
+                    with open(path, "rb") as fh:
+                        blob = fh.read()
+                except OSError:
+                    continue
+                doc = self._decode(path, blob)
+                if doc is None:
+                    continue
+                fns = {}
+                for prog_key, prog_doc in doc["programs"].items():
+                    try:
+                        fns[prog_key] = fitter.aot_deserialize(prog_doc)
+                    except Exception as e:
+                        self._discard(path, "executable failed to "
+                                      f"deserialize ({e!r})")
+                        fns = None
+                        break
+                if fns is not None:
+                    with self._lock:
+                        self._prewarmed[path] = fns
+
+        if not background:
+            work()
+            return None
+        t = threading.Thread(target=work, name="pex-prewarm",
+                             daemon=True)
+        self._prewarm_thread = t
+        t.start()
+        return t
+
+    def _join_prewarm(self):
+        # taken WITHOUT self._lock held: the worker needs the lock to
+        # publish its entries
+        t = self._prewarm_thread
+        if t is not None and t.is_alive():
+            t.join()
+
+    def load(self, key):
+        """Rehydrate the program table for ``key`` from disk, or None.
+        Every failure mode — missing file, bad magic/CRC, stale
+        identity, undeserializable program — warns (except the plain
+        miss) and returns None so the caller recompiles."""
+        path = self._path(key)
+        with obs_trace.span("excache.persist_load", key=key) as sp:
+            self._join_prewarm()
+            with self._lock:
+                fns = self._prewarmed.pop(path, None)
+                if fns is not None:
+                    self.loads += 1
+                    self.prewarm_hits += 1
+                    sp.set(outcome="prewarm_hit", programs=len(fns))
+                    return fns
+            with self._lock:
+                self.loads += 1
+                try:
+                    with open(path, "rb") as fh:
+                        blob = fh.read()
+                except FileNotFoundError:
+                    self.load_misses += 1
+                    sp.set(outcome="absent")
+                    return None
+                doc = self._decode(path, blob)
+                if doc is None:
+                    sp.set(outcome="corrupt")
+                    return None
+            fns = {}
+            from .. import fitter
+
+            for prog_key, prog_doc in doc["programs"].items():
+                try:
+                    fns[prog_key] = fitter.aot_deserialize(prog_doc)
+                except Exception as e:
+                    self._discard(
+                        path, f"executable failed to deserialize "
+                        f"({e!r})")
+                    sp.set(outcome="stale")
+                    return None
+            sp.set(outcome="hit", programs=len(fns))
+            return fns
+
+    def _decode(self, path, blob):
+        head = len(PERSIST_MAGIC) + _PERSIST_HEADER.size
+        if blob[:len(PERSIST_MAGIC)] != PERSIST_MAGIC or len(blob) < head:
+            self._discard(path, "bad magic/truncated header")
+            return None
+        length, crc = _PERSIST_HEADER.unpack(
+            blob[len(PERSIST_MAGIC):head])
+        payload = blob[head:head + length]
+        if len(payload) < length or zlib.crc32(payload) != crc:
+            self._discard(path, "CRC mismatch")
+            return None
+        try:
+            doc = pickle.loads(payload)
+        except Exception as e:
+            self._discard(path, f"undecodable payload ({e!r})")
+            return None
+        # identity re-derived locally: the sha-keyed filename already
+        # partitions on it, but an adversarially-renamed or stale file
+        # must still be refused explicitly
+        expect = None
+        try:
+            ident = doc.get("identity", {})
+            expect = {k: ident.get(k) for k in
+                      ("format", "platform", "jax_version")}
+        except AttributeError:
+            self._discard(path, "malformed document")
+            return None
+        import jax
+
+        want = {"format": PERSIST_FORMAT_VERSION,
+                "platform": jax.default_backend(),
+                "jax_version": jax.__version__}
+        if expect != want:
+            self.stale += 1
+            warnings.warn(
+                f"persisted executable {os.path.basename(path)} is "
+                f"stale ({expect} != {want}); recompiling")
+            self._remove(path)
+            return None
+        return doc
+
+    def _discard(self, path, why):
+        self.corrupt += 1
+        warnings.warn(
+            f"persisted executable {os.path.basename(path)} unusable "
+            f"({why}); deleting and recompiling")
+        self._remove(path)
+
+    @staticmethod
+    def _remove(path):
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+    def counters(self):
+        with self._lock:
+            return {"stores": self.stores, "loads": self.loads,
+                    "load_misses": self.load_misses,
+                    "corrupt": self.corrupt, "stale": self.stale,
+                    "prewarm_hits": self.prewarm_hits}

@@ -21,6 +21,19 @@ def _next_id():
     return f"req-{next(_ids)}"
 
 
+def ensure_request_counter_above(n):
+    """Advance the process-wide request-id counter past ``n``.
+
+    Crash recovery replays requests that carry ids minted by a DEAD
+    process; without this, fresh requests created in the recovered
+    process would restart at req-0 and collide with replayed ids in
+    the journal. ServeEngine.recover calls this with the highest id
+    it saw in the log."""
+    global _ids
+    current = next(_ids)
+    _ids = itertools.count(max(current, int(n) + 1))
+
+
 @dataclass
 class TimingRequest:
     """Base request: a (model, toas) pair plus the service contract.

@@ -1,0 +1,405 @@
+"""Crash-safety suite for the durable serving stack (ISSUE 10).
+
+Covers the layers bottom-up: the shared atomic-write helpers
+(pint_tpu.durable), the CRC-framed write-ahead request journal and its
+torn-tail recovery, the persisted executable cache's corrupt/stale
+degrade paths, single-artifact checkpoint rotation, the unified
+save/restore_serve_state snapshot, in-process replay idempotence
+(committed results never re-emitted, pending requests re-run
+bit-identically) — and, as the acceptance capstone, a real SIGKILL
+matrix: a serving subprocess is killed mid-flush at EVERY named kill
+site in faultinject.KILL_SITES, restarted, and recovery is asserted to
+lose nothing, duplicate nothing, and replay bit-identically against a
+fault-free reference run.
+"""
+
+import os
+import pickle
+import struct
+import types
+import warnings
+import zlib
+
+import numpy as np
+import pytest
+
+warnings.simplefilter("ignore")
+
+from pint_tpu.durable import (atomic_replace, atomic_write_bytes,
+                              atomic_write_json, atomic_write_text,
+                              fsync_dir)
+from pint_tpu.checkpoint import FitCheckpointer
+from pint_tpu.models import get_model
+from pint_tpu.resilience import FaultPoint, disarm, inject
+from pint_tpu.resilience.faultinject import KILL_SITES
+from pint_tpu.serve import (FitRequest, PersistentExecutableCache,
+                            RequestJournal, ServeEngine,
+                            restore_serve_state, result_digest,
+                            save_serve_state)
+from pint_tpu.serve import journal as journal_mod
+from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+PAR = """
+PSR CRSH{i}
+RAJ 10:0{i}:00.0
+DECJ 8:30:00.0
+F0 31{i}.25 1
+F1 -2e-16 1
+PEPOCH 55500
+DM 13.{i} 1
+"""
+
+
+def _pulsar(i=0, n_toa=24, seed=0):
+    m = get_model(PAR.format(i=i))
+    rng = np.random.default_rng(seed + i)
+    mjds = np.sort(rng.uniform(54500, 56500, n_toa))
+    t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=1400.0,
+                                obs="gbt", add_noise=True, seed=seed + i,
+                                iterations=0)
+    return m, t
+
+
+@pytest.fixture(scope="module")
+def pulsar():
+    return _pulsar(0, 24)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_everything():
+    yield
+    disarm()
+
+
+def _req(rid):
+    """A picklable stand-in request for journal-only tests."""
+    return types.SimpleNamespace(request_id=rid)
+
+
+# -- atomic write helpers --------------------------------------------
+
+
+def test_atomic_write_bytes_publishes_whole_or_nothing(tmp_path):
+    dst = tmp_path / "artifact.bin"
+    assert atomic_write_bytes(dst, b"generation-1") == str(dst)
+    assert dst.read_bytes() == b"generation-1"
+    atomic_write_bytes(dst, b"generation-2")
+    assert dst.read_bytes() == b"generation-2"
+    # a failed write never touches the destination and leaves no temp
+    with pytest.raises(TypeError):
+        atomic_write_bytes(dst, "not-bytes")
+    assert dst.read_bytes() == b"generation-2"
+    assert [p.name for p in tmp_path.iterdir()] == ["artifact.bin"]
+
+
+def test_atomic_write_text_and_json(tmp_path):
+    t = tmp_path / "note.txt"
+    atomic_write_text(t, "héllo")
+    assert t.read_text(encoding="utf-8") == "héllo"
+    j = tmp_path / "doc.json"
+    atomic_write_json(j, {"a": [1, 2]}, sort_keys=True)
+    assert j.read_text() == '{"a": [1, 2]}'
+
+
+def test_atomic_replace_rotation(tmp_path):
+    new = tmp_path / "snap.new"
+    cur = tmp_path / "snap"
+    cur.write_bytes(b"old")
+    new.write_bytes(b"new")
+    atomic_replace(new, cur)
+    assert cur.read_bytes() == b"new" and not new.exists()
+    fsync_dir(tmp_path)          # best-effort, must not raise
+    fsync_dir(tmp_path / "gone")  # missing dir degrades silently
+
+
+# -- request journal: framing, commit point, torn tails --------------
+
+
+def test_journal_commit_is_the_delivery_point(tmp_path):
+    j = RequestJournal(tmp_path)
+    j.record_intake(_req("a"))
+    j.record_intake(_req("b"))
+    j.record_commit("a", "ok", value={"x": 1.0})
+    j.close()
+
+    rep = RequestJournal(tmp_path).replay()
+    assert set(rep.committed) == {"a"}
+    assert rep.committed["a"]["value"] == {"x": 1.0}
+    assert [r["rid"] for r in rep.pending] == ["b"]
+    assert rep.torn_truncated == 0
+
+
+def test_journal_replay_dedups_reintaken_requests(tmp_path):
+    j = RequestJournal(tmp_path)
+    j.record_intake(_req("a"))
+    j.record_intake(_req("a"))  # a replayed request re-journals intake
+    j.close()
+    rep = RequestJournal(tmp_path).replay()
+    assert [r["rid"] for r in rep.pending] == ["a"]
+
+
+def test_journal_torn_tail_truncated_and_prefix_replays(tmp_path):
+    j = RequestJournal(tmp_path)
+    j.record_intake(_req("a"))
+    j.record_commit("a", "ok", value=None)
+    j.record_intake(_req("b"))
+    j.close()
+    good_size = os.path.getsize(j.path)
+    # a power cut mid-frame: half a valid frame's bytes land
+    payload = pickle.dumps({"t": "intake", "rid": "c"})
+    frame = (journal_mod.MAGIC
+             + struct.pack("<II", len(payload), zlib.crc32(payload))
+             + payload)
+    with open(j.path, "ab") as fh:
+        fh.write(frame[:len(frame) // 2])
+
+    j2 = RequestJournal(tmp_path)
+    with pytest.warns(UserWarning, match="torn"):
+        rep = j2.replay()
+    # the torn record was never acknowledged: dropping it is correct
+    assert rep.torn_truncated == len(frame) // 2
+    assert os.path.getsize(j2.path) == good_size
+    assert set(rep.committed) == {"a"}
+    assert [r["rid"] for r in rep.pending] == ["b"]
+    # the log is writable again after truncation
+    j2.record_intake(_req("d"))
+    j2.close()
+    rep2 = RequestJournal(tmp_path).replay()
+    assert [r["rid"] for r in rep2.pending] == ["b", "d"]
+
+
+def test_journal_torn_write_fault_point(tmp_path):
+    j = RequestJournal(tmp_path)
+    j.record_intake(_req("a"))
+    with inject(FaultPoint("journal_torn_write", count=1,
+                           payload={"frac": 0.4})):
+        j.record_intake(_req("torn"))  # only 40% of the frame lands
+    j.close()
+    with pytest.warns(UserWarning, match="torn"):
+        rep = RequestJournal(tmp_path).replay()
+    assert rep.torn_truncated > 0
+    assert [r["rid"] for r in rep.pending] == ["a"]
+
+
+# -- persisted executable cache: corrupt/stale degrade ---------------
+
+
+def _write_pex(pc, key, programs=None, identity=None):
+    """Hand-build a framed .pex file the way store() would."""
+    from pint_tpu.serve import excache as ex
+
+    payload = pickle.dumps({
+        "identity": identity if identity is not None else pc.identity(key),
+        "programs": programs or {}})
+    blob = (ex.PERSIST_MAGIC
+            + ex._PERSIST_HEADER.pack(len(payload), zlib.crc32(payload))
+            + payload)
+    path = pc._path(key)
+    atomic_write_bytes(path, blob)
+    return path
+
+
+def test_excache_bad_magic_warns_deletes_recompiles(tmp_path):
+    pc = PersistentExecutableCache(tmp_path)
+    path = pc._path("k")
+    atomic_write_bytes(path, b"JUNKJUNKJUNKJUNK")
+    with pytest.warns(UserWarning, match="unusable"):
+        assert pc.load("k") is None
+    assert not os.path.exists(path)  # deleted: next store starts clean
+    assert pc.counters()["corrupt"] == 1
+
+
+def test_excache_crc_mismatch_warns_and_degrades(tmp_path):
+    pc = PersistentExecutableCache(tmp_path)
+    path = _write_pex(pc, "k")
+    pc._damage(path)  # the on-disk bitrot the CRC exists to catch
+    with pytest.warns(UserWarning, match="CRC mismatch"):
+        assert pc.load("k") is None
+    assert not os.path.exists(path)
+    assert pc.counters()["corrupt"] == 1
+
+
+def test_excache_stale_identity_refused(tmp_path):
+    pc = PersistentExecutableCache(tmp_path)
+    ident = pc.identity("k")
+    ident["jax_version"] = "0.0.0"  # a build upgrade happened
+    path = _write_pex(pc, "k", identity=ident)
+    with pytest.warns(UserWarning, match="stale"):
+        assert pc.load("k") is None
+    assert not os.path.exists(path)
+    assert pc.counters()["stale"] == 1
+
+
+def test_excache_prewarm_discards_corrupt_survivors(tmp_path):
+    pc = PersistentExecutableCache(tmp_path)
+    good = _write_pex(pc, "good")
+    bad = _write_pex(pc, "bad")
+    pc._damage(bad)
+    with pytest.warns(UserWarning, match="CRC mismatch"):
+        pc.prewarm(background=False)
+    assert not os.path.exists(bad)
+    # the valid entry is staged and served as a prewarm hit
+    assert pc.load("good") == {}
+    assert pc.counters()["prewarm_hits"] == 1
+    assert good in pc._prewarmed or pc.counters()["loads"] == 1
+
+
+def test_excache_store_load_roundtrip_with_corrupt_fault(pulsar,
+                                                         tmp_path):
+    """End-to-end through the real fault point: compile a tiny AOT
+    program, persist it, let ``executable_cache_corrupt`` damage the
+    store, and watch the loader degrade to recompile — then a clean
+    store round-trips to live callables."""
+    from pint_tpu.parallel import PTABatch
+
+    m, t = pulsar
+    pta = PTABatch([m], [t])
+    pta.aot_compile("wls", maxiter=2)
+    fns = dict(pta._fns)
+
+    pc = PersistentExecutableCache(tmp_path / "damaged")
+    with inject(FaultPoint("executable_cache_corrupt")):
+        assert pc.store("k", fns) >= 1
+    with pytest.warns(UserWarning, match="CRC mismatch"):
+        assert pc.load("k") is None  # warn + recompile, never crash
+
+    pc2 = PersistentExecutableCache(tmp_path / "clean")
+    n = pc2.store("k", fns)
+    assert n >= 1
+    out = pc2.load("k")
+    assert out is not None and len(out) == n
+    for fn in out.values():
+        assert callable(fn)
+
+
+# -- checkpoint single-artifact snapshots ----------------------------
+
+
+def test_checkpoint_writes_one_artifact_no_sidecar(tmp_path):
+    ckpt = FitCheckpointer(tmp_path)
+    ckpt.save("fit", {"x": np.arange(4.0), "iter": 1})
+    names = sorted(p.name for p in tmp_path.iterdir())
+    assert not any(n.endswith(".meta.json") for n in names)
+    out = ckpt.restore("fit")
+    assert int(out["iter"]) == 1
+
+
+def test_checkpoint_rotation_clears_stale_legacy_prev(tmp_path):
+    # a pre-single-artifact layout left a sidecar next to fit.prev; the
+    # unit rotation must clear the WHOLE .prev generation before
+    # rotating, or a fallback restore could pair a new .prev.npz with
+    # a stale sidecar from another generation
+    stale = tmp_path / "fit.prev.meta.json"
+    stale.write_text("{}")
+    ckpt = FitCheckpointer(tmp_path)
+    ckpt.save("fit", {"x": np.arange(4.0), "iter": 1})
+    ckpt.save("fit", {"x": np.arange(4.0), "iter": 2})  # rotates
+    assert not stale.exists()
+    out = ckpt.restore("fit")
+    assert int(out["iter"]) == 2
+
+
+# -- unified serve-state snapshot ------------------------------------
+
+
+def test_serve_state_roundtrip_restores_policy(tmp_path):
+    eng = ServeEngine(max_batch=1, max_latency_s=1e9, bucket_floor=32,
+                      durable_dir=tmp_path)
+    eng.breaker.trip(("fit", 32, "gls"))
+    save_serve_state(eng)
+    eng.journal.close()
+
+    fresh = ServeEngine(max_batch=1, max_latency_s=1e9, bucket_floor=32,
+                        durable_dir=tmp_path)
+    restored = restore_serve_state(fresh, tmp_path)
+    assert restored is not None and "breaker" in restored
+    assert fresh.breaker.open_count() == 1
+    assert fresh.breaker.state(("fit", 32, "gls")) != "closed"
+    fresh.journal.close()
+
+
+def test_restore_serve_state_fresh_start_is_none(tmp_path):
+    eng = ServeEngine(max_batch=1, max_latency_s=1e9, bucket_floor=32)
+    assert restore_serve_state(eng, tmp_path) is None
+
+
+# -- in-process replay idempotence -----------------------------------
+
+
+def test_recover_replays_pending_bit_identically(pulsar, tmp_path):
+    m, t = pulsar
+
+    def req(rid):
+        return FitRequest(m, t, method="wls", maxiter=2,
+                          request_id=rid)
+
+    # fault-free reference digest for the pending request
+    ref_eng = ServeEngine(max_batch=1, max_latency_s=1e9,
+                          bucket_floor=32)
+    ref = ref_eng.run_stream([req("ref")])[0]
+    assert ref.status == "ok"
+    ref_digest = result_digest(ref.value)
+
+    # a dead process's journal: r0 committed (sentinel value so a
+    # re-run would be visible), r1 accepted but never delivered
+    ddir = tmp_path / "durable"
+    j = RequestJournal(ddir)
+    j.record_intake(req("r0"))
+    j.record_commit("r0", "ok", value={"marker": 1.0})
+    j.record_intake(req("r1"))
+    j.close()
+
+    eng = ServeEngine(max_batch=1, max_latency_s=1e9, bucket_floor=32,
+                      durable_dir=ddir)
+    rep = eng.recover()
+    # committed results come back from the journal, never the fit path
+    assert rep["n_committed"] == 1
+    assert rep["committed"]["r0"]["value"] == {"marker": 1.0}
+    # the pending request re-ran, bit-identically to the reference
+    assert rep["n_replayed"] == 1
+    replayed = rep["replayed"]["r1"]
+    assert replayed.status == "ok"
+    assert result_digest(replayed.value) == ref_digest
+
+    # idempotent: a second recover finds everything committed
+    rep2 = eng.recover()
+    assert rep2["n_replayed"] == 0
+    assert set(rep2["committed"]) == {"r0", "r1"}
+    eng.journal.close()
+
+
+# -- the acceptance capstone: SIGKILL at every named site ------------
+
+
+def test_sigkill_matrix_exactly_once(tmp_path):
+    """SIGKILL a real serving subprocess mid-flush at every named kill
+    site, restart it, and assert the exactly-once contract: no
+    journaled intake is lost, no committed result is re-delivered,
+    every replayed result matches the fault-free reference digest
+    bit-for-bit. The cold/warm latency SLO is exercised at bench scale
+    (bench.py kill-chaos stage); here the fixture is sized for CI so
+    only the correctness half is bounded."""
+    from pint_tpu.scripts.pint_serve_bench import run_kill_chaos
+
+    report = run_kill_chaos(
+        sites=KILL_SITES, ntoa=128, lanes=2, maxiter=2, method="wls",
+        structure=0, seed=3, workdir=str(tmp_path),
+        ratio_bound=float("inf"), child_timeout_s=300.0)
+    assert report["reference_ok"], report
+    assert set(report["sites"]) == set(KILL_SITES)
+    for site, entry in report["sites"].items():
+        assert entry["killed"], (site, entry)       # SIGKILL landed
+        assert entry["ok"], (site, entry)
+        assert entry["lost"] == 0 and entry["duplicated"] == 0
+        assert entry["digest_mismatches"] == 0
+        if site == "excache_store":
+            # the store died: recovery must recompile, not crash
+            assert entry["recompiles"] >= 1
+        else:
+            # warm persisted cache: recovery never recompiles
+            assert entry["recompiles"] == 0
+    # the mid-commit tear leaves a torn tail the journal truncates
+    assert report["sites"]["mid_commit"]["torn_truncated"] > 0
+    # at least one site stranded genuinely pending work to replay
+    assert report["replayed"] > 0
+    assert report["ok"], report

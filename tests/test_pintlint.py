@@ -723,6 +723,87 @@ def test_quality_signal_dropped_scoped_and_suppressible(tmp_path):
                for f in fs)
 
 
+# -- durable-write-unatomic ------------------------------------------
+
+
+DURABLE_CFG = LintConfig(
+    durable_artifact_modules=("/checkpoint.py", "/serve/journal.py"))
+
+
+def test_durable_write_flags_truncating_open(tmp_path):
+    bad = """
+        import json
+
+        def save_meta(path, meta):
+            with open(path, "w") as fh:   # tears on a crash mid-write
+                json.dump(meta, fh)
+    """
+    fs = lint(tmp_path, {"checkpoint.py": bad}, DURABLE_CFG)
+    assert len(live(fs, "durable-write-unatomic")) == 1
+
+
+def test_durable_write_flags_mode_kwarg_and_exclusive(tmp_path):
+    bad = """
+        def a(path):
+            return open(path, mode="wb")
+
+        def b(path):
+            return open(path, "x")
+    """
+    # anchor.py keeps the lint root at tmp_path so the registered
+    # "/serve/journal.py" suffix sees its directory
+    fs = lint(tmp_path, {"serve/journal.py": bad, "anchor.py": "x = 1\n"},
+              DURABLE_CFG)
+    assert len(live(fs, "durable-write-unatomic")) == 2
+
+
+def test_durable_write_quiet_on_reads_appends_and_patches(tmp_path):
+    good = """
+        def scan(path):
+            with open(path, "rb") as fh:
+                return fh.read()
+
+        def append_frame(path, frame):
+            # append-only log: the CRC framing is its torn-write
+            # protocol, so "ab" is the legal durable mode
+            with open(path, "ab") as fh:
+                fh.write(frame)
+
+        def damage(path):
+            # the fault injectors' in-place byte-flipper
+            with open(path, "r+b") as fh:
+                fh.write(b"x")
+    """
+    fs = lint(tmp_path, {"serve/journal.py": good, "anchor.py": "x = 1\n"},
+              DURABLE_CFG)
+    assert live(fs, "durable-write-unatomic") == []
+
+
+def test_durable_write_scoped_to_registered_modules(tmp_path):
+    src = """
+        def export(path, text):
+            with open(path, "w") as fh:
+                fh.write(text)
+    """
+    # the same truncating open outside the durable registry is legal
+    fs = lint(tmp_path, {"report.py": src}, DURABLE_CFG)
+    assert live(fs, "durable-write-unatomic") == []
+
+
+def test_durable_write_suppressible(tmp_path):
+    src = """
+        def debug_dump(path, text):
+            # throwaway debug artifact, loss is fine
+            # pintlint: disable=durable-write-unatomic
+            with open(path, "w") as fh:
+                fh.write(text)
+    """
+    fs = lint(tmp_path, {"checkpoint.py": src}, DURABLE_CFG)
+    assert live(fs, "durable-write-unatomic") == []
+    assert any(f.rule == "durable-write-unatomic" and f.suppressed
+               for f in fs)
+
+
 # -- suppression grammar ---------------------------------------------
 
 

@@ -490,20 +490,24 @@ def test_checkpointed_pta_fit_restarts_cleanly(tmp_path):
 
 
 def test_legacy_snapshot_without_crc_restores(tmp_path):
+    # pre-embed era layout: a plain data .npz next to a .meta.json
+    # sidecar with no integrity record — built by hand, since save()
+    # now embeds meta+CRC inside a single snapshot artifact
     import json
     import os
 
-    ckpt = FitCheckpointer(tmp_path)
-    ckpt.save("fit", _state(3))
-    meta_path = os.path.join(str(tmp_path), "fit.meta.json")
-    with open(meta_path) as fh:
-        meta = json.load(fh)
-    assert ckpt_mod.INTEGRITY_KEY in meta
-    del meta[ckpt_mod.INTEGRITY_KEY]  # pre-integrity-era sidecar
-    with open(meta_path, "w") as fh:
+    state = _state(3)
+    numeric = {k: np.asarray(v) for k, v in state.items()
+               if np.asarray(v).dtype.kind not in "US"}
+    meta = {k: np.asarray(v).tolist() for k, v in state.items()
+            if np.asarray(v).dtype.kind in "US"}
+    np.savez(os.path.join(str(tmp_path), "fit.npz"), **numeric)
+    with open(os.path.join(str(tmp_path), "fit.meta.json"), "w") as fh:
         json.dump(meta, fh)
+    ckpt = FitCheckpointer(tmp_path)
     out = ckpt.restore("fit")
     assert out is not None and int(out["iter"]) == 3
+    assert [str(n) for n in out["param_names"]] == ["F0", "F1"]
 
 
 # -- solver_diverge at the fitter/pta entries ------------------------
