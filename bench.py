@@ -233,9 +233,13 @@ def _full_scale_stage(meta):
                              ".bench_cache")
 
     def _mode_cache_path(mode):
+        # plan cache v2: the quantum-ladder planner rewrote the packed
+        # geometry (padding x1.092 -> x1.049 on these counts), so a v1
+        # plan pack would silently measure the OLD layout
+        ver = "v2" if mode == "plan" else "v1"
         return os.path.join(
             cache_dir, "full670k_v1.pkl" if mode == "pow2"
-            else f"full670k_{mode}_v1.pkl")
+            else f"full670k_{mode}_{ver}.pkl")
 
     def _load_entries(path):
         """Tolerant pack-cache reader -> [(par, idxs_or_None, state)]
@@ -1130,6 +1134,118 @@ def main():
                    f", bitwise={fleet_report['fleet_pipeline_bitwise']}")
 
     # ------------------------------------------------------------------
+    # fused GLS pipeline stage: the shape planner's packed layout
+    # driven through the fused whiten->Gram->RHS program
+    # (kernels/fusedgls.py) against the classic three-pass packed
+    # program (fused=False) on a plan-packed sub-fleet of the headline
+    # pulsars. Records the fused refit wall + MFU (regress-gated), the
+    # 670k fused-pipeline padded-FLOP acceptance ratio (host-only
+    # planner property, budget <= 1.05), and the fused-vs-classic
+    # max param rel diff (budget <= 1e-15 — the equivalence contract).
+    # The Pallas mixed timing keys are TPU-only: on CPU the kernel has
+    # no MXU to feed and the keys carry a reason-coded null instead.
+    fused_report = None
+
+    def _fused_stage():
+        nonlocal fused_report
+        try:
+            from pint_tpu.parallel import PTAFleet
+            from pint_tpu.parallel.shapeplan import plan_shapes
+
+            fplatform = jax.devices()[0].platform
+            rep = {}
+            plan670 = plan_shapes([int(c) for c in _ragged_counts()])
+            rep["fused_padding_ratio"] = round(plan670.padding_ratio, 4)
+            rep["fused_plan_n_programs"] = plan670.n_programs
+            n_sub = min(16, n_psr)
+            fl = PTAFleet(models[:n_sub], toas_list[:n_sub],
+                          toa_bucket="plan", plan_quantum=32,
+                          plan_max_pack=8, plan_compile_budget=2,
+                          plan_min_width=128)
+            fbatches = list(fl.batches.values())
+            infos = [b.aot_compile("gls", maxiter=2) for b in fbatches]
+            fused_flops = (sum(i["flops"] for i in infos)
+                           if all(i["flops"] is not None for i in infos)
+                           else None)
+
+            def _timed(**kw):
+                for b in fbatches:  # compile + warm-up
+                    jax.block_until_ready(b.gls_fit(maxiter=2, **kw)[1])
+                times = []
+                for _ in range(3):
+                    t0 = obs_clock.now()
+                    for b in fbatches:
+                        _, c, _ = b.gls_fit(maxiter=2, **kw)
+                        jax.block_until_ready(c)
+                    times.append(obs_clock.now() - t0)
+                return min(times)
+
+            fused_s = _timed()
+            classic_s = _timed(fused=False)
+            maxrel = 0.0
+            for b in fbatches:
+                xf = np.asarray(b.gls_fit(maxiter=2)[0])
+                xc = np.asarray(b.gls_fit(maxiter=2, fused=False)[0])
+                maxrel = max(maxrel, float(np.max(
+                    np.abs(xf - xc) / np.maximum(np.abs(xc), 1e-300))))
+            rep.update({
+                "gls_fused_refit_s": round(fused_s, 4),
+                "gls_fused_mfu_pct": _mfu(fused_flops, fused_s,
+                                          fplatform),
+                "gls_fused_vs_classic_speedup": round(
+                    classic_s / fused_s, 3),
+                "fused_vs_plan_max_param_rel_diff": maxrel,
+                "gls_fused_mixed_refit_s": None,
+                "gls_fused_mixed_mfu_pct": None,
+            })
+            want_mixed = os.environ.get(
+                "PINT_TPU_BENCH_FUSED_MIXED",
+                "1" if fplatform == "tpu" else "0") == "1"
+            if want_mixed:
+                mixed_infos = [b.aot_compile("gls", maxiter=2,
+                                             precision="mixed")
+                               for b in fbatches]
+                mflops = (sum(i["flops"] for i in mixed_infos)
+                          if all(i["flops"] is not None
+                                 for i in mixed_infos) else None)
+                mixed_s = _timed(precision="mixed")
+                rep.update({
+                    "gls_fused_mixed_refit_s": round(mixed_s, 4),
+                    "gls_fused_mixed_mfu_pct": _mfu(mflops, mixed_s,
+                                                    fplatform),
+                })
+            fused_report = rep  # set LAST: completion marker
+        except Exception as e:
+            _stage(f"fused-pipeline stage failed ({type(e).__name__}: "
+                   f"{e}); headline JSON unaffected")
+
+    fused_wedged = False
+    if os.environ.get("PINT_TPU_BENCH_SKIP_FUSED") == "1":
+        _stage("fused-pipeline stage skipped "
+               "(PINT_TPU_BENCH_SKIP_FUSED=1)")
+    else:
+        _stage("fused-pipeline: packed fused whiten+Gram+RHS program "
+               "vs classic packed program")
+        tfu = threading.Thread(target=_fused_stage, daemon=True)
+        tfu.start()
+        tfu.join(timeout=600)
+        fused_wedged = tfu.is_alive()
+        if fused_wedged:
+            fused_report = None  # snapshot: late finish must not race
+            _stage("fused-pipeline stage timed out; headline JSON "
+                   "unaffected")
+        elif fused_report is not None:
+            _stage(f"fused-pipeline: refit "
+                   f"{fused_report['gls_fused_refit_s']}s (x"
+                   f"{fused_report['gls_fused_vs_classic_speedup']} vs "
+                   f"classic), mfu {fused_report['gls_fused_mfu_pct']}%"
+                   f", 670k padding x"
+                   f"{fused_report['fused_padding_ratio']} in "
+                   f"{fused_report['fused_plan_n_programs']} programs, "
+                   f"max param rel "
+                   f"{fused_report['fused_vs_plan_max_param_rel_diff']:.2e}")
+
+    # ------------------------------------------------------------------
     # pintlint stage: static-analysis finding counts over the package
     # (pure AST, no device work). The CI gate (tests/test_pintlint.py)
     # enforces zero unsuppressed; the bench records the counts so a
@@ -1411,6 +1527,26 @@ def main():
         "gls_mixed_max_param_rel_diff": mixed_rel,
         "gls_mixed_speedup": round(gls_refit_s / mixed_stats["min"], 3),
         "projected_670k_gls_refit_s": round(projected_670k, 2),
+        "gls_fused_refit_s": (fused_report["gls_fused_refit_s"]
+                              if fused_report else None),
+        "gls_fused_mfu_pct": (fused_report["gls_fused_mfu_pct"]
+                              if fused_report else None),
+        "gls_fused_vs_classic_speedup": (
+            fused_report["gls_fused_vs_classic_speedup"]
+            if fused_report else None),
+        "fused_padding_ratio": (fused_report["fused_padding_ratio"]
+                                if fused_report else None),
+        "fused_plan_n_programs": (fused_report["fused_plan_n_programs"]
+                                  if fused_report else None),
+        "fused_vs_plan_max_param_rel_diff": (
+            fused_report["fused_vs_plan_max_param_rel_diff"]
+            if fused_report else None),
+        "gls_fused_mixed_refit_s": (
+            fused_report["gls_fused_mixed_refit_s"]
+            if fused_report else None),
+        "gls_fused_mixed_mfu_pct": (
+            fused_report["gls_fused_mixed_mfu_pct"]
+            if fused_report else None),
         "wls_compile_s": round(wls_compile_s, 2),
         "wls_trace_s": wls_aot["trace_s"],
         "wls_xla_compile_s": wls_aot["backend_compile_s"],
@@ -1592,6 +1728,9 @@ def main():
          [k for k in meta if k.startswith("regress_")]),
         ("PINT_TPU_BENCH_SKIP_FITQ", fitq_report,
          [k for k in meta if k.startswith("measured_670k_fitq_")]),
+        ("PINT_TPU_BENCH_SKIP_FUSED", fused_report,
+         [k for k in meta
+          if k.startswith(("gls_fused_", "fused_"))]),
     ):
         _reason = _stage_reason(_env, _rep)
         if _reason:
@@ -1611,6 +1750,16 @@ def main():
                    "measured_670k_mixed_refit_s",
                    "measured_670k_mixed_max_param_rel_diff",
                    "measured_670k_mixed_fell_back_f64")
+    if fused_report is not None \
+            and meta.get("gls_fused_mixed_refit_s") is None:
+        # the fused stage ran but skipped the Pallas mixed timing:
+        # no MXU to feed off-TPU (force with PINT_TPU_BENCH_FUSED_MIXED=1)
+        _want_fused_mixed = os.environ.get(
+            "PINT_TPU_BENCH_FUSED_MIXED",
+            "1" if platform == "tpu" else "0") == "1"
+        _note_null("mixed_fused_incomplete" if _want_fused_mixed
+                   else "mixed_fused_off:not_tpu",
+                   "gls_fused_mixed_refit_s", "gls_fused_mixed_mfu_pct")
     _note_null("flag_unset:only_set_on_wedge",
                "measured_670k_mixed_overlapped_headline")
     meta["null_reasons"] = null_reasons
@@ -1622,7 +1771,7 @@ def main():
         "detail": meta,
     }), flush=True)
     if wedged or serve_wedged or chaos_wedged or fleet_wedged \
-            or full_alive or _MIXED_THREAD_ALIVE:
+            or fused_wedged or full_alive or _MIXED_THREAD_ALIVE:
         # a daemon thread stuck in a C++ device wait can hang (or a
         # still-live dropped full-scale worker can crash) normal
         # interpreter teardown — measured rc=250 from exactly that;

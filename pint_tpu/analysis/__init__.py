@@ -16,8 +16,9 @@ from .core import (Finding, Rule, RULES, all_rules, counts_by_rule,
                    register, run, unsuppressed)
 # importing the rule modules populates the registry
 from . import (rules_bench, rules_bucket, rules_budget,  # noqa: F401
-               rules_durable, rules_faults, rules_locks, rules_obs,
-               rules_precision, rules_quality, rules_retrace)
+               rules_durable, rules_faults, rules_kernels, rules_locks,
+               rules_obs, rules_precision, rules_quality,
+               rules_retrace)
 from .report import json_report, text_report
 
 __all__ = [

@@ -191,6 +191,15 @@ DURABLE_ARTIFACT_MODULES = (
     "/serve/excache.py",
 )
 
+# -- kernel dispatch ---------------------------------------------------
+
+# Path markers identifying dual-path kernel modules: exception
+# handlers around a Pallas dispatch there must make the jnp fallback
+# visible (kernels.fallback.note_pallas_fallback) instead of
+# swallowing it — a fleet silently pinned to the reference path loses
+# its MXU throughput with no signal anywhere.
+KERNEL_DISPATCH_MODULES = ("/kernels/",)
+
 # -- budget coverage ---------------------------------------------------
 
 # Modules (normalized "/"-prefixed path suffixes) whose measured_*/
@@ -246,6 +255,7 @@ class LintConfig:
     obs_raw_timer_calls: frozenset = OBS_RAW_TIMER_CALLS
     obs_allowed_path_markers: tuple = OBS_ALLOWED_PATH_MARKERS
     durable_artifact_modules: tuple = ()
+    kernel_dispatch_modules: tuple = ()
     budget_meta_modules: tuple = ()
     budgeted_meta_keys: frozenset = None  # None -> rule is inert
     quality_signal_modules: tuple = ()
@@ -270,6 +280,7 @@ class LintConfig:
                    bucket_allowed_modules=BUCKET_ALLOWED_MODULES,
                    obs_instrumented_modules=OBS_INSTRUMENTED_MODULES,
                    durable_artifact_modules=DURABLE_ARTIFACT_MODULES,
+                   kernel_dispatch_modules=KERNEL_DISPATCH_MODULES,
                    budget_meta_modules=BUDGET_META_MODULES,
                    budgeted_meta_keys=budgeted,
                    quality_signal_modules=QUALITY_SIGNAL_MODULES)

@@ -1,0 +1,69 @@
+"""Kernel-dispatch fallback-visibility rule family.
+
+- kernel-silent-fallback: an exception handler around a Pallas kernel
+  dispatch (in a ``kernels/`` module) that swallows the failure
+  without routing through ``kernels.fallback.note_pallas_fallback``
+  or re-raising. The dual-path kernels fall back to their jnp
+  reference paths on any Pallas failure — which is *correct* but
+  slow, so a fleet silently pinned to the fallback looks healthy in
+  every fit-quality probe while quietly losing its MXU throughput.
+  The seed fixture is the bare ``except Exception: pass`` that
+  shipped in kernels/seggram.py's dispatcher: one mosaic version
+  quirk away from an invisible ~10x GLS slowdown. Handlers must bump
+  the ``kernels.pallas_fallbacks`` counter + flight note via
+  ``note_pallas_fallback`` (or re-raise).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Rule, mentions, register
+
+_PALLAS = re.compile(r"pallas", re.IGNORECASE)
+_NOTE = re.compile(r"note_pallas_fallback")
+
+
+@register
+class KernelSilentFallbackRule(Rule):
+    id = "kernel-silent-fallback"
+    family = "kernels"
+    rationale = ("a swallowed Pallas dispatch failure silently pins "
+                 "the fleet to the slow jnp reference path; route "
+                 "fallbacks through kernels.fallback."
+                 "note_pallas_fallback so the degradation is counted, "
+                 "flight-recorded, and logged")
+
+    def _applies(self, ctx):
+        rel = "/" + ctx.rel.replace("\\", "/")
+        markers = getattr(ctx.config, "kernel_dispatch_modules", ())
+        return any(m in rel for m in markers)
+
+    @staticmethod
+    def _silent(handler):
+        """True when the handler neither re-raises nor routes through
+        note_pallas_fallback — including the seed ``pass`` form."""
+        if any(isinstance(sub, ast.Raise) for sub in ast.walk(handler)):
+            return False
+        return not mentions(handler, _NOTE)
+
+    def check_file(self, ctx):
+        if not self._applies(ctx):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            if not any(mentions(stmt, _PALLAS) for stmt in node.body):
+                continue
+            for handler in node.handlers:
+                if self._silent(handler):
+                    ctx.report(
+                        self.id, handler,
+                        "exception handler around a Pallas dispatch "
+                        "swallows the failure silently: the jnp "
+                        "fallback is correct but slow, and nothing "
+                        "records the degradation. Call kernels."
+                        "fallback.note_pallas_fallback(kernel, exc) "
+                        "(counter + flight note + warn-once) or "
+                        "re-raise")

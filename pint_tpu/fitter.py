@@ -595,6 +595,41 @@ def gls_gram(Mn, q, precision="f64"):
     return A + jnp.diag(q * q)
 
 
+def gls_fused_normal(Mn, z, q, precision="f64"):
+    """(A, b, rNr) of the normal equations from ONE augmented Gram.
+
+    The classic dense step makes two passes over the whitened design:
+    ``A = Mn^T Mn`` and ``b = Mn^T z`` (plus a reduction for the
+    whitened residual power). Augmenting the design with the residual
+    column, ``aug = [Mn | z]``, folds all three into a single (k+1)
+    Gram — the same trick the packed path's fused kernel plays
+    (kernels/fusedgls.py), kept here so the unpacked fit shares the
+    memory-traffic win and the two paths state the identity in one
+    place:
+
+        aug^T aug = [[ Mn^T Mn, Mn^T z ],
+                     [  z^T Mn,  z^T z ]]
+
+    ``precision="mixed"`` keeps b and rNr exact (f64, O(n k)) and
+    takes only the f32 Gram from gls_gram — an f32 RHS would poison
+    the refinement fixed point (it converges to the b it is given).
+    """
+    import jax.numpy as jnp
+
+    k = Mn.shape[1]
+    if precision == "mixed":
+        A = gls_gram(Mn, q, "mixed")
+        b = Mn.T @ z
+        rNr = jnp.sum(jnp.square(z))
+    else:
+        aug = jnp.concatenate([Mn, z[:, None]], axis=1)
+        G = aug.T @ aug
+        A = G[:k, :k] + jnp.diag(q * q)
+        b = G[:k, k]
+        rNr = G[k, k]
+    return A, b, rNr
+
+
 def relres_failed(rel_resid, tol=1e-8):
     """NaN-aware check of gls_eigh_refine's convergence diagnostic
     (single home for every mixed-precision guard: gls_solve, PTABatch,
@@ -653,6 +688,51 @@ def gls_eigh_refine(A_approx, b, matvec, threshold=1e-12, iters=2):
     pr = project(b - matvec(dxn))
     rel_resid = jnp.linalg.norm(pr) / (jnp.linalg.norm(pb) + 1e-300)
     covn = evecs @ (einv[:, None] * evecs.T)
+    return dxn, covn, rel_resid
+
+
+def seg_gls_eigh_refine(A_approx, b, matvec, threshold=1e-12, iters=2):
+    """Batched gls_eigh_refine over per-segment normal systems.
+
+    ``A_approx`` is (S, k, k) — one approximate (f32-accumulated)
+    Gram per segment, e.g. from kernels/fusedgls.py — ``b`` (S, k)
+    the EXACT f64 right-hand sides, and ``matvec`` applies the exact
+    f64 normal operator to all segments at once via segment-masked
+    O(n k) products through the packed design (never forming the f64
+    Grams). Same eigenvalue cut, refinement recurrence, projected
+    rel_resid and covariance conventions as gls_eigh_refine — that
+    docstring is the contract; this is its vmap-free batched form
+    (einsum over the segment axis, so it lives inside the packed
+    program without a second vmap level).
+
+    Returns (dxn (S, k), covn (S, k, k), rel_resid (S,)); callers
+    MUST check rel_resid per segment (fitter.relres_failed semantics)
+    and fall back to precision="f64" on failure.
+    """
+    import jax.numpy as jnp
+
+    evals, evecs = jnp.linalg.eigh(A_approx)
+    cut = max(threshold**2, GLS_EIG_FLOOR)
+    good = evals > cut * jnp.max(evals, axis=-1, keepdims=True)
+    einv = jnp.where(good, 1.0 / jnp.where(good, evals, 1.0), 0.0)
+    keep = good.astype(b.dtype)
+
+    def apply_inv(v):
+        return jnp.einsum("sij,sj->si", evecs,
+                          einv * jnp.einsum("sij,si->sj", evecs, v))
+
+    def project(v):
+        return jnp.einsum("sij,sj->si", evecs,
+                          keep * jnp.einsum("sij,si->sj", evecs, v))
+
+    dxn = apply_inv(b)
+    for _ in range(iters):
+        dxn = dxn + apply_inv(b - matvec(dxn))
+    pb = project(b)
+    pr = project(b - matvec(dxn))
+    rel_resid = (jnp.linalg.norm(pr, axis=-1)
+                 / (jnp.linalg.norm(pb, axis=-1) + 1e-300))
+    covn = jnp.einsum("sik,sk,sjk->sij", evecs, einv, evecs)
     return dxn, covn, rel_resid
 
 
@@ -725,6 +805,22 @@ def seg_gls_whiten(Mfull, sigma, sqrt_phi_inv, seg_id, n_seg):
     norm = jnp.hypot(seg_column_norms(Mw, seg_id, n_seg), sqrt_phi_inv)
     Mn = Mw / norm[seg_id]
     return Mn, norm, sqrt_phi_inv / norm
+
+
+def seg_gls_norm(Mfull, sigma, sqrt_phi_inv, seg_id, n_seg):
+    """(norm, q) of seg_gls_whiten WITHOUT materializing Mn.
+
+    The fused packed path (kernels/fusedgls.py) whitens inside the
+    kernel, so the caller only needs the per-segment column norms to
+    pre-scale the raw design (``P = Mfull / norm[seg_id]`` — f32-safe
+    magnitudes for the kernel tile) and the folded prior ``q``. The
+    norms here are BITWISE those of seg_gls_whiten: same Mw, same
+    hypot fold."""
+    import jax.numpy as jnp
+
+    Mw = Mfull / sigma[:, None]
+    norm = jnp.hypot(seg_column_norms(Mw, seg_id, n_seg), sqrt_phi_inv)
+    return norm, sqrt_phi_inv / norm
 
 
 def seg_gls_gram(Mn, q, block_seg, n_seg, block, precision="f64"):

@@ -5,6 +5,9 @@ harmonic-sum reduction is the one op where streaming beats XLA's
 materialize-then-reduce; everything else fuses fine.)
 """
 
+from .fallback import note_pallas_fallback  # noqa: F401
+from .fusedgls import (fused_segment_gls,  # noqa: F401
+                       fused_segment_gls_jnp, fused_segment_gls_pallas)
 from .harmonics import (harmonic_sums, harmonic_sums_jnp,  # noqa: F401
                         harmonic_sums_pallas)
 from .seggram import (segment_gram, segment_gram_jnp,  # noqa: F401

@@ -1,0 +1,63 @@
+"""Visible accounting for Pallas-kernel fallbacks.
+
+Every kernel in this package is dual-path: an f32 Pallas TPU kernel
+and a deterministic jnp reference. When the Pallas dispatch fails
+(mosaic/version quirks, a missing lowering on the running backend)
+the dispatcher falls back to the jnp path — which is *correct* but
+slow, and a fleet silently pinned to it would look healthy in every
+fit-quality probe while quietly losing its MXU throughput. This
+module makes the event observable three ways:
+
+- the ``kernels.pallas_fallbacks`` counter in ``obs.REGISTRY``
+  (scraped by the metrics exposition and the bench obs stage),
+- a flight-recorder note carrying the kernel name and exception
+  (so post-incident dumps name the kernel that degraded), and
+- one ``logging`` warning per (kernel, exception type) — the first
+  failure is loud, the per-batch repeat storm is not.
+
+The pintlint ``kernel-silent-fallback`` rule enforces that kernel
+dispatchers route through :func:`note_pallas_fallback` instead of a
+bare ``except Exception: pass``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+_LOG = logging.getLogger(__name__)
+_LOCK = threading.Lock()
+_warned_keys: set = set()
+
+COUNTER_NAME = "kernels.pallas_fallbacks"
+
+
+def note_pallas_fallback(kernel, exc):
+    """Record one Pallas->jnp fallback for ``kernel`` caused by
+    ``exc``: bump the obs counter, leave a flight-recorder note, and
+    warn once per (kernel, exception type)."""
+    reason = f"{type(exc).__name__}: {exc}"
+    try:
+        from ..obs import RECORDER, REGISTRY
+
+        REGISTRY.counter(COUNTER_NAME).inc()
+        RECORDER.note("pallas_fallback", kernel=str(kernel),
+                      reason=reason[:300])
+    except Exception:
+        # observability must never take down the math path it watches
+        _LOG.debug("pallas fallback accounting failed", exc_info=True)
+    key = (str(kernel), type(exc).__name__)
+    with _LOCK:
+        first = key not in _warned_keys
+        _warned_keys.add(key)
+    if first:
+        _LOG.warning(
+            "Pallas kernel %r fell back to its jnp reference path: %s "
+            "(further identical fallbacks counted in %s, not logged)",
+            kernel, reason, COUNTER_NAME)
+
+
+def reset_warned_for_tests():
+    """Clear the warn-once memory (test isolation only)."""
+    with _LOCK:
+        _warned_keys.clear()

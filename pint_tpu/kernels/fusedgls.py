@@ -1,0 +1,188 @@
+"""Fused whiten -> Gram -> RHS segment kernel for the packed GLS fit.
+
+The packed GLS normal equations (parallel/pta.py::_build_gls_packed)
+used to make three separate reduction passes over each packed row:
+the per-segment block Gram ``A0`` (kernels/seggram.py), the
+right-hand side ``b0 = segment_sum(Mn * z)``, and the whitened
+residual power ``rNr = segment_sum(z^2)``. This module fuses all
+three into ONE streamed pass by augmenting the design tile with two
+extra columns:
+
+    aug = [ X | r | winv ]          (n, K + 2)
+
+where X is the column-normalized design block, r the residual and
+``winv = 1/sigma`` the per-TOA error weight. Each block tile is
+whitened in-registers by its error column (``xw = aug * winv_col`` —
+every column, including r, picks up the 1/sigma weight) and a single
+Gram of the whitened tile is accumulated:
+
+    G = xw^T xw = [[ Mn^T Mn,  Mn^T z,  . ],
+                   [  z^T Mn,   z^T z,  . ],
+                   [     .,        .,   . ]]
+
+so ``A0 = G[:K, :K]``, ``b0 = G[:K, K]`` and ``rNr = G[K, K]`` fall
+out of one product; the winv^2 row/column is garbage and sliced off.
+The row data is read from HBM once instead of three times, and the
+two extra columns are free on TPU (K pads to the 128 lane width
+either way).
+
+Dual path mirroring seggram/harmonics:
+
+- :func:`fused_segment_gls_jnp` — the bitwise-deterministic f64 jnp
+  reference (the CPU production path; same block factorization and
+  reduction order every call).
+- :func:`fused_block_gls_pallas` / :func:`fused_segment_gls_pallas`
+  — the f32 Pallas TPU kernel: one (Q, K+2) tile HBM -> VMEM per
+  grid step, whiten on the VPU, Gram + RHS on the MXU with f32
+  accumulation. f32 RHS/rNr are *not* accurate enough for the 1e-15
+  packed-vs-sequential contract, so the mixed-precision caller keeps
+  the exact f64 RHS and hands A0 to fitter.seg_gls_eigh_refine as
+  the preconditioner (ERRORBUDGET.md precision tiers).
+- :func:`fused_segment_gls_f32_jnp` — f32 jnp emulation of the
+  kernel numerics, the mixed-precision path on backends without
+  Pallas (lets CI exercise the mixed packed fit on CPU).
+
+``fused_segment_gls`` dispatches; a failed Pallas dispatch falls
+back to the emulation VISIBLY via kernels.fallback (obs counter +
+flight note + one log line), never silently.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from .fallback import note_pallas_fallback
+from .seggram import _LANE, _tpu_backend
+
+
+def augment(X, r, winv):
+    """Stack the fused tile ``[X | r | winv]`` (n, K+2)."""
+    import jax.numpy as jnp
+
+    return jnp.concatenate(
+        [X, r[:, None], winv[:, None]], axis=1)
+
+
+def fused_block_gls_jnp(aug, block):
+    """(n, K+2) augmented rows -> (n/block, K+2, K+2) whitened
+    per-block Grams; dtype follows ``aug`` (f64 reference)."""
+    import jax.numpy as jnp
+
+    aug = jnp.asarray(aug)
+    n, ka = aug.shape
+    xw = aug * aug[:, -1:]
+    xb = xw.reshape(n // block, block, ka)
+    return jnp.einsum("nbk,nbl->nkl", xb, xb)
+
+
+def _slice_out(G, k):
+    """(S, K+2, K+2) segment Grams -> (A0, b0, rNr)."""
+    return G[:, :k, :k], G[:, :k, k], G[:, k, k]
+
+
+def fused_segment_gls_jnp(X, r, winv, block_seg, n_seg, block):
+    """Reference path: one fused pass in f64.
+
+    X: (n, K) column-normalized design rows, n a multiple of
+    ``block``; r/winv: (n,) residual and 1/sigma columns.
+    block_seg: (n/block,) int segment id per block.
+    Returns (A0 (n_seg, K, K), b0 (n_seg, K), rNr (n_seg,)).
+    """
+    import jax
+
+    grams = fused_block_gls_jnp(augment(X, r, winv), block)
+    G = jax.ops.segment_sum(grams, block_seg, num_segments=n_seg)
+    return _slice_out(G, X.shape[1])
+
+
+def _kernel(wcol, bk_ref, out_ref):
+    """One grid step: whiten one (block, K+2) tile by its error
+    column on the VPU, Gram + RHS on the MXU."""
+    import jax.numpy as jnp
+
+    x = bk_ref[:]
+    w = x[:, wcol:wcol + 1]
+    xw = x * w
+    out_ref[:] = jnp.dot(xw.T, xw, preferred_element_type=jnp.float32)
+
+
+def fused_block_gls_pallas(aug, block, interpret=False):
+    """Pallas path: whitened per-block Grams in f32, columns padded
+    to the lane width. Returns (n/block, K+2, K+2) f32; the segment
+    reduction stays outside (cheap, f64-capable)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    x = jnp.asarray(aug, jnp.float32)
+    n, ka = x.shape
+    nb = n // block
+    kpad = -(-ka // _LANE) * _LANE
+    if kpad != ka:
+        # zero pad: padded columns whiten to zero and never reach the
+        # sliced (ka, ka) output
+        x = jnp.pad(x, ((0, 0), (0, kpad - ka)))
+    out = pl.pallas_call(
+        functools.partial(_kernel, ka - 1),
+        grid=(nb,),
+        in_specs=[pl.BlockSpec((block, kpad), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((kpad, kpad), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((nb * kpad, kpad), jnp.float32),
+        interpret=interpret,
+    )(x)
+    return out.reshape(nb, kpad, kpad)[:, :ka, :ka]
+
+
+def fused_segment_gls_pallas(X, r, winv, block_seg, n_seg, block,
+                             interpret=False):
+    """Pallas fused pass + f64 segment reduction."""
+    import jax
+    import jax.numpy as jnp
+
+    grams = fused_block_gls_pallas(augment(X, r, winv), block,
+                                   interpret=interpret)
+    G = jax.ops.segment_sum(grams.astype(jnp.float64), block_seg,
+                            num_segments=n_seg)
+    return _slice_out(G, X.shape[1])
+
+
+def fused_segment_gls_f32_jnp(X, r, winv, block_seg, n_seg, block):
+    """f32 jnp emulation of the kernel numerics: same whiten + block
+    Gram in f32, f64 segment reduction. The mixed-precision packed
+    fit runs this on backends without Pallas so the refinement path
+    is exercised (and CI-testable) everywhere."""
+    import jax
+    import jax.numpy as jnp
+
+    aug = augment(X, r, winv).astype(jnp.float32)
+    grams = fused_block_gls_jnp(aug, block)
+    G = jax.ops.segment_sum(grams.astype(jnp.float64), block_seg,
+                            num_segments=n_seg)
+    return _slice_out(G, X.shape[1])
+
+
+def fused_segment_gls(X, r, winv, block_seg, n_seg, block,
+                      precision="f64", interpret=False):
+    """Dispatch the fused whiten+Gram+RHS pass.
+
+    ``precision="f64"`` always takes the jnp reference (bitwise
+    deterministic, the packed-vs-sequential contract). ``"mixed"``
+    takes the Pallas kernel on TPU (or anywhere under
+    ``interpret=True``) and the f32 jnp emulation elsewhere; the
+    caller is responsible for recovering f64 accuracy by refinement
+    (fitter.seg_gls_eigh_refine) and for using an exact f64 RHS.
+    """
+    if precision == "mixed":
+        if _tpu_backend() or interpret:
+            try:
+                return fused_segment_gls_pallas(
+                    X, r, winv, block_seg, n_seg, block,
+                    interpret=interpret)
+            except Exception as exc:  # mosaic/version quirks
+                note_pallas_fallback("fusedgls.fused_segment_gls", exc)
+        return fused_segment_gls_f32_jnp(X, r, winv, block_seg, n_seg,
+                                         block)
+    return fused_segment_gls_jnp(X, r, winv, block_seg, n_seg, block)

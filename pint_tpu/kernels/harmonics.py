@@ -152,6 +152,8 @@ def harmonic_sums(phases, m, weights=None):
     if ph.ndim == 1 and n >= (1 << 16) and _tpu_backend():
         try:
             return harmonic_sums_pallas(phases, m, weights=weights)
-        except Exception:  # mosaic/version quirks: fall back silently
-            pass
+        except Exception as exc:  # mosaic/version quirks
+            from .fallback import note_pallas_fallback
+
+            note_pallas_fallback("harmonics.harmonic_sums", exc)
     return harmonic_sums_jnp(phases, m, weights=weights)

@@ -114,13 +114,15 @@ def _tpu_backend():
 
 def segment_gram(x, block_seg, n_seg, block, precision="f64"):
     """Dispatch: Pallas kernel on TPU when f32 block products are
-    acceptable (``precision="mixed"``), jnp otherwise. The packed GLS
-    fit is f64-only today, so it pins the jnp path; the kernel exists
-    for the mixed-precision Gram work the TPU path will grow into,
+    acceptable (``precision="mixed"``), jnp otherwise. The fused GLS
+    path (kernels/fusedgls.py) owns the mixed packed fit; this entry
+    still serves the ECORR downdate Grams and any direct callers,
     verified against the reference by tests/test_shapeplan.py."""
     if precision == "mixed" and _tpu_backend():
         try:
             return segment_gram_pallas(x, block_seg, n_seg, block)
-        except Exception:  # mosaic/version quirks: fall back silently
-            pass
+        except Exception as exc:  # mosaic/version quirks
+            from .fallback import note_pallas_fallback
+
+            note_pallas_fallback("seggram.segment_gram", exc)
     return segment_gram_jnp(x, block_seg, n_seg, block)

@@ -981,7 +981,7 @@ class PTABatch:
         return noise_bw
 
     def _build_gls(self, maxiter=2, threshold=1e-12, ecorr_mode="auto",
-                   precision="f64"):
+                   precision="f64", fused=None):
         """(cache key, per-pulsar fit_one) for the GLS program — the
         single home of the program construction, shared by
         :meth:`gls_fit` (JIT path) and :meth:`aot_compile` (explicit
@@ -1015,12 +1015,14 @@ class PTABatch:
         import jax.numpy as jnp
 
         from ..fitter import (_warn_degraded_once, check_precision,
-                              gls_eigh_refine, gls_eigh_solve, gls_gram,
-                              gls_whiten, stack_noise_bases)
+                              gls_eigh_refine, gls_eigh_solve,
+                              gls_fused_normal, gls_gram, gls_whiten,
+                              stack_noise_bases)
 
         if getattr(self, "_pack", None):
-            return self._build_gls_packed(maxiter, threshold,
-                                          ecorr_mode, precision)
+            return self._build_gls_packed(
+                maxiter, threshold, ecorr_mode, precision,
+                fused=(True if fused is None else bool(fused)))
         _warn_degraded_once()
 
         if ecorr_mode not in ("auto", "dense"):
@@ -1099,8 +1101,10 @@ class PTABatch:
             Mfull, sqrt_phi_inv, nparam = stack_noise_bases(M, bw)
             Mn, norm, q = gls_whiten(Mfull, sigma_s, sqrt_phi_inv)
             z = r / sigma_s
-            b = Mn.T @ z
-            A = gls_gram(Mn, q, precision)
+            # fused normal assembly: A, b and |z|^2 from ONE augmented
+            # Gram [Mn | z] — one pass over the whitened design
+            # instead of three (fitter.gls_fused_normal)
+            A, b, rNr = gls_fused_normal(Mn, z, q, precision)
             if precision == "mixed":
                 dxn, covn, relres = gls_eigh_refine(
                     A, b, lambda v: Mn.T @ (Mn @ v) + (q * q) * v,
@@ -1110,7 +1114,7 @@ class PTABatch:
                 relres = jnp.zeros(())
             dx_all = dxn / norm
             # whitened marginalized chi2: r^T C^-1 r = |rw|^2 - b.dxn
-            chi2 = jnp.sum(jnp.square(r / sigma_s)) - b @ dxn
+            chi2 = rNr - b @ dxn
             return (x - dx_all[1:nparam], chi2,
                     (covn[1:nparam, 1:nparam], norm[1:nparam], relres))
 
@@ -1145,8 +1149,9 @@ class PTABatch:
             Mn, norm, q = gls_whiten(Mfull, sigma_s, sqrt_phi_inv)
             z = r / sigma_s
             a = 1.0 / sigma_s
-            b0 = Mn.T @ z
-            rNr = jnp.sum(jnp.square(z))
+            # fused: A0 (+ prior diag), b0 and |z|^2 from one
+            # augmented Gram (see one_step_dense / gls_fused_normal)
+            A0q, b0, rNr = gls_fused_normal(Mn, z, q, precision)
             s = jax.ops.segment_sum(a * a, e_idx, num_segments=k + 1)[:k]
             G = jax.ops.segment_sum(Mn * a[:, None], e_idx,
                                     num_segments=k + 1)[:k]
@@ -1161,15 +1166,14 @@ class PTABatch:
             rCr = rNr - jnp.sum(c * jnp.square(t))
             if precision == "mixed":
                 Gc32 = Gc.astype(jnp.float32)
-                An = (gls_gram(Mn, q, "mixed")
-                      - (Gc32.T @ Gc32).astype(jnp.float64))
+                An = A0q - (Gc32.T @ Gc32).astype(jnp.float64)
                 dxn, covn, relres = gls_eigh_refine(
                     An, bn,
                     lambda v: (Mn.T @ (Mn @ v) - Gc.T @ (Gc @ v)
                                + (q * q) * v),
                     threshold)
             else:
-                An = (Mn.T @ Mn - Gc.T @ Gc + jnp.diag(q * q))
+                An = A0q - Gc.T @ Gc
                 dxn, covn = gls_eigh_solve(An, bn, threshold)
                 relres = jnp.zeros(())
             dx_all = dxn / norm
@@ -1224,15 +1228,23 @@ class PTABatch:
             nparam = M.shape[1]
             Mn_p, normM, _ = gls_whiten(M, sigma_s, jnp.zeros(nparam))
             z = r / sigma_s
-            b0 = jnp.concatenate([Mn_p.T @ z, pre["Bn"].T @ z])
-            rNr = jnp.sum(jnp.square(z))
+            # fused parameter-block assembly: augmenting the small
+            # per-iteration block with z folds Bn^T z into the SAME
+            # pass over the big constant basis as the cross Gram, and
+            # the tiny aug Gram yields Mn_p^T Mn_p, Mn_p^T z and
+            # |z|^2 together (the kernels/fusedgls.py identity)
+            aug_p = jnp.concatenate([Mn_p, z[:, None]], axis=1)
+            GpB = aug_p.T @ pre["Bn"]
+            Gpp = aug_p.T @ aug_p
+            b0 = jnp.concatenate([Gpp[:nparam, nparam], GpB[nparam]])
+            rNr = Gpp[nparam, nparam]
             G_p = jax.ops.segment_sum(Mn_p * a[:, None], pre["e_idx"],
                                       num_segments=k + 1)[:k]
             Gc_p = pre["sc"][:, None] * G_p
             t = jax.ops.segment_sum(z * a, pre["e_idx"],
                                     num_segments=k + 1)[:k]
-            ApB = Mn_p.T @ pre["Bn"]
-            A0 = jnp.block([[Mn_p.T @ Mn_p, ApB],
+            ApB = GpB[:nparam]
+            A0 = jnp.block([[Gpp[:nparam, :nparam], ApB],
                             [ApB.T, pre["FtF"]]])
             GcX = Gc_p.T @ pre["GcB"]
             Gct = jnp.block([[Gc_p.T @ Gc_p, GcX],
@@ -1289,7 +1301,8 @@ class PTABatch:
                 fit_one)
 
     def _build_gls_packed(self, maxiter=2, threshold=1e-12,
-                          ecorr_mode="auto", precision="f64"):
+                          ecorr_mode="auto", precision="f64",
+                          fused=True):
         """(cache key, per-ROW fit_one) for the segment-packed GLS
         program — the shapeplan layout where several pulsars share one
         padded row (stack_packed).
@@ -1297,22 +1310,41 @@ class PTABatch:
         Same math as one_step_dense / one_step_marg in the SAME
         operation order, with every whole-row reduction replaced by
         its per-segment form: fitter.seg_gls_whiten for the whitened
-        column normalization, kernels/seggram block-factorized segment
-        Grams for the normal matrices, and segment sums keyed by the
-        per-TOA owner for the b/chi2/epoch reductions. Each slot
-        evaluates phase/design/noise with ITS params over the whole
-        row (foreign-row outputs are masked out before any reduction);
-        the slot loop accumulates the combined arrays in place so peak
-        memory stays at one row, not n_slots rows. Packed batches are
-        f64-only: the mixed path's refinement operator is whole-row
-        shaped and has no segment form yet.
+        column normalization, block-factorized segment Grams for the
+        normal matrices, and segment sums keyed by the per-TOA owner
+        for the b/chi2/epoch reductions. Each slot evaluates
+        phase/design/noise with ITS params over the whole row
+        (foreign-row outputs are masked out before any reduction);
+        the slot loop accumulates the combined arrays in place so
+        peak memory stays at one row, not n_slots rows.
+
+        ``fused=True`` (the default) assembles the per-segment normal
+        matrix, right-hand side and whitened residual power in ONE
+        streamed pass over the packed row (kernels/fusedgls.py:
+        whiten -> Gram -> RHS fused — the Pallas TPU kernel under
+        precision="mixed", the f64 jnp mirror otherwise) and — when
+        no noise parameter is free — HOISTS the x-independent slot
+        work (sigma, the noise basis + prior, ECORR weights) out of
+        the Gauss-Newton iteration, so each iteration re-evaluates
+        only the phase and the parameter jacobian per slot.
+        ``fused=False`` keeps the classic three-pass f64 program as
+        the equivalence reference (tests/test_shapeplan.py).
+
+        ``precision="mixed"`` (fused only) runs the fused pass in f32
+        (the MXU path on TPU) and recovers f64 accuracy with
+        fitter.seg_gls_eigh_refine: the right-hand sides stay exact
+        f64 segment sums and the refinement matvec applies the exact
+        f64 normal operator through segment-masked O(n k) products —
+        the f32 kernel output is only the eigh preconditioner.
         """
         import jax
         import jax.numpy as jnp
 
         from ..fitter import (_warn_degraded_once, check_precision,
-                              gls_eigh_solve, seg_gls_whiten,
+                              gls_eigh_solve, seg_gls_eigh_refine,
+                              seg_gls_norm, seg_gls_whiten,
                               stack_noise_bases)
+        from ..kernels.fusedgls import fused_segment_gls
         from ..kernels.seggram import segment_gram
 
         _warn_degraded_once()
@@ -1320,10 +1352,11 @@ class PTABatch:
             raise ValueError(
                 f"ecorr_mode must be 'auto' or 'dense', got {ecorr_mode!r}")
         check_precision(precision)
-        if precision != "f64":
+        if precision != "f64" and not fused:
             raise ValueError(
-                "packed plan batches are f64-only; use a pow2/split "
-                "bucket for precision='mixed'")
+                "packed plan batches are f64-only on the classic "
+                "(fused=False) path; precision='mixed' needs the "
+                "fused kernel program (fused=True)")
         phase_fn = self._phase_fn()
         sigma_fn = self._sigma_fn()
         has_ecorr = "EcorrNoise" in self.template.components
@@ -1338,6 +1371,18 @@ class PTABatch:
                     else self._noise_bw_fn())
         ecorr_comp = (self.template.components.get("EcorrNoise")
                       if marginalize else None)
+        # packed hoist guard — mirrors the unpacked one (_build_gls):
+        # with every noise parameter frozen, sigma, the noise
+        # basis/prior and the ECORR weights never read the fit vector,
+        # so they are bitwise iteration constants. Kept off the
+        # classic path so fused=False stays the unchanged reference.
+        free_names = {n for n, _, _ in self.free_map()}
+        noise_param_names = set()
+        for c in self.template.components.values():
+            if (getattr(c, "basis_weight", None) is not None
+                    or getattr(c, "scale_sigma", None) is not None):
+                noise_param_names.update(c.params)
+        hoist = fused and not (free_names & noise_param_names)
         pack = self._pack
         S = int(pack["n_slots"])
         Q = int(pack["quantum"])
@@ -1354,52 +1399,79 @@ class PTABatch:
             W = batch.tdb_sec.shape[0]
             owner = jnp.repeat(block_slot, Q, total_repeat_length=W)
 
-            def eval_slot(x_s, s):
+            def slot_env(s):
                 ps = jax.tree_util.tree_map(lambda v: v[s], params)
                 full = dict(shared)
                 for k in slot_keys:
                     full[k] = prep[k][s]
-                p = self._overlay(ps, x_s)
-                ph = phase_fn(p, batch, full)
-                sig = sigma_fn(p, batch, full)
+                return ps, full
 
-                def phase_of(xv):
-                    return phase_fn(self._overlay(ps, xv), batch, full)
-
-                M = jax.jacfwd(phase_of)(x_s) / p["F"][0]
-                M = jnp.concatenate([jnp.ones((W, 1)), M], axis=1)
-                bw = (noise_bw(p, full) if noise_bw is not None
-                      else None) or (None, None)
-                Mfull, spi, nparam = stack_noise_bases(M, bw)
+            def combine_noise(x):
+                # combined-over-slots sigma and noise-basis columns,
+                # (S, ...) prior sqrts, row-global ECORR weights.
+                # x-independent under the hoist guard (evaluated once
+                # per fit); recomputed per iteration otherwise.
+                spis = []
                 w_ec = None
-                if marginalize:
-                    _, w_ec = ecorr_comp.epoch_index_weight(
-                        p, {**full, **self.static})
-                return ph, sig, Mfull, spi, w_ec, p["F"][0], nparam
-
-            def one_step(x):
-                # slot-by-slot accumulation of the combined per-TOA
-                # arrays: peak memory one (W, K) design, not (S, W, K)
-                spis, f0s = [], []
-                w_ec = None
+                sig = B = None
                 for s in range(S):
-                    ph_s, sig_s, Mf_s, spi_s, wec_s, f0_s, nparam = \
-                        eval_slot(x[s], s)
+                    ps, full = slot_env(s)
+                    p = self._overlay(ps, x[s])
+                    sig_s = sigma_fn(p, batch, full)
+                    bw = (noise_bw(p, full) if noise_bw is not None
+                          else None) or (None, None)
+                    # zero-width params block: stack_noise_bases on
+                    # the basis alone (one home of the prior formula)
+                    B_s, spiB_s, _ = stack_noise_bases(
+                        jnp.zeros((W, 0)), bw)
                     if s == 0:
-                        ph, sig, Mfull = ph_s, sig_s, Mf_s
+                        sig, B = sig_s, B_s
                     else:
                         m = owner == s
-                        ph = jnp.where(m, ph_s, ph)
                         sig = jnp.where(m, sig_s, sig)
-                        Mfull = jnp.where(m[:, None], Mf_s, Mfull)
-                    spis.append(spi_s)
-                    f0s.append(f0_s)
-                    if wec_s is not None:
+                        B = jnp.where(m[:, None], B_s, B)
+                    spis.append(spiB_s)
+                    if marginalize:
+                        _, wec_s = ecorr_comp.epoch_index_weight(
+                            p, {**full, **self.static})
                         # disjoint global epoch spans: summing the
                         # per-slot weight vectors assembles the row's
                         w_ec = wec_s if w_ec is None else w_ec + wec_s
-                spi = jnp.stack(spis)  # (S, K)
-                F0 = jnp.stack(f0s)    # (S,)
+                return sig, B, jnp.stack(spis), w_ec
+
+            def combine_design(x):
+                # the per-iteration slot work: phase + the
+                # (1 + n_free)-column parameter jacobian
+                f0s = []
+                ph = M = None
+                for s in range(S):
+                    ps, full = slot_env(s)
+                    p = self._overlay(ps, x[s])
+                    ph_s = phase_fn(p, batch, full)
+
+                    def phase_of(xv, ps=ps, full=full):
+                        return phase_fn(self._overlay(ps, xv),
+                                        batch, full)
+
+                    M_s = jax.jacfwd(phase_of)(x[s]) / p["F"][0]
+                    if s == 0:
+                        ph, M = ph_s, M_s
+                    else:
+                        m = owner == s
+                        ph = jnp.where(m, ph_s, ph)
+                        M = jnp.where(m[:, None], M_s, M)
+                    f0s.append(p["F"][0])
+                M = jnp.concatenate([jnp.ones((W, 1)), M], axis=1)
+                return ph, M, jnp.stack(f0s)
+
+            def one_step(x, noise):
+                sig, B, spiB, w_ec = noise
+                ph, M, F0 = combine_design(x)
+                nparam = M.shape[1]
+                Mfull = (jnp.concatenate([M, B], axis=1)
+                         if B.shape[1] else M)
+                spi = jnp.concatenate(
+                    [jnp.zeros((S, nparam)), spiB], axis=1)
                 # per-segment weighted phase mean — the packed analog
                 # of _resid_fn's whole-row mean subtraction
                 frac = ph - jnp.floor(ph + 0.5)
@@ -1410,14 +1482,40 @@ class PTABatch:
                 frac = frac - (num / den)[owner]
                 r = frac / F0[owner]
                 sigma_s = sig * 1e-6
-                Mn, norm, q = seg_gls_whiten(Mfull, sigma_s, spi,
-                                             owner, S)
-                z = r / sigma_s
-                b0 = jax.ops.segment_sum(Mn * z[:, None], owner,
-                                         num_segments=S)
-                rNr = jax.ops.segment_sum(z * z, owner, num_segments=S)
-                A0 = segment_gram(Mn, block_slot, S, Q,
-                                  precision=precision)
+                if fused:
+                    winv = 1.0 / sigma_s
+                    norm, q = seg_gls_norm(Mfull, sigma_s, spi,
+                                           owner, S)
+                    # pre-normalized raw design: the kernel whitens by
+                    # the winv column in-tile, so P * winv == Mn up to
+                    # one rounding (the packed-vs-sequential 1e-15
+                    # param contract holds — tests/test_shapeplan.py)
+                    P = Mfull / norm[owner]
+                    A0, b0, rNr = fused_segment_gls(
+                        P, r, winv, block_slot, S, Q,
+                        precision=precision)
+                    Mn = P * winv[:, None]
+                    z = r * winv
+                    if precision == "mixed":
+                        # the f32 kernel Gram is only the refinement
+                        # preconditioner; the RHS must stay exact f64
+                        # or the refinement fixed point inherits its
+                        # error (kernels/fusedgls.py docstring)
+                        b0 = jax.ops.segment_sum(
+                            Mn * z[:, None], owner, num_segments=S)
+                        rNr = jax.ops.segment_sum(
+                            z * z, owner, num_segments=S)
+                else:
+                    Mn, norm, q = seg_gls_whiten(Mfull, sigma_s, spi,
+                                                 owner, S)
+                    z = r / sigma_s
+                    b0 = jax.ops.segment_sum(Mn * z[:, None], owner,
+                                             num_segments=S)
+                    rNr = jax.ops.segment_sum(z * z, owner,
+                                              num_segments=S)
+                    A0 = segment_gram(Mn, block_slot, S, Q,
+                                      precision=precision)
+                eowner = Gc = None
                 if marginalize:
                     a = 1.0 / sigma_s
                     NE = w_ec.shape[0]
@@ -1447,21 +1545,51 @@ class PTABatch:
                     An = A0 + jax.vmap(jnp.diag)(q * q)
                     bn = b0
                     rCr = rNr
-                dxn, covn = jax.vmap(
-                    lambda Ai, bi: gls_eigh_solve(Ai, bi, threshold))(
-                        An, bn)
+                if precision == "mixed":
+                    def matvec(v):
+                        # exact f64 normal operator for all segments
+                        # at once via owner-masked O(n k) products —
+                        # the f64 Grams never form (the segment analog
+                        # of one_step_marg_hoisted's factored matvec)
+                        u = jnp.sum(Mn * v[owner], axis=1)
+                        Av = jax.ops.segment_sum(
+                            Mn * u[:, None], owner, num_segments=S)
+                        Av = Av + (q * q) * v
+                        if marginalize:
+                            gv = jnp.sum(Gc * v[eowner], axis=1)
+                            Av = Av - jax.ops.segment_sum(
+                                Gc * gv[:, None], eowner,
+                                num_segments=S)
+                        return Av
+
+                    dxn, covn, relres = seg_gls_eigh_refine(
+                        An, bn, matvec, threshold)
+                else:
+                    dxn, covn = jax.vmap(
+                        lambda Ai, bi: gls_eigh_solve(Ai, bi,
+                                                      threshold))(
+                            An, bn)
+                    relres = jnp.zeros(S)
                 dx_all = dxn / norm
                 chi2 = rCr - jnp.sum(bn * dxn, axis=1)
                 return (x - dx_all[:, 1:nparam], chi2,
-                        (covn[:, 1:nparam, 1:nparam], norm[:, 1:nparam]))
+                        (covn[:, 1:nparam, 1:nparam],
+                         norm[:, 1:nparam], relres))
 
             x = x0
+            # worst refinement residual over iterations, like the
+            # unpacked fit_one (zeros throughout on the f64 paths)
+            worst = jnp.zeros(S)
+            noise = combine_noise(x0) if hoist else None
             for _ in range(maxiter):
-                x, chi2, (covn, norm) = one_step(x)
-            return x, chi2, (covn, norm, jnp.zeros(x.shape[0]))
+                x, chi2, (covn, norm, relres) = one_step(
+                    x, noise if hoist else combine_noise(x))
+                worst = jnp.maximum(worst, relres)
+            return x, chi2, (covn, norm, worst)
 
         return (("gls", maxiter, threshold, marginalize, precision,
-                 "packed"), fit_one)
+                 "packed-fused" if fused else "packed", hoist),
+                fit_one)
 
     @staticmethod
     def _precision_verdict(timings, mixed_failed):
@@ -1481,7 +1609,7 @@ class PTABatch:
                 else "mixed")
 
     def _resolve_precision(self, precision, maxiter=2, threshold=1e-12,
-                           ecorr_mode="auto"):
+                           ecorr_mode="auto", fused=None):
         """Resolve precision="auto" to the MEASURED winner of "f64" vs
         "mixed" for this bucket structure (gls_mixed_speedup = 0.768
         on CPU made mixed a regression where it runs today, so the
@@ -1500,13 +1628,14 @@ class PTABatch:
         check_precision(precision, allow_auto=True)
         if precision != "auto":
             return precision
-        if getattr(self, "_pack", None):
-            # packed batches are f64-only (no segment-masked mixed
-            # refinement operator): auto resolves without a probe
+        if getattr(self, "_pack", None) and fused is not None \
+                and not fused:
+            # the classic packed program is f64-only: auto resolves
+            # without a probe (mixed needs the fused kernel path)
             return "f64"
         cache_key = (self.structure_key(self.template),
                      self.shape_signature(), maxiter, threshold,
-                     ecorr_mode)
+                     ecorr_mode, fused)
         with _PRECISION_AUTO_LOCK:
             choice = _PRECISION_AUTO_CACHE.get(cache_key)
         if choice is not None:
@@ -1516,7 +1645,8 @@ class PTABatch:
         mixed_failed = False
         for mode in ("f64", "mixed"):
             key, fit_one = self._build_gls(maxiter, threshold,
-                                           ecorr_mode, mode)
+                                           ecorr_mode, mode,
+                                           fused=fused)
             if key not in self._fns:
                 self._fns[key] = jax.jit(jax.vmap(fit_one))
             out = self._fns[key](*args)  # compile + warm-up
@@ -1542,16 +1672,17 @@ class PTABatch:
         return choice
 
     def _dispatch_gls(self, maxiter=2, threshold=1e-12, ecorr_mode="auto",
-                      precision="f64"):
+                      precision="f64", fused=None):
         """Dispatch the GLS program WITHOUT pulling results (see
         _dispatch_wls); gls_fit == finalize(dispatch). Resolves
         precision="auto" to the measured per-structure winner first."""
         import jax
 
         precision = self._resolve_precision(precision, maxiter,
-                                            threshold, ecorr_mode)
+                                            threshold, ecorr_mode,
+                                            fused=fused)
         key, fit_one = self._build_gls(maxiter, threshold, ecorr_mode,
-                                       precision)
+                                       precision, fused=fused)
         t0 = obs_clock.now()
         warm = key in self._fns
         if not warm:
@@ -1561,7 +1692,7 @@ class PTABatch:
         return {"method": "gls", "t0": t0, "warm": warm, "x0": x0,
                 "maxiter": maxiter, "threshold": threshold,
                 "ecorr_mode": ecorr_mode, "precision": precision,
-                "out": out}
+                "fused": fused, "out": out}
 
     def _finalize_gls(self, handle):
         """Blocking half of the GLS fit: pull, mixed-precision
@@ -1603,7 +1734,8 @@ class PTABatch:
             return self.gls_fit(maxiter=handle["maxiter"],
                                 threshold=handle["threshold"],
                                 ecorr_mode=handle["ecorr_mode"],
-                                precision="f64")
+                                precision="f64",
+                                fused=handle.get("fused"))
         cov = covn / (norm[:, :, None] * norm[:, None, :])
         chi2 = self._maybe_inject_divergence(chi2, "gls")
         x, chi2 = self._isolate_diverged(handle["x0"], x, chi2)
@@ -1617,7 +1749,7 @@ class PTABatch:
         return x, chi2, cov
 
     def gls_fit(self, maxiter=2, threshold=1e-12, ecorr_mode="auto",
-                precision="f64"):
+                precision="f64", fused=None):
         """Vmapped, mesh-sharded multi-pulsar GLS fit — the
         BASELINE.json north-star path (NANOGrav-15yr-style refit with
         EFAC/EQUAD/ECORR/red-noise) as ONE jitted program. See
@@ -1635,21 +1767,25 @@ class PTABatch:
         bucket structure (cached per process) and uses the winner —
         see :meth:`_resolve_precision`.
 
+        ``fused`` selects the packed fused-kernel program (default
+        True on packed plan batches; ignored elsewhere) — see
+        :meth:`_build_gls_packed`.
+
         Returns (x_fit, chi2_whitened, cov) like wls_fit; diverged
         pulsars reported via self.diverged.
         """
         return self._finalize_gls(self._dispatch_gls(
-            maxiter, threshold, ecorr_mode, precision))
+            maxiter, threshold, ecorr_mode, precision, fused=fused))
 
     def _build_method(self, method, maxiter, threshold, ecorr_mode,
-                      precision):
+                      precision, fused=None):
         """Shared method dispatch for program_key/aot_lower: returns
         (cache_key, fit_one) with the per-method maxiter default
         applied (gls: 2, wls: 3)."""
         if method == "gls":
             maxiter = 2 if maxiter is None else maxiter
             return self._build_gls(maxiter, threshold, ecorr_mode,
-                                   precision)
+                                   precision, fused=fused)
         if method == "wls":
             if precision != "f64":
                 raise ValueError(
@@ -1660,15 +1796,18 @@ class PTABatch:
         raise ValueError(f"aot_compile: unknown method {method!r}")
 
     def program_key(self, method="gls", maxiter=None, threshold=1e-12,
-                    ecorr_mode="auto", precision="f64"):
+                    ecorr_mode="auto", precision="f64", fused=None):
         """The _fns cache key the given fit options compile to — lets
         a fleet/serve scheduler test ``key in batch._fns`` (is this
-        program already warm?) without building or tracing anything."""
+        program already warm?) without building or tracing anything.
+        Fused packed programs key as "packed-fused", so executable
+        caches (serve/engine.py) never alias them with classic-path
+        builds."""
         return self._build_method(method, maxiter, threshold, ecorr_mode,
-                                  precision)[0]
+                                  precision, fused=fused)[0]
 
     def aot_lower(self, method="gls", maxiter=None, threshold=1e-12,
-                  ecorr_mode="auto", precision="f64"):
+                  ecorr_mode="auto", precision="f64", fused=None):
         """Trace (lower) one vmapped fit program WITHOUT compiling it.
 
         Tracing is GIL-bound Python work, so a pipelined executor runs
@@ -1684,7 +1823,8 @@ class PTABatch:
         from .. import fitter
 
         key, fit_one = self._build_method(method, maxiter, threshold,
-                                          ecorr_mode, precision)
+                                          ecorr_mode, precision,
+                                          fused=fused)
         import jax
 
         low = fitter.aot_lower(jax.jit(jax.vmap(fit_one)), self._x0(),
@@ -1712,7 +1852,7 @@ class PTABatch:
                 **info}
 
     def aot_compile(self, method="gls", maxiter=None, threshold=1e-12,
-                    ecorr_mode="auto", precision="f64"):
+                    ecorr_mode="auto", precision="f64", fused=None):
         """Ahead-of-time compile one vmapped fit program, splitting
         Python/JAX *trace* time from XLA *backend compile* time and
         recording the compiled executable's own cost model.
@@ -1733,7 +1873,8 @@ class PTABatch:
         which splits this into aot_lower + _aot_backend_compile.
         """
         return self._aot_backend_compile(self.aot_lower(
-            method, maxiter, threshold, ecorr_mode, precision))
+            method, maxiter, threshold, ecorr_mode, precision,
+            fused=fused))
 
     @staticmethod
     def structure_key(model):
@@ -2338,7 +2479,7 @@ class PTAFleet:
             batch = self._resolve(key)
             use_gls = self._use_gls(batch, method)
             bkw = dict(kw)
-            allowed = ({"threshold", "ecorr_mode", "precision"}
+            allowed = ({"threshold", "ecorr_mode", "precision", "fused"}
                        if use_gls else {"threshold"})
             extra = set(bkw) - allowed
             if extra:
@@ -2351,12 +2492,14 @@ class PTAFleet:
                 bkw["precision"] = batch._resolve_precision(
                     bkw["precision"], maxiter,
                     bkw.get("threshold", 1e-12),
-                    bkw.get("ecorr_mode", "auto"))
+                    bkw.get("ecorr_mode", "auto"),
+                    fused=bkw.get("fused"))
             if use_gls:
                 pkey = batch.program_key(
                     "gls", maxiter, bkw.get("threshold", 1e-12),
                     bkw.get("ecorr_mode", "auto"),
-                    bkw.get("precision", "f64"))
+                    bkw.get("precision", "f64"),
+                    fused=bkw.get("fused"))
             else:
                 pkey = batch.program_key(
                     "wls", maxiter, bkw.get("threshold", 1e-12))
@@ -2380,6 +2523,7 @@ class PTAFleet:
                         lkw["ecorr_mode"] = bkw.get("ecorr_mode",
                                                     "auto")
                         lkw["precision"] = bkw.get("precision", "f64")
+                        lkw["fused"] = bkw.get("fused")
                     lowered.append((key, batch,
                                     batch.aot_lower(**lkw)))
             tid = obs_trace.current_trace_id()
@@ -2429,7 +2573,8 @@ class PTAFleet:
                         h = batch._dispatch_gls(
                             maxiter, bkw.get("threshold", 1e-12),
                             bkw.get("ecorr_mode", "auto"),
-                            bkw.get("precision", "f64"))
+                            bkw.get("precision", "f64"),
+                            fused=bkw.get("fused"))
                     else:
                         h = batch._dispatch_wls(
                             maxiter, bkw.get("threshold", 1e-12))

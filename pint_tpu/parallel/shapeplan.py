@@ -41,8 +41,21 @@ DEFAULT_COMPILE_BUDGET = 4
 # below this, vector lanes go idle and per-program overhead dominates
 DEFAULT_MIN_WIDTH = 1024
 # candidate-pool size for the ladder search: subsets of <= budget
-# widths from <= _POOL candidates keeps the search < ~1000 ladders
-_POOL = 12
+# widths from <= _POOL candidates keeps the search a few thousand
+# ladders even with the quantum ladder multiplying it out
+_POOL = 20
+
+# finer segment quanta the planner may refine to when the caller's
+# quantum is coarser: every entry is a multiple of 32, so the packed
+# path's gcd-derived block size and the 32-aligned ECORR epoch
+# quantum stay compatible (parallel/pta.py::stack_packed)
+_QUANTUM_LADDER = (128, 96, 64, 32)
+# relative cost penalty for finer quanta, x(1 + _QUANTUM_PENALTY/q):
+# the block-factorized Gram stores + segment-sums one (K, K) block
+# per q rows next to the 2 K^2 multiply-adds per row, an overhead
+# share of ~1/(2q); doubled to 1/q to also cover the intermediate's
+# memory traffic. A finer quantum must buy its padding back first.
+_QUANTUM_PENALTY = 1.0
 
 
 def pow2_width(n, floor=256):
@@ -213,14 +226,17 @@ def _ffd_pack(segs, width, max_pack):
 
 
 # relative cost of one extra evaluation slot per row: the packed path
-# evaluates phase/design once per slot over the whole row, which is
-# cheap next to the K^2-per-TOA Gram but not free. Tuned to the
-# measured phase/Gram FLOP ratio at K=64.
-_SLOT_COST = 0.05
+# evaluates phase + the parameter jacobian once per slot over the
+# whole row — cheap next to the K^2-per-TOA Gram but not free. With
+# the x-independent slot work hoisted out of the iteration loop
+# (parallel/pta.py packed hoist) the residual marginal cost is the
+# per-iteration phase/jacobian alone; 0.08 is its measured share.
+_SLOT_COST = 0.08
 # the planner's padding target: among ladders at or under this ratio
 # the slot-overhead cost decides; a ladder over it only wins when no
-# compliant ladder exists
-DEFAULT_PADDING_TARGET = 1.10
+# compliant ladder exists. 1.05 is the fused-pipeline acceptance
+# bound at the 670k fleet scale (ERRORBUDGET.md padded-FLOP budget).
+DEFAULT_PADDING_TARGET = 1.05
 
 
 def _evaluate_ladder(widths, segs_desc, max_pack):
@@ -290,7 +306,15 @@ def plan_shapes(counts, quantum=DEFAULT_QUANTUM, max_pack=DEFAULT_MAX_PACK,
     FFD-packed padded area plus a per-slot evaluation overhead, with
     ``padding_target`` as a soft ceiling: ladders padding worse than
     the target lose to any compliant ladder regardless of slot count.
-    Deterministic for fixed inputs.
+
+    ``quantum`` is the COARSEST alignment the caller accepts: the
+    search also tries the finer entries of ``_QUANTUM_LADDER`` below
+    it (each cost-penalized by x(1 + _QUANTUM_PENALTY/q) for its
+    block-bookkeeping overhead) and keeps the overall winner — the
+    compile budget is unchanged, only the segment alignment inside
+    the same number of programs gets finer. Explicitly fine quanta
+    (e.g. test fixtures at 16) see a single-entry ladder and behave
+    exactly as before. Deterministic for fixed inputs.
     """
     counts = [int(c) for c in counts]
     if not counts or min(counts) < 1:
@@ -298,6 +322,24 @@ def plan_shapes(counts, quantum=DEFAULT_QUANTUM, max_pack=DEFAULT_MAX_PACK,
     if compile_budget < 1:
         raise ValueError("compile_budget must be >= 1")
     max_pack = max(1, int(max_pack))
+    best = None  # ((over_target, cost, n_widths, n_rows), buckets)
+    for q in [int(quantum)] + [m for m in _QUANTUM_LADDER
+                               if m < int(quantum)]:
+        cand = _plan_for_quantum(counts, q, max_pack, compile_budget,
+                                 min_width, padding_target)
+        if best is None or cand[0] < best[0]:
+            best = cand
+    return ShapePlan(buckets=best[1], counts=tuple(counts),
+                     quantum=int(quantum), max_pack=max_pack,
+                     compile_budget=int(compile_budget))
+
+
+def _plan_for_quantum(counts, quantum, max_pack, compile_budget,
+                      min_width, padding_target):
+    """One quantum's ladder search: ((over, cost, n_widths, n_rows),
+    buckets) for the best ladder at this alignment, with the cost
+    already carrying the finer-quantum penalty so plan_shapes can
+    compare candidates across quanta directly."""
     segs = sorted(
         ((max(align_up(n, quantum), 1), i, n)
          for i, n in enumerate(counts)),
@@ -307,16 +349,15 @@ def plan_shapes(counts, quantum=DEFAULT_QUANTUM, max_pack=DEFAULT_MAX_PACK,
     top = max(max(seg_widths), min_width)
     rest = [w for w in pool if w != top]
     real = sum(counts)
-    best = None  # ((over_target, cost, n_widths, n_rows), buckets)
+    penalty = 1.0 + _QUANTUM_PENALTY / quantum
+    best = None
     for k in range(0, min(compile_budget, len(rest) + 1)):
         for combo in itertools.combinations(rest, k):
             cost, area, buckets = _evaluate_ladder(
                 combo + (top,), segs, max_pack)
             n_rows = sum(len(b.rows) for b in buckets)
             over = area > padding_target * real
-            key = (over, cost, len(buckets), n_rows)
+            key = (over, cost * penalty, len(buckets), n_rows)
             if best is None or key < best[0]:
                 best = (key, buckets)
-    return ShapePlan(buckets=best[1], counts=tuple(counts),
-                     quantum=int(quantum), max_pack=max_pack,
-                     compile_budget=int(compile_budget))
+    return best

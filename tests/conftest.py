@@ -24,6 +24,18 @@ jax.config.update("jax_enable_x64", True)
 
 
 @pytest.fixture
+def pallas_interpret():
+    """Interpret-mode selector for Pallas kernel tests. On hosts
+    without a real TPU (tier-1 CI) this is True: the kernel bodies run
+    through the Pallas interpreter on CPU, so the exact kernel logic —
+    whitening, MXU Gram accumulation, block padding — is exercised
+    against the jnp references on every run, not just on hardware. On
+    a real TPU it is False and the same tests compile the kernels for
+    the chip."""
+    return jax.devices()[0].platform != "tpu"
+
+
+@pytest.fixture
 def device_mesh():
     """N>=4 virtual-device CPU mesh for distributed-failure-domain
     tests. The XLA_FLAGS above normally guarantee 8 virtual devices,

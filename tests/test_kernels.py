@@ -37,6 +37,136 @@ def test_harmonic_sums_weighted_and_padding():
     assert np.abs(np.asarray(s0) - np.asarray(s1)).max() < tol
 
 
+def test_block_grams_pallas_matches_jnp(pallas_interpret):
+    """The seggram Pallas kernel (interpret mode on CPU) against its
+    f64 jnp reference: f32 block products, <= 1e-6 relative."""
+    from pint_tpu.kernels.seggram import block_grams_jnp, block_grams_pallas
+
+    rng = np.random.default_rng(11)
+    n, k, block = 256, 21, 32  # k deliberately NOT lane-aligned
+    x = rng.normal(size=(n, k))
+    ref = np.asarray(block_grams_jnp(x, block))
+    out = np.asarray(block_grams_pallas(x, block,
+                                        interpret=pallas_interpret))
+    assert out.shape == ref.shape
+    scale = np.abs(ref).max()
+    assert np.abs(out - ref).max() <= 1e-6 * scale
+
+
+def test_fused_block_gls_pallas_matches_jnp(pallas_interpret):
+    """The fused whiten+Gram kernel (interpret mode on CPU) against
+    the f64 fused reference: same augmented-tile factorization, f32
+    in-kernel whitening and MXU accumulation, <= 1e-6 relative."""
+    from pint_tpu.kernels.fusedgls import (augment, fused_block_gls_jnp,
+                                           fused_block_gls_pallas)
+
+    rng = np.random.default_rng(12)
+    n, k, block = 192, 9, 32
+    x = rng.normal(size=(n, k))
+    r = rng.normal(size=n)
+    winv = 1.0 / rng.uniform(0.5, 2.0, n)
+    aug = np.asarray(augment(x, r, winv))
+    ref = np.asarray(fused_block_gls_jnp(aug, block))
+    out = np.asarray(fused_block_gls_pallas(aug, block,
+                                            interpret=pallas_interpret))
+    assert out.shape == ref.shape
+    scale = np.abs(ref).max()
+    assert np.abs(out - ref).max() <= 1e-6 * scale
+
+
+def test_fused_segment_gls_interpret_end_to_end(pallas_interpret):
+    """The full fused segment pass — Pallas kernel (interpreted on
+    CPU) + f64 segment reduction — against the f64 reference and a
+    dense per-segment recomputation."""
+    from pint_tpu.kernels import fused_segment_gls, fused_segment_gls_jnp
+
+    rng = np.random.default_rng(13)
+    n, k, block, n_seg = 256, 7, 16, 3
+    x = rng.normal(size=(n, k))
+    r = rng.normal(size=n)
+    winv = 1.0 / rng.uniform(0.5, 2.0, n)
+    block_seg = (np.arange(n // block) % n_seg).astype(np.int32)
+    a_ref, b_ref, rnr_ref = (np.asarray(v) for v in fused_segment_gls_jnp(
+        x, r, winv, block_seg, n_seg, block))
+    # dense recomputation per segment
+    owner = np.repeat(block_seg, block)
+    for s in range(n_seg):
+        m = owner == s
+        mw = x[m] * winv[m][:, None]
+        zw = r[m] * winv[m]
+        assert np.allclose(a_ref[s], mw.T @ mw, rtol=0, atol=1e-12)
+        assert np.allclose(b_ref[s], mw.T @ zw, rtol=0, atol=1e-12)
+        assert np.isclose(rnr_ref[s], zw @ zw, rtol=0, atol=1e-12)
+    # mixed dispatch through the (interpreted) kernel: f32 tolerance
+    a_mx, b_mx, rnr_mx = (np.asarray(v) for v in fused_segment_gls(
+        x, r, winv, block_seg, n_seg, block, precision="mixed",
+        interpret=pallas_interpret))
+    scale = np.abs(a_ref).max()
+    assert np.abs(a_mx - a_ref).max() <= 1e-6 * scale
+    assert np.abs(b_mx - b_ref).max() <= 1e-6 * np.abs(b_ref).max()
+    assert np.abs(rnr_mx - rnr_ref).max() <= 1e-6 * np.abs(rnr_ref).max()
+    # f64 dispatch is the reference bit-for-bit
+    a64, b64, rnr64 = (np.asarray(v) for v in fused_segment_gls(
+        x, r, winv, block_seg, n_seg, block, precision="f64"))
+    assert np.array_equal(a64, a_ref)
+    assert np.array_equal(b64, b_ref)
+    assert np.array_equal(rnr64, rnr_ref)
+
+
+def test_fused_pallas_fallback_is_visible(monkeypatch, caplog):
+    """A failing Pallas dispatch must fall back to the f32 emulation
+    AND leave a trail: counter bump, flight-recorder note, one log
+    warning — never a silent except/pass."""
+    import logging
+
+    from pint_tpu.kernels import fallback as fb
+    from pint_tpu.kernels import fusedgls
+    from pint_tpu.obs import RECORDER, REGISTRY
+
+    def boom(*a, **kw):
+        raise RuntimeError("mosaic lowering unavailable")
+
+    monkeypatch.setattr(fusedgls, "fused_segment_gls_pallas", boom)
+    # logging_setup.setup() (run by any earlier CLI-script test) pins
+    # propagate=False on the "pint_tpu" logger, which would strand the
+    # fallback warning below caplog's root handler
+    monkeypatch.setattr(logging.getLogger("pint_tpu"), "propagate", True)
+    fb.reset_warned_for_tests()
+    before = REGISTRY.counter(fb.COUNTER_NAME).value
+    rng = np.random.default_rng(14)
+    n, k, block = 64, 5, 16
+    x = rng.normal(size=(n, k))
+    r = rng.normal(size=n)
+    winv = np.ones(n)
+    block_seg = np.zeros(n // block, np.int32)
+    with caplog.at_level(logging.WARNING,
+                         logger="pint_tpu.kernels.fallback"):
+        a, b, rnr = fusedgls.fused_segment_gls(
+            x, r, winv, block_seg, 1, block,
+            precision="mixed", interpret=True)
+    assert REGISTRY.counter(fb.COUNTER_NAME).value == before + 1
+    assert any("fell back" in r.getMessage() for r in caplog.records)
+    # the result is the f32 emulation, not garbage
+    a_ref, b_ref, _ = fusedgls.fused_segment_gls_f32_jnp(
+        x, r, winv, block_seg, 1, block)
+    assert np.array_equal(np.asarray(a), np.asarray(a_ref))
+    assert np.array_equal(np.asarray(b), np.asarray(b_ref))
+    # warn-once: a second identical failure is counted, not re-logged
+    caplog.clear()
+    with caplog.at_level(logging.WARNING,
+                         logger="pint_tpu.kernels.fallback"):
+        fusedgls.fused_segment_gls(x, r, winv, block_seg, 1, block,
+                                   precision="mixed", interpret=True)
+    assert REGISTRY.counter(fb.COUNTER_NAME).value == before + 2
+    assert not any("fell back" in r.getMessage()
+                   for r in caplog.records)
+    # the flight recorder carries the kernel name + reason
+    notes = [e for e in RECORDER.events()
+             if e.get("what") == "pallas_fallback"]
+    assert notes and "fusedgls" in notes[-1]["kernel"]
+    assert "mosaic lowering unavailable" in notes[-1]["reason"]
+
+
 def test_z2m_h_test_through_kernel_path():
     """End statistic: H-test of a pulsed signal is unchanged (to stat
     noise) whichever path computes the harmonic sums."""

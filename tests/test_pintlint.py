@@ -804,6 +804,109 @@ def test_durable_write_suppressible(tmp_path):
                for f in fs)
 
 
+# -- kernel-silent-fallback ------------------------------------------
+
+
+KERNEL_CFG = LintConfig(kernel_dispatch_modules=("/kernels/",))
+
+
+def test_kernel_silent_fallback_flags_bare_pass(tmp_path):
+    # the seed fixture: the except/pass that shipped in
+    # kernels/seggram.py's dispatcher — one mosaic quirk away from an
+    # invisible fleet-wide jnp slowdown
+    bad = """
+        def segment_gram(x, seg, n_seg, block, precision="f64"):
+            if precision == "mixed":
+                try:
+                    return segment_gram_pallas(x, seg, n_seg, block)
+                except Exception:
+                    pass
+            return segment_gram_jnp(x, seg, n_seg, block)
+    """
+    fs = lint(tmp_path, {"kernels/seggram.py": bad,
+                         "anchor.py": "x = 1\n"}, KERNEL_CFG)
+    assert len(live(fs, "kernel-silent-fallback")) == 1
+
+
+def test_kernel_silent_fallback_flags_silent_return(tmp_path):
+    # swallowing into a direct fallback return is just as invisible
+    # as pass
+    bad = """
+        def harmonic_sums(ph, m):
+            try:
+                return harmonic_sums_pallas(ph, m)
+            except Exception:
+                return harmonic_sums_jnp(ph, m)
+    """
+    fs = lint(tmp_path, {"kernels/harmonics.py": bad,
+                         "anchor.py": "x = 1\n"}, KERNEL_CFG)
+    assert len(live(fs, "kernel-silent-fallback")) == 1
+
+
+def test_kernel_silent_fallback_quiet_on_noted_or_reraised(tmp_path):
+    good = """
+        from .fallback import note_pallas_fallback
+
+        def segment_gram(x, seg, n_seg, block, precision="f64"):
+            if precision == "mixed":
+                try:
+                    return segment_gram_pallas(x, seg, n_seg, block)
+                except Exception as exc:
+                    note_pallas_fallback("seggram.segment_gram", exc)
+            return segment_gram_jnp(x, seg, n_seg, block)
+
+        def strict(x, seg, n_seg, block):
+            try:
+                return segment_gram_pallas(x, seg, n_seg, block)
+            except Exception:
+                raise
+    """
+    fs = lint(tmp_path, {"kernels/seggram.py": good,
+                         "anchor.py": "x = 1\n"}, KERNEL_CFG)
+    assert live(fs, "kernel-silent-fallback") == []
+
+
+def test_kernel_silent_fallback_scoped_to_kernel_modules(tmp_path):
+    # non-Pallas try bodies in kernels/ (the _tpu_backend device
+    # probe) and Pallas swallows OUTSIDE kernels/ are both legal
+    src_probe = """
+        def _tpu_backend():
+            import jax
+            try:
+                return jax.devices()[0].platform == "tpu"
+            except Exception:
+                return False
+    """
+    src_outside = """
+        def helper(x):
+            try:
+                return run_pallas(x)
+            except Exception:
+                pass
+    """
+    fs = lint(tmp_path, {"kernels/seggram.py": src_probe,
+                         "other.py": src_outside}, KERNEL_CFG)
+    assert live(fs, "kernel-silent-fallback") == []
+
+
+def test_kernel_silent_fallback_suppressible(tmp_path):
+    src = """
+        def probe(x):
+            try:
+                return run_pallas_probe(x)
+            # a capability probe: failure IS the answer, not a
+            # degradation worth counting
+            # pintlint: disable=kernel-silent-fallback
+            except Exception:
+                return None
+    """
+    fs = lint(tmp_path, {"kernels/probe.py": src,
+                         "anchor.py": "x = 1\n"}, KERNEL_CFG)
+    assert live(fs, "kernel-silent-fallback") == []
+    assert any(f.rule == "kernel-silent-fallback" and f.suppressed
+               for f in fs)
+
+
 # -- suppression grammar ---------------------------------------------
 
 

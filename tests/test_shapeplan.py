@@ -101,17 +101,53 @@ def test_plan_signature_stable_and_geometry_sensitive():
 
 
 def test_plan_full_scale_meets_padding_and_compile_targets():
-    """The tentpole acceptance numbers, as a host-only property: the
-    670k bench workload plans to <= 4 programs at <= 1.10 padding
-    (the pow2 ladder needs 6 programs for x1.46 on the same
-    counts)."""
+    """The fused-pipeline acceptance numbers, as a host-only property:
+    the 670k bench workload plans to <= 4 programs at <= 1.05 padding
+    (down from x1.092 before the quantum-ladder search; the pow2
+    ladder needs 6 programs for x1.46 on the same counts)."""
     counts = _ragged_counts()
     plan = plan_shapes([int(c) for c in counts])
     assert plan.n_programs <= 4
-    assert plan.padding_ratio <= 1.10
+    assert plan.padding_ratio <= 1.05
     assert sorted(plan.indices()) == list(range(len(counts)))
     pow2_area = sum(pow2_width(int(c)) for c in counts)
     assert pow2_area / counts.sum() > plan.padding_ratio
+
+
+def test_plan_quantum_ladder_ragged_tail():
+    """Finer-quantum ladder properties: (a) on a tail-heavy fixture
+    where every pulsar sits just above a coarse-quantum multiple, the
+    ladder picks a finer alignment and roughly halves the padding;
+    (b) on random ragged counts the ladder never does worse than
+    planning at the requested quantum alone; (c) geometry invariants
+    (exact coverage, requested-quantum signature stability) hold for
+    whatever quantum the search picks."""
+    from pint_tpu.parallel.shapeplan import _plan_for_quantum
+
+    # (a) constructed ragged tail: 260 TOAs is 4 over a 256 multiple,
+    # so coarse-only padding is x1.97 while the 32-quantum ladder
+    # entry fits a 288-wide row at x1.11
+    counts = [260] * 12
+    plan = plan_shapes(counts, quantum=256, max_pack=1,
+                       compile_budget=2, min_width=32)
+    _, coarse = _plan_for_quantum(counts, 256, 1, 2, 32, 1.05)
+    coarse_ratio = sum(b.padded_area for b in coarse) / sum(counts)
+    assert coarse_ratio > 1.9
+    assert plan.padding_ratio < 1.2
+    assert plan.quantum == 256  # signature keeps the REQUESTED quantum
+    # (b)+(c) random ragged tails: ladder <= coarse-only, coverage
+    # exact, widths aligned to some ladder quantum
+    for seed in range(5):
+        rng = np.random.default_rng(seed)
+        c = [int(x) for x in rng.integers(40, 4000, 24)]
+        p = plan_shapes(c, quantum=256, max_pack=4, compile_budget=3,
+                        min_width=64)
+        _, cb = _plan_for_quantum(c, 256, 4, 3, 64, 1.05)
+        coarse_ratio = sum(b.padded_area for b in cb) / sum(c)
+        assert p.padding_ratio <= coarse_ratio + 1e-12, seed
+        assert sorted(p.indices()) == list(range(24))
+        assert all(any(w % q == 0 for q in (256, 128, 96, 64, 32))
+                   for w in p.widths)
 
 
 def test_plan_invalid_inputs():
@@ -200,14 +236,45 @@ def test_packed_scope_guards(packed_fixture):
     models, toas, order, pb = packed_fixture
     with pytest.raises(RuntimeError):
         pb.wls_fit(maxiter=2)
+    # precision="mixed" needs the fused kernel program: the classic
+    # (fused=False) packed path stays f64-only
     with pytest.raises(ValueError):
-        pb.gls_fit(maxiter=2, precision="mixed")
+        pb.gls_fit(maxiter=2, precision="mixed", fused=False)
     with pytest.raises(RuntimeError):
         pb.time_residuals()
     with pytest.raises(RuntimeError):
         pb.phases()
-    # auto resolves to f64 without a probe on packed batches
-    assert pb._resolve_precision("auto") == "f64"
+    # auto resolves to f64 without a probe on the classic packed path
+    assert pb._resolve_precision("auto", fused=False) == "f64"
+
+
+def test_packed_mixed_fused_matches_sequential(packed_fixture):
+    """The fused mixed path — f32 in-kernel block Gram as the eigh
+    preconditioner, exact f64 RHS, f64 iterative refinement — must
+    still land within 1e-15 of the sequential per-pulsar f64 fit."""
+    models, toas, order, pb = packed_fixture
+    xm = np.asarray(pb.gls_fit(maxiter=2, precision="mixed")[0])
+    for lane, i in enumerate(order):
+        b1 = PTABatch([models[i]], [toas[i]])
+        x1 = np.asarray(b1.gls_fit(maxiter=2)[0])[0]
+        rel = np.max(np.abs(xm[lane] - x1)
+                     / np.maximum(np.abs(x1), 1e-300))
+        assert rel <= 1e-15, (i, rel)
+
+
+def test_packed_classic_path_bitwise_matches_fused(packed_fixture):
+    """fused=False keeps the pre-fused packed program as an unchanged
+    f64 reference; the fused default's parameters must agree with it
+    BITWISE (the hoisted noise build and whitening produce identical
+    floats). chi2 regroups the rNr reduction inside the augmented
+    Gram, so it may differ in the last ulp."""
+    models, toas, order, pb = packed_fixture
+    xf, cf, _ = pb.gls_fit(maxiter=2)
+    xc, cc, _ = pb.gls_fit(maxiter=2, fused=False)
+    assert np.array_equal(np.asarray(xf), np.asarray(xc))
+    relc = np.max(np.abs(np.asarray(cf) - np.asarray(cc))
+                  / np.abs(np.asarray(cc)))
+    assert relc <= 1e-12
 
 
 def test_packed_pack_state_round_trip(packed_fixture):
@@ -291,6 +358,37 @@ def test_fleet_plan_pipelined_bitwise_and_fault_parity(fleet_68):
     for i, (a, b) in enumerate(zip(xd, x1)):
         if i != victim:
             assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fleet_plan_mixed_fused_and_fault_parity(fleet_68):
+    """Mixed precision through the fused packed program at fleet
+    scope: <= 1e-15 against the per-lane f64 fleet, and a
+    solver_diverge injection still isolates exactly one pulsar and
+    restores it finite (the f64 refit fallback preserves the fused
+    program choice)."""
+    models, toas = fleet_68
+    models, toas = models[:6], toas[:6]
+    ref = PTAFleet(models, toas)
+    xr, _, _ = ref.fit(maxiter=2)
+    fleet = PTAFleet(models, toas, toa_bucket="plan", plan_quantum=16,
+                     plan_max_pack=3, plan_compile_budget=1,
+                     plan_min_width=128)
+    xm, _, _ = fleet.fit(maxiter=2, precision="mixed")
+    for a, b in zip(xm, xr):
+        a, b = np.asarray(a), np.asarray(b)
+        rel = np.max(np.abs(a - b) / np.maximum(np.abs(b), 1e-300))
+        assert rel <= 1e-15, rel
+    with inject(FaultPoint("solver_diverge", count=1,
+                           payload={"lanes": [1]})):
+        xd, _, _ = fleet.fit(maxiter=2, precision="mixed")
+    assert len(fleet.diverged) == 1
+    victim = fleet.diverged[0]
+    assert np.all(np.isfinite(np.asarray(xd[victim])))
+    for i, (a, b) in enumerate(zip(xd, xr)):
+        if i != victim:
+            a, b = np.asarray(a), np.asarray(b)
+            rel = np.max(np.abs(a - b) / np.maximum(np.abs(b), 1e-300))
+            assert rel <= 1e-15, (i, rel)
 
 
 def test_fleet_plan_kwarg_validation(fleet_68):
