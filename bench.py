@@ -967,6 +967,45 @@ def main():
                    f"{serve_report['recompiles_after_warmup']} recompiles "
                    "after warmup")
 
+    # open-loop saturation sweep: seeded Poisson arrivals through a
+    # monotone ladder of offered rates, reporting the p99-vs-throughput
+    # knee (where queueing starts dominating latency). Same posture as
+    # the serve stage: optional, daemon thread + join timeout, skip
+    # with PINT_TPU_BENCH_SKIP_SATURATION=1.
+    saturation_report = None
+
+    def _saturation_stage():
+        nonlocal saturation_report
+        try:
+            from pint_tpu.scripts.pint_serve_bench import run_arrival_sweep
+
+            rep = run_arrival_sweep(n_per_rate=48)
+            saturation_report = rep  # set LAST: completion marker
+        except Exception as e:
+            _stage(f"saturation stage failed ({type(e).__name__}: {e}); "
+                   "headline JSON unaffected")
+
+    saturation_wedged = False
+    if os.environ.get("PINT_TPU_BENCH_SKIP_SATURATION") == "1":
+        _stage("saturation stage skipped "
+               "(PINT_TPU_BENCH_SKIP_SATURATION=1)")
+    else:
+        _stage("saturation: open-loop Poisson arrival sweep "
+               "(8 offered rates x 48 requests)")
+        tsat = threading.Thread(target=_saturation_stage, daemon=True)
+        tsat.start()
+        tsat.join(timeout=600)
+        saturation_wedged = tsat.is_alive()
+        if saturation_wedged:
+            saturation_report = None  # snapshot: late finish must not race
+            _stage("saturation stage timed out; headline JSON "
+                   "unaffected")
+        elif saturation_report is not None:
+            _stage(f"saturation: base {saturation_report['base_rps']} rps, "
+                   f"knee {saturation_report['knee_rps']} rps, "
+                   f"p99@knee {saturation_report['p99_at_knee_s']} s, "
+                   f"shed onset {saturation_report['shed_onset_rps']}")
+
     # chaos side metric: the same serve stream with a 5% toa_nan fault
     # schedule vs a fault-free reference — the trajectory tracks
     # robustness (zero healthy-request failures, healthy end state,
@@ -1580,6 +1619,39 @@ def main():
         "serve_max_param_rel_diff": (
             serve_report.get("max_param_rel_diff_vs_offline")
             if serve_report else None),
+        "reqlife_overhead_pct": (
+            serve_report.get("reqlife_overhead_pct")
+            if serve_report else None),
+        "reqlife_lost_records": (
+            serve_report.get("reqlife_lost_records")
+            if serve_report else None),
+        "reqlife_nonterminal": (
+            serve_report.get("reqlife_nonterminal")
+            if serve_report else None),
+        "reqlife_bitwise_on_off": (
+            serve_report.get("reqlife_bitwise_on_off")
+            if serve_report else None),
+        "reqlife_exactly_one_terminal": (
+            serve_report.get("reqlife_exactly_one_terminal")
+            if serve_report else None),
+        "serve_saturation_base_rps": (
+            saturation_report["base_rps"]
+            if saturation_report else None),
+        "serve_saturation_knee_rps": (
+            saturation_report["knee_rps"]
+            if saturation_report else None),
+        "serve_saturation_p99_at_knee_s": (
+            saturation_report["p99_at_knee_s"]
+            if saturation_report else None),
+        "serve_saturation_shed_onset_rps": (
+            saturation_report["shed_onset_rps"]
+            if saturation_report else None),
+        "serve_saturation_monotone": (
+            saturation_report["monotone_offered"]
+            if saturation_report else None),
+        "serve_saturation_saturated": (
+            saturation_report["saturated"]
+            if saturation_report else None),
         "chaos_ok": chaos_report["ok"] if chaos_report else None,
         "chaos_injected": (chaos_report["injected"]
                            if chaos_report else None),
@@ -1709,7 +1781,12 @@ def main():
 
     for _env, _rep, _keys in (
         ("PINT_TPU_BENCH_SKIP_SERVE", serve_report,
-         [k for k in meta if k.startswith("serve_")]),
+         [k for k in meta
+          if (k.startswith("serve_")
+              and not k.startswith("serve_saturation_"))
+          or k.startswith("reqlife_")]),
+        ("PINT_TPU_BENCH_SKIP_SATURATION", saturation_report,
+         [k for k in meta if k.startswith("serve_saturation_")]),
         ("PINT_TPU_BENCH_SKIP_CHAOS", chaos_report,
          [k for k in meta if k.startswith("chaos_")
           and not k.startswith(("chaos_device_", "chaos_kill_"))]),
@@ -1760,6 +1837,13 @@ def main():
         _note_null("mixed_fused_incomplete" if _want_fused_mixed
                    else "mixed_fused_off:not_tpu",
                    "gls_fused_mixed_refit_s", "gls_fused_mixed_mfu_pct")
+    if saturation_report is not None:
+        # the sweep ran but some curve keys are legitimately null
+        # (e.g. the single-threaded driver's queue never fills): pass
+        # its own reason codes through to the regress gate
+        for _k, _r in (saturation_report.get("null_reasons")
+                       or {}).items():
+            _note_null("sweep:" + _r, "serve_saturation_" + _k)
     _note_null("flag_unset:only_set_on_wedge",
                "measured_670k_mixed_overlapped_headline")
     meta["null_reasons"] = null_reasons
@@ -1770,8 +1854,9 @@ def main():
         "vs_baseline": round(vs_baseline, 3),
         "detail": meta,
     }), flush=True)
-    if wedged or serve_wedged or chaos_wedged or fleet_wedged \
-            or fused_wedged or full_alive or _MIXED_THREAD_ALIVE:
+    if wedged or serve_wedged or saturation_wedged or chaos_wedged \
+            or fleet_wedged or fused_wedged or full_alive \
+            or _MIXED_THREAD_ALIVE:
         # a daemon thread stuck in a C++ device wait can hang (or a
         # still-live dropped full-scale worker can crash) normal
         # interpreter teardown — measured rc=250 from exactly that;

@@ -17,8 +17,8 @@ from .core import (Finding, Rule, RULES, all_rules, counts_by_rule,
 # importing the rule modules populates the registry
 from . import (rules_bench, rules_bucket, rules_budget,  # noqa: F401
                rules_durable, rules_faults, rules_kernels, rules_locks,
-               rules_obs, rules_precision, rules_quality,
-               rules_retrace)
+               rules_obs, rules_precision, rules_quality, rules_retrace,
+               rules_serve)
 from .report import json_report, text_report
 
 __all__ = [

@@ -231,6 +231,22 @@ QUALITY_SIGNAL_PATTERN = r"relres_failed|chi2_whitened"
 QUALITY_RECORD_PATTERN = (
     r"quality|FITQ|obs_fitq|record_fit_batch|note_fallback")
 
+# -- serve request-state coverage -------------------------------------
+
+# Modules (normalized "/"-prefixed path suffixes) that own the serve
+# request state machine: any function there that assigns a request's
+# terminal outcome (``res.status`` / ``res.reason``) must also emit a
+# lifecycle transition (pint_tpu.obs.reqlife) or a telemetry record in
+# the same function — a status set on a path the ledger never hears
+# about breaks the exactly-one-terminal-state invariant silently.
+SERVE_STATE_MODULES = ("/serve/engine.py",)
+
+# Identifier pattern marking that the enclosing function records the
+# outcome (a lifecycle transition, a telemetry record/counter, or one
+# of the reject/fail helpers that do both).
+SERVE_STATE_RECORD_PATTERN = (
+    r"_lc|reqlife|lifecycle|telemetry|_reject|_fail")
+
 # Names that mark a value as a NaN-signalling convergence diagnostic:
 # comparing one of these with ``>`` (False under NaN) silently
 # swallows a diverged fit. ADVICE.md round 5 found three variants of
@@ -261,6 +277,8 @@ class LintConfig:
     quality_signal_modules: tuple = ()
     quality_signal_pattern: str = QUALITY_SIGNAL_PATTERN
     quality_record_pattern: str = QUALITY_RECORD_PATTERN
+    serve_state_modules: tuple = ()
+    serve_state_record_pattern: str = SERVE_STATE_RECORD_PATTERN
 
     @classmethod
     def default(cls):
@@ -283,4 +301,5 @@ class LintConfig:
                    kernel_dispatch_modules=KERNEL_DISPATCH_MODULES,
                    budget_meta_modules=BUDGET_META_MODULES,
                    budgeted_meta_keys=budgeted,
-                   quality_signal_modules=QUALITY_SIGNAL_MODULES)
+                   quality_signal_modules=QUALITY_SIGNAL_MODULES,
+                   serve_state_modules=SERVE_STATE_MODULES)

@@ -28,9 +28,17 @@ the AOT compile split and attributes roofline MFU per program,
 machine-readable ``budgets.json``, and :mod:`slo` runs dual-window
 burn-rate alerts over serve telemetry.
 
+The request-lifecycle observatory (ISSUE 12) joins the two planes:
+:mod:`reqlife` tracks every serve request through its state machine
+(submitted -> queued -> packed -> executing -> delivered | shed |
+rejected | error) keyed by the same trace ids the ``serve.*`` spans
+carry, tail-latency exemplars on the serve histograms point back into
+that ledger, and per-tenant accounting rides the registry's label
+families behind a hard cardinality cap.
+
 CLI: ``python -m pint_tpu.obs`` (traced fleet demo, flight-dump ->
 Perfetto conversion, Prometheus rendering, the ``regress`` perf gate,
-and offline ``slo`` replay).
+offline ``slo`` replay, and ``tail`` p99-exemplar resolution).
 """
 
 from . import baseline  # noqa: F401
@@ -38,6 +46,7 @@ from . import clock  # noqa: F401
 from . import costmodel  # noqa: F401
 from . import drift  # noqa: F401
 from . import fitquality  # noqa: F401
+from . import reqlife  # noqa: F401
 from . import slo  # noqa: F401
 from .trace import (  # noqa: F401
     NOOP_SPAN,
@@ -71,7 +80,19 @@ from .costmodel import (  # noqa: F401
     executable_cost,
     mfu_pct,
 )
-from .slo import BurnRateMonitor, SLOSpec, serve_slos  # noqa: F401
+from .slo import (  # noqa: F401
+    BurnRateMonitor,
+    SLOSpec,
+    serve_slos,
+    tenant_slos,
+)
+from .reqlife import (  # noqa: F401
+    REQLIFE,
+    LifecycleLedger,
+    phase_split,
+    resolve_tail,
+    tail_artifact,
+)
 from .drift import CUSUM, EWMA, DriftBoard, DriftSentinel  # noqa: F401
 from .fitquality import (  # noqa: F401
     FITQ,
@@ -81,19 +102,22 @@ from .fitquality import (  # noqa: F401
 from .export import (  # noqa: F401
     chrome_trace,
     flight_spans,
+    reqlife_spans,
     write_chrome_trace,
 )
 
 __all__ = [
     "BurnRateMonitor", "CUSUM", "Counter", "DriftBoard",
     "DriftSentinel", "EWMA", "FITQ", "FitQualityLedger",
-    "FlightRecorder", "Gauge", "Histogram", "LEDGER", "NOOP_SPAN",
-    "ProgramLedger", "RECORDER", "REGISTRY", "Registry", "SLOSpec",
-    "Span", "TRACER", "Tracer", "attribute", "baseline",
-    "chrome_trace", "clock", "configure", "costmodel",
-    "current_trace_id", "device_spec", "disable", "drift", "enable",
-    "enabled", "executable_cost", "fit_quality_slos", "fitquality",
-    "flight_spans", "mfu_pct", "percentile", "prometheus_text",
-    "reset", "serve_slos", "slo", "span", "spans", "summary",
+    "FlightRecorder", "Gauge", "Histogram", "LEDGER",
+    "LifecycleLedger", "NOOP_SPAN", "ProgramLedger", "RECORDER",
+    "REGISTRY", "REQLIFE", "Registry", "SLOSpec", "Span", "TRACER",
+    "Tracer", "attribute", "baseline", "chrome_trace", "clock",
+    "configure", "costmodel", "current_trace_id", "device_spec",
+    "disable", "drift", "enable", "enabled", "executable_cost",
+    "fit_quality_slos", "fitquality", "flight_spans", "mfu_pct",
+    "percentile", "phase_split", "prometheus_text", "reqlife",
+    "reqlife_spans", "reset", "resolve_tail", "serve_slos", "slo",
+    "span", "spans", "summary", "tail_artifact", "tenant_slos",
     "write_chrome_trace",
 ]

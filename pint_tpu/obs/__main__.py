@@ -25,6 +25,11 @@ Subcommands:
   probe violation).
 - ``doctor``  — one CI entry point: regress + (optional) slo replay +
   (optional) fitq snapshot check; exit non-zero on ANY violation.
+- ``tail``    — "why was this request slow" in one command: resolve a
+  p99 tail-latency exemplar from a serve run (a ``--tail-out``
+  artifact of pint_serve_bench, or a live mini serve stream when no
+  file is given) to its request-lifecycle record — tenant, state
+  timeline, queue-wait vs execute split, and the flush trace id.
 """
 
 from __future__ import annotations
@@ -237,6 +242,51 @@ def _cmd_doctor(args):
     return 0 if out["ok"] else 1
 
 
+def _cmd_tail(args):
+    from . import reqlife
+
+    if args.artifact:
+        with open(args.artifact) as fh:
+            artifact = json.load(fh)
+    else:
+        # no artifact: run a small live serve stream and resolve its
+        # own tail (the reqlife twin of the `fitq` live mode)
+        from ..scripts.pint_serve_bench import run_serve_stream
+
+        print("[pint_trace] live serve stream of %d requests ..."
+              % args.n_requests, file=sys.stderr)
+        rep = run_serve_stream(n_requests=args.n_requests,
+                               sizes=tuple(args.sizes),
+                               bucket_floor=args.bucket_floor,
+                               seed=args.seed, compare_offline=False,
+                               measure_overhead=False)
+        artifact = rep["tail_artifact"]
+    if args.trace:
+        # resolve a specific trace id instead of the p99 exemplar
+        recs = [r for r in artifact.get("lifecycle", [])
+                if r.get("trace") == args.trace]
+        if not recs:
+            print(json.dumps({"resolved": False,
+                              "reason": "trace_not_in_ledger",
+                              "trace": args.trace}, indent=1))
+            return 1
+        split = reqlife.phase_split(recs[0])
+        out = {"resolved": True, "trace": args.trace,
+               "request_id": recs[0].get("request_id"),
+               "tenant": recs[0].get("tenant"),
+               "states": [s["state"] for s in recs[0]["states"]],
+               "queue_wait_s": split["queue_wait_s"],
+               "execute_s": split["execute_s"],
+               "per_state_s": split["per_state_s"],
+               "flush_trace": (recs[0].get("attrs") or {})
+               .get("flush_trace"),
+               "record": recs[0]}
+    else:
+        out = reqlife.resolve_tail(artifact)
+    print(json.dumps(out, indent=1, default=float))
+    return 0 if out.get("resolved") else 1
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         prog="python -m pint_tpu.obs",
@@ -327,6 +377,21 @@ def main(argv=None):
     d.add_argument("--json", action="store_true",
                    help="emit the machine-readable report")
     d.set_defaults(fn=_cmd_doctor)
+
+    t = sub.add_parser("tail", help="resolve a p99 tail exemplar to "
+                                    "its request-lifecycle record")
+    t.add_argument("artifact", nargs="?", default=None,
+                   help="tail artifact JSON (pint_serve_bench "
+                        "--tail-out); omitted -> run a live mini "
+                        "serve stream")
+    t.add_argument("--trace", default=None,
+                   help="resolve this trace id instead of the p99 "
+                        "exemplar")
+    t.add_argument("--n-requests", type=int, default=48)
+    t.add_argument("--sizes", type=int, nargs="+", default=[48])
+    t.add_argument("--seed", type=int, default=0)
+    t.add_argument("--bucket-floor", type=int, default=64)
+    t.set_defaults(fn=_cmd_tail)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -67,6 +67,31 @@ def write_chrome_trace(path, spans=None, process_name="pint_tpu"):
     return path
 
 
+def reqlife_spans(records):
+    """Convert request-lifecycle records (``LifecycleLedger.export()``)
+    into span dicts for :func:`chrome_trace`: one complete span per
+    consecutive state interval, one timeline row per tenant — the
+    request plane rendered next to the ``serve.*`` spans it joins via
+    trace ids."""
+    spans = []
+    for rec in records or []:
+        states = rec.get("states") or []
+        for prev, nxt in zip(states, states[1:]):
+            spans.append({
+                "name": "req.%s" % prev["state"],
+                "trace": rec.get("trace"),
+                "thread": "tenant:%s" % (rec.get("tenant") or "anon"),
+                "t0": prev.get("t"), "t1": nxt.get("t"),
+                "attrs": {"request_id": rec.get("request_id"),
+                          "tenant": rec.get("tenant"),
+                          "next_state": nxt.get("state"),
+                          "reason": nxt.get("reason"),
+                          "flush_trace": (rec.get("attrs") or {})
+                          .get("flush_trace")},
+            })
+    return spans
+
+
 def flight_spans(doc):
     """Pull the span events back out of a flight-recorder dump dict
     (``kind == "span"`` entries), ready for :func:`chrome_trace`."""

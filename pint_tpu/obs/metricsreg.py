@@ -12,8 +12,35 @@ stage summaries, and the profile harness all previously duplicated.
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
+
+#: Hard cap on distinct label sets per metric family (env-tunable).
+#: Past the cap a new label set folds into the ``other`` bucket and
+#: the ``metrics.label_overflow`` counter ticks — an unbounded tenant
+#: id space must never become unbounded registry memory.
+LABEL_CAP_ENV = "PINT_TPU_LABEL_CAP"
+
+
+def label_cap():
+    try:
+        return max(1, int(os.environ.get(LABEL_CAP_ENV, 64)))
+    except (TypeError, ValueError):
+        return 64
+
+
+_LBL_ESC = {"\\": "\\\\", '"': '\\"', "\n": "\\n"}
+
+
+def render_labels(labels):
+    """Canonical ``{k="v",...}`` rendering (sorted keys, Prometheus
+    label-value escaping) — the registry's storage-key suffix for
+    labeled metrics, chosen so exposition needs no re-rendering."""
+    body = ",".join(
+        '%s="%s"' % (k, "".join(_LBL_ESC.get(c, c) for c in str(v)))
+        for k, v in sorted(labels.items()))
+    return "{%s}" % body
 
 
 def percentile(values, q):
@@ -81,12 +108,18 @@ class Histogram:
     quantiles stay an unbiased estimate of the whole stream instead
     of silently narrowing to the most recent window. ``observed``
     and ``sum`` always cover the full stream — Prometheus ``_count``
-    / ``_sum`` stay exact either way."""
+    / ``_sum`` stay exact either way.
+
+    Exemplar slots: ``record(value, exemplar={...})`` keeps the
+    ``exemplar_slots`` largest-valued (value, labels) pairs seen so
+    far — trace id + labels on the max-latency observations — so a
+    p99 spike resolves to a concrete request (``obs tail``) instead
+    of an anonymous quantile."""
 
     __slots__ = ("_lock", "_capacity", "_values", "_observed",
-                 "_sum", "_rng")
+                 "_sum", "_rng", "_exemplars", "_exemplar_slots")
 
-    def __init__(self, capacity=4096, seed=0):
+    def __init__(self, capacity=4096, seed=0, exemplar_slots=4):
         import random
 
         self._lock = threading.Lock()
@@ -95,8 +128,10 @@ class Histogram:
         self._observed = 0
         self._sum = 0.0
         self._rng = random.Random(seed)
+        self._exemplars = []  # [(value, labels dict)], ascending
+        self._exemplar_slots = int(exemplar_slots)
 
-    def record(self, value):
+    def record(self, value, exemplar=None):
         val = float(value)
         with self._lock:
             self._observed += 1
@@ -107,6 +142,13 @@ class Histogram:
                 j = self._rng.randrange(self._observed)
                 if j < self._capacity:
                     self._values[j] = val
+            if exemplar is not None and self._exemplar_slots > 0:
+                ex = self._exemplars
+                if (len(ex) < self._exemplar_slots
+                        or val > ex[0][0]):
+                    ex.append((val, dict(exemplar)))
+                    ex.sort(key=lambda p: p[0])
+                    del ex[:-self._exemplar_slots]
         return self
 
     @property
@@ -129,11 +171,22 @@ class Histogram:
     def percentile(self, q):
         return percentile(self.values(), q)
 
+    def exemplars(self):
+        """Max-latency exemplars, largest first: JSON-safe dicts of
+        ``{"value": v, **labels}``."""
+        with self._lock:
+            return [{"value": v, **labels}
+                    for v, labels in reversed(self._exemplars)]
+
     def summary(self, quantiles=(50, 90, 99)):
         out = summary(self.values(), quantiles)
         with self._lock:
             out["observed"] = self._observed
             out["sum"] = self._sum
+            if self._exemplars:
+                out["exemplars"] = [{"value": v, **labels}
+                                    for v, labels
+                                    in reversed(self._exemplars)]
         return out
 
 
@@ -146,27 +199,66 @@ class Registry:
         self._counters = {}
         self._gauges = {}
         self._histograms = {}
+        self._families = {}  # base name -> set of rendered label sets
 
-    def counter(self, name):
+    def _family_key(self, name, labels):
+        """Storage key for a (name, labels) pair, enforcing the hard
+        per-family cardinality cap: the first ``label_cap()`` distinct
+        label sets are admitted verbatim; every later one folds into
+        the ``other`` bucket and ticks ``metrics.label_overflow``.
+        Unlabeled metrics pass through untouched (and uncapped)."""
+        if not labels:
+            return name
+        rendered = render_labels(labels)
+        overflow = False
+        with self._lock:
+            fam = self._families.setdefault(name, set())
+            if rendered not in fam:
+                if len(fam) < label_cap():
+                    fam.add(rendered)
+                else:
+                    overflow = True
+        if overflow:
+            # counted per folded observation: the counter's rate IS
+            # the rate of traffic landing in the overflow bucket
+            self.counter("metrics.label_overflow").inc()
+            return name + render_labels(
+                {k: "other" for k in labels})
+        return name + rendered
+
+    def counter(self, name, labels=None):
+        name = self._family_key(name, labels)
         with self._lock:
             m = self._counters.get(name)
             if m is None:
                 m = self._counters[name] = Counter()
         return m
 
-    def gauge(self, name):
+    def gauge(self, name, labels=None):
+        name = self._family_key(name, labels)
         with self._lock:
             m = self._gauges.get(name)
             if m is None:
                 m = self._gauges[name] = Gauge()
         return m
 
-    def histogram(self, name, capacity=4096):
+    def histogram(self, name, capacity=4096, labels=None):
+        name = self._family_key(name, labels)
         with self._lock:
             m = self._histograms.get(name)
             if m is None:
                 m = self._histograms[name] = Histogram(capacity)
         return m
+
+    def attach_histogram(self, name, hist, labels=None):
+        """Install a live Histogram object under ``name`` (shared, not
+        copied) — how ServeTelemetry's per-phase latency histograms
+        (and their exemplar slots) join the scraped exposition without
+        re-recording samples at export time."""
+        name = self._family_key(name, labels)
+        with self._lock:
+            self._histograms[name] = hist
+        return hist
 
     def absorb(self, mapping, prefix=""):
         """Fold a flat or nested dict of numbers into the registry:
@@ -215,6 +307,7 @@ class Registry:
             self._counters.clear()
             self._gauges.clear()
             self._histograms.clear()
+            self._families.clear()
 
 
 REGISTRY = Registry()
@@ -224,6 +317,23 @@ _PROM_BAD = re.compile(r"[^a-zA-Z0-9_]")
 
 def prom_name(name, prefix="pint_tpu_"):
     return prefix + _PROM_BAD.sub("_", name)
+
+
+def _prom_split(name, prefix):
+    """Split a registry storage key into (sanitized name, label body):
+    labeled keys carry their canonical ``{k="v"}`` suffix, which must
+    survive exposition verbatim rather than being sanitized away."""
+    base, brace, rest = name.partition("{")
+    labels = (brace + rest) if brace else ""
+    return prom_name(base, prefix), labels
+
+
+def _merge_labels(labels, extra):
+    """Append ``extra`` (e.g. a quantile label) into a rendered label
+    body, handling the unlabeled case."""
+    if not labels:
+        return "{%s}" % extra
+    return labels[:-1] + "," + extra + "}"
 
 
 def prometheus_text(registry=None, prefix="pint_tpu_"):
@@ -244,27 +354,38 @@ def prometheus_text(registry=None, prefix="pint_tpu_"):
             lines.append("# TYPE %s %s" % (pn, kind))
 
     for name, val in snap.get("counters", {}).items():
-        pn = prom_name(name, prefix)
+        pn, lbl = _prom_split(name, prefix)
         _type(pn, "counter")
-        lines.append("%s %s" % (pn, _prom_value(val)))
+        lines.append("%s%s %s" % (pn, lbl, _prom_value(val)))
     for name, val in snap.get("gauges", {}).items():
-        pn = prom_name(name, prefix)
+        pn, lbl = _prom_split(name, prefix)
         _type(pn, "gauge")
-        lines.append("%s %s" % (pn, _prom_value(val)))
+        lines.append("%s%s %s" % (pn, lbl, _prom_value(val)))
     for name, summ in snap.get("histograms", {}).items():
-        pn = prom_name(name, prefix)
+        pn, lbl = _prom_split(name, prefix)
         _type(pn, "summary")
         for q in (50, 90, 99):
-            lines.append('%s{quantile="0.%02d"} %s'
-                         % (pn, q, _prom_value(summ.get("p%d" % q))))
+            qlbl = _merge_labels(lbl, 'quantile="0.%02d"' % q)
+            lines.append('%s%s %s'
+                         % (pn, qlbl, _prom_value(summ.get("p%d" % q))))
         count = summ.get("observed", summ["count"])
-        lines.append("%s_count %s" % (pn, _prom_value(count)))
+        lines.append("%s_count%s %s" % (pn, lbl, _prom_value(count)))
         total = summ.get("sum")
         if total is None:
             mean = summ.get("mean")
             total = (mean * summ["count"]
                      if mean is not None and summ["count"] else 0)
-        lines.append("%s_sum %s" % (pn, _prom_value(total)))
+        lines.append("%s_sum%s %s" % (pn, lbl, _prom_value(total)))
+        for ex in summ.get("exemplars") or []:
+            # classic-text-format-safe exemplar: comment lines are
+            # ignored by Prometheus parsers, OpenMetrics-style body
+            ex = dict(ex)
+            val = ex.pop("value", None)
+            body = ",".join('%s="%s"' % (k, v)
+                            for k, v in sorted(ex.items())
+                            if v is not None)
+            lines.append("# exemplar: %s{%s} %s"
+                         % (pn, body, _prom_value(val)))
     return "\n".join(lines) + "\n"
 
 

@@ -107,17 +107,54 @@ class SLOSpec:
         return float(state[0]), float(state[1])
 
 
+def _tenant_row(snapshot, tenant):
+    return (snapshot.get("tenants") or {}).get(tenant) or {}
+
+
+def tenant_slos(tenants, latency_limit_s=0.25,
+                availability_budget=0.01, latency_budget=0.05,
+                **window_kw):
+    """Per-tenant availability + p99-latency SLOs over the
+    ``snapshot()["tenants"]`` rows (serve.metrics tenant accounting).
+    Tenants are an explicit list — the monitor tracks the principals
+    you promised budgets to, not whatever ids traffic invents (the
+    cardinality cap folds those into ``other``, which can itself be
+    monitored by naming it here)."""
+    specs = []
+    for t in tenants:
+        specs.append(SLOSpec(
+            "tenant_%s_availability" % t, availability_budget,
+            bad=lambda s, t=t: (_tenant_row(s, t).get("requests", 0)
+                                - _tenant_row(s, t).get("ok", 0)),
+            total=lambda s, t=t: _tenant_row(s, t).get("requests", 0),
+            **window_kw))
+        specs.append(SLOSpec(
+            "tenant_%s_latency_p99" % t, latency_budget,
+            value=lambda s, t=t: _tenant_row(s, t).get("p99_s"),
+            limit=latency_limit_s, **window_kw))
+    return specs
+
+
 def serve_slos(latency_limit_s=0.25, availability_budget=0.01,
                shed_budget=0.02, breaker_budget=0.02,
-               latency_budget=0.05, lane_budget=0.01, **window_kw):
+               latency_budget=0.05, lane_budget=0.01, tenants=None,
+               **window_kw):
     """The default serve-engine SLO set over
     ``ServeEngine.snapshot()`` dicts: availability (non-ok request
     fraction), queue sheds, breaker rejections, p99 latency vs a
     limit, and device-lane losses. Budgets must satisfy
     ``1 / budget > fast_burn`` or the alert is unreachable (burn is
     capped at 1/budget when every sample is bad) — 0.05 with the
-    14.4x default leaves headroom; 0.10 would not."""
-    return [
+    14.4x default leaves headroom; 0.10 would not.
+
+    tenants: optional list of tenant ids; each adds a per-tenant
+    availability + p99-latency pair (see :func:`tenant_slos`) riding
+    the same windows."""
+    extra = (tenant_slos(tenants, latency_limit_s=latency_limit_s,
+                         availability_budget=availability_budget,
+                         latency_budget=latency_budget, **window_kw)
+             if tenants else [])
+    return extra + [
         SLOSpec("availability", availability_budget,
                 bad=lambda s: (s.get("requests", 0)
                                - s.get("requests_ok", 0)),

@@ -74,29 +74,45 @@ def run_serve_stream(n_requests=216, max_batch=8, max_latency_s=0.05,
                      bucket_floor=64, cache_capacity=32,
                      sizes=(48, 96, 180), per_combo=3, maxiter=3,
                      precision="f64", compare_offline=True, mesh=None,
-                     seed=0, concurrent_prewarm=False):
+                     seed=0, concurrent_prewarm=False,
+                     measure_overhead=True, tenants=None):
     """Prewarm + stream n_requests fit requests round-robin over the
     mixed fleet; returns a JSON-safe report with the engine snapshot,
     recompile count after warmup, and (optionally) the max relative
     parameter difference vs the offline PTAFleet fit of the same
     pulsars. concurrent_prewarm=True warms the cache through
     ServeEngine.prewarm_concurrent (trace-serial / XLA-concurrent,
-    the fleet executor's compile path) instead of serial flushes."""
+    the fleet executor's compile path) instead of serial flushes.
 
-    from pint_tpu.serve import FitRequest, ServeEngine
+    The stream runs with a private request-lifecycle ledger attached
+    (reqlife_* report keys: terminal-state census, lost records, the
+    ``tail_artifact`` joining p99 exemplars to lifecycle records) and,
+    when ``measure_overhead``, re-runs a short warm slice of the
+    stream ledger-on vs ledger-detached to price the instrumentation
+    (``reqlife_overhead_pct``) and digest-assert that it never touches
+    results (``reqlife_bitwise_on_off``). tenants: optional tenant-id
+    cycle assigned round-robin to requests (default: all ``anon``)."""
+
+    from pint_tpu.obs.reqlife import LifecycleLedger, tail_artifact
+    from pint_tpu.serve import FitRequest, ServeEngine, result_digest
 
     models, toas_list = build_serve_fleet(sizes=sizes,
                                           per_combo=per_combo,
                                           seed=seed)
     n_pulsars = len(models)
+    ledger = LifecycleLedger()
     eng = ServeEngine(max_batch=max_batch, max_latency_s=max_latency_s,
                       bucket_floor=bucket_floor,
-                      cache_capacity=cache_capacity, mesh=mesh)
+                      cache_capacity=cache_capacity, mesh=mesh,
+                      reqlife=ledger)
 
     def req(i):
+        kw = {}
+        if tenants:
+            kw["tenant"] = tenants[i % len(tenants)]
         return FitRequest(models[i % n_pulsars],
                           toas_list[i % n_pulsars],
-                          maxiter=maxiter, precision=precision)
+                          maxiter=maxiter, precision=precision, **kw)
 
     # one request per pulsar covers every (structure, bucket) slot
     t_warm = obs_clock.now()
@@ -108,6 +124,9 @@ def run_serve_stream(n_requests=216, max_batch=8, max_latency_s=0.05,
     prewarm_wall_s = obs_clock.now() - t_warm
     results = eng.run_stream([req(i) for i in range(n_requests)])
     snap = eng.snapshot()
+    # lifecycle census for the steady-state stream (prewarm reset the
+    # ledger): every request must sit in exactly one terminal state
+    lsnap = ledger.snapshot()
     statuses = {}
     for r in results:
         statuses[r.status] = statuses.get(r.status, 0) + 1
@@ -129,7 +148,43 @@ def run_serve_stream(n_requests=216, max_batch=8, max_latency_s=0.05,
         "queue_wait_p50_s": snap["queue_wait_s"]["p50"],
         "execute_p50_s": snap["execute_s"]["p50"],
         "counters": snap["counters"],
+        "reqlife_nonterminal": lsnap["non_terminal"],
+        "reqlife_lost_records": lsnap["lost_records"],
+        "reqlife_double_terminal": lsnap["double_terminal"],
+        "reqlife_by_state": lsnap["by_state"],
+        "reqlife_exactly_one_terminal": bool(
+            lsnap["non_terminal"] == 0
+            and lsnap["double_terminal"] == 0
+            and lsnap["terminal"] == n_requests),
+        "tenants": snap.get("tenants"),
+        "tail_artifact": tail_artifact(snap, ledger),
     }
+    if measure_overhead:
+        # price the ledger on an identical warm slice, alternating
+        # ledger-on / ledger-detached so drift hits both sides alike;
+        # min-of-3 walls, and digest-assert the results never differ
+        n_over = min(n_requests, 72)
+        walls_on, walls_off, dig_on, dig_off = [], [], None, None
+        for _ in range(3):
+            t0 = obs_clock.now()
+            r_on = eng.run_stream([req(i) for i in range(n_over)])
+            walls_on.append(obs_clock.now() - t0)
+            eng.reqlife = None
+            t0 = obs_clock.now()
+            r_off = eng.run_stream([req(i) for i in range(n_over)])
+            walls_off.append(obs_clock.now() - t0)
+            eng.reqlife = ledger
+            if dig_on is None:
+                dig_on = [result_digest(r.value) for r in r_on
+                          if r.status == "ok"]
+                dig_off = [result_digest(r.value) for r in r_off
+                           if r.status == "ok"]
+        off = min(walls_off)
+        report["reqlife_overhead_pct"] = (
+            round(max(0.0, 100.0 * (min(walls_on) - off) / off), 3)
+            if off > 0 else 0.0)
+        report["reqlife_bitwise_on_off"] = bool(
+            dig_on and dig_on == dig_off)
     if compare_offline:
         from pint_tpu.parallel import PTAFleet
 
@@ -166,6 +221,191 @@ def run_serve_stream(n_requests=216, max_batch=8, max_latency_s=0.05,
             worst = float(np.maximum(worst, rel))
         report["max_param_rel_diff_vs_offline"] = worst
     return report
+
+
+def arrival_schedule(rate_rps, n, seed=0, rate_index=0):
+    """Deterministic open-loop Poisson arrivals: n cumulative offsets
+    (seconds from stream start) with exponential inter-arrival gaps at
+    ``rate_rps``, drawn from ``default_rng([seed, rate_index])`` so
+    every (seed, ladder-rung) pair replays the same schedule
+    bit-for-bit across processes."""
+    rng = np.random.default_rng([int(seed), int(rate_index)])
+    gaps = rng.exponential(1.0 / float(rate_rps), size=int(n))
+    return np.cumsum(gaps)
+
+
+def run_arrival_sweep(n_per_rate=48, fracs=(0.25, 0.5, 0.75, 1.0,
+                                            1.25, 1.5, 2.0, 3.0),
+                      max_batch=8, max_latency_s=0.01, max_queue=256,
+                      bucket_floor=64, cache_capacity=32, sizes=(48,),
+                      per_combo=1, maxiter=2, precision="f64",
+                      knee_factor=3.0, seed=0, mesh=None):
+    """Open-loop saturation bench: drive the serve engine with seeded
+    Poisson arrivals through a monotone ladder of offered rates and
+    report the p99-vs-throughput curve with knee detection.
+
+    Calibration first runs a closed-loop burst to measure the
+    engine's service capacity (``base_rps``); the ladder offers
+    ``fracs`` multiples of it. Each rung replays a deterministic
+    :func:`arrival_schedule` and submits on schedule regardless of
+    how far behind the engine has fallen — latency is measured from
+    the SCHEDULED arrival (via the lifecycle ledger's terminal-state
+    timestamp), so queue growth under overload is charged to the
+    rung instead of being hidden by coordinated omission.
+
+    The knee is the last rung still "good" — p99 within
+    ``knee_factor`` x the unloaded (lowest-rate) open-loop p99 and
+    zero queue-full sheds — before the first degraded rung;
+    ``shed_onset_rps`` is the first offered rate that tripped
+    ``max_queue``, None with a reason when the ladder never sheds —
+    which is the EXPECTED outcome on this single-threaded driver,
+    where a slot flushes inline the moment it fills, bounding queue
+    depth at ~slots x max_batch regardless of offered rate (keep
+    ``max_queue`` above that bound: a smaller cap sheds during the
+    closed-loop calibration burst and drives the health controller
+    into draining, poisoning the whole ladder). Returns a JSON-safe
+    report with per-rung rows, the knee keys, and a schedule digest
+    for determinism tests."""
+    import hashlib
+    import time as _time
+
+    from pint_tpu.obs.metricsreg import percentile
+    from pint_tpu.obs.reqlife import (TERMINAL_STATES,
+                                      LifecycleLedger)
+    from pint_tpu.serve import FitRequest, ServeEngine
+
+    t_sweep = obs_clock.now()
+    models, toas_list = build_serve_fleet(sizes=sizes,
+                                          per_combo=per_combo,
+                                          seed=seed)
+    n_pulsars = len(models)
+    ledger = LifecycleLedger()
+    eng = ServeEngine(max_batch=max_batch, max_latency_s=max_latency_s,
+                      max_queue=max_queue, bucket_floor=bucket_floor,
+                      cache_capacity=cache_capacity, mesh=mesh,
+                      reqlife=ledger)
+
+    def req(i):
+        return FitRequest(models[i % n_pulsars],
+                          toas_list[i % n_pulsars],
+                          maxiter=maxiter, precision=precision)
+
+    eng.prewarm([req(i) for i in range(n_pulsars)])
+
+    # closed-loop calibration burst: back-to-back submits measure the
+    # service capacity the open-loop ladder is scaled against
+    t0 = obs_clock.now()
+    cal = eng.run_stream([req(i) for i in range(n_per_rate)])
+    cal_wall = max(obs_clock.now() - t0, 1e-9)
+    base_rps = n_per_rate / cal_wall
+    base_p99 = percentile([r.telemetry.get("total_s") for r in cal
+                           if r.status == "ok"
+                           and r.telemetry.get("total_s") is not None],
+                          99)
+
+    fracs = tuple(sorted(fracs))
+    rates = [f * base_rps for f in fracs]
+    sched_hash = hashlib.sha256()
+    rows = []
+    nonterminal_total = 0
+    for idx, rate in enumerate(rates):
+        sched = arrival_schedule(rate, n_per_rate, seed=seed,
+                                 rate_index=idx)
+        sched_hash.update(np.asarray(sched, np.float64).tobytes())
+        ledger.reset()
+        eng.telemetry.reset()
+        start = obs_clock.now()
+        handles = []
+        for k in range(n_per_rate):
+            target = start + sched[k]
+            while True:
+                now = obs_clock.now()
+                if now >= target:
+                    break
+                eng.poll()
+                _time.sleep(min(target - now, 1e-3))
+            handles.append(eng.submit(req(k)))
+            eng.poll()
+        eng.drain()
+        end = obs_clock.now()
+        lats, delivered, shed = [], 0, 0
+        for k, h in enumerate(handles):
+            rec = ledger.record(h.request.request_id)
+            term_t = None
+            for st in (rec or {}).get("states", ()):
+                if st["state"] in TERMINAL_STATES:
+                    term_t = st["t"]
+            if h.status == "ok":
+                delivered += 1
+                lats.append((term_t if term_t is not None else end)
+                            - (start + sched[k]))
+            elif h.status == "shed":
+                shed += 1
+        nonterminal_total += len(ledger.nonterminal_ids())
+        span_s = max(end - start, 1e-9)
+        rows.append({
+            "offered_rps": round(rate, 3),
+            "achieved_rps": round(delivered / span_s, 3),
+            "delivered": delivered,
+            "shed": shed,
+            "errors": n_per_rate - delivered - shed,
+            "p50_s": percentile(lats, 50),
+            "p99_s": percentile(lats, 99),
+            "max_s": max(lats) if lats else None,
+        })
+
+    # knee: last good rung before the first degraded one, measured
+    # against the unloaded open-loop latency (rung 0 carries the
+    # max-latency batch timer that closed-loop calibration hides)
+    ref_p99 = rows[0]["p99_s"] if rows else None
+
+    def good(row):
+        return (row["delivered"] > 0 and row["shed"] == 0
+                and row["p99_s"] is not None and ref_p99 is not None
+                and row["p99_s"] <= knee_factor * ref_p99)
+
+    first_bad = next((i for i, row in enumerate(rows)
+                      if not good(row)), None)
+    if first_bad is None:
+        knee_idx, saturated = len(rows) - 1, False
+    elif first_bad == 0:
+        knee_idx, saturated = None, True
+    else:
+        knee_idx, saturated = first_bad - 1, True
+    shed_onset = next((row["offered_rps"] for row in rows
+                       if row["shed"] > 0), None)
+    null_reasons = {}
+    if knee_idx is None:
+        null_reasons["knee_rps"] = "degraded_at_lowest_rate"
+        null_reasons["p99_at_knee_s"] = "degraded_at_lowest_rate"
+    if shed_onset is None:
+        null_reasons["shed_onset_rps"] = (
+            "queue_bounded_by_inline_flush" if saturated
+            else "no_saturation_observed")
+    offered = [row["offered_rps"] for row in rows]
+    return {
+        "n_per_rate": n_per_rate,
+        "fracs": list(fracs),
+        "base_rps": round(base_rps, 3),
+        "base_p99_s": base_p99,
+        "ref_p99_s": ref_p99,
+        "knee_factor": knee_factor,
+        "max_queue": max_queue,
+        "offered_rps": offered,
+        "monotone_offered": bool(
+            all(a < b for a, b in zip(offered, offered[1:]))),
+        "rows": rows,
+        "saturated": saturated,
+        "knee_rps": (rows[knee_idx]["offered_rps"]
+                     if knee_idx is not None else None),
+        "p99_at_knee_s": (rows[knee_idx]["p99_s"]
+                          if knee_idx is not None else None),
+        "shed_onset_rps": shed_onset,
+        "null_reasons": null_reasons,
+        "schedule_sha256": sched_hash.hexdigest(),
+        "reqlife_nonterminal": nonterminal_total,
+        "wall_s": round(obs_clock.now() - t_sweep, 3),
+    }
 
 
 def run_chaos_stream(n_requests=216, fault_rate=0.05,
@@ -529,6 +769,11 @@ def _run_chaos_child(config):
                        "digest": result_digest(rec.get("value"))}
                  for rid, rec in jrep.committed.items()
                  if str(rid).startswith("req-")}
+    # recovery must leave no request mid-machine: journal returns are
+    # replayed_committed, replays ran to live terminal states, probes
+    # delivered — anything still non-terminal is a leak
+    reqlife_nonterminal = (len(eng.reqlife.nonterminal_ids())
+                           if eng.reqlife is not None else None)
     eng.journal.close()
     atomic_write_json(config["out"], {
         "mode": mode,
@@ -547,6 +792,7 @@ def _run_chaos_child(config):
         "replay_wall_s": rep["replay_wall_s"],
         "torn_truncated": rep["torn_truncated"],
         "state_restored": rep["state_restored"],
+        "reqlife_nonterminal": reqlife_nonterminal,
         "lost": [rid for rid in
                  (r["rid"] for r in jrep.pending)
                  if str(rid).startswith("req-")],
@@ -697,12 +943,16 @@ def run_kill_chaos(sites=None, ntoa=8192, lanes=4, maxiter=40,
             warm_refit_s=round(rec["warm_refit_s"], 4),
             cold_vs_warm_ratio=round(ratio, 3),
             recompiles=rec["compiles"],
+            reqlife_nonterminal=rec.get("reqlife_nonterminal"),
         )
         entry["ok"] = bool(
             entry["killed"] and rec_rc == 0
             and entry["lost"] == 0 and entry["duplicated"] == 0
             and entry["digest_mismatches"] == 0
             and rec["cold_probe_ok"]
+            # None = ledger disabled in the child env; 0 = the
+            # recovered machine reached a terminal state everywhere
+            and rec.get("reqlife_nonterminal") in (0, None)
             # a warm shared cache must serve the restart without a
             # single recompile AND inside the cold-start bound; the
             # cold-cache site must instead recompile (store died)
@@ -776,6 +1026,21 @@ def main(argv=None) -> int:
                    help="enable obs tracing for the run and export "
                         "the span timeline as Chrome trace-event "
                         "JSON (chrome://tracing / Perfetto)")
+    p.add_argument("--tail-out", default=None, metavar="PATH",
+                   help="write the run's tail artifact (p99 "
+                        "exemplars + lifecycle records) as JSON for "
+                        "`python -m pint_tpu.obs tail`")
+    p.add_argument("--arrival-sweep", action="store_true",
+                   help="run the open-loop saturation bench (seeded "
+                        "Poisson arrivals through a ladder of "
+                        "offered rates, p99-vs-throughput knee) "
+                        "instead of the plain serve bench")
+    p.add_argument("--n-per-rate", type=int, default=48,
+                   help="arrival-sweep: requests per ladder rung")
+    p.add_argument("--knee-factor", type=float, default=3.0,
+                   help="arrival-sweep: p99 degradation factor vs "
+                        "the unloaded rung that marks the knee")
+    p.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
 
     if args.chaos_child:
@@ -798,6 +1063,22 @@ def main(argv=None) -> int:
             print(f"trace written to {args.trace_out}",
                   file=sys.stderr)
         return rc
+
+    if args.arrival_sweep:
+        report = run_arrival_sweep(
+            n_per_rate=args.n_per_rate, max_batch=args.max_batch,
+            bucket_floor=args.bucket_floor, maxiter=args.maxiter,
+            precision=args.precision, knee_factor=args.knee_factor,
+            seed=args.seed)
+        print(json.dumps(report, default=float))
+        ok = (report["monotone_offered"]
+              and report["knee_rps"] is not None
+              and report["p99_at_knee_s"] is not None)
+        if not ok:
+            print("FAIL: saturation sweep found no knee "
+                  f"(null_reasons={report['null_reasons']})",
+                  file=sys.stderr)
+        return _finish(0 if ok else 1)
 
     if args.chaos:
         from pint_tpu.resilience import DEVICE_POINTS
@@ -858,7 +1139,15 @@ def main(argv=None) -> int:
         max_latency_s=args.max_latency, bucket_floor=args.bucket_floor,
         maxiter=args.maxiter, precision=args.precision,
         compare_offline=not args.no_offline_check,
-        concurrent_prewarm=args.concurrent_prewarm)
+        concurrent_prewarm=args.concurrent_prewarm, seed=args.seed)
+    # the tail artifact is a joinable sidecar (exemplars + full
+    # lifecycle records), not a bench metric — keep stdout lean
+    artifact = report.pop("tail_artifact", None)
+    if args.tail_out and artifact is not None:
+        with open(args.tail_out, "w") as fh:
+            json.dump(artifact, fh, default=float)
+        print(f"tail artifact written to {args.tail_out}",
+              file=sys.stderr)
     print(json.dumps(report, default=float))
     hit_rate = report["cache"]["hit_rate"] or 0.0
     ok = (report["recompiles_after_warmup"] == 0

@@ -49,7 +49,8 @@ class MicroBatcher:
         self.bucket_floor = int(bucket_floor)
         self.plan = plan  # optional shapeplan.ShapePlan width ladder
         self._lock = threading.RLock()
-        self._slots = {}  # key -> list[(request, result, t_submit)]
+        # key -> list[(request, result, t_submit, trace_id)]
+        self._slots = {}
 
     def bucket_for(self, n):
         """TOA bucket for a request of ``n`` TOAs: the shape plan's
@@ -75,13 +76,15 @@ class MicroBatcher:
         with self._lock:
             return sum(len(v) for v in self._slots.values())
 
-    def admit(self, key, request, result, now):
+    def admit(self, key, request, result, now, trace=None):
         """Queue one request; True when the slot just reached
-        max_batch and must flush. Submitter threads race the engine's
-        flush loop on ``_slots``, hence the lock."""
+        max_batch and must flush. ``trace`` is the request's lifecycle
+        trace id (obs.reqlife) riding the slot into the flush span.
+        Submitter threads race the engine's flush loop on ``_slots``,
+        hence the lock."""
         with self._lock:
             entries = self._slots.setdefault(key, [])
-            entries.append((request, result, now))
+            entries.append((request, result, now, trace))
             return len(entries) >= self.max_batch
 
     def due(self, now):

@@ -40,6 +40,7 @@ import zlib
 
 import numpy as np
 
+from ..obs import reqlife as obs_reqlife
 from ..obs import trace as obs_trace
 from ..obs.recorder import RECORDER as _flight
 from ..resilience import faultinject
@@ -80,7 +81,7 @@ class ServeEngine:
                  mesh=None, clock=time.monotonic, sleep=time.sleep,
                  backoff=None, breaker=None, health=None,
                  bisect_depth=4, plan=None, devices=None,
-                 durable_dir=None, excache_dir=None):
+                 durable_dir=None, excache_dir=None, reqlife=None):
         self.plan = plan  # optional shapeplan.ShapePlan width ladder
         self.batcher = MicroBatcher(max_batch=max_batch,
                                     max_latency_s=max_latency_s,
@@ -136,6 +137,20 @@ class ServeEngine:
         self._slot_recompiles = {}
         self._slo_monitor = None  # attach_slo() opt-in
         self._fitq_board = None  # attach_fit_quality() opt-in
+        # request-lifecycle ledger (obs.reqlife): every submit mints a
+        # trace id and records the full state machine. reqlife=None
+        # follows PINT_TPU_REQLIFE (on unless "0"), False detaches,
+        # and a LifecycleLedger instance gives the engine a private
+        # ledger (benches/tests). All ledger work is host-side dict
+        # bookkeeping — results stay bitwise identical either way.
+        if reqlife is None:
+            reqlife = os.environ.get("PINT_TPU_REQLIFE", "1") != "0"
+        if reqlife is True:
+            self.reqlife = obs_reqlife.REQLIFE
+        elif reqlife is False:
+            self.reqlife = None
+        else:
+            self.reqlife = reqlife
 
     # -- SLO burn-rate monitoring ------------------------------------
 
@@ -289,12 +304,36 @@ class ServeEngine:
                 "recover", n_committed=len(rep.committed),
                 n_pending=len(rep.pending),
                 torn_truncated=rep.torn_truncated)
+            if self.reqlife is not None:
+                # journal returns are terminal without touching the
+                # serve path: ledger them as replayed_committed so
+                # post-crash accounting separates them from live fits
+                t_rec = self.clock()
+                for rid, crec in rep.committed.items():
+                    tele = (crec.get("telemetry")
+                            if isinstance(crec, dict) else None) or {}
+                    self.reqlife.submitted(
+                        rid, tenant=tele.get("tenant", "anon"),
+                        kind=tele.get("kind"), t=t_rec)
+                    self.reqlife.transition(rid, "replayed_committed",
+                                            t=t_rec)
             replayed = {}
             for rec in rep.pending:
                 # pre-mark the id so every terminal outcome of the
                 # replay — including a synchronous rejection — writes
                 # a commit record and the request can't replay forever
                 self.journal.note_intake(rec["rid"])
+                if self.reqlife is not None:
+                    req = rec["req"]
+                    self.reqlife.submitted(
+                        rec["rid"],
+                        tenant=getattr(req, "tenant", "anon"),
+                        kind=getattr(req, "kind", None),
+                        t=self.clock())
+                    # non-terminal marker: submit() re-anchors the
+                    # machine and runs it to a live terminal state
+                    self.reqlife.transition(rec["rid"], "re_executed",
+                                            t=self.clock())
                 replayed[rec["rid"]] = self.submit(rec["req"])
             self.drain()
             self.journal.sync()
@@ -320,6 +359,15 @@ class ServeEngine:
 
     # -- intake ------------------------------------------------------
 
+    def _lc(self, req, state, t=None, reason=None, **attrs):
+        """One lifecycle transition on the engine's clock (no-op when
+        the ledger is detached)."""
+        if self.reqlife is not None:
+            self.reqlife.transition(
+                req.request_id, state,
+                t=self.clock() if t is None else t,
+                reason=reason, **attrs)
+
     def submit(self, request):
         """Route one request. Returns a ServeResult handle, filled in
         when its slot flushes; a submit that fills a slot flushes it
@@ -327,6 +375,12 @@ class ServeEngine:
         immediately."""
         res = ServeResult(request=request)
         now = self.clock()
+        trace = None
+        if self.reqlife is not None:
+            trace = self.reqlife.submitted(
+                request.request_id,
+                tenant=getattr(request, "tenant", "anon"),
+                kind=request.kind, t=now)
         fault = (faultinject.fire("toa_nan",
                                   request_id=request.request_id)
                  or faultinject.fire("toa_inf_error",
@@ -345,8 +399,11 @@ class ServeEngine:
             self.telemetry.incr("errors")
             self.telemetry.record(request_id=request.request_id,
                                   kind=request.kind, status="error",
-                                  reason=res.reason)
+                                  reason=res.reason,
+                                  tenant=getattr(request, "tenant",
+                                                 "anon"), trace=trace)
             self.health.note_request("error")
+            self._lc(request, "error", reason=res.reason)
             return res
         nv, ne = self._nonfinite_counts(request)
         if nv or ne:
@@ -362,7 +419,7 @@ class ServeEngine:
                 # durable before the work runs
                 self.journal.record_intake(request)
                 self.journal.sync()
-            self._execute_solo(request, res, routing, now)
+            self._execute_solo(request, res, routing, now, trace=trace)
             if self.journal is not None:
                 self.journal.sync()
             return res
@@ -381,14 +438,18 @@ class ServeEngine:
             self.telemetry.incr("shed_queue_full")
             self.telemetry.record(request_id=request.request_id,
                                   kind=routing[0], status="shed",
-                                  reason="queue_full")
+                                  reason="queue_full",
+                                  tenant=getattr(request, "tenant",
+                                                 "anon"), trace=trace)
             self.health.note_request("shed")
+            self._lc(request, "shed", t=now, reason="queue_full")
             return res
         if self.journal is not None:
             # buffered WAL append; the flush's group sync makes it
             # durable before any execution touches the request
             self.journal.record_intake(request)
-        if self.batcher.admit(key, request, res, now):
+        self._lc(request, "queued", t=now)
+        if self.batcher.admit(key, request, res, now, trace=trace):
             self._flush(key)
         return res
 
@@ -431,8 +492,10 @@ class ServeEngine:
                                          **detail)
         self.telemetry.incr(f"rejected_{reason}")
         self.telemetry.record(request_id=req.request_id, kind=kind,
-                              status="rejected", reason=reason)
+                              status="rejected", reason=reason,
+                              tenant=getattr(req, "tenant", "anon"))
         self.health.note_request("rejected", reason)
+        self._lc(req, "rejected", reason=reason)
         self._commit(req, res)  # no-op unless the intake was journaled
         return res
 
@@ -478,6 +541,10 @@ class ServeEngine:
                                    f"{res.reason}")
         self.telemetry.reset()
         self.cache.reset_counters()
+        if self.reqlife is not None:
+            # steady-state lifecycle accounting starts clean, like the
+            # latency records and cache counters above
+            self.reqlife.reset()
         return self.executables_compiled - before
 
     def snapshot(self):
@@ -493,6 +560,8 @@ class ServeEngine:
                                        devices=lanes)
         snap["executables_compiled"] = self.executables_compiled
         snap["queue_depth"] = self.batcher.depth()
+        if self.reqlife is not None:
+            snap["reqlife"] = self.reqlife.snapshot()
         from ..obs import fitquality as obs_fitq
 
         if self._fitq_board is not None or obs_fitq.enabled():
@@ -517,6 +586,9 @@ class ServeEngine:
             health=self.health, breaker=self.breaker, devices=lanes)
         reg.absorb({"executables_compiled": self.executables_compiled,
                     "queue_depth": self.batcher.depth()}, prefix=prefix)
+        if self.reqlife is not None:
+            reg.absorb(self.reqlife.snapshot(),
+                       prefix=prefix + "reqlife.")
         from ..obs import fitquality as obs_fitq
 
         if self._fitq_board is not None or obs_fitq.enabled():
@@ -724,8 +796,13 @@ class ServeEngine:
                 return
             self.telemetry.incr("flushes")
             now = self.clock()
+            # the flush trace id joins each delivered request's
+            # lifecycle record to the serve.flush span (tracing on) or
+            # at least to its co-flushed neighbors (tracing off)
+            flush_trace = (obs_trace.current_trace_id()
+                           or obs_trace.TRACER.new_trace_id())
             live = []
-            for req, res, t_sub in entries:
+            for req, res, t_sub, tr in entries:
                 if policy.expired(req, t_sub, now):
                     res.status = "shed"
                     res.reason = "deadline"
@@ -737,11 +814,16 @@ class ServeEngine:
                     self.telemetry.record(request_id=req.request_id,
                                           status="shed",
                                           reason="deadline",
-                                          queue_wait_s=now - t_sub)
+                                          queue_wait_s=now - t_sub,
+                                          tenant=getattr(req, "tenant",
+                                                         "anon"),
+                                          trace=tr)
                     self.health.note_request("shed")
+                    self._lc(req, "shed", t=now, reason="deadline",
+                             queue_wait_s=now - t_sub)
                     self._commit(req, res)
                 else:
-                    live.append((req, res, t_sub))
+                    live.append((req, res, t_sub, tr))
             fsp.set(n_live=len(live), shed=len(entries) - len(live))
             if self.journal is not None:
                 # group commit of every intake (and shed completion)
@@ -751,7 +833,8 @@ class ServeEngine:
                 self.journal.sync()
                 faultinject.fire_kill("intake_append", slot=str(key))
             if live:
-                self._execute(key, live, flush_start=now)
+                self._execute(key, live, flush_start=now,
+                              flush_trace=flush_trace)
                 self.health.note_flush(self.clock() - now)
             if self.journal is not None:
                 # catch-all sync for completions recorded on failure /
@@ -774,18 +857,22 @@ class ServeEngine:
     def _fail(self, live, kind, exc):
         reason = f"{type(exc).__name__}: {exc}"
         self.telemetry.incr("errors", len(live))
-        for req, res, _ in live:
+        for req, res, _, tr in live:
             res.status = "error"
             res.reason = reason
             self.telemetry.record(request_id=req.request_id, kind=kind,
-                                  status="error", reason=reason)
+                                  status="error", reason=reason,
+                                  tenant=getattr(req, "tenant", "anon"),
+                                  trace=tr)
             self.health.note_request("error")
+            self._lc(req, "error", reason=reason)
             self._commit(req, res)
 
     def _on_retry(self, attempt, exc, delay_s):
         self.telemetry.incr("retries")
 
-    def _execute(self, slot_key, live, flush_start, depth=0):
+    def _execute(self, slot_key, live, flush_start, depth=0,
+                 flush_trace=None):
         """Fault-handling driver around one batched flush.
 
         - transient exceptions: retried with jittered backoff;
@@ -800,7 +887,8 @@ class ServeEngine:
         kind = slot_key[2]
         try:
             poisoned = with_retries(
-                lambda: self._execute_batch(slot_key, live, flush_start),
+                lambda: self._execute_batch(slot_key, live, flush_start,
+                                            flush_trace=flush_trace),
                 policy=self.backoff, sleep=self._sleep,
                 on_retry=self._on_retry,
                 trace_id=obs_trace.current_trace_id())
@@ -815,9 +903,9 @@ class ServeEngine:
                 with obs_trace.span("serve.bisect", depth=depth,
                                     n=len(live)):
                     self._execute(slot_key, live[:mid], flush_start,
-                                  depth + 1)
+                                  depth + 1, flush_trace=flush_trace)
                     self._execute(slot_key, live[mid:], flush_start,
-                                  depth + 1)
+                                  depth + 1, flush_trace=flush_trace)
                 return
             self._fail(live, kind, e)
             tripped = self.breaker.record_failure(slot_key)
@@ -834,13 +922,15 @@ class ServeEngine:
             reason = ("solver_diverged" if kind == "fit"
                       else "nonfinite_result")
             for i in sorted(poisoned):
-                req, res, _ = live[i]
+                req, res, _, _ = live[i]
                 self.telemetry.incr("quarantined")
                 self._reject(req, res, reason, kind, quarantined=True)
             if healthy:
-                self._execute(slot_key, healthy, flush_start, depth)
+                self._execute(slot_key, healthy, flush_start, depth,
+                              flush_trace=flush_trace)
 
-    def _execute_batch(self, slot_key, live, flush_start):
+    def _execute_batch(self, slot_key, live, flush_start,
+                       flush_trace=None):
         """One attempt at a batched flush. Commits results and returns
         an empty set on success; returns the set of poisoned live-lane
         indices (committing NOTHING) when per-lane results are
@@ -877,11 +967,14 @@ class ServeEngine:
                     f"({len(self.device_lanes)} lanes quarantined)")
         t0 = self.clock()
         with obs_trace.span("serve.pack", bucket=bucket, n=n_live):
-            pta = self._padded_batch(bucket,
-                                     [req.model for req, _, _ in live],
-                                     [req.toas for req, _, _ in live],
-                                     lane=dev_lane)
+            pta = self._padded_batch(
+                bucket, [req.model for req, _, _, _ in live],
+                [req.toas for req, _, _, _ in live], lane=dev_lane)
         pack_s = self.clock() - t0
+        if self.reqlife is not None:
+            t_packed = self.clock()
+            for req, _, _, _ in live:
+                self._lc(req, "packed", t=t_packed)
         exec_key = self._exec_key(slot_key, lanes, pta)
         if dev_lane is not None:
             # per-lane executables: a stolen slot compiles fresh on
@@ -933,6 +1026,9 @@ class ServeEngine:
 
         degraded = False
         t0 = self.clock()
+        if self.reqlife is not None:
+            for req, _, _, _ in live:
+                self._lc(req, "executing", t=t0)
         with obs_trace.span("serve.run", kind=kind,
                             bucket=bucket, cold=cold):
             if kind == "fit":
@@ -998,7 +1094,7 @@ class ServeEngine:
                 sig = np.sqrt(np.maximum(
                     np.diagonal(cov, axis1=-2, axis2=-1), 0.0))
             tid = obs_trace.current_trace_id()
-            for i, (req, _, _) in enumerate(live):
+            for i, (req, _, _, _) in enumerate(live):
                 dof = max(1.0, len(req.toas) - x.shape[1] - 1)
                 self._fitq_board.observe(
                     self._fit_label(req),
@@ -1012,7 +1108,7 @@ class ServeEngine:
             # re-runs the whole flush on recovery (bit-identically —
             # lane independence under vmap)
             faultinject.fire_kill("pre_commit", slot=str(slot_key))
-        for i, (req, res, t_sub) in enumerate(live):
+        for i, (req, res, t_sub, tr) in enumerate(live):
             res.status = "ok"
             res.value = value_of(i)
             rec = {"request_id": req.request_id, "kind": kind,
@@ -1021,10 +1117,16 @@ class ServeEngine:
                    "pack_s": pack_s, "compile_s": compile_s,
                    "execute_s": execute_s, "total_s": done - t_sub,
                    "lanes": lanes, "bucket": bucket, "cold": cold,
-                   "degraded": degraded, "spilled": False}
+                   "degraded": degraded, "spilled": False,
+                   "tenant": getattr(req, "tenant", "anon"),
+                   "trace": tr}
             res.telemetry = rec
             self.telemetry.record(**rec)
             self.health.note_request("ok")
+            self._lc(req, "delivered", t=done,
+                     queue_wait_s=rec["queue_wait_s"],
+                     execute_s=execute_s, bucket=bucket, cold=cold,
+                     flush_trace=flush_trace)
             self._commit(req, res)
         if self.journal is not None:
             # group commit: one fsync makes every completion of this
@@ -1038,7 +1140,8 @@ class ServeEngine:
             dev_lane.breaker.record_success(dev_lane.key)
         return set()
 
-    def _execute_solo(self, request, res, routing, submitted_at):
+    def _execute_solo(self, request, res, routing, submitted_at,
+                      trace=None):
         """Oversize spill: run unbatched, padded to the request's own
         TOA count (no bucket), so one monster request can't force a
         huge shared executable shape. Compiles per unique shape —
@@ -1047,7 +1150,7 @@ class ServeEngine:
         from ..parallel.pta import PTABatch
 
         kind, method, maxiter, precision = routing
-        live = [(request, res, submitted_at)]
+        live = [(request, res, submitted_at, trace)]
         t0 = self.clock()
         try:
             # deliberately unpadded: the spill path trades a per-shape
@@ -1056,8 +1159,10 @@ class ServeEngine:
             pta = PTABatch([request.model], [request.toas],
                            mesh=self.mesh)
             pack_s = self.clock() - t0
+            self._lc(request, "packed")
             degraded = False
             t0 = self.clock()
+            self._lc(request, "executing", t=t0)
             if kind == "fit":
                 with warnings.catch_warnings(record=True) as caught:
                     warnings.simplefilter("always")
@@ -1085,14 +1190,19 @@ class ServeEngine:
             self.telemetry.incr("degraded_mixed")
         res.status = "ok"
         res.value = value
+        done = self.clock()
         rec = {"request_id": request.request_id, "kind": kind,
                "status": "ok", "reason": None, "queue_wait_s": 0.0,
                "pack_s": pack_s, "compile_s": None,
                "execute_s": execute_s,
-               "total_s": self.clock() - submitted_at,
+               "total_s": done - submitted_at,
                "lanes": 1, "bucket": None, "cold": True,
-               "degraded": degraded, "spilled": True}
+               "degraded": degraded, "spilled": True,
+               "tenant": getattr(request, "tenant", "anon"),
+               "trace": trace}
         res.telemetry = rec
         self.telemetry.record(**rec)
         self.health.note_request("ok")
+        self._lc(request, "delivered", t=done, queue_wait_s=0.0,
+                 execute_s=execute_s, spilled=True)
         self._commit(request, res)

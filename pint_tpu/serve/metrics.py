@@ -11,11 +11,22 @@ shared by the whole flush), total (submit -> result).
 from __future__ import annotations
 
 import json
+import os
 
 # The one nearest-rank implementation lives with the obs histogram
 # primitives now; re-exported here so serve-layer callers (and bench)
 # keep their import path.
-from ..obs.metricsreg import percentile  # noqa: F401
+from ..obs.metricsreg import Histogram, percentile  # noqa: F401
+
+
+def tenant_cap():
+    """Hard cardinality cap on per-tenant rows (env-tunable): the tail
+    beyond the cap folds into one ``other`` row, mirroring the metrics
+    registry's label guard."""
+    try:
+        return max(1, int(os.environ.get("PINT_TPU_TENANT_CAP", 32)))
+    except (TypeError, ValueError):
+        return 32
 
 
 class ServeTelemetry:
@@ -31,19 +42,85 @@ class ServeTelemetry:
     def __init__(self):
         self.counters = {}
         self.records = []
+        # live per-phase latency histograms; total_s carries exemplar
+        # slots (trace id + tenant on the max-latency observations) so
+        # a p99 spike resolves to a lifecycle record via `obs tail`
+        self.histograms = {p: Histogram() for p in self.PHASES}
 
     def incr(self, name, n=1):
         self.counters[name] = self.counters.get(name, 0) + n
 
     def record(self, **fields):
         """Append one per-request record (same dict the request's
-        ServeResult.telemetry carries)."""
+        ServeResult.telemetry carries); completed requests also feed
+        the per-phase histograms, total_s with an exemplar."""
         self.records.append(fields)
+        if fields.get("status") != "ok":
+            return
+        for phase in self.PHASES:
+            v = fields.get(phase)
+            if v is None:
+                continue
+            if phase == "total_s":
+                self.histograms[phase].record(v, exemplar={
+                    "trace": fields.get("trace"),
+                    "request_id": fields.get("request_id"),
+                    "tenant": fields.get("tenant"),
+                })
+            else:
+                self.histograms[phase].record(v)
 
     def latencies(self, phase="total_s", status="ok"):
         return [r[phase] for r in self.records
                 if r.get("status") == status
                 and r.get(phase) is not None]
+
+    def tenant_rows(self, cap=None):
+        """Per-tenant accounting rows behind the hard cardinality cap:
+        request/outcome counts and ok-latency p50/p99 per tenant, the
+        tail beyond the cap folded into one aggregate ``other`` row
+        (largest tenants by request count are kept)."""
+        by_tenant = {}
+        for r in self.records:
+            t = r.get("tenant") or "anon"
+            row = by_tenant.setdefault(
+                t, {"requests": 0, "ok": 0, "shed": 0, "rejected": 0,
+                    "errors": 0, "_lat": []})
+            row["requests"] += 1
+            status = r.get("status")
+            if status == "ok":
+                row["ok"] += 1
+                if r.get("total_s") is not None:
+                    row["_lat"].append(r["total_s"])
+            elif status == "shed":
+                row["shed"] += 1
+            elif status == "rejected":
+                row["rejected"] += 1
+            elif status == "error":
+                row["errors"] += 1
+        cap = tenant_cap() if cap is None else max(1, int(cap))
+        if len(by_tenant) > cap:
+            ranked = sorted(by_tenant.items(),
+                            key=lambda kv: (-kv[1]["requests"], kv[0]))
+            kept = dict(ranked[:cap])
+            other = kept.pop("other", None) or {
+                "requests": 0, "ok": 0, "shed": 0, "rejected": 0,
+                "errors": 0, "_lat": []}
+            for t, row in ranked[cap:]:
+                for k in ("requests", "ok", "shed", "rejected",
+                          "errors"):
+                    other[k] += row[k]
+                other["_lat"].extend(row["_lat"])
+            kept["other"] = other
+            by_tenant = kept
+        out = {}
+        for t in sorted(by_tenant):
+            row = by_tenant[t]
+            lat = row.pop("_lat")
+            row["p50_s"] = percentile(lat, 50)
+            row["p99_s"] = percentile(lat, 99)
+            out[t] = row
+        return out
 
     def snapshot(self, cache=None, health=None, breaker=None,
                  devices=None):
@@ -70,6 +147,8 @@ class ServeTelemetry:
             snap[phase] = {"p50": percentile(vals, 50),
                            "p99": percentile(vals, 99),
                            "max": max(vals) if vals else None}
+        snap["exemplars"] = self.histograms["total_s"].exemplars()
+        snap["tenants"] = self.tenant_rows()
         if cache is not None:
             snap["cache"] = cache.counters()
         if health is not None:
@@ -105,14 +184,38 @@ class ServeTelemetry:
         reg = metricsreg.REGISTRY if registry is None else registry
         snap = self.snapshot(**snapshot_kw)
         lanes = snap.get("devices", {}).pop("lanes", None)
+        tenants = snap.pop("tenants", None)
+        snap.pop("exemplars", None)  # ride the live histograms below
         reg.absorb(snap, prefix=prefix)
         if lanes is not None:
             for lane in lanes:
                 reg.absorb(lane,
                            prefix="%slane.%s." % (prefix,
                                                   lane.get("index")))
+        # live per-phase histograms join the registry by reference —
+        # their quantiles AND exemplar slots render in the Prometheus
+        # exposition without re-recording a single sample
+        for phase, hist in self.histograms.items():
+            reg.attach_histogram(prefix + "latency." + phase, hist)
+        if tenants:
+            # labeled per-tenant families, routed through the
+            # registry's cardinality guard (fold-to-other + overflow
+            # counter) rather than minting one metric name per tenant
+            for t, row in tenants.items():
+                labels = {"tenant": t}
+                for key in ("requests", "ok", "shed", "rejected",
+                            "errors"):
+                    c = reg.counter(prefix + "tenant." + key,
+                                    labels=labels)
+                    with c._lock:
+                        c.value = row[key]
+                reg.gauge(prefix + "tenant.p50_s",
+                          labels=labels).set(row["p50_s"])
+                reg.gauge(prefix + "tenant.p99_s",
+                          labels=labels).set(row["p99_s"])
         return reg
 
     def reset(self):
         self.counters = {}
         self.records = []
+        self.histograms = {p: Histogram() for p in self.PHASES}

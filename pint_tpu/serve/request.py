@@ -43,12 +43,16 @@ class TimingRequest:
         rather than executed late (serve.policy).
     precision: "f64" or "mixed" — GLS fits only (fitter.gls_gram);
         non-fit kinds and WLS always run f64.
+    tenant: accounting principal for per-tenant metrics/SLOs
+        (obs.reqlife lifecycle records, snapshot()["tenants"] rows);
+        never part of the slot key — tenants share warm executables.
     """
 
     model: object
     toas: object
     deadline_s: float | None = None
     precision: str = "f64"
+    tenant: str = "anon"
     request_id: str = field(default_factory=_next_id)
 
     kind = "fit"

@@ -723,6 +723,71 @@ def test_quality_signal_dropped_scoped_and_suppressible(tmp_path):
                for f in fs)
 
 
+# -- request-state-leak ----------------------------------------------
+
+
+RSL_CFG = LintConfig(serve_state_modules=("/engine.py",))
+
+
+def test_request_state_leak_flags_unrecorded_status(tmp_path):
+    bad = """
+        def shed(res):
+            res.status = "shed"
+            res.reason = "queue_full"
+            return res
+    """
+    fs = lint(tmp_path, {"engine.py": bad}, RSL_CFG)
+    assert len(live(fs, "request-state-leak")) == 1
+
+
+def test_request_state_leak_quiet_when_recorded(tmp_path):
+    good = """
+        class Engine:
+            def shed_one(self, req, res):
+                res.status = "shed"
+                self.telemetry.incr("shed_queue_full")
+                return res
+
+            def error_one(self, req, res):
+                res.status = "error"
+                self._lc(req, "error")
+                return res
+    """
+    fs = lint(tmp_path, {"engine.py": good}, RSL_CFG)
+    assert live(fs, "request-state-leak") == []
+
+
+def test_request_state_leak_ignores_self_and_scope(tmp_path):
+    quiet = """
+        class Engine:
+            def note(self):
+                self.status = "healthy"
+    """
+    # self.* is engine state, not a request outcome
+    fs = lint(tmp_path, {"engine.py": quiet}, RSL_CFG)
+    assert live(fs, "request-state-leak") == []
+    bad = """
+        def shed(res):
+            res.status = "shed"
+    """
+    # outside the registered modules: quiet
+    fs = lint(tmp_path, {"other.py": bad}, RSL_CFG)
+    assert live(fs, "request-state-leak") == []
+
+
+def test_request_state_leak_suppressible(tmp_path):
+    suppressed = """
+        def touch(res):
+            # outcome recorded by the caller
+            # pintlint: disable=request-state-leak
+            res.reason = None
+    """
+    fs = lint(tmp_path, {"engine.py": suppressed}, RSL_CFG)
+    assert live(fs, "request-state-leak") == []
+    assert any(f.rule == "request-state-leak" and f.suppressed
+               for f in fs)
+
+
 # -- durable-write-unatomic ------------------------------------------
 
 
