@@ -315,6 +315,64 @@ def _fitq_workload(n_psr, n_toas, iters):
     }
 
 
+def _store_workload(n_psr, n_toas):
+    """Packed-TOA columnar store (pint_tpu/store) on a ragged fleet:
+    cold build (live prep + CRC-framed write-back) vs warm bring-up
+    (mmap + verify + from_packed, no astropy), with fit parity
+    asserted bit-identical and the store counters reported. The
+    670k-scale version runs as bench.py's store sub-stage
+    (measured_670k_store_* keys)."""
+    import copy
+    import tempfile
+    import warnings
+
+    warnings.simplefilter("ignore")
+    from pint_tpu.parallel import PTAFleet
+    from pint_tpu.scripts.pint_serve_bench import build_serve_fleet
+    from pint_tpu.store import PackStore
+
+    models, toas_list = build_serve_fleet(
+        sizes=(max(16, n_toas // 2), n_toas),
+        per_combo=max(1, n_psr // 4), seed=3)
+
+    def _fit(store=None):
+        t0 = obs_clock.now()
+        fleet = PTAFleet([copy.deepcopy(m) for m in models], toas_list,
+                         toa_bucket="pow2", bucket_floor=16,
+                         store=store)
+        build_s = obs_clock.now() - t0
+        x, chi2, _ = fleet.fit(method="auto", maxiter=2)
+        return build_s, [np.asarray(xi) for xi in x]
+
+    sdir = tempfile.mkdtemp(prefix="pint_store_prof_")
+    live_build_s, x_live = _fit(store=None)
+    cold = PackStore(sdir)
+    cold_build_s, x_cold = _fit(store=cold)
+    warm = PackStore(sdir)
+    warm.prewarm(background=False)
+    warm_build_s, x_warm = _fit(store=warm)
+    cc, wc = cold.counters(), warm.counters()
+    assert wc["hits"] >= 1 and wc["misses"] == 0, \
+        f"warm store run missed: {wc}"
+    assert wc["corrupt"] == 0 and wc["stale"] == 0, \
+        f"store flagged its own fresh entries: {wc}"
+    parity = max(float(np.max(np.abs(a - b)))
+                 for a, b in zip(x_warm, x_live))
+    assert parity == 0.0, \
+        f"store-hit fit diverged from live prep (max abs {parity})"
+    return {
+        "live_prep_pack_s": round(live_build_s, 4),
+        "cold_store_prep_pack_s": round(cold_build_s, 4),
+        "warm_store_prep_pack_s": round(warm_build_s, 4),
+        "prep_speedup_warm_vs_live": round(
+            live_build_s / max(warm_build_s, 1e-9), 3),
+        "store_bytes": cc["bytes_written"],
+        "cold_counters": cc,
+        "warm_counters": wc,
+        "parity_max_abs": parity,
+    }
+
+
 def _roofline_workload(n_psr, n_toas, iters):
     """One GLS program through the instrumented jit().lower()/.compile()
     split, then a warm refit timed and attributed against the platform
@@ -366,7 +424,7 @@ def main(argv=None):
     p.add_argument("--workload", choices=("wls", "pta", "serve",
                                           "chaos", "fleet_pipeline",
                                           "shapeplan", "roofline",
-                                          "fitq", "fusedgls"),
+                                          "fitq", "fusedgls", "store"),
                    default="wls")
     p.add_argument("--n-toas", type=int, default=5000)
     p.add_argument("--n-psr", type=int, default=8)
@@ -393,6 +451,15 @@ def main(argv=None):
         t0 = obs_clock.now()
         report = _fitq_workload(args.n_psr, args.n_toas, args.iters)
         report.update({"workload": "fitq",
+                       "platform": jax.default_backend(),
+                       "wall_s": round(obs_clock.now() - t0, 3)})
+        print(json.dumps(report, default=float))
+        return 0
+
+    if args.workload == "store":
+        t0 = obs_clock.now()
+        report = _store_workload(args.n_psr, args.n_toas)
+        report.update({"workload": "store",
                        "platform": jax.default_backend(),
                        "wall_s": round(obs_clock.now() - t0, 3)})
         print(json.dumps(report, default=float))

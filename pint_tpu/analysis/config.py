@@ -180,15 +180,16 @@ OBS_ALLOWED_PATH_MARKERS = ("/obs/", "/tests/", "/test_")
 
 # Modules (normalized "/"-prefixed path suffixes) that own
 # crash-surviving artifacts: checkpoint snapshots, the write-ahead
-# request journal, the persisted executable cache, flight-recorder
-# dumps. Truncating open() there must go through pint_tpu.durable's
-# atomic writers — a crash mid-`open(path, "w")` tears the previous
-# good artifact, the exact loss these modules exist to prevent.
+# request journal, the persisted executable cache, the packed-TOA
+# columnar store, flight-recorder dumps. Truncating open() there must
+# go through pint_tpu.durable's atomic writers — a crash
+# mid-`open(path, "w")` tears the previous good artifact, the exact
+# loss these modules exist to prevent.
 # pint_tpu/durable.py itself is NOT listed: its temp-file write IS
 # the atomic implementation.
 DURABLE_ARTIFACT_MODULES = (
     "/checkpoint.py", "/obs/recorder.py", "/serve/journal.py",
-    "/serve/excache.py",
+    "/serve/excache.py", "/store/packstore.py",
 )
 
 # -- kernel dispatch ---------------------------------------------------

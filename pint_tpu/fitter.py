@@ -1067,6 +1067,112 @@ def wls_step(Mw, rw, threshold=1e-12):
     return dx, covn, norm
 
 
+def _wls_fused_fns(prepared, threshold=1e-12, track_mode="nearest",
+                   subtract_mean=True, use_weighted_mean=True,
+                   incoffset=True):
+    """One jitted program per WLS iteration instead of four.
+
+    The historical loop dispatched resid_fn, scaled_sigma_us, dm_fn,
+    and wls_step as separate programs with a host chi2 sync between
+    them — four device round-trips per iteration whose launch gaps are
+    pure host tax on a refit that itself runs in milliseconds. These
+    builders fuse the same math (identical op sequence: residuals,
+    EFAC/EQUAD sigmas, jacfwd design matrix, the column-normalized SVD
+    step, chi2 at the new iterate) into two structure-cached programs:
+
+    - eval: x -> (rw, sigma_s, chi2) — the pre-loop evaluation
+    - step: (x, rw, sigma_s) -> (x', rw', sigma_s', chi2', covn, norm)
+
+    Returns (eval, step, noff) with noff the leading design-matrix
+    offset-column count the covariance slice needs.
+
+    carrying the whitened residuals across the iteration boundary
+    exactly as the host loop did, so the fitter syncs ONE scalar per
+    iteration (chi2, which the best-iterate safeguard genuinely needs
+    on host). Everything stays f64; this is a scheduling change the
+    ERRORBUDGET precision tiers are indifferent to. Programs live in
+    the process-global structure-keyed cache (_global_fn), so repeated
+    refits of same-structure models reuse the XLA executables."""
+    import jax
+    import jax.numpy as jnp
+
+    from .models.timing_model import (
+        _merge_prep, _overlay_params, _phase_impl, _sigma_impl)
+    from .utils import weighted_mean
+
+    model, static = prepared.model, prepared._prep_static
+    free_map = tuple(prepared.free_param_map())
+    labels = [n for n, _, _ in free_map]
+    if incoffset and "PHOFF" in labels:
+        incoffset = False
+    noff = 1 if incoffset else 0
+    # resolve the solver ONCE and key the program cache on it: a
+    # replaced wls_step (tests, experiments) must get its own trace,
+    # not silently reuse a program compiled from the original
+    step_impl = wls_step
+
+    def resid_and_sigma(x, params0, batch, pa):
+        # mirrors residual_vector_fn's traced body, additionally
+        # returning sigma [s] so the step never recomputes it
+        prep = _merge_prep(static, pa)
+        p = _overlay_params(x, params0, free_map)
+        frac = _phase_impl(model, p, batch, prep)
+        if track_mode == "use_pulse_numbers":
+            pn = batch.pulse_number
+            tracked = (prep["phi_ref_int"] - pn) + frac
+            wrapped = frac - jnp.floor(frac + 0.5)
+            resid = jnp.where(jnp.isnan(pn), wrapped, tracked)
+        else:
+            resid = frac - jnp.floor(frac + 0.5)
+        sigma = _sigma_impl(model, p, batch, prep)
+        if subtract_mean:
+            if use_weighted_mean:
+                resid = resid - weighted_mean(resid, sigma)
+            else:
+                resid = resid - jnp.mean(resid)
+        return resid / p["F"][0], sigma * 1e-6
+
+    def build_eval():
+        def f(x, params0, batch, pa):
+            r, sigma_s = resid_and_sigma(x, params0, batch, pa)
+            rw = r / sigma_s
+            return rw, sigma_s, jnp.sum(jnp.square(rw))
+        return f
+
+    def build_step():
+        def f(x, rw, sigma_s, params0, batch, pa):
+            prep = _merge_prep(static, pa)
+
+            def ph(xx):
+                return _phase_impl(
+                    model, _overlay_params(xx, params0, free_map),
+                    batch, prep)
+
+            M = jax.jacfwd(ph)(x)
+            if incoffset:
+                M = jnp.concatenate(
+                    [jnp.ones((M.shape[0], 1)), M], axis=1)
+            Mw = (M / params0["F"][0]) / sigma_s[:, None]
+            dx_all, covn, norm = step_impl(Mw, rw, threshold)
+            x2 = x - dx_all[noff:]
+            r2, sigma2 = resid_and_sigma(x2, params0, batch, pa)
+            rw2 = r2 / sigma2
+            return (x2, rw2, sigma2, jnp.sum(jnp.square(rw2)),
+                    covn, norm)
+        return f
+
+    key = (subtract_mean, use_weighted_mean, track_mode)
+    eval_fn = prepared._global_fn(("wlsfused_eval",) + key, build_eval)
+    step_fn = prepared._global_fn(
+        ("wlsfused_step",) + key
+        + (incoffset, float(threshold), step_impl), build_step)
+    p0, batch, pa = prepared.params0, prepared.batch, \
+        prepared._prep_arrays
+    return (lambda x: eval_fn(x, p0, batch, pa),
+            lambda x, rw, s: step_fn(x, rw, s, p0, batch, pa),
+            noff)
+
+
 def _reject_free_dmjump(model):
     """Narrowband fitters must refuse free DMJUMPs: their time-domain
     design column is identically zero, so the 'fit' would report the
@@ -1111,9 +1217,6 @@ class WLSFitter(Fitter):
     def fit_toas(self, maxiter=2, threshold=1e-12):
         from .obs import clock as obs_clock
 
-        import jax
-        import jax.numpy as jnp
-
         _maybe_inject_solver_diverge("wls")
         corr = _correlated_noise_components(self.model)
         if corr:
@@ -1123,21 +1226,18 @@ class WLSFitter(Fitter):
         t_start = obs_clock.now()
         prepared = self.model.prepare(self.toas)
         prep_s = obs_clock.now() - t_start
-        resid_fn = prepared.residual_vector_fn(track_mode=self._track_mode())
-        dm_fn, labels = prepared.designmatrix_fn()
-        noff = _n_offset(labels)
-        f0 = prepared.params0["F"][0]
+        # fused per-iteration program (_wls_fused_fns): residuals,
+        # sigmas, design matrix, SVD step, and chi2 in ONE dispatch,
+        # with a single scalar host sync per iteration — the rest of
+        # the per-refit host tax lives in launch gaps this removes
+        eval_fn, step_fn, noff = _wls_fused_fns(
+            prepared, threshold=threshold,
+            track_mode=self._track_mode())
         iter_s = []
 
-        def whitened(x):
-            r = resid_fn(x)
-            sigma_s = prepared.scaled_sigma_us(
-                prepared.params_with_vector(x)) * 1e-6
-            return r / sigma_s, sigma_s
-
         x = prepared.vector_from_params()
-        rw, sigma_s = whitened(x)
-        chi2 = float(jnp.sum(jnp.square(rw)))
+        rw, sigma_s, chi2 = eval_fn(x)
+        chi2 = float(chi2)
         # best-iterate safeguard: a plain Gauss-Newton step can increase
         # chi2 (strong nonlinearity, or a corrupted normal-equation
         # projection on degraded-f64 backends); never hand back an
@@ -1146,14 +1246,10 @@ class WLSFitter(Fitter):
         first_cov = None
         for _ in range(maxiter):
             t_it = obs_clock.now()
-            M = dm_fn(x)
-            Mw = (M / f0) / sigma_s[:, None]
-            dx_all, covn, norm = wls_step(Mw, rw, threshold)
+            x, rw, sigma_s, chi2, covn, norm = step_fn(x, rw, sigma_s)
+            chi2 = float(chi2)
             if first_cov is None:
                 first_cov = (covn, norm)
-            x = x - dx_all[noff:]
-            rw, sigma_s = whitened(x)
-            chi2 = float(jnp.sum(jnp.square(rw)))
             iter_s.append(obs_clock.now() - t_it)
             if chi2 < best[0]:
                 best = (chi2, x, (covn, norm))

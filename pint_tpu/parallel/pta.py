@@ -2211,7 +2211,7 @@ class PTAFleet:
     def __init__(self, models, toas_list, mesh=None, toa_bucket=None,
                  bucket_floor=256, pipeline=False,
                  plan_compile_budget=None, plan_max_pack=None,
-                 plan_quantum=None, plan_min_width=None):
+                 plan_quantum=None, plan_min_width=None, store=None):
         """toa_bucket=None: group by model structure only (each batch
         pads to its own max TOA count). toa_bucket="pow2": additionally
         bucket pulsars by next-power-of-two TOA count (>= bucket_floor,
@@ -2244,7 +2244,18 @@ class PTAFleet:
         buckets pack concurrently with each other and with whatever
         the caller does next (compile, earlier buckets' fits), and
         fit() defaults to the pipelined executor. Results are bitwise
-        identical to pipeline=False — only scheduling changes."""
+        identical to pipeline=False — only scheduling changes.
+
+        store (a ``pint_tpu.store.PackStore``) short-circuits the
+        host prep: each bucket first consults the store under the
+        fleet's content signature and, on a verified hit, rebuilds
+        via PTABatch.from_packed straight from the mmap'd columns —
+        the astropy chain never runs. Misses (cold store, stale
+        signature, corrupt entry) fall back to live prep and write
+        the fresh pack state back, so the NEXT bring-up hits. Both
+        the inline and pipelined build paths take the same detour;
+        results are bit-identical either way (the store round-trips
+        pack_state exactly)."""
         self.buckets = {}
         self.order = []  # (bucket_key, index_within_bucket) per pulsar
         groups, build_kwargs, self.plans = self.plan_groups(
@@ -2259,6 +2270,37 @@ class PTAFleet:
         self.batches = {}
         self._batch_futures = {}
         self._prep_pool = None
+        self.store = store
+        self._store_sig = None
+        if store is not None:
+            from ..store import content_signature
+
+            # one signature for the whole fleet: the par files, raw
+            # TOA columns, clock/ephemeris config, plan geometry, and
+            # bucketing options — computed WITHOUT running prep
+            self._store_sig = content_signature(
+                models, toas_list, plans=self.plans,
+                toa_bucket=toa_bucket, bucket_floor=bucket_floor,
+                plan_compile_budget=plan_compile_budget,
+                plan_max_pack=plan_max_pack, plan_quantum=plan_quantum,
+                plan_min_width=plan_min_width)
+        sig = self._store_sig
+
+        def _make(key, ms, ts, bkw):
+            """Store-first bucket build: mmap hit -> from_packed,
+            else live prep (+ write-back). Shared by both paths."""
+            if store is not None:
+                st = store.load(sig, key)
+                if st is not None and not ("pack" in st
+                                           and mesh is not None):
+                    # packed plan batches reject a device mesh in
+                    # from_packed; that combination rebuilds live
+                    return PTABatch.from_packed(ms[0], st, mesh=mesh)
+                b = PTABatch(ms, ts, mesh=mesh, **bkw)
+                store.put(sig, key, b.pack_state())
+                return b
+            return PTABatch(ms, ts, mesh=mesh, **bkw)
+
         if self.pipeline and len(groups) > 1:
             import os
             from concurrent.futures import ThreadPoolExecutor
@@ -2270,7 +2312,7 @@ class PTAFleet:
                 # (span stacks are thread-local)
                 with obs_trace.span("fleet.host_prep", trace_id=tid,
                                     bucket=key, n=len(ms)):
-                    return PTABatch(ms, ts, mesh=mesh, **bkw)
+                    return _make(key, ms, ts, bkw)
 
             self._prep_pool = ThreadPoolExecutor(
                 max_workers=min(len(groups), os.cpu_count() or 1))
@@ -2283,10 +2325,10 @@ class PTAFleet:
             for key, idxs in groups.items():
                 with obs_trace.span("fleet.host_prep", bucket=key,
                                     n=len(idxs)):
-                    self.batches[key] = PTABatch(
-                        [models[i] for i in idxs],
-                        [toas_list[i] for i in idxs], mesh=mesh,
-                        **build_kwargs.get(key, {}))
+                    self.batches[key] = _make(
+                        key, [models[i] for i in idxs],
+                        [toas_list[i] for i in idxs],
+                        build_kwargs.get(key, {}))
         self.n = len(models)
         real = sum(len(t) for t in toas_list)
         if toa_bucket == "plan":
@@ -2334,6 +2376,8 @@ class PTAFleet:
         fleet._lock = threading.RLock()
         fleet._batch_futures = {}
         fleet._prep_pool = None
+        fleet.store = None
+        fleet._store_sig = None
         fleet.batches = dict(enumerate(batches))
         start = 0
         fleet.group_indices = {}

@@ -33,11 +33,23 @@ from dataclasses import dataclass, field
 __all__ = [
     "Segment", "PlanRow", "PlanBucket", "ShapePlan",
     "align_up", "ladder_width", "plan_shapes", "pow2_width",
+    "PACK_GEOMETRY_VERSION",
 ]
 
 DEFAULT_QUANTUM = 256
 DEFAULT_MAX_PACK = 8
 DEFAULT_COMPILE_BUDGET = 4
+# Version of the PACKED-BATCH GEOMETRY itself: bump whenever the
+# layout a ShapePlan (or the pow2/split bucketer) produces for the
+# SAME inputs changes — segment alignment rules, renumbering, dummy
+# padding conventions, pack_state field layout. A plan key can stay
+# stable while the geometry under it moves (the PR 11 quantum-ladder
+# refinement did exactly that, forcing bench.py's pack-cache v1->v2
+# bump); any on-disk cache of packed arrays (store/, bench
+# .bench_cache) must fold this into its content signature so a
+# geometry change invalidates cleanly instead of rebuilding batches
+# from stale layouts.
+PACK_GEOMETRY_VERSION = 2
 # below this, vector lanes go idle and per-program overhead dominates
 DEFAULT_MIN_WIDTH = 1024
 # candidate-pool size for the ladder search: subsets of <= budget

@@ -44,10 +44,12 @@ Injection points (site locations in parentheses):
   the injected stall, ``lane`` pins the slow lane.
 - ``process_kill`` — the serving process dies by SIGKILL at a named
   durability site (:func:`fire_kill` calls placed in
-  ``serve.engine`` / ``serve.journal`` / ``serve.excache``; payload
-  ``at`` pins one of :data:`KILL_SITES`, omitted means the first
-  site reached). The process does not get to clean up — that is the
-  point; recovery is proven by ``ServeEngine.recover`` afterwards.
+  ``serve.engine`` / ``serve.journal`` / ``serve.excache`` /
+  ``store.packstore`` — ``store_write`` kills just before the
+  pack-store's atomic publish; payload ``at`` pins one of
+  :data:`KILL_SITES`, omitted means the first site reached). The
+  process does not get to clean up — that is the point; recovery is
+  proven by ``ServeEngine.recover`` afterwards.
 - ``journal_torn_write`` — a journal append is torn mid-frame, as a
   power cut would leave it (``serve.journal`` frame writer; payload
   ``frac`` sets the fraction of the frame that lands). The reader
@@ -77,7 +79,7 @@ POINTS = ("toa_nan", "toa_inf_error", "compile_fail", "dispatch_slow",
 # journal/commit/cache protocol with a distinct recovery obligation;
 # the chaos harness kills at every one of them.
 KILL_SITES = ("intake_append", "pre_commit", "mid_commit",
-              "post_commit", "excache_store")
+              "post_commit", "excache_store", "store_write")
 
 # the device-level failure domain (ISSUE 6): points that model a chip
 # / lane dying, hanging, or straggling rather than a bad request —

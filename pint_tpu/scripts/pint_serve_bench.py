@@ -683,18 +683,25 @@ def _run_chaos_child(config):
         return ServeEngine(max_batch=lanes, max_latency_s=600.0,
                            bucket_floor=ntoa,
                            durable_dir=config["durable_dir"],
-                           excache_dir=config["excache_dir"])
+                           excache_dir=config["excache_dir"],
+                           store_dir=config.get("store_dir"))
 
     def bringup(premade=None):
         """Restart sequence a real serving process follows: construct
         the engine FIRST (which kicks off the background executable
-        rehydrate from the persisted cache), then do the rest of the
-        process bring-up — loading pulsar models and TOAs — while the
-        deserialize tax is paid off the critical path. By
-        ready-to-serve the executables are warm; this overlap is what
-        makes the 2x cold-start bound reachable (serializing them
-        costs ~0.5-0.7 s of deserialize that nothing else would
-        hide). Returns (engine, model, toas, bringup_wall)."""
+        rehydrate from the persisted cache AND the pack-store CRC
+        prewarm), then do the rest of the process bring-up — loading
+        pulsar models and TOAs — while the deserialize tax is paid
+        off the critical path. By ready-to-serve the executables are
+        warm; this overlap is what makes the 2x cold-start bound
+        reachable (serializing them costs ~0.5-0.7 s of deserialize
+        that nothing else would hide). With an explicit ``store_dir``
+        in the config (the store_write chaos legs), the fleet batch
+        is additionally built THROUGH the pack store — a store hit
+        skips host prep, a miss runs it live and writes back, and the
+        armed ``store_write`` kill lands just before that write's
+        atomic publish. Returns (engine, model, toas,
+        bringup_wall)."""
         t0 = obs_clock.now()
         eng = premade if premade is not None else engine()
         models, toas_list = build_serve_fleet(sizes=(ntoa,),
@@ -703,6 +710,11 @@ def _run_chaos_child(config):
         # the default (red-noise GLS, 8192 TOAs, maxiter 40) is sized
         # so a warm refit flush dominates the residual restart tax,
         # making the 2x cold-start bound a real constraint, not noise
+        if config.get("store_dir") and eng.store is not None:
+            from pint_tpu.parallel.pta import PTAFleet
+
+            PTAFleet([models[structure]], [toas_list[structure]],
+                     store=eng.store)
         return (eng, models[structure], toas_list[structure],
                 obs_clock.now() - t0)
 
@@ -774,6 +786,14 @@ def _run_chaos_child(config):
     # delivered — anything still non-terminal is a leak
     reqlife_nonterminal = (len(eng.reqlife.nonterminal_ids())
                            if eng.reqlife is not None else None)
+    store_rep = None
+    if config.get("store_dir") and eng.store is not None:
+        # scanned AFTER bringup's rebuild: a torn artifact from the
+        # killed writer would have shown up as a corrupt-CRC load
+        # (counters["corrupt"] > 0) during the store consult, and the
+        # scan proves the re-put entry verifies end to end
+        store_rep = {"scan": eng.store.scan(),
+                     "counters": eng.store.counters()}
     eng.journal.close()
     atomic_write_json(config["out"], {
         "mode": mode,
@@ -801,6 +821,7 @@ def _run_chaos_child(config):
         "committed": committed,
         "compiles": snap["executables_compiled"],
         "cache": snap["cache"],
+        "store": store_rep,
     })
     return 0
 
@@ -822,7 +843,11 @@ def run_kill_chaos(sites=None, ntoa=8192, lanes=4, maxiter=40,
     - warm restart: with the persisted executable cache, cold-start to
       first result stays within ``ratio_bound`` x a warm refit flush
       (``excache_store`` runs against a private cold cache -- the kill
-      lands mid-store -- so it checks recompile-on-absence instead).
+      lands mid-store -- so it checks recompile-on-absence instead);
+    - no torn pack-store artifact: the ``store_write`` site kills just
+      before the packed-TOA store's atomic publish during bring-up;
+      the restarted process must see a clean miss (zero corrupt-CRC
+      loads), rebuild live, and re-publish a verifying entry.
 
     Each leg is a real separate process (fork/exec via subprocess);
     the kill is a genuine ``os.kill(getpid(), SIGKILL)`` fired from
@@ -906,17 +931,27 @@ def run_kill_chaos(sites=None, ntoa=8192, lanes=4, maxiter=40,
         if site == "excache_store":
             exdir = os.path.join(workdir, "excache-store-private")
             spec = f"process_kill:at={site},after=0"
+        elif site == "store_write":
+            # store_write kills just before the pack-store's atomic
+            # publish during bring-up: a cold private store so the
+            # put actually fires, but the warm shared excache so the
+            # standard no-recompile/ratio criteria still apply
+            exdir = shared_excache
+            spec = f"process_kill:at={site},after=0"
         else:
             exdir = shared_excache
             spec = f"process_kill:at={site},after=1"
+        sdir = (os.path.join(workdir, "store-private")
+                if site == "store_write" else None)
         kill_cfg = dict(base, mode="serve", tag=f"kill-{site}",
                         site=site, durable_dir=ddir, excache_dir=exdir,
+                        store_dir=sdir,
                         out=os.path.join(workdir, f"kill-{site}.json"))
         kill_rc, kill_err = child(kill_cfg, env_faults=spec)
         rec_out = os.path.join(workdir, f"recover-{site}.json")
         rec_cfg = dict(base, mode="recover", tag=f"recover-{site}",
                        site=site, durable_dir=ddir, excache_dir=exdir,
-                       out=rec_out)
+                       store_dir=sdir, out=rec_out)
         rec_rc, rec_err = child(rec_cfg)
         rec = load_out(rec_out)
         entry = {"kill_rc": kill_rc, "recover_rc": rec_rc,
@@ -930,6 +965,22 @@ def run_kill_chaos(sites=None, ntoa=8192, lanes=4, maxiter=40,
             if c["status"] == "ok"
             and c["digest"] != ref_digests.get(rid)]
         warm_cache = site != "excache_store"
+        store_ok = True
+        if site == "store_write":
+            srep = rec.get("store") or {}
+            scan = srep.get("scan") or {}
+            cnt = srep.get("counters") or {}
+            entry["store_scan"] = scan
+            entry["store_counters"] = cnt
+            # torn-artifact contract: the killed writer left nothing
+            # behind (the recover leg's store consult was a clean
+            # miss, not a corrupt-CRC hit), the rebuild re-put the
+            # entry, and the published artifact verifies end to end
+            store_ok = bool(scan.get("corrupt_or_stale") == 0
+                            and scan.get("valid", 0) >= 1
+                            and cnt.get("corrupt") == 0
+                            and cnt.get("puts", 0) >= 1)
+            entry["store_ok"] = store_ok
         ratio = rec["cold_first_result_s"] / max(rec["warm_refit_s"],
                                                  1e-9)
         entry.update(
@@ -957,7 +1008,8 @@ def run_kill_chaos(sites=None, ntoa=8192, lanes=4, maxiter=40,
             # single recompile AND inside the cold-start bound; the
             # cold-cache site must instead recompile (store died)
             and ((entry["recompiles"] == 0 and ratio <= ratio_bound)
-                 if warm_cache else entry["recompiles"] >= 1))
+                 if warm_cache else entry["recompiles"] >= 1)
+            and store_ok)
         totals["lost"] += entry["lost"]
         totals["duplicated"] += entry["duplicated"]
         totals["replayed"] += entry["replayed"]

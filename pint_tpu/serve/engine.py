@@ -81,7 +81,8 @@ class ServeEngine:
                  mesh=None, clock=time.monotonic, sleep=time.sleep,
                  backoff=None, breaker=None, health=None,
                  bisect_depth=4, plan=None, devices=None,
-                 durable_dir=None, excache_dir=None, reqlife=None):
+                 durable_dir=None, excache_dir=None, store_dir=None,
+                 reqlife=None):
         self.plan = plan  # optional shapeplan.ShapePlan width ladder
         self.batcher = MicroBatcher(max_batch=max_batch,
                                     max_latency_s=max_latency_s,
@@ -110,6 +111,22 @@ class ServeEngine:
             # by the time the first flush looks up an executable, the
             # background rehydrate has (mostly) already paid it
             persistent.prewarm()
+        # packed-TOA store (store.PackStore): durable engines get one
+        # under durable_dir/store by default, so a restarted process
+        # rebuilds its fleet batches from mmap'd columns instead of
+        # re-running the astropy host chain. Its prewarm (CRC verify +
+        # stage) runs on its own thread, OVERLAPPING the executable
+        # rehydrate above — the two independent cold-start taxes are
+        # paid concurrently with each other and with intake.
+        if store_dir is None and self.durable_dir is not None:
+            store_dir = os.path.join(self.durable_dir, "store")
+        if store_dir is None:
+            self.store = None
+        else:
+            from ..store import PackStore
+
+            self.store = PackStore(store_dir)
+            self.store.prewarm()
         self.telemetry = ServeTelemetry()
         self.oversize_toas = oversize_toas
         self.mesh = mesh
@@ -560,6 +577,8 @@ class ServeEngine:
                                        devices=lanes)
         snap["executables_compiled"] = self.executables_compiled
         snap["queue_depth"] = self.batcher.depth()
+        if self.store is not None:
+            snap["store"] = self.store.counters()
         if self.reqlife is not None:
             snap["reqlife"] = self.reqlife.snapshot()
         from ..obs import fitquality as obs_fitq

@@ -1,0 +1,23 @@
+"""Columnar packed-TOA store: mmap'd post-barycentering arrays.
+
+See :mod:`pint_tpu.store.packstore` for the format, keying, and
+failure-handling contract. Public surface::
+
+    from pint_tpu.store import PackStore, content_signature
+
+    store = PackStore("cache/packstore")
+    fleet = PTAFleet(models, toas_list, toa_bucket="plan", store=store)
+"""
+
+from .packstore import (  # noqa: F401
+    PackStore,
+    content_signature,
+    store_identity,
+    STORE_MAGIC,
+    STORE_FORMAT_VERSION,
+)
+
+__all__ = [
+    "PackStore", "content_signature", "store_identity",
+    "STORE_MAGIC", "STORE_FORMAT_VERSION",
+]

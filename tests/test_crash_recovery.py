@@ -400,6 +400,15 @@ def test_sigkill_matrix_exactly_once(tmp_path):
             assert entry["recompiles"] == 0
     # the mid-commit tear leaves a torn tail the journal truncates
     assert report["sites"]["mid_commit"]["torn_truncated"] > 0
+    # SIGKILL just before the pack-store publish leaves no torn
+    # artifact: the restarted process saw a clean miss (zero
+    # corrupt-CRC loads), rebuilt live, and re-published an entry
+    # that verifies end to end
+    sw = report["sites"]["store_write"]
+    assert sw["store_ok"], sw
+    assert sw["store_counters"]["corrupt"] == 0
+    assert sw["store_scan"]["corrupt_or_stale"] == 0
+    assert sw["store_scan"]["valid"] >= 1
     # at least one site stranded genuinely pending work to replay
     assert report["replayed"] > 0
     assert report["ok"], report

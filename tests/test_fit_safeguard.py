@@ -45,17 +45,28 @@ def test_wls_poisoned_step_reverts(monkeypatch):
     clean = F.WLSFitter(t, get_model(PAR))
     clean_chi2 = clean.fit_toas(maxiter=2)
 
-    real_step = F.wls_step
+    # the WLS iteration is one fused device program now
+    # (_wls_fused_fns), so the corruption is injected at its host
+    # boundary: poison the second step's iterate, then re-evaluate its
+    # chi2 honestly — exactly what a corrupted normal-equation
+    # projection looks like to the safeguard
+    real_fns = F._wls_fused_fns
     calls = {"n": 0}
 
-    def poisoned(Mw, rw, threshold=1e-12):
-        dx, covn, norm = real_step(Mw, rw, threshold)
-        calls["n"] += 1
-        if calls["n"] == 2:  # second iteration steps off a cliff
-            dx = dx + 1e-6
-        return dx, covn, norm
+    def patched(prepared, **kw):
+        eval_fn, step_fn, noff = real_fns(prepared, **kw)
 
-    monkeypatch.setattr(F, "wls_step", poisoned)
+        def poisoned_step(x, rw, s):
+            x2, rw2, s2, chi2, covn, norm = step_fn(x, rw, s)
+            calls["n"] += 1
+            if calls["n"] == 2:  # second iteration steps off a cliff
+                x2 = x2 + 1e-6
+                rw2, s2, chi2 = eval_fn(x2)
+            return x2, rw2, s2, chi2, covn, norm
+
+        return eval_fn, poisoned_step, noff
+
+    monkeypatch.setattr(F, "_wls_fused_fns", patched)
     f = F.WLSFitter(t, get_model(PAR))
     with pytest.warns(UserWarning, match="increased chi2"):
         chi2 = f.fit_toas(maxiter=2)

@@ -844,6 +844,25 @@ def test_durable_write_quiet_on_reads_appends_and_patches(tmp_path):
     assert live(fs, "durable-write-unatomic") == []
 
 
+def test_durable_write_flags_pack_store(tmp_path):
+    # the packed-TOA store is registered in the REAL durable registry
+    # (not just a fixture one): a truncating write there would tear a
+    # multi-hundred-MB column file on crash
+    from pint_tpu.analysis.config import DURABLE_ARTIFACT_MODULES
+
+    assert "/store/packstore.py" in DURABLE_ARTIFACT_MODULES
+    bad = """
+        def save_entry(path, blob):
+            with open(path, "wb") as fh:   # tears on a crash mid-write
+                fh.write(blob)
+    """
+    fs = lint(tmp_path, {"store/packstore.py": bad,
+                         "anchor.py": "x = 1\n"},
+              LintConfig(
+                  durable_artifact_modules=DURABLE_ARTIFACT_MODULES))
+    assert len(live(fs, "durable-write-unatomic")) == 1
+
+
 def test_durable_write_scoped_to_registered_modules(tmp_path):
     src = """
         def export(path, text):

@@ -1,0 +1,310 @@
+"""Packed-TOA columnar store (pint_tpu/store): keying, CRC framing,
+invalidation, and crash behavior.
+
+The contract under test (ISSUE 13): a store entry can cost TIME,
+never CORRECTNESS. Every failure mode — bitrot, truncation, a stale
+jax/pack-geometry identity, a mismatched content signature — must
+warn, delete the entry, and rebuild from live prep to bit-identical
+fit parameters; a SIGKILL at the ``store_write`` fault point must
+leave no torn artifact on disk.
+"""
+
+import copy
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+warnings.simplefilter("ignore")
+
+from pint_tpu.models import get_model
+from pint_tpu.parallel import PTAFleet
+from pint_tpu.simulation import make_fake_toas_fromMJDs
+from pint_tpu.store import (PackStore, content_signature,
+                            store_identity)
+
+
+def _fleet_inputs(n_psr=3, base_toas=30):
+    rng = np.random.default_rng(0)
+    models, toas_list = [], []
+    for i in range(n_psr):
+        par = (f"PSR ST{i}\nRAJ 1{i % 10}:00:00.0\n"
+               f"DECJ {5 + i}:30:00.0\nF0 {200 + 10 * i}.5 1\n"
+               f"F1 -{3 + i}e-16 1\nPEPOCH 55500\nDM {10 + i}.5 1\n")
+        m = get_model(par)
+        n = base_toas + 5 * i
+        mjds = np.sort(rng.uniform(55000, 56000, n))
+        freqs = np.where(np.arange(n) % 2, 1400.0, 800.0)
+        t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0,
+                                    freq_mhz=freqs, obs="gbt",
+                                    add_noise=True, seed=i)
+        models.append(m)
+        toas_list.append(t)
+    return models, toas_list
+
+
+def _fit(models, toas_list, store=None):
+    fleet = PTAFleet([copy.deepcopy(m) for m in models], toas_list,
+                     store=store)
+    x, chi2, cov = fleet.fit(method="wls", maxiter=2)
+    return np.asarray(x), np.asarray(chi2)
+
+
+_SYNTH = None
+
+
+def _synthetic_state():
+    # pack_state-shaped tree: dict/list/tuple nodes, numeric numpy
+    # leaves, and non-array scalars/strings in the meta region
+    return {
+        "params": np.linspace(0.0, 1.0, 7),
+        "batch": {"day": np.arange(10, dtype=np.int64),
+                  "freq": np.full((2, 5), 1400.0, np.float32)},
+        "free_map": [("F0", 0), ("DM", 2)],
+        "n_toas": np.array([10, 10]),
+        "static": {"ephem": "de440", "planets": True},
+    }
+
+
+def test_synthetic_roundtrip_and_mmap_views(tmp_path):
+    store = PackStore(tmp_path)
+    state = _synthetic_state()
+    sig = "pack-" + "a" * 40
+    nbytes = store.put(sig, (0, 256), state)
+    assert nbytes > 0
+    out = store.load(sig, (0, 256))
+    assert out is not None
+    np.testing.assert_array_equal(out["params"], state["params"])
+    np.testing.assert_array_equal(out["batch"]["day"],
+                                  state["batch"]["day"])
+    assert out["batch"]["freq"].dtype == np.float32
+    assert out["batch"]["freq"].shape == (2, 5)
+    # container types and non-array leaves survive the meta pickle
+    assert out["free_map"] == [("F0", 0), ("DM", 2)]
+    assert isinstance(out["free_map"][0], tuple)
+    assert out["static"] == {"ephem": "de440", "planets": True}
+    # array leaves are read-only views over the pinned mmap
+    assert not out["params"].flags.writeable
+    c = store.counters()
+    assert c["puts"] == 1 and c["hits"] == 1 and c["misses"] == 0
+    assert c["bytes_written"] == nbytes and c["bytes_mapped"] > 0
+
+
+def test_cold_miss_counts_rebuild(tmp_path):
+    store = PackStore(tmp_path)
+    assert store.load("pack-" + "b" * 40, (0, 256)) is None
+    c = store.counters()
+    assert c["misses"] == 1 and c["rebuilds"] == 1 and c["hits"] == 0
+
+
+def test_content_signature_discriminates():
+    models, toas_list = _fleet_inputs(2)
+    sig = content_signature(models, toas_list, bucket_floor=256)
+    # deterministic over equal inputs (deepcopies)
+    assert content_signature([copy.deepcopy(m) for m in models],
+                             toas_list, bucket_floor=256) == sig
+    # a par-file edit must change the key
+    m2 = copy.deepcopy(models[0])
+    m2.F0.value += 1e-6
+    assert content_signature([m2, models[1]], toas_list,
+                             bucket_floor=256) != sig
+    # fewer TOA tables / different bucketing options must change it
+    assert content_signature(models, toas_list[:1],
+                             bucket_floor=256) != sig
+    assert content_signature(models, toas_list,
+                             bucket_floor=512) != sig
+    # the environment identity is deliberately NOT hashed into the
+    # signature (it is checked at load; see the geometry-bump test)
+    assert sig.startswith("pack-")
+    assert set(store_identity()) == {"format", "jax_version",
+                                     "pack_geometry"}
+
+
+def test_fleet_store_hit_is_bit_identical(tmp_path):
+    models, toas_list = _fleet_inputs()
+    x_live, chi2_live = _fit(models, toas_list, store=None)
+
+    cold = PackStore(tmp_path)
+    x_cold, chi2_cold = _fit(models, toas_list, store=cold)
+    cc = cold.counters()
+    assert cc["misses"] >= 1 and cc["puts"] >= 1 and cc["hits"] == 0
+
+    warm = PackStore(tmp_path)  # fresh process-equivalent
+    x_warm, chi2_warm = _fit(models, toas_list, store=warm)
+    wc = warm.counters()
+    assert wc["hits"] >= 1 and wc["misses"] == 0 and wc["puts"] == 0
+
+    np.testing.assert_array_equal(x_cold, x_live)
+    np.testing.assert_array_equal(x_warm, x_live)
+    np.testing.assert_array_equal(chi2_warm, chi2_live)
+
+
+def test_byte_flip_warns_deletes_rebuilds(tmp_path):
+    models, toas_list = _fleet_inputs()
+    x_live, _ = _fit(models, toas_list, store=None)
+    cold = PackStore(tmp_path)
+    _fit(models, toas_list, store=cold)
+    (key,) = [k for k in os.listdir(tmp_path) if k.endswith(".ptpk")]
+    path = os.path.join(tmp_path, key)
+    # flip the file's LAST byte: the file ends exactly at the final
+    # column's payload, so this is real column data and some CRC
+    # check must catch it
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        fh.seek(size - 1)
+        b = fh.read(1)
+        fh.seek(size - 1)
+        fh.write(bytes([b[0] ^ 0xFF]))
+
+    hurt = PackStore(tmp_path)
+    with pytest.warns(UserWarning, match="unusable"):
+        x2, _ = _fit(models, toas_list, store=hurt)
+    hc = hurt.counters()
+    assert hc["corrupt"] >= 1 and hc["rebuilds"] >= 1 \
+        and hc["puts"] >= 1
+    np.testing.assert_array_equal(x2, x_live)
+    # the rebuild re-published a verifying entry
+    assert hurt.scan() == {"entries": 1, "valid": 1,
+                           "corrupt_or_stale": 0,
+                           "bytes": os.path.getsize(
+                               os.path.join(tmp_path, key))}
+
+
+def test_truncation_warns_and_rebuilds(tmp_path):
+    store = PackStore(tmp_path)
+    sig = "pack-" + "c" * 40
+    store.put(sig, (0, 256), _synthetic_state())
+    (name,) = os.listdir(tmp_path)
+    path = os.path.join(tmp_path, name)
+    os.truncate(path, os.path.getsize(path) // 2)
+    with pytest.warns(UserWarning, match="unusable"):
+        assert store.load(sig, (0, 256)) is None
+    assert not os.path.exists(path)  # deleted, not left to re-fail
+    c = store.counters()
+    assert c["corrupt"] == 1 and c["rebuilds"] == 1
+
+
+def test_signature_mismatch_is_stale_not_corrupt(tmp_path):
+    # defense in depth: a file whose EMBEDDED signature disagrees
+    # with the requested one (hash collision, manual copy) is stale
+    store = PackStore(tmp_path)
+    sig_a = "pack-" + "d" * 40
+    sig_b = "pack-" + "e" * 40
+    store.put(sig_a, (0, 256), _synthetic_state())
+    os.rename(store._path(sig_a, (0, 256)),
+              store._path(sig_b, (0, 256)))
+    with pytest.warns(UserWarning, match="stale"):
+        assert store.load(sig_b, (0, 256)) is None
+    c = store.counters()
+    assert c["stale"] == 1 and c["corrupt"] == 0
+
+
+def test_geometry_bump_invalidates_visibly(tmp_path, monkeypatch):
+    """A PACK_GEOMETRY_VERSION bump (a ShapePlan whose key is stable
+    but whose layout moved — the PR 11 hazard) must find the OLD
+    entry at the SAME path and invalidate it with warn + delete +
+    rebuild, never serve stale columns and never silently orphan."""
+    from pint_tpu.parallel import shapeplan
+
+    models, toas_list = _fleet_inputs()
+    x_live, _ = _fit(models, toas_list, store=None)
+    _fit(models, toas_list, store=PackStore(tmp_path))
+    assert len(os.listdir(tmp_path)) == 1
+
+    monkeypatch.setattr(shapeplan, "PACK_GEOMETRY_VERSION",
+                        shapeplan.PACK_GEOMETRY_VERSION + 1)
+    bumped = PackStore(tmp_path)
+    with pytest.warns(UserWarning, match="stale"):
+        x2, _ = _fit(models, toas_list, store=bumped)
+    bc = bumped.counters()
+    assert bc["stale"] >= 1 and bc["rebuilds"] >= 1 and bc["puts"] >= 1
+    np.testing.assert_array_equal(x2, x_live)
+    # exactly one entry remains (rewritten under the new identity,
+    # same content signature -> same path; no orphan accumulation)
+    assert len(os.listdir(tmp_path)) == 1
+    assert bumped.scan()["valid"] == 1
+
+
+def test_prewarm_stages_and_load_consumes(tmp_path):
+    sig = "pack-" + "f" * 40
+    PackStore(tmp_path).put(sig, (0, 256), _synthetic_state())
+
+    store = PackStore(tmp_path)
+    t = store.prewarm(background=True)
+    assert t is not None
+    out = store.load(sig, (0, 256))  # joins the worker internally
+    assert out is not None
+    c = store.counters()
+    assert c["prewarm_hits"] == 1 and c["hits"] == 1
+
+    # inline prewarm (background=False) stages synchronously
+    store2 = PackStore(tmp_path)
+    assert store2.prewarm(background=False) is None
+    assert store2.load(sig, (0, 256)) is not None
+    assert store2.counters()["prewarm_hits"] == 1
+
+    # empty directory: nothing to do, no thread
+    assert PackStore(tmp_path / "empty").prewarm() is None
+
+
+def test_scan_is_a_pure_probe(tmp_path):
+    store = PackStore(tmp_path)
+    siga = "pack-" + "1" * 40
+    sigb = "pack-" + "2" * 40
+    store.put(siga, (0, 256), _synthetic_state())
+    store.put(sigb, (1, 512), _synthetic_state())
+    rep = store.scan()
+    assert rep["entries"] == 2 and rep["valid"] == 2
+    assert rep["corrupt_or_stale"] == 0 and rep["bytes"] > 0
+    store._damage(sigb, (1, 512), offset=3)
+    with pytest.warns(UserWarning):
+        rep2 = store.scan()
+    assert rep2["corrupt_or_stale"] == 1 and rep2["valid"] == 1
+    # a scan is telemetry-neutral: the corruption counters only move
+    # for real traffic (the damaged entry was deleted by the probe)
+    c = store.counters()
+    assert c["corrupt"] == 0 and c["stale"] == 0
+
+
+_KILL_CHILD = """
+import os, warnings
+warnings.simplefilter("ignore")
+import numpy as np
+from pint_tpu.store import PackStore
+store = PackStore({d!r})
+store.put("pack-" + "9" * 40, (0, 256),
+          {{"a": np.arange(64.0), "s": {{"k": 1}}}})
+print("SURVIVED", len(os.listdir({d!r})))
+"""
+
+
+def test_sigkill_at_store_write_leaves_no_torn_artifact(tmp_path):
+    """The ``store_write`` process-kill fault fires immediately
+    before the atomic publish: the killed writer must leave an empty
+    directory (no entry, no temp file), and an unfaulted retry must
+    publish a verifying entry. The serving-scale version of this —
+    kill during bring-up, restart, clean-miss rebuild — runs in
+    tests/test_crash_recovery.py's SIGKILL matrix."""
+    d = str(tmp_path / "store")
+    code = _KILL_CHILD.format(d=d)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PINT_TPU_FAULTS="process_kill:at=store_write,after=0")
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == -9, proc.stderr[-1000:]
+    assert "SURVIVED" not in proc.stdout
+    leftovers = os.listdir(d) if os.path.isdir(d) else []
+    assert leftovers == [], leftovers  # nothing torn, nothing temp
+
+    env.pop("PINT_TPU_FAULTS")
+    proc2 = subprocess.run([sys.executable, "-c", code], env=env,
+                           capture_output=True, text=True, timeout=300)
+    assert proc2.returncode == 0, proc2.stderr[-1000:]
+    assert "SURVIVED 1" in proc2.stdout
+    rep = PackStore(d).scan()
+    assert rep == {"entries": 1, "valid": 1, "corrupt_or_stale": 0,
+                   "bytes": rep["bytes"]}
+    assert rep["bytes"] > 0
