@@ -1614,6 +1614,88 @@ def main():
                    f"{fitq_report['fitq_fits']} fits, "
                    f"bitwise={fitq_report['fitq_bitwise']})")
 
+    # ------------------------------------------------------------------
+    # gw stage: the Hellings–Downs detection pipeline (pint_tpu/gw/).
+    # Three sub-measurements: (a) injected-GWB recovery — the optimal
+    # statistic on a seeded synthetic 68-pulsar lattice must recover
+    # the injected amplitude, beat the monopole/dipole alternatives,
+    # and calibrate an honest p-value from sky-scramble nulls; (b)
+    # pair-sweep throughput + MFU on a larger synthetic lattice (the
+    # O(P^2) batched-matmul workload the subsystem exists for); (c)
+    # the end-to-end PTAFleet.gw_stage on a small fitted fleet. Same
+    # optional posture as the other stages: daemon thread + join
+    # timeout, skip with PINT_TPU_BENCH_SKIP_GW=1.
+    gw_report = None
+
+    def _gw_stage():
+        nonlocal gw_report
+        try:
+            from pint_tpu import gw as gw_mod
+            from pint_tpu.gw.hd import isotropic_positions
+            from pint_tpu.parallel import PTAFleet
+
+            inj_amp = 0.5
+            pos = isotropic_positions(68, seed=0)
+            lat = gw_mod.inject_gwb(pos, 128, inj_amp, seed=0)
+            os_hd = gw_mod.optimal_statistic(lat)
+            os_mono = gw_mod.optimal_statistic(lat, orf="monopole")
+            os_dip = gw_mod.optimal_statistic(lat, orf="dipole")
+            null = gw_mod.scramble_null(lat, n_draws=32, seed=0,
+                                        mode="sky",
+                                        snr_obs=os_hd["snr"])
+            amp_ratio = (float(np.sqrt(os_hd["amp2"]) / inj_amp)
+                         if os_hd["amp2"] and os_hd["amp2"] > 0
+                         else None)
+            # (b) throughput: 512 pulsars x 512 cells, warm best-of-3
+            posb = isotropic_positions(512, seed=1)
+            latb = gw_mod.inject_gwb(posb, 512, 0.0, seed=1)
+            sweep = None
+            for _ in range(3):
+                s = gw_mod.correlation_sweep(
+                    latb.z, latb.w, lambda *a: None, block=256)
+                if sweep is None or s["wall_s"] < sweep["wall_s"]:
+                    sweep = s
+            # (c) end-to-end on a small fitted fleet
+            gmodels, gtoas = build_batch(12, 48, noise=True, seed=0)
+            gfl = PTAFleet(gmodels, gtoas, pipeline=True)
+            fe = gfl.gw_stage(maxiter=2, lattice_days=60.0)
+            gw_report = {  # set LAST: completion marker
+                "gw_os_snr": round(os_hd["snr"], 3),
+                "gw_os_amp_ratio": (round(amp_ratio, 4)
+                                    if amp_ratio else None),
+                "gw_null_p": null["p_value"],
+                "gw_hd_beats_alternatives": bool(
+                    os_hd["snr"] > abs(os_mono["snr"])
+                    and os_hd["snr"] > abs(os_dip["snr"])),
+                "gw_pairs_per_s": (round(sweep["pairs_per_s"], 1)
+                                   if sweep["pairs_per_s"] else None),
+                "gw_mfu_pct": sweep["mfu_pct"],
+                "gw_bound": sweep["bound"],
+                "gw_fleet_snr": (round(fe["snr"], 3)
+                                 if fe["snr"] is not None else None),
+                "gw_fleet_pairs": fe["n_pairs"],
+            }
+        except Exception as e:
+            _stage(f"gw stage failed ({type(e).__name__}: {e}); "
+                   "headline JSON unaffected")
+
+    if os.environ.get("PINT_TPU_BENCH_SKIP_GW") == "1":
+        _stage("gw stage skipped (PINT_TPU_BENCH_SKIP_GW=1)")
+    else:
+        _stage("gw: HD optimal statistic — injected recovery, pair "
+               "throughput, fleet end-to-end")
+        tg = threading.Thread(target=_gw_stage, daemon=True)
+        tg.start()
+        tg.join(timeout=300)
+        if tg.is_alive():
+            gw_report = None  # snapshot: late finish must not race
+            _stage("gw stage timed out; headline JSON unaffected")
+        elif gw_report is not None:
+            _stage(f"gw: os_snr {gw_report['gw_os_snr']} "
+                   f"(amp ratio {gw_report['gw_os_amp_ratio']}, "
+                   f"null p {gw_report['gw_null_p']:.3f}), "
+                   f"{gw_report['gw_pairs_per_s']} pairs/s")
+
     total_toas = n_psr * n_toa
     rate = total_toas / gls_refit_s  # TOAs GLS-refit per second
     projected_670k = gls_refit_s * (670_000 / total_toas)
@@ -1857,6 +1939,20 @@ def main():
             fitq_report["fitq_max_abs_chi2_z"] if fitq_report else None),
         "measured_670k_fitq_max_condition": (
             fitq_report["fitq_max_condition"] if fitq_report else None),
+        "gw_os_snr": (gw_report["gw_os_snr"] if gw_report else None),
+        "gw_os_amp_ratio": (gw_report["gw_os_amp_ratio"]
+                            if gw_report else None),
+        "gw_null_p": (gw_report["gw_null_p"] if gw_report else None),
+        "gw_hd_beats_alternatives": (
+            gw_report["gw_hd_beats_alternatives"] if gw_report else None),
+        "gw_pairs_per_s": (gw_report["gw_pairs_per_s"]
+                           if gw_report else None),
+        "gw_mfu_pct": (gw_report["gw_mfu_pct"] if gw_report else None),
+        "gw_bound": (gw_report["gw_bound"] if gw_report else None),
+        "gw_fleet_snr": (gw_report["gw_fleet_snr"]
+                         if gw_report else None),
+        "gw_fleet_pairs": (gw_report["gw_fleet_pairs"]
+                           if gw_report else None),
         "platform": platform,
     }
     meta.update(full_meta)
@@ -1907,6 +2003,8 @@ def main():
         ("PINT_TPU_BENCH_SKIP_FUSED", fused_report,
          [k for k in meta
           if k.startswith(("gls_fused_", "fused_"))]),
+        ("PINT_TPU_BENCH_SKIP_GW", gw_report,
+         [k for k in meta if k.startswith("gw_")]),
     ):
         _reason = _stage_reason(_env, _rep)
         if _reason:

@@ -163,6 +163,8 @@ OBS_INSTRUMENTED_MODULES = (
     "/serve/engine.py", "/serve/excache.py", "/serve/batcher.py",
     "/serve/metrics.py", "/resilience/retry.py", "/bench.py",
     "/benchmarks/profile_harness.py", "/scripts/pint_serve_bench.py",
+    "/gw/residuals.py", "/gw/correlate.py", "/gw/hd.py",
+    "/gw/__main__.py",
 )
 
 # Raw timer call names timing-untraced flags in instrumented modules.
@@ -199,7 +201,7 @@ DURABLE_ARTIFACT_MODULES = (
 # visible (kernels.fallback.note_pallas_fallback) instead of
 # swallowing it — a fleet silently pinned to the reference path loses
 # its MXU throughput with no signal anywhere.
-KERNEL_DISPATCH_MODULES = ("/kernels/",)
+KERNEL_DISPATCH_MODULES = ("/kernels/", "/gw/")
 
 # -- budget coverage ---------------------------------------------------
 

@@ -1,0 +1,45 @@
+"""Gravitational-wave detection over fleet fit outputs (ISSUE 15).
+
+The PTA end product is evidence for a gravitational-wave background
+in the *inter-pulsar correlations* of post-fit timing residuals: an
+isotropic GWB imprints the Hellings–Downs curve Gamma(xi) on the
+cross-correlation of every pulsar pair as a function of their angular
+separation xi. Everything upstream — packed fleet fits, the whitened
+fit-quality ledger, the columnar store — exists to feed this stage.
+
+Pipeline (one pass, all host-orchestrated, device-heavy in the
+middle):
+
+1. :mod:`residuals` — assemble per-pulsar post-fit residual/sigma
+   arrays from a :class:`~pint_tpu.parallel.pta.PTAFleet`'s fit
+   results (``PTABatch.gw_arrays``), sky unit vectors from the timing
+   models, and regrid everything onto a common epoch lattice.
+2. :mod:`correlate` — the O(P^2) all-pairs cross-correlation sweep as
+   tiled batched matmuls over the lattice (kernels/paircorr.py dual
+   path), streamed through an upper-triangle pair-block accumulator
+   so the 3000-pulsar pair matrix (~4.5M pairs) never materializes.
+3. :mod:`hd` — the Hellings–Downs overlap-reduction curve and the
+   frequentist optimal statistic (amplitude estimate A^2, S/N,
+   per-pair weights), with significance calibrated by seeded
+   sky-scramble / phase-shift null draws
+   (``np.random.default_rng([seed, draw])``, the PR-12 idiom).
+
+Entry points: ``PTAFleet.gw_stage()`` for fleets, ``python -m
+pint_tpu.gw`` for a synthetic injected demo, and the bench.py gw
+stage for the tracked ``gw_*`` meta keys. Obs surface: ``gw.correlate``
+/ ``gw.os`` / ``gw.scramble`` spans, ``gw.*`` registry counters, and
+roofline attribution on the pair-matmul sweep via obs.costmodel.
+"""
+
+from . import correlate, hd, residuals  # noqa: F401
+from .correlate import correlation_matrix, correlation_sweep  # noqa: F401
+from .hd import (hd_curve, inject_gwb, optimal_statistic,  # noqa: F401
+                 scramble_null)
+from .residuals import GWInputs, assemble, regrid, sky_positions  # noqa: F401
+
+__all__ = [
+    "GWInputs", "assemble", "correlate", "correlation_matrix",
+    "correlation_sweep", "hd", "hd_curve", "inject_gwb",
+    "optimal_statistic", "regrid", "residuals", "scramble_null",
+    "sky_positions",
+]

@@ -1,0 +1,73 @@
+"""``python -m pint_tpu.gw`` — synthetic injected-GWB demo.
+
+Builds a seeded isotropic sky, injects an HD-correlated background
+into a white-noise lattice, runs the optimal statistic under all
+three overlap-reduction templates, and (optionally) calibrates the
+HD significance with scramble nulls. Everything is deterministic in
+``--seed``, so the JSON output doubles as a quick cross-platform
+reproducibility check of the whole gw/ pipeline."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m pint_tpu.gw",
+        description="synthetic injected-GWB optimal-statistic demo")
+    ap.add_argument("--pulsars", type=int, default=68)
+    ap.add_argument("--cells", type=int, default=256)
+    ap.add_argument("--amplitude", type=float, default=0.5,
+                    help="injected GWB RMS amplitude (recovered "
+                    "as amp2 ~ amplitude^2)")
+    ap.add_argument("--noise-sigma", type=float, default=1.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--scrambles", type=int, default=0,
+                    help="sky-scramble null draws (0 = skip)")
+    ap.add_argument("--scramble-mode", choices=("sky", "phase"),
+                    default="sky")
+    ap.add_argument("--block", type=int, default=256)
+    ap.add_argument("--precision", choices=("f64", "mixed"),
+                    default="f64")
+    args = ap.parse_args(argv)
+
+    from . import hd
+
+    pos = hd.isotropic_positions(args.pulsars, seed=args.seed)
+    lat = hd.inject_gwb(pos, args.cells, args.amplitude,
+                        seed=args.seed, noise_sigma=args.noise_sigma)
+    out = {"n_pulsars": args.pulsars, "n_cells": args.cells,
+           "injected_amplitude": args.amplitude, "seed": args.seed}
+    for orf in ("hd", "monopole", "dipole"):
+        os_ = hd.optimal_statistic(lat, orf=orf, block=args.block,
+                                   precision=args.precision)
+        out[orf] = {"amp2": os_["amp2"], "snr": os_["snr"],
+                    "sigma_amp2": os_["sigma_amp2"]}
+        if orf == "hd":
+            amp2 = os_["amp2"]
+            out["recovered_amplitude"] = (
+                float(np.sqrt(amp2)) if amp2 and amp2 > 0 else None)
+            out["pairs_per_s"] = os_["sweep"]["pairs_per_s"]
+            snr_obs = os_["snr"]
+    if args.scrambles:
+        null = hd.scramble_null(
+            lat, n_draws=args.scrambles, seed=args.seed,
+            mode=args.scramble_mode, block=args.block,
+            precision=args.precision, snr_obs=snr_obs)
+        out["null"] = {"mode": null["mode"],
+                       "n_draws": null["n_draws"],
+                       "p_value": null["p_value"],
+                       "snr_null_max": float(
+                           np.max(np.abs(null["snr_null"])))}
+    json.dump(out, sys.stdout, indent=2, default=float)
+    sys.stdout.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

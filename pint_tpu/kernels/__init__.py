@@ -10,5 +10,7 @@ from .fusedgls import (fused_segment_gls,  # noqa: F401
                        fused_segment_gls_jnp, fused_segment_gls_pallas)
 from .harmonics import (harmonic_sums, harmonic_sums_jnp,  # noqa: F401
                         harmonic_sums_pallas)
+from .paircorr import (pair_products, pair_products_jnp,  # noqa: F401
+                       pair_products_pallas)
 from .seggram import (segment_gram, segment_gram_jnp,  # noqa: F401
                       segment_gram_pallas)

@@ -155,10 +155,13 @@ class FitQualityLedger:
         self.fallbacks = 0
         self.diverged = 0
         self.drift_alarms = 0
+        self.pairs_probed = 0
+        self.pairs_incoherent = 0
         self.probe_wall_s = 0.0
         self.max_abs_chi2_z = None
         self.max_condition = None
         self.max_relres = None
+        self.max_pair_snr = None
 
     def _fold_max(self, attr, value):
         if value is None or not math.isfinite(value):
@@ -209,6 +212,20 @@ class FitQualityLedger:
         with self._lock:
             self.drift_alarms += 1
 
+    def note_pair_coherence(self, n_pairs, n_incoherent,
+                            max_abs_snr=None):
+        """Fold one GW pair-correlation sweep's coherence census in:
+        ``n_pairs`` probed cross-pairs, of which ``n_incoherent``
+        exceeded the per-pair |num/sqrt(den)| z-limit (an incoherent
+        pair means one of the two pulsars' noise models is lying —
+        the pair analog of the chi2 z probe). Feeds the
+        ``gw_coherence`` SLO in :func:`fit_quality_slos`."""
+        with self._lock:
+            self.pairs_probed += int(n_pairs)
+            self.pairs_incoherent += int(n_incoherent)
+            if max_abs_snr is not None:
+                self._fold_max("max_pair_snr", float(max_abs_snr))
+
     def note_probe_wall(self, wall_s):
         with self._lock:
             self.probe_wall_s += float(wall_s)
@@ -226,10 +243,14 @@ class FitQualityLedger:
                 "counters": {"fits": self.fits,
                              "fallbacks": self.fallbacks,
                              "diverged": self.diverged,
-                             "drift_alarms": self.drift_alarms},
+                             "drift_alarms": self.drift_alarms,
+                             "pairs_probed": self.pairs_probed,
+                             "pairs_incoherent":
+                                 self.pairs_incoherent},
                 "max_abs_chi2_z": self.max_abs_chi2_z,
                 "max_condition": self.max_condition,
                 "max_relres": self.max_relres,
+                "max_pair_snr": self.max_pair_snr,
                 "probe_wall_s": self.probe_wall_s,
                 "n_pulsars": len(self._pulsars),
                 "pulsars": {k: dict(v)
@@ -241,10 +262,12 @@ class FitQualityLedger:
             self._pulsars.clear()
             self.fits = self.fallbacks = self.diverged = 0
             self.drift_alarms = 0
+            self.pairs_probed = self.pairs_incoherent = 0
             self.probe_wall_s = 0.0
             self.max_abs_chi2_z = None
             self.max_condition = None
             self.max_relres = None
+            self.max_pair_snr = None
 
     # -- checkpointable state -----------------------------------------
 
@@ -262,11 +285,15 @@ class FitQualityLedger:
                     "counters": {"fits": self.fits,
                                  "fallbacks": self.fallbacks,
                                  "diverged": self.diverged,
-                                 "drift_alarms": self.drift_alarms},
+                                 "drift_alarms": self.drift_alarms,
+                                 "pairs_probed": self.pairs_probed,
+                                 "pairs_incoherent":
+                                     self.pairs_incoherent},
                     "probe_wall_s": self.probe_wall_s,
                     "max_abs_chi2_z": self.max_abs_chi2_z,
                     "max_condition": self.max_condition,
                     "max_relres": self.max_relres,
+                    "max_pair_snr": self.max_pair_snr,
                     "pulsars": {k: dict(v)
                                 for k, v in self._pulsars.items()}}
 
@@ -285,10 +312,17 @@ class FitQualityLedger:
             self.fallbacks = int(counters.get("fallbacks", 0))
             self.diverged = int(counters.get("diverged", 0))
             self.drift_alarms = int(counters.get("drift_alarms", 0))
+            # pair-coherence fields postdate v1 states on disk: .get
+            # defaults keep old journals loadable without a version
+            # bump (additive-only change)
+            self.pairs_probed = int(counters.get("pairs_probed", 0))
+            self.pairs_incoherent = int(
+                counters.get("pairs_incoherent", 0))
             self.probe_wall_s = float(state.get("probe_wall_s", 0.0))
             self.max_abs_chi2_z = state.get("max_abs_chi2_z")
             self.max_condition = state.get("max_condition")
             self.max_relres = state.get("max_relres")
+            self.max_pair_snr = state.get("max_pair_snr")
 
 
 FITQ = FitQualityLedger()
@@ -399,10 +433,14 @@ def _fq(snapshot):
 def fit_quality_slos(chi2_z_limit=6.0, condition_limit=1e12,
                      chi2_budget=0.05, fallback_budget=0.05,
                      divergence_budget=0.02, condition_budget=0.05,
-                     drift_budget=0.05, **window_kw):
-    """The fit_quality SLO five-pack over ledger/engine snapshots:
-    chi2 z-score ceiling, mixed-fallback rate, divergence rate,
-    condition-number ceiling, drift-alarm rate. Budgets keep
+                     drift_budget=0.05, coherence_budget=0.05,
+                     **window_kw):
+    """The fit_quality SLO pack over ledger/engine snapshots: chi2
+    z-score ceiling, mixed-fallback rate, divergence rate,
+    condition-number ceiling, drift-alarm rate, and the GW pair
+    incoherence rate (pairs whose normalized cross-correlation blew
+    past the z-limit in the last optimal-statistic sweep — see
+    :meth:`FitQualityLedger.note_pair_coherence`). Budgets keep
     ``1/budget > fast_burn`` (default 14.4x) so every alert is
     reachable — same constraint as serve_slos."""
 
@@ -425,6 +463,9 @@ def fit_quality_slos(chi2_z_limit=6.0, condition_limit=1e12,
         SLOSpec("fitq_drift", drift_budget,
                 bad=counter("drift_alarms"), total=counter("fits"),
                 **window_kw),
+        SLOSpec("gw_coherence", coherence_budget,
+                bad=counter("pairs_incoherent"),
+                total=counter("pairs_probed"), **window_kw),
     ]
 
 

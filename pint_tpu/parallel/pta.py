@@ -1940,6 +1940,137 @@ class PTABatch:
         mask = np.arange(ph.shape[1])[None, :] < self.n_toas[:, None]
         return ph, mask
 
+    def _gw_eval_packed(self, x):
+        """Packed-plan half of :meth:`gw_arrays`: scatter the
+        per-pulsar fitted vectors into the (rows, slots, k) packed
+        layout, then evaluate residual seconds + sigma per ROW with
+        the same slot-merge machinery the packed fit uses (slot_env /
+        owner-masked jnp.where merges / per-segment weighted phase
+        mean). Dummy slots produce NaN rows that no real pulsar's
+        span ever indexes. Returns device (R, W) arrays."""
+        import jax
+        import jax.numpy as jnp
+
+        pack = self._pack
+        S = int(pack["n_slots"])
+        Q = int(pack["quantum"])
+        slot_keys = frozenset(pack["slot_keys"])
+        key = ("gw_resid_packed",)
+        if key not in self._fns:
+            phase_fn = self._phase_fn()
+            sigma_fn = self._sigma_fn()
+
+            def row_eval(xrow, params, batch, prep):
+                shared = {k: v for k, v in prep.items()
+                          if k not in slot_keys
+                          and not k.startswith("_pack_")}
+                block_slot = prep["_pack_block_slot"]
+                W = batch.tdb_sec.shape[0]
+                owner = jnp.repeat(block_slot, Q,
+                                   total_repeat_length=W)
+                ph = sig = None
+                f0s = []
+                for s in range(S):
+                    ps = jax.tree_util.tree_map(lambda v: v[s],
+                                                params)
+                    full = dict(shared)
+                    for k2 in slot_keys:
+                        full[k2] = prep[k2][s]
+                    p = self._overlay(ps, xrow[s])
+                    ph_s = phase_fn(p, batch, full)
+                    sig_s = sigma_fn(p, batch, full)
+                    if s == 0:
+                        ph, sig = ph_s, sig_s
+                    else:
+                        m = owner == s
+                        ph = jnp.where(m, ph_s, ph)
+                        sig = jnp.where(m, sig_s, sig)
+                    f0s.append(p["F"][0])
+                F0 = jnp.stack(f0s)
+                # per-segment weighted phase mean — same convention
+                # as the packed fit's one_step
+                frac = ph - jnp.floor(ph + 0.5)
+                wts = 1.0 / jnp.square(sig)
+                num = jax.ops.segment_sum(frac * wts, owner,
+                                          num_segments=S)
+                den = jax.ops.segment_sum(wts, owner, num_segments=S)
+                frac = frac - (num / den)[owner]
+                return frac / F0[owner], sig
+
+            self._fns[key] = jax.jit(jax.vmap(row_eval))
+        base = np.array(jax.device_get(self._x0()), np.float64)
+        base[np.asarray(pack["row_of"]),
+             np.asarray(pack["slot_of"])] = np.asarray(
+                 jax.device_get(x), np.float64)
+        return self._fns[key](jnp.asarray(base), self.params,
+                              self.batch, self.prep)
+
+    def gw_arrays(self, x):
+        """Post-fit per-pulsar arrays for the GW detection stage
+        (pint_tpu/gw/): residual seconds evaluated at the FITTED
+        parameter vectors ``x`` (n_psr, n_free), per-TOA sigma (us),
+        TDB MJDs, and the validity mask — all (n_psr, n_toa_max) host
+        numpy in original pulsar order. Unlike :meth:`time_residuals`
+        (initial params, regular layout only) this overlays the fit
+        result into the phase/sigma programs and also walks
+        segment-packed plan batches, gathering each pulsar's
+        contiguous span back out of its packed row. The jitted
+        programs are cached in ``self._fns`` like the fit programs."""
+        import jax
+        import jax.numpy as jnp
+
+        x = jnp.asarray(x)
+        n_toas = np.asarray(self.n_toas).reshape(-1)
+        if getattr(self, "_pack", None):
+            r, sig = self._gw_eval_packed(x)
+            pack = self._pack
+            Q = int(pack["quantum"])
+            r, sig, bs, day, sec = self._pull(
+                (r, sig, self.prep["_pack_block_slot"],
+                 self.batch.tdb_day, self.batch.tdb_sec))
+            r = np.asarray(r, np.float64)
+            sig = np.asarray(sig, np.float64)
+            bs = np.asarray(bs)
+            mjd = (np.asarray(day, np.float64)
+                   + np.asarray(sec, np.float64) / 86400.0)
+            P = len(n_toas)
+            n_max = int(n_toas.max())
+            out_r = np.zeros((P, n_max))
+            out_s = np.ones((P, n_max))
+            out_t = np.zeros((P, n_max))
+            mask = np.arange(n_max)[None, :] < n_toas[:, None]
+            row_of = np.asarray(pack["row_of"])
+            slot_of = np.asarray(pack["slot_of"])
+            for i in range(P):
+                r0, s0 = int(row_of[i]), int(slot_of[i])
+                # segments are contiguous Q-quantum spans in the row
+                start = int(np.flatnonzero(bs[r0] == s0)[0]) * Q
+                n = int(n_toas[i])
+                sl = slice(start, start + n)
+                out_r[i, :n] = r[r0, sl]
+                out_s[i, :n] = sig[r0, sl]
+                out_t[i, :n] = mjd[r0, sl]
+            return {"resid": out_r, "sigma_us": out_s, "mjd": out_t,
+                    "mask": mask}
+        key = ("gw_resid",)
+        if key not in self._fns:
+            resid_fn = self._resid_fn()
+
+            def one(xv, params, batch, prep):
+                return resid_fn(self._overlay(params, xv), batch,
+                                prep)
+
+            self._fns[key] = jax.jit(jax.vmap(one))
+        r, sig = self._fns[key](x, self.params, self.batch, self.prep)
+        r, sig, day, sec = self._pull(
+            (r, sig, self.batch.tdb_day, self.batch.tdb_sec))
+        mjd = (np.asarray(day, np.float64)
+               + np.asarray(sec, np.float64) / 86400.0)
+        mask = np.arange(r.shape[1])[None, :] < n_toas[:, None]
+        return {"resid": np.asarray(r, np.float64),
+                "sigma_us": np.asarray(sig, np.float64),
+                "mjd": mjd, "mask": mask}
+
     def shape_signature(self):
         """Hashable fingerprint of every traced array's (shape, dtype)
         across (params, prep, batch). Two PTABatches with equal
@@ -2695,4 +2826,44 @@ class PTAFleet:
             fmap = self.batches[key].free_map()
             for i in idxs:
                 out[i] = fmap
+        return out
+
+    def gw_stage(self, xs=None, method="auto", maxiter=3,
+                 lattice_days=30.0, orf="hd", n_scrambles=0,
+                 scramble_mode="sky", seed=0, precision="f64",
+                 block=256, positions=None, interpret=False, **kw):
+        """End-to-end GW detection stage over this fleet (the
+        pint_tpu/gw/ pipeline): fit every bucket (skipped when the
+        fitted per-pulsar vectors ``xs`` are supplied), assemble
+        post-fit residual/weight arrays and sky positions, regrid
+        onto a common ``lattice_days`` epoch lattice, and run the
+        Hellings–Downs optimal statistic over all pulsar pairs.
+        ``n_scrambles > 0`` additionally calibrates significance with
+        that many seeded ``scramble_mode`` null draws ("sky" or
+        "phase"). ``positions`` (n, 3) overrides model astrometry —
+        required for store-rebuilt fleets whose template models carry
+        no real coordinates. Returns the optimal-statistic dict
+        (amp2 / snr / pair sweep stats) plus lattice shape and, when
+        scrambling, the ``null`` block with its p-value."""
+        from .. import gw
+
+        with obs_trace.span("gw.stage", n_psr=self.n, orf=orf,
+                            n_scrambles=n_scrambles):
+            if xs is None:
+                xs, _, _ = self.fit(method=method, maxiter=maxiter,
+                                    **kw)
+            inputs = gw.assemble(self, xs, positions=positions)
+            lat = gw.regrid(inputs, lattice_days=lattice_days)
+            out = gw.optimal_statistic(lat, orf=orf,
+                                       precision=precision,
+                                       block=block,
+                                       interpret=interpret)
+            out["n_pulsars"] = lat.n_pulsars
+            out["n_cells"] = lat.n_cells
+            if n_scrambles:
+                out["null"] = gw.scramble_null(
+                    lat, n_draws=n_scrambles, seed=seed,
+                    mode=scramble_mode, orf=orf, precision=precision,
+                    block=block, interpret=interpret,
+                    snr_obs=out["snr"])
         return out

@@ -15,6 +15,13 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running integration tests excluded from the "
+        "tier-1 gate (-m 'not slow')")
+
 # The axon sitecustomize registers the TPU plugin at interpreter start and
 # overrides JAX_PLATFORMS, so the env var alone is not enough: force CPU via
 # config. Tests must run on CPU — the axon TPU's emulated f64 is ~47-bit and

@@ -186,7 +186,7 @@ def test_fit_quality_slo_five_pack_reads_both_snapshot_shapes():
     specs = {s.name: s for s in fit_quality_slos()}
     assert set(specs) == {"fitq_chi2_z", "fitq_fallback",
                           "fitq_divergence", "fitq_condition",
-                          "fitq_drift"}
+                          "fitq_drift", "gw_coherence"}
     bare = _healthy_snapshot()
     engine = {"requests": 10, "fit_quality": bare}  # serve snapshot
     for snap in (bare, engine):
@@ -195,6 +195,10 @@ def test_fit_quality_slo_five_pack_reads_both_snapshot_shapes():
         assert specs["fitq_fallback"].bad(snap) == 1
         assert specs["fitq_fallback"].total(snap) == 100
         assert specs["fitq_drift"].bad(snap) == 0
+        # pair-coherence counters are absent from pre-gw snapshots:
+        # the SLO must read them as 0/0, not KeyError
+        assert specs["gw_coherence"].bad(snap) == 0
+        assert specs["gw_coherence"].total(snap) == 0
     # every budget must stay alertable by the fast burn window
     for s in fit_quality_slos():
         assert 1.0 / s.budget > 14.0
