@@ -1066,11 +1066,15 @@ def main():
                    f"{serve_report['recompiles_after_warmup']} recompiles "
                    "after warmup")
 
-    # open-loop saturation sweep: seeded Poisson arrivals through a
-    # monotone ladder of offered rates, reporting the p99-vs-throughput
-    # knee (where queueing starts dominating latency). Same posture as
-    # the serve stage: optional, daemon thread + join timeout, skip
-    # with PINT_TPU_BENCH_SKIP_SATURATION=1.
+    # open-loop saturation sweep: seeded Poisson arrivals from
+    # concurrent producer threads through a monotone ladder of offered
+    # rates against the ASYNC front door (serve.frontdoor), reporting
+    # the p99-vs-throughput knee and the shed onset — with intake
+    # decoupled from flush the bounded queue genuinely fills under
+    # overload, so both keys are real measurements (max_queue=16 keeps
+    # the backlog-exceeds-bound point inside one rung at this request
+    # count). Same posture as the serve stage: optional, daemon thread
+    # + join timeout, skip with PINT_TPU_BENCH_SKIP_SATURATION=1.
     saturation_report = None
 
     def _saturation_stage():
@@ -1078,7 +1082,8 @@ def main():
         try:
             from pint_tpu.scripts.pint_serve_bench import run_arrival_sweep
 
-            rep = run_arrival_sweep(n_per_rate=48)
+            rep = run_arrival_sweep(n_per_rate=64, max_queue=16,
+                                    producers=4)
             saturation_report = rep  # set LAST: completion marker
         except Exception as e:
             _stage(f"saturation stage failed ({type(e).__name__}: {e}); "
@@ -1090,7 +1095,8 @@ def main():
                "(PINT_TPU_BENCH_SKIP_SATURATION=1)")
     else:
         _stage("saturation: open-loop Poisson arrival sweep "
-               "(8 offered rates x 48 requests)")
+               "(8 offered rates x 64 requests, 4 producer threads, "
+               "async engine)")
         tsat = threading.Thread(target=_saturation_stage, daemon=True)
         tsat.start()
         tsat.join(timeout=600)

@@ -46,6 +46,11 @@ LOCKED_CLASSES = {
     "MicroBatcher": {"lock": "_lock", "attrs": None},
     "HealthMonitor": {"lock": "_lock", "attrs": None},
     "CircuitBreaker": {"lock": "_lock", "attrs": None},
+    # the async front door: submitter threads, the flusher worker,
+    # and the watchdog all touch these
+    "ServeTelemetry": {"lock": "_lock", "attrs": None},
+    "IntakeQueue": {"lock": "_lock", "attrs": None},
+    "AdmissionController": {"lock": "_lock", "attrs": None},
     # only the pipeline state shared with the prep worker pool; fit
     # results (diverged, fit_metrics, ...) are caller-thread-only
     "PTAFleet": {"lock": "_lock",
@@ -161,7 +166,8 @@ TIMER_CALLS = frozenset({
 OBS_INSTRUMENTED_MODULES = (
     "/fitter.py", "/parallel/pta.py", "/parallel/fleetmesh.py",
     "/serve/engine.py", "/serve/excache.py", "/serve/batcher.py",
-    "/serve/metrics.py", "/resilience/retry.py", "/bench.py",
+    "/serve/metrics.py", "/serve/frontdoor.py", "/serve/admission.py",
+    "/resilience/retry.py", "/bench.py",
     "/benchmarks/profile_harness.py", "/scripts/pint_serve_bench.py",
     "/gw/residuals.py", "/gw/correlate.py", "/gw/hd.py",
     "/gw/__main__.py",
@@ -242,7 +248,7 @@ QUALITY_RECORD_PATTERN = (
 # lifecycle transition (pint_tpu.obs.reqlife) or a telemetry record in
 # the same function — a status set on a path the ledger never hears
 # about breaks the exactly-one-terminal-state invariant silently.
-SERVE_STATE_MODULES = ("/serve/engine.py",)
+SERVE_STATE_MODULES = ("/serve/engine.py", "/serve/frontdoor.py")
 
 # Identifier pattern marking that the enclosing function records the
 # outcome (a lifecycle transition, a telemetry record/counter, or one

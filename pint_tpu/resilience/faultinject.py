@@ -44,12 +44,25 @@ Injection points (site locations in parentheses):
   the injected stall, ``lane`` pins the slow lane.
 - ``process_kill`` — the serving process dies by SIGKILL at a named
   durability site (:func:`fire_kill` calls placed in
-  ``serve.engine`` / ``serve.journal`` / ``serve.excache`` /
-  ``store.packstore`` — ``store_write`` kills just before the
-  pack-store's atomic publish; payload ``at`` pins one of
-  :data:`KILL_SITES`, omitted means the first site reached). The
-  process does not get to clean up — that is the point; recovery is
-  proven by ``ServeEngine.recover`` afterwards.
+  ``serve.engine`` / ``serve.frontdoor`` / ``serve.journal`` /
+  ``serve.excache`` / ``store.packstore`` — ``store_write`` kills
+  just before the pack-store's atomic publish; ``flusher_take``
+  kills the async engine's flusher worker right after it dequeues a
+  request, the flusher-death leg of the kill matrix; payload ``at``
+  pins one of :data:`KILL_SITES`, omitted means the first site
+  reached). The process does not get to clean up — that is the
+  point; recovery is proven by ``ServeEngine.recover`` afterwards.
+- ``flusher_stall`` — the async engine's flusher worker wedges
+  without dying (``serve.frontdoor`` flusher loop-top, BEFORE any
+  dequeue, so a stalled worker never strands a request in its
+  hands; payload ``hang_s`` sets each injected stall). The watchdog
+  must supersede and restart it; no request may lose its terminal
+  state.
+- ``intake_overflow`` — the async front door's bounded intake
+  refuses an accepted-and-journaled request as if the queue were
+  full (``serve.frontdoor.AsyncServeEngine.submit`` after the WAL
+  intake). The shed must be committed to the journal so replay
+  stays exactly-once.
 - ``journal_torn_write`` — a journal append is torn mid-frame, as a
   power cut would leave it (``serve.journal`` frame writer; payload
   ``frac`` sets the fraction of the frame that lands). The reader
@@ -72,14 +85,18 @@ import numpy as np
 POINTS = ("toa_nan", "toa_inf_error", "compile_fail", "dispatch_slow",
           "solver_diverge", "checkpoint_corrupt", "device_loss",
           "collective_timeout", "straggler_delay", "process_kill",
-          "journal_torn_write", "executable_cache_corrupt")
+          "journal_torn_write", "executable_cache_corrupt",
+          "flusher_stall", "intake_overflow")
 
 # named durability sites where an armed ``process_kill`` can SIGKILL
 # the serving process (see fire_kill). Each is a distinct point in the
 # journal/commit/cache protocol with a distinct recovery obligation;
-# the chaos harness kills at every one of them.
+# the chaos harness kills at every one of them. ``flusher_take`` is
+# the async front door's flusher-death leg: the worker dies with a
+# request dequeued but nothing flushed or committed.
 KILL_SITES = ("intake_append", "pre_commit", "mid_commit",
-              "post_commit", "excache_store", "store_write")
+              "post_commit", "excache_store", "store_write",
+              "flusher_take")
 
 # the device-level failure domain (ISSUE 6): points that model a chip
 # / lane dying, hanging, or straggling rather than a bad request —

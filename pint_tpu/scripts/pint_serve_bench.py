@@ -234,56 +234,75 @@ def arrival_schedule(rate_rps, n, seed=0, rate_index=0):
     return np.cumsum(gaps)
 
 
-def run_arrival_sweep(n_per_rate=48, fracs=(0.25, 0.5, 0.75, 1.0,
+def run_arrival_sweep(n_per_rate=96, fracs=(0.25, 0.5, 0.75, 1.0,
                                             1.25, 1.5, 2.0, 3.0),
-                      max_batch=8, max_latency_s=0.01, max_queue=256,
+                      max_batch=8, max_latency_s=0.01, max_queue=None,
                       bucket_floor=64, cache_capacity=32, sizes=(48,),
                       per_combo=1, maxiter=2, precision="f64",
-                      knee_factor=3.0, seed=0, mesh=None):
-    """Open-loop saturation bench: drive the serve engine with seeded
-    Poisson arrivals through a monotone ladder of offered rates and
-    report the p99-vs-throughput curve with knee detection.
+                      knee_factor=3.0, seed=0, mesh=None, producers=4):
+    """Open-loop saturation bench over the ASYNC front door: drive
+    AsyncServeEngine with seeded Poisson arrivals from ``producers``
+    concurrent submitter threads through a monotone ladder of offered
+    rates and report the p99-vs-throughput curve with knee detection.
 
-    Calibration first runs a closed-loop burst to measure the
+    Calibration first runs a closed-loop burst (a bounded in-flight
+    window of ``max_batch`` outstanding requests) to measure the
     engine's service capacity (``base_rps``); the ladder offers
     ``fracs`` multiples of it. Each rung replays a deterministic
-    :func:`arrival_schedule` and submits on schedule regardless of
-    how far behind the engine has fallen — latency is measured from
-    the SCHEDULED arrival (via the lifecycle ledger's terminal-state
-    timestamp), so queue growth under overload is charged to the
-    rung instead of being hidden by coordinated omission.
+    :func:`arrival_schedule` — bit-reproducible per (seed, rung), the
+    producer threads only PARTITION it (k = pid mod producers), they
+    never re-draw it — and submits on schedule regardless of how far
+    behind the engine has fallen: latency is measured from the
+    SCHEDULED arrival (via the lifecycle ledger's terminal-state
+    timestamp), so queue growth under overload is charged to the rung
+    instead of being hidden by coordinated omission.
 
-    The knee is the last rung still "good" — p99 within
-    ``knee_factor`` x the unloaded (lowest-rate) open-loop p99 and
-    zero queue-full sheds — before the first degraded rung;
-    ``shed_onset_rps`` is the first offered rate that tripped
-    ``max_queue``, None with a reason when the ladder never sheds —
-    which is the EXPECTED outcome on this single-threaded driver,
-    where a slot flushes inline the moment it fills, bounding queue
-    depth at ~slots x max_batch regardless of offered rate (keep
-    ``max_queue`` above that bound: a smaller cap sheds during the
-    closed-loop calibration burst and drives the health controller
-    into draining, poisoning the whole ladder). Returns a JSON-safe
-    report with per-rung rows, the knee keys, and a schedule digest
-    for determinism tests."""
+    Because intake is decoupled from flush (serve.frontdoor), the
+    bounded queue genuinely fills when offered > service rate and the
+    engine SHEDS: ``shed_onset_rps`` is the first offered rate that
+    tripped the intake bound, and the knee is the last rung still
+    "good" (p99 within ``knee_factor`` x the unloaded rung's p99 and
+    zero sheds) before the first degraded rung. max_queue defaults to
+    ``max(4 * max_batch, n_per_rate // 2)`` so overload rungs build a
+    backlog that actually exceeds the bound within one rung. The
+    engine runs a lenient HealthMonitor (draining disabled): overload
+    rungs are SUPPOSED to shed heavily, and draining would poison
+    every later rung with rejections. Returns a JSON-safe report with
+    per-rung rows, the knee keys, and a schedule digest for
+    determinism tests; null knee keys carry machine-readable
+    ``null_reasons`` only for genuine skips (no saturation observed /
+    degraded at the lowest rate)."""
     import hashlib
+    import threading
     import time as _time
 
     from pint_tpu.obs.metricsreg import percentile
     from pint_tpu.obs.reqlife import (TERMINAL_STATES,
                                       LifecycleLedger)
-    from pint_tpu.serve import FitRequest, ServeEngine
+    from pint_tpu.resilience.health import HealthMonitor
+    from pint_tpu.serve import AsyncServeEngine, FitRequest
 
     t_sweep = obs_clock.now()
+    if max_queue is None:
+        max_queue = max(4 * max_batch, n_per_rate // 2)
+    producers = max(1, int(producers))
     models, toas_list = build_serve_fleet(sizes=sizes,
                                           per_combo=per_combo,
                                           seed=seed)
     n_pulsars = len(models)
     ledger = LifecycleLedger()
-    eng = ServeEngine(max_batch=max_batch, max_latency_s=max_latency_s,
-                      max_queue=max_queue, bucket_floor=bucket_floor,
-                      cache_capacity=cache_capacity, mesh=mesh,
-                      reqlife=ledger)
+    # shed_rate thresholds above 1.0 are unreachable: overload rungs
+    # shed by design, and a draining health state would reject every
+    # later rung's traffic at the door
+    health = HealthMonitor(clock=_time.monotonic,
+                           degraded_shed_rate=1.01,
+                           draining_shed_rate=1.01)
+    eng = AsyncServeEngine(max_batch=max_batch,
+                           max_latency_s=max_latency_s,
+                           max_queue=max_queue,
+                           bucket_floor=bucket_floor,
+                           cache_capacity=cache_capacity, mesh=mesh,
+                           health=health, reqlife=ledger)
 
     def req(i):
         return FitRequest(models[i % n_pulsars],
@@ -292,10 +311,22 @@ def run_arrival_sweep(n_per_rate=48, fracs=(0.25, 0.5, 0.75, 1.0,
 
     eng.prewarm([req(i) for i in range(n_pulsars)])
 
-    # closed-loop calibration burst: back-to-back submits measure the
-    # service capacity the open-loop ladder is scaled against
+    # closed-loop calibration: a bounded window of max_batch
+    # outstanding requests measures the service capacity the open-loop
+    # ladder is scaled against, without ever overfilling the intake
+    window = max(1, int(max_batch))
+    cal = []
+    head = 0
     t0 = obs_clock.now()
-    cal = eng.run_stream([req(i) for i in range(n_per_rate)])
+    for i in range(n_per_rate):
+        cal.append(eng.submit(req(i)))
+        while head < len(cal) and cal[head].done:
+            head += 1
+        while len(cal) - head >= window:
+            _time.sleep(2e-4)
+            while head < len(cal) and cal[head].done:
+                head += 1
+    eng.drain()
     cal_wall = max(obs_clock.now() - t0, 1e-9)
     base_rps = n_per_rate / cal_wall
     base_p99 = percentile([r.telemetry.get("total_s") for r in cal
@@ -314,18 +345,34 @@ def run_arrival_sweep(n_per_rate=48, fracs=(0.25, 0.5, 0.75, 1.0,
         sched_hash.update(np.asarray(sched, np.float64).tobytes())
         ledger.reset()
         eng.telemetry.reset()
+        # requests minted up front on the driver thread: ids (and the
+        # schedule itself) stay deterministic, and producer threads do
+        # nothing but pace and submit
+        reqs = [req(k) for k in range(n_per_rate)]
+        handles = [None] * n_per_rate
+
+        def producer(pid, start):
+            # offered load, open loop: every producer paces its
+            # partition of the SHARED schedule against the shared
+            # start time, so the merged arrival process is the same
+            # Poisson draw regardless of the producer count
+            for k in range(pid, n_per_rate, producers):
+                target = start + sched[k]
+                while True:
+                    now = obs_clock.now()
+                    if now >= target:
+                        break
+                    _time.sleep(min(target - now, 2e-4))
+                handles[k] = eng.submit(reqs[k])
+
         start = obs_clock.now()
-        handles = []
-        for k in range(n_per_rate):
-            target = start + sched[k]
-            while True:
-                now = obs_clock.now()
-                if now >= target:
-                    break
-                eng.poll()
-                _time.sleep(min(target - now, 1e-3))
-            handles.append(eng.submit(req(k)))
-            eng.poll()
+        threads = [threading.Thread(target=producer, args=(pid, start),
+                                    name=f"sweep-producer-{pid}")
+                   for pid in range(producers)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
         eng.drain()
         end = obs_clock.now()
         lats, delivered, shed = [], 0, 0
@@ -353,10 +400,11 @@ def run_arrival_sweep(n_per_rate=48, fracs=(0.25, 0.5, 0.75, 1.0,
             "p99_s": percentile(lats, 99),
             "max_s": max(lats) if lats else None,
         })
+    eng.close()
 
     # knee: last good rung before the first degraded one, measured
     # against the unloaded open-loop latency (rung 0 carries the
-    # max-latency batch timer that closed-loop calibration hides)
+    # continuous-batching handoff that closed-loop calibration hides)
     ref_p99 = rows[0]["p99_s"] if rows else None
 
     def good(row):
@@ -379,13 +427,16 @@ def run_arrival_sweep(n_per_rate=48, fracs=(0.25, 0.5, 0.75, 1.0,
         null_reasons["knee_rps"] = "degraded_at_lowest_rate"
         null_reasons["p99_at_knee_s"] = "degraded_at_lowest_rate"
     if shed_onset is None:
-        null_reasons["shed_onset_rps"] = (
-            "queue_bounded_by_inline_flush" if saturated
-            else "no_saturation_observed")
+        # the inline-flush excuse (queue_bounded_by_inline_flush) is
+        # retired with the async front door: a concurrent driver that
+        # never sheds simply never offered enough load
+        null_reasons["shed_onset_rps"] = "no_saturation_observed"
     offered = [row["offered_rps"] for row in rows]
     return {
         "n_per_rate": n_per_rate,
         "fracs": list(fracs),
+        "producers": producers,
+        "engine": "async",
         "base_rps": round(base_rps, 3),
         "base_p99_s": base_p99,
         "ref_p99_s": ref_p99,
@@ -663,7 +714,8 @@ def _run_chaos_child(config):
     file, which the parent treats as the verdict)."""
 
     from pint_tpu.durable import atomic_write_json
-    from pint_tpu.serve import (FitRequest, ServeEngine, result_digest,
+    from pint_tpu.serve import (AsyncServeEngine, FitRequest,
+                                ServeEngine, result_digest,
                                 save_serve_state)
 
     mode = config["mode"]
@@ -679,12 +731,21 @@ def _run_chaos_child(config):
     def engine():
         # max_latency_s high: slots flush when FULL (lanes requests),
         # so every kill strands a genuine committed/pending mixture
-        # instead of single-request flushes
-        return ServeEngine(max_batch=lanes, max_latency_s=600.0,
-                           bucket_floor=ntoa,
-                           durable_dir=config["durable_dir"],
-                           excache_dir=config["excache_dir"],
-                           store_dir=config.get("store_dir"))
+        # instead of single-request flushes. The flusher_take legs
+        # run the ASYNC front door — that kill site only fires on the
+        # flusher worker thread right after a dequeue, which is where
+        # a real serving process dies; the other sites live in the
+        # shared submit/journal/cache path, so they keep the sync
+        # engine (no flusher/watchdog threads competing for the one
+        # CPU the compile-heavy child already saturates).
+        kw = dict(max_batch=lanes, max_latency_s=600.0,
+                  bucket_floor=ntoa,
+                  durable_dir=config["durable_dir"],
+                  excache_dir=config["excache_dir"],
+                  store_dir=config.get("store_dir"))
+        if site == "flusher_take":
+            return AsyncServeEngine(**kw)
+        return ServeEngine(**kw)
 
     def bringup(premade=None):
         """Restart sequence a real serving process follows: construct
@@ -736,6 +797,8 @@ def _run_chaos_child(config):
         # only reached when no kill fired (the fault-free reference)
         snap = eng.snapshot()
         save_serve_state(eng)
+        if isinstance(eng, AsyncServeEngine):
+            eng.close()
         eng.journal.close()
         atomic_write_json(config["out"], {
             "mode": mode,
@@ -794,6 +857,8 @@ def _run_chaos_child(config):
         # scan proves the re-put entry verifies end to end
         store_rep = {"scan": eng.store.scan(),
                      "counters": eng.store.counters()}
+    if isinstance(eng, AsyncServeEngine):
+        eng.close()
     eng.journal.close()
     atomic_write_json(config["out"], {
         "mode": mode,
@@ -1087,8 +1152,14 @@ def main(argv=None) -> int:
                         "Poisson arrivals through a ladder of "
                         "offered rates, p99-vs-throughput knee) "
                         "instead of the plain serve bench")
-    p.add_argument("--n-per-rate", type=int, default=48,
+    p.add_argument("--n-per-rate", type=int, default=96,
                    help="arrival-sweep: requests per ladder rung")
+    p.add_argument("--producers", type=int, default=4,
+                   help="arrival-sweep: concurrent submitter threads "
+                        "partitioning each rung's shared schedule")
+    p.add_argument("--max-queue", type=int, default=None,
+                   help="arrival-sweep: intake bound (default: "
+                        "max(4*max_batch, n_per_rate//2))")
     p.add_argument("--knee-factor", type=float, default=3.0,
                    help="arrival-sweep: p99 degradation factor vs "
                         "the unloaded rung that marks the knee")
@@ -1119,15 +1190,17 @@ def main(argv=None) -> int:
     if args.arrival_sweep:
         report = run_arrival_sweep(
             n_per_rate=args.n_per_rate, max_batch=args.max_batch,
+            max_queue=args.max_queue,
             bucket_floor=args.bucket_floor, maxiter=args.maxiter,
             precision=args.precision, knee_factor=args.knee_factor,
-            seed=args.seed)
+            seed=args.seed, producers=args.producers)
         print(json.dumps(report, default=float))
         ok = (report["monotone_offered"]
               and report["knee_rps"] is not None
-              and report["p99_at_knee_s"] is not None)
+              and report["p99_at_knee_s"] is not None
+              and report["shed_onset_rps"] is not None)
         if not ok:
-            print("FAIL: saturation sweep found no knee "
+            print("FAIL: saturation sweep found no knee/shed onset "
                   f"(null_reasons={report['null_reasons']})",
                   file=sys.stderr)
         return _finish(0 if ok else 1)

@@ -19,9 +19,12 @@ included or required.
     eng.snapshot()                   # p50/p99 + cache/shed counters
 """
 
+from .admission import (PRIORITY_BATCH, PRIORITY_HIGH, PRIORITY_NORMAL,
+                        AdmissionController, AdmissionDecision)
 from .batcher import MicroBatcher, pow2_bucket
 from .engine import ServeEngine
 from .excache import ExecutableCache, PersistentExecutableCache
+from .frontdoor import AsyncServeEngine, IntakeQueue
 from .journal import RequestJournal
 from .metrics import ServeTelemetry, percentile
 from .recovery import (restore_serve_state, result_digest,
@@ -30,7 +33,10 @@ from .request import (FitRequest, PhasePredictRequest, ResidualRequest,
                       ServeResult, TimingRequest)
 
 __all__ = [
-    "ServeEngine", "MicroBatcher", "ExecutableCache", "ServeTelemetry",
+    "ServeEngine", "AsyncServeEngine", "IntakeQueue",
+    "AdmissionController", "AdmissionDecision",
+    "PRIORITY_HIGH", "PRIORITY_NORMAL", "PRIORITY_BATCH",
+    "MicroBatcher", "ExecutableCache", "ServeTelemetry",
     "PersistentExecutableCache", "RequestJournal", "save_serve_state",
     "restore_serve_state", "result_digest",
     "percentile", "pow2_bucket", "TimingRequest", "FitRequest",

@@ -87,6 +87,25 @@ class MicroBatcher:
             entries.append((request, result, now, trace))
             return len(entries) >= self.max_batch
 
+    def admit_bounded(self, key, request, result, now, max_queue,
+                      trace=None):
+        """Depth-checked admit: one atomic decision under the lock
+        that owns ``_slots``, so concurrent submitters cannot both
+        pass a stale depth check and overfill the queue (the
+        check-then-act race of checking ``depth()`` first and
+        admitting second). Returns ``(admitted, full, depth)``:
+        admitted False means the queue was already at ``max_queue``
+        and the caller must shed; ``depth`` is the queued total AFTER
+        this decision (the shed detail's observed depth on refusal,
+        the new depth on admit); ``full`` mirrors :meth:`admit`."""
+        with self._lock:
+            depth = sum(len(v) for v in self._slots.values())
+            if depth >= int(max_queue):
+                return False, False, depth
+            entries = self._slots.setdefault(key, [])
+            entries.append((request, result, now, trace))
+            return True, len(entries) >= self.max_batch, depth + 1
+
     def due(self, now):
         """Slot keys whose OLDEST entry has waited >= max_latency_s
         (the latency timer fires per slot, oldest-first semantics)."""

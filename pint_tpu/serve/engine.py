@@ -398,6 +398,44 @@ class ServeEngine:
                 request.request_id,
                 tenant=getattr(request, "tenant", "anon"),
                 kind=request.kind, t=now)
+        request, fault = self._maybe_corrupt(request, res)
+        if self.health.state == "draining":
+            return self._reject(request, res, "draining", request.kind,
+                                health_state="draining")
+        screened = self._screen(request, res, now, trace,
+                                injected=fault)
+        if screened is None:
+            return res
+        key, routing = screened
+        if self.journal is not None:
+            # buffered WAL append BEFORE the queue admit: once the
+            # entry is visible in a slot, a concurrent submitter's
+            # inline flush may commit it immediately, and a commit
+            # whose intake never reached the log would replay a
+            # delivered request after a crash
+            self.journal.record_intake(request)
+        self._lc(request, "queued", t=now)
+        admitted, full, depth = self.batcher.admit_bounded(
+            key, request, res, now, max_queue=self.max_queue,
+            trace=trace)
+        if not admitted:
+            # the depth check and the shed decision happen atomically
+            # under the batcher's lock (admit_bounded) — two racing
+            # submitters cannot both pass a stale depth check and
+            # overfill the queue
+            self._shed(request, res, "queue_full", kind=routing[0],
+                       t=now, trace=trace, queue_depth=depth,
+                       max_queue=self.max_queue)
+            self._commit(request, res)
+            return res
+        if full:
+            self._flush(key)
+        return res
+
+    def _maybe_corrupt(self, request, res):
+        """Intake fault hooks: ``toa_nan`` / ``toa_inf_error`` corrupt
+        a deep copy of the request (callers never observe it). Returns
+        the (possibly replaced) request and the fired payload."""
         fault = (faultinject.fire("toa_nan",
                                   request_id=request.request_id)
                  or faultinject.fire("toa_inf_error",
@@ -405,9 +443,14 @@ class ServeEngine:
         if fault:
             request = self._corrupted(request, fault)
             res.request = request
-        if self.health.state == "draining":
-            return self._reject(request, res, "draining", request.kind,
-                                health_state="draining")
+        return request, fault
+
+    def _screen(self, request, res, now, trace, injected=None):
+        """Screening shared by the synchronous submit path and the
+        async flusher: routing resolution, non-finite input rejection,
+        oversize spill, breaker gate. Returns ``(slot_key, routing)``
+        for requests that should join a batch slot, or None when
+        ``res`` was completed here (error / rejected / spilled)."""
         try:
             routing = policy.resolve(request)
         except ValueError as e:
@@ -421,54 +464,36 @@ class ServeEngine:
                                                  "anon"), trace=trace)
             self.health.note_request("error")
             self._lc(request, "error", reason=res.reason)
-            return res
+            self._commit(request, res)  # no-op unless intake journaled
+            return None
         nv, ne = self._nonfinite_counts(request)
         if nv or ne:
             detail = {"nonfinite_values": nv, "nonfinite_errors": ne}
-            if fault:
-                detail["injected_point"] = fault["point"]
-            return self._reject(request, res, "nonfinite_input",
-                                routing[0], **detail)
+            if injected:
+                detail["injected_point"] = injected["point"]
+            self._reject(request, res, "nonfinite_input", routing[0],
+                         **detail)
+            return None
         if policy.is_oversize(len(request.toas), self.oversize_toas):
             self.telemetry.incr("spilled_oversize")
             if self.journal is not None:
                 # spills execute immediately: their intake must be
-                # durable before the work runs
-                self.journal.record_intake(request)
+                # durable before the work runs (the async flusher has
+                # already journaled it — don't append a duplicate)
+                if not self.journal.has_intake(request.request_id):
+                    self.journal.record_intake(request)
                 self.journal.sync()
             self._execute_solo(request, res, routing, now, trace=trace)
             if self.journal is not None:
                 self.journal.sync()
-            return res
+            return None
         key = self.batcher.slot_key(request, routing)
         if not self.breaker.allow(key):
-            return self._reject(
+            self._reject(
                 request, res, "circuit_open", routing[0],
                 retry_after_s=round(self.breaker.retry_after_s(key), 3))
-        if self.batcher.depth() >= self.max_queue:
-            res.status = "shed"
-            res.reason = "queue_full"
-            res.telemetry = policy.rejection(
-                "queue_full", queue_depth=self.batcher.depth(),
-                max_queue=self.max_queue,
-                request_id=request.request_id)
-            self.telemetry.incr("shed_queue_full")
-            self.telemetry.record(request_id=request.request_id,
-                                  kind=routing[0], status="shed",
-                                  reason="queue_full",
-                                  tenant=getattr(request, "tenant",
-                                                 "anon"), trace=trace)
-            self.health.note_request("shed")
-            self._lc(request, "shed", t=now, reason="queue_full")
-            return res
-        if self.journal is not None:
-            # buffered WAL append; the flush's group sync makes it
-            # durable before any execution touches the request
-            self.journal.record_intake(request)
-        self._lc(request, "queued", t=now)
-        if self.batcher.admit(key, request, res, now, trace=trace):
-            self._flush(key)
-        return res
+            return None
+        return key, routing
 
     @staticmethod
     def _nonfinite_counts(request):
@@ -498,6 +523,28 @@ class ServeEngine:
         req = copy.copy(request)
         req.toas = toas
         return req
+
+    def _shed(self, req, res, reason, kind=None, t=None, trace=None,
+              **detail):
+        """Complete ``res`` as a load shed (queue_full, admission
+        backpressure/quota/throttle, intake overflow): structured
+        rejection payload for the client, telemetry counter
+        ``shed_<reason>``, health note, terminal lifecycle record.
+        Does NOT journal-commit — callers that journaled the intake
+        first must follow with :meth:`_commit`."""
+        res.status = "shed"
+        res.reason = reason
+        res.telemetry = policy.rejection(reason,
+                                         request_id=req.request_id,
+                                         **detail)
+        self.telemetry.incr(f"shed_{reason}")
+        self.telemetry.record(request_id=req.request_id, kind=kind,
+                              status="shed", reason=reason,
+                              tenant=getattr(req, "tenant", "anon"),
+                              trace=trace)
+        self.health.note_request("shed")
+        self._lc(req, "shed", t=t, reason=reason)
+        return res
 
     def _reject(self, req, res, reason, kind=None, **detail):
         """Complete ``res`` as a structured rejection (client keeps a

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 
 # The one nearest-rank implementation lives with the obs histogram
 # primitives now; re-exported here so serve-layer callers (and bench)
@@ -30,16 +31,27 @@ def tenant_cap():
 
 
 class ServeTelemetry:
+    """Thread-safe: submitter threads, the async engine's flusher
+    worker, and metric scrapers all touch the counters/records/
+    histograms concurrently, so every mutation (and every read of the
+    mutable aggregates) holds ``_lock``. Registered in pintlint's
+    LOCKED_CLASSES; tests/lockcheck.py instruments it at runtime."""
+
     PHASES = ("queue_wait_s", "pack_s", "compile_s", "execute_s",
               "total_s")
 
     # Always present in snapshots (0 until first increment): the SLO
     # burn-rate monitor and Prometheus scrapes read these by name, so
     # they must exist from the first scrape, not appear on first shed.
+    # The admission-control sheds (serve.admission) are standing for
+    # the same reason: tenant throttling alerts key on them.
     STANDING_COUNTERS = ("shed_queue_full", "rejected_circuit_open",
-                         "errors")
+                         "errors", "shed_backpressure",
+                         "shed_tenant_quota", "shed_slo_throttle",
+                         "shed_intake_overflow")
 
     def __init__(self):
+        self._lock = threading.RLock()
         self.counters = {}
         self.records = []
         # live per-phase latency histograms; total_s carries exemplar
@@ -48,40 +60,45 @@ class ServeTelemetry:
         self.histograms = {p: Histogram() for p in self.PHASES}
 
     def incr(self, name, n=1):
-        self.counters[name] = self.counters.get(name, 0) + n
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
 
     def record(self, **fields):
         """Append one per-request record (same dict the request's
         ServeResult.telemetry carries); completed requests also feed
         the per-phase histograms, total_s with an exemplar."""
-        self.records.append(fields)
-        if fields.get("status") != "ok":
-            return
-        for phase in self.PHASES:
-            v = fields.get(phase)
-            if v is None:
-                continue
-            if phase == "total_s":
-                self.histograms[phase].record(v, exemplar={
-                    "trace": fields.get("trace"),
-                    "request_id": fields.get("request_id"),
-                    "tenant": fields.get("tenant"),
-                })
-            else:
-                self.histograms[phase].record(v)
+        with self._lock:
+            self.records.append(fields)
+            if fields.get("status") != "ok":
+                return
+            for phase in self.PHASES:
+                v = fields.get(phase)
+                if v is None:
+                    continue
+                if phase == "total_s":
+                    self.histograms[phase].record(v, exemplar={
+                        "trace": fields.get("trace"),
+                        "request_id": fields.get("request_id"),
+                        "tenant": fields.get("tenant"),
+                    })
+                else:
+                    self.histograms[phase].record(v)
 
     def latencies(self, phase="total_s", status="ok"):
-        return [r[phase] for r in self.records
-                if r.get("status") == status
-                and r.get(phase) is not None]
+        with self._lock:
+            return [r[phase] for r in self.records
+                    if r.get("status") == status
+                    and r.get(phase) is not None]
 
     def tenant_rows(self, cap=None):
         """Per-tenant accounting rows behind the hard cardinality cap:
         request/outcome counts and ok-latency p50/p99 per tenant, the
         tail beyond the cap folded into one aggregate ``other`` row
         (largest tenants by request count are kept)."""
+        with self._lock:
+            records = list(self.records)
         by_tenant = {}
-        for r in self.records:
+        for r in records:
             t = r.get("tenant") or "anon"
             row = by_tenant.setdefault(
                 t, {"requests": 0, "ok": 0, "shed": 0, "rejected": 0,
@@ -133,12 +150,14 @@ class ServeTelemetry:
         per-device failure domains); summarized into a ``devices``
         block with alive/lost census alongside the per-lane detail."""
         counters = {name: 0 for name in self.STANDING_COUNTERS}
-        counters.update(self.counters)
+        with self._lock:
+            counters.update(self.counters)
+            records = list(self.records)
         snap = {
-            "requests": len(self.records),
-            "requests_ok": sum(1 for r in self.records
+            "requests": len(records),
+            "requests_ok": sum(1 for r in records
                                if r.get("status") == "ok"),
-            "requests_rejected": sum(1 for r in self.records
+            "requests_rejected": sum(1 for r in records
                                      if r.get("status") == "rejected"),
             "counters": dict(sorted(counters.items())),
         }
@@ -216,6 +235,7 @@ class ServeTelemetry:
         return reg
 
     def reset(self):
-        self.counters = {}
-        self.records = []
-        self.histograms = {p: Histogram() for p in self.PHASES}
+        with self._lock:
+            self.counters = {}
+            self.records = []
+            self.histograms = {p: Histogram() for p in self.PHASES}

@@ -46,6 +46,10 @@ class TimingRequest:
     tenant: accounting principal for per-tenant metrics/SLOs
         (obs.reqlife lifecycle records, snapshot()["tenants"] rows);
         never part of the slot key — tenants share warm executables.
+    priority: admission class (serve.admission): 0 = high (interactive,
+        never backpressure-shed), 1 = normal (default), 2 = batch
+        (first to shed under load). Like tenant, never part of the
+        slot key — priorities share warm executables.
     """
 
     model: object
@@ -53,6 +57,7 @@ class TimingRequest:
     deadline_s: float | None = None
     precision: str = "f64"
     tenant: str = "anon"
+    priority: int = 1
     request_id: str = field(default_factory=_next_id)
 
     kind = "fit"

@@ -1,0 +1,382 @@
+"""Async continuous-batching front door (pint_tpu.serve.frontdoor)
+and SLO-aware admission control (pint_tpu.serve.admission): digest
+parity with the sync engine, concurrent-submit lock discipline
+(tests/lockcheck runtime instrumentation), watchdog recovery from a
+stalled flusher, exactly-once journaling of the intake_overflow
+fault, tenant quota isolation, clean shutdown, and a smoke pass of
+the multi-threaded saturation sweep."""
+
+import threading
+import time
+import types
+import warnings
+
+import numpy as np
+import pytest
+
+warnings.simplefilter("ignore")
+
+from pint_tpu.models import get_model
+from pint_tpu.obs.reqlife import TERMINAL_STATES, LifecycleLedger
+from pint_tpu.resilience.faultinject import FaultPoint, inject
+from pint_tpu.serve import (PRIORITY_BATCH, PRIORITY_HIGH,
+                            PRIORITY_NORMAL, AdmissionController,
+                            AsyncServeEngine, FitRequest, ServeEngine,
+                            result_digest)
+from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+from lockcheck import assert_no_violations, instrument
+
+PAR = """
+PSR ASYN{i}
+RAJ 12:0{i}:00.0
+DECJ 10:00:00.0
+F0 3{i}1.25 1
+F1 -4e-16 1
+PEPOCH 55500
+DM 12.{i} 1
+"""
+
+
+def _pulsar(i=0, n_toa=24, seed=0):
+    m = get_model(PAR.format(i=i))
+    rng = np.random.default_rng(seed + i)
+    mjds = np.sort(rng.uniform(54500, 56500, n_toa))
+    t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0, freq_mhz=1400.0,
+                                obs="gbt", add_noise=True, seed=seed + i,
+                                iterations=0)
+    return m, t
+
+
+@pytest.fixture(scope="module")
+def two_pulsars():
+    return [_pulsar(0, 24), _pulsar(1, 24)]
+
+
+def _reqs(two_pulsars, n, **kw):
+    return [FitRequest(*two_pulsars[i % 2], maxiter=2, **kw)
+            for i in range(n)]
+
+
+# -- digest parity with the sync engine ------------------------------
+
+
+def test_async_results_bitwise_identical_to_sync(two_pulsars):
+    """The continuous-batching front door must deliver byte-identical
+    results to the inline-flush sync engine on the same request
+    stream: lanes are independent under vmap and every flush pads to
+    max_batch, so batch composition cannot leak into the numbers."""
+    sync = ServeEngine(max_batch=4, max_latency_s=1e9, bucket_floor=32)
+    ref = sync.run_stream(_reqs(two_pulsars, 6))
+    assert all(r.status == "ok" for r in ref)
+
+    eng = AsyncServeEngine(max_batch=4, max_latency_s=1e9,
+                           bucket_floor=32)
+    try:
+        handles = [eng.submit(r) for r in _reqs(two_pulsars, 6)]
+        eng.drain()
+        assert all(h.status == "ok" for h in handles)
+        for r, h in zip(ref, handles):
+            assert result_digest(r.value) == result_digest(h.value)
+    finally:
+        eng.close()
+
+
+# -- concurrent-submit stress under lock instrumentation -------------
+
+
+def test_concurrent_stress_lock_discipline(two_pulsars):
+    """N producer threads x mixed tenants hammer submit() while the
+    flusher drains; every shared structure the threads touch is
+    runtime-instrumented — zero cross-thread unlocked writes, every
+    request reaches exactly one terminal lifecycle state."""
+    from pint_tpu.serve.batcher import MicroBatcher
+    from pint_tpu.serve.frontdoor import IntakeQueue
+    from pint_tpu.serve.metrics import ServeTelemetry
+
+    ledger = LifecycleLedger()
+    eng = AsyncServeEngine(max_batch=4, max_latency_s=1e9,
+                           bucket_floor=32, max_queue=64,
+                           reqlife=ledger)
+    eng.prewarm(_reqs(two_pulsars, 2))
+
+    n_producers, per_producer = 4, 8
+    tenants = ("alice", "bob", "carol", "dave")
+    handles = [[None] * per_producer for _ in range(n_producers)]
+
+    def producer(pid):
+        for k in range(per_producer):
+            req = FitRequest(*two_pulsars[(pid + k) % 2], maxiter=2,
+                             tenant=tenants[pid],
+                             priority=(k % 3))
+            handles[pid][k] = eng.submit(req)
+
+    violations = []
+    try:
+        with instrument(ServeTelemetry, violations,
+                        dict_attrs=("counters",),
+                        instances=(eng.telemetry,)), \
+             instrument(MicroBatcher, violations,
+                        dict_attrs=("_slots",),
+                        instances=(eng.batcher,)), \
+             instrument(IntakeQueue, violations,
+                        instances=(eng.intake,)), \
+             instrument(AdmissionController, violations,
+                        dict_attrs=("_buckets", "_burning",
+                                    "_throttled"),
+                        instances=(eng.admission,)):
+            threads = [threading.Thread(target=producer, args=(pid,))
+                       for pid in range(n_producers)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            eng.drain()
+    finally:
+        eng.close()
+
+    assert_no_violations(violations)
+    flat = [h for row in handles for h in row]
+    assert all(h.done for h in flat)
+    assert all(h.status in ("ok", "shed") for h in flat)
+    assert len(ledger.nonterminal_ids()) == 0
+    # exactly one terminal state per request, cross-thread or not
+    for h in flat:
+        rec = ledger.record(h.request.request_id)
+        terms = [s for s in rec["states"]
+                 if s["state"] in TERMINAL_STATES]
+        assert len(terms) == 1
+    # mixed tenants all accounted
+    rows = eng.telemetry.tenant_rows()
+    assert set(tenants) <= set(rows)
+
+
+# -- flusher stall -> watchdog restart -------------------------------
+
+
+def test_flusher_stall_watchdog_restarts(two_pulsars):
+    """An injected flusher_stall wedges the worker without killing
+    it; the watchdog must supersede the stale generation, start a
+    fresh flusher, and no request may lose its terminal state."""
+    ledger = LifecycleLedger()
+    eng = AsyncServeEngine(max_batch=4, max_latency_s=1e9,
+                           bucket_floor=32, reqlife=ledger,
+                           stall_timeout_s=0.1, watchdog_poll_s=0.02)
+    eng.prewarm(_reqs(two_pulsars, 2))
+    try:
+        with inject(FaultPoint("flusher_stall", count=1,
+                               payload={"hang_s": 1.0})):
+            time.sleep(0.01)  # let the stall fire at the loop top
+            handles = [eng.submit(r) for r in _reqs(two_pulsars, 4)]
+            deadline = time.monotonic() + 10.0
+            while (eng.telemetry.counters.get("flusher_restarts", 0)
+                   < 1):
+                assert time.monotonic() < deadline, \
+                    "watchdog never restarted the stalled flusher"
+                time.sleep(0.01)
+            eng.drain()
+        assert all(h.status == "ok" for h in handles)
+        assert len(ledger.nonterminal_ids()) == 0
+        assert eng.telemetry.counters["flusher_restarts"] >= 1
+        snap = eng.snapshot()
+        assert snap["intake"]["generation"] >= 1
+        assert snap["intake"]["flusher_alive"]
+    finally:
+        eng.close()
+
+
+def test_flusher_death_watchdog_restarts(two_pulsars):
+    """A flusher that dies outright (not just stalls) is detected by
+    liveness, not heartbeat, and replaced."""
+    eng = AsyncServeEngine(max_batch=4, max_latency_s=1e9,
+                           bucket_floor=32,
+                           stall_timeout_s=30.0, watchdog_poll_s=0.02)
+    eng.prewarm(_reqs(two_pulsars, 2))
+    try:
+        eng.intake.supersede()  # current flusher exits at loop top
+        eng._flusher.join(timeout=5.0)
+        assert not eng._flusher.is_alive()
+        deadline = time.monotonic() + 10.0
+        while not eng._flusher.is_alive():
+            assert time.monotonic() < deadline, \
+                "watchdog never replaced the dead flusher"
+            time.sleep(0.01)
+        handles = [eng.submit(r) for r in _reqs(two_pulsars, 4)]
+        eng.drain()
+        assert all(h.status == "ok" for h in handles)
+        assert eng.telemetry.counters["flusher_restarts"] >= 1
+    finally:
+        eng.close()
+
+
+# -- intake_overflow fault: shed is journaled exactly-once -----------
+
+
+def test_intake_overflow_shed_is_committed(two_pulsars, tmp_path):
+    """The intake_overflow fault fires AFTER the WAL intake, so the
+    shed must be committed — replay sees a terminal record, not a
+    pending request to re-run."""
+    eng = AsyncServeEngine(max_batch=4, max_latency_s=1e9,
+                           bucket_floor=32,
+                           durable_dir=str(tmp_path / "wal"))
+    try:
+        with inject(FaultPoint("intake_overflow", count=1)):
+            h = eng.submit(FitRequest(*two_pulsars[0], maxiter=2))
+        assert h.status == "shed"
+        assert h.reason == "intake_overflow"
+        assert eng.telemetry.counters["shed_intake_overflow"] == 1
+        eng.journal.sync()
+        jrep = eng.journal.replay()
+        rid = h.request.request_id
+        assert rid in jrep.committed
+        assert jrep.committed[rid].get("status") == "shed"
+        assert all(p["rid"] != rid for p in jrep.pending)
+    finally:
+        eng.close()
+        eng.journal.close()
+
+
+# -- admission controller unit semantics -----------------------------
+
+
+def _fake_req(tenant="anon", priority=PRIORITY_NORMAL):
+    return types.SimpleNamespace(tenant=tenant, priority=priority)
+
+
+def test_admission_quota_bucket():
+    t = [0.0]
+    adm = AdmissionController(quotas={"hot": 2.0}, burst_s=1.0,
+                              clock=lambda: t[0])
+    for _ in range(2):
+        assert adm.decide(_fake_req("hot"), depth=0, capacity=64).admit
+    d = adm.decide(_fake_req("hot"), depth=0, capacity=64)
+    assert not d.admit and d.reason == "tenant_quota"
+    assert d.detail["tenant"] == "hot"
+    # unquota'd tenants ride free; tokens refill with the clock
+    assert adm.decide(_fake_req("cold"), depth=0, capacity=64).admit
+    t[0] += 1.0
+    assert adm.decide(_fake_req("hot"), depth=0, capacity=64).admit
+
+
+def test_admission_backpressure_priority_ladder():
+    adm = AdmissionController(soft_watermark=0.5)
+    depth, cap = 40, 64  # above the soft watermark, below capacity
+    assert adm.decide(_fake_req(priority=PRIORITY_HIGH),
+                      depth=depth, capacity=cap).admit
+    assert adm.decide(_fake_req(priority=PRIORITY_NORMAL),
+                      depth=depth, capacity=cap).admit
+    d = adm.decide(_fake_req(priority=PRIORITY_BATCH),
+                   depth=depth, capacity=cap)
+    assert not d.admit and d.reason == "backpressure"
+
+
+def test_admission_slo_throttle():
+    adm = AdmissionController()
+    throttled = adm.observe_slo(
+        [{"name": "tenant_hot_availability", "alerting": True},
+         {"name": "tenant_good_latency_p99", "alerting": False}])
+    assert throttled == {"hot"}
+    d = adm.decide(_fake_req("hot"), depth=0, capacity=64)
+    assert not d.admit and d.reason == "slo_throttle"
+    # high-priority traffic from the burning tenant still lands
+    assert adm.decide(_fake_req("hot", priority=PRIORITY_HIGH),
+                      depth=0, capacity=64).admit
+    # recovery clears the throttle
+    adm.observe_slo(
+        [{"name": "tenant_hot_availability", "alerting": False}])
+    assert adm.decide(_fake_req("hot"), depth=0, capacity=64).admit
+
+
+# -- tenant isolation ------------------------------------------------
+
+
+def test_hot_tenant_quota_does_not_starve_good_tenant(two_pulsars):
+    """A hot tenant at ~3x its quota gets shed (and attributed in
+    tenant_rows); a well-behaved tenant keeps 100% availability and a
+    sane p99."""
+    adm = AdmissionController(quotas={"hot": 4.0}, burst_s=1.0)
+    ledger = LifecycleLedger()
+    eng = AsyncServeEngine(max_batch=4, max_latency_s=1e9,
+                           bucket_floor=32, max_queue=64,
+                           admission=adm, reqlife=ledger)
+    eng.prewarm(_reqs(two_pulsars, 2))
+    hot_h, good_h = [], []
+
+    def hot():
+        # ~3x the 4 rps quota for ~1s
+        for k in range(12):
+            hot_h.append(eng.submit(
+                FitRequest(*two_pulsars[k % 2], maxiter=2,
+                           tenant="hot")))
+            time.sleep(1.0 / 12.0)
+
+    def good():
+        for k in range(6):
+            good_h.append(eng.submit(
+                FitRequest(*two_pulsars[k % 2], maxiter=2,
+                           tenant="good")))
+            time.sleep(0.18)
+
+    try:
+        th, tg = threading.Thread(target=hot), \
+            threading.Thread(target=good)
+        th.start(); tg.start()
+        th.join(); tg.join()
+        eng.drain()
+    finally:
+        eng.close()
+
+    rows = eng.telemetry.tenant_rows()
+    # the hot tenant's overage was shed and attributed to it
+    assert rows["hot"]["shed"] >= 1
+    assert sum(1 for h in hot_h if h.status == "shed") \
+        == rows["hot"]["shed"]
+    assert all(h.reason == "tenant_quota" for h in hot_h
+               if h.status == "shed")
+    # the good tenant is untouched: full availability, no sheds
+    assert rows["good"]["shed"] == 0
+    assert rows["good"]["rejected"] == 0
+    assert rows["good"]["ok"] == rows["good"]["requests"] == 6
+    assert all(h.status == "ok" for h in good_h)
+    assert rows["good"]["p99_s"] is not None
+    assert rows["good"]["p99_s"] < 5.0
+    assert len(ledger.nonterminal_ids()) == 0
+
+
+# -- shutdown / draining ---------------------------------------------
+
+
+def test_close_drains_then_rejects(two_pulsars):
+    eng = AsyncServeEngine(max_batch=4, max_latency_s=1e9,
+                           bucket_floor=32)
+    handles = [eng.submit(r) for r in _reqs(two_pulsars, 4)]
+    eng.close()
+    assert all(h.status == "ok" for h in handles)
+    assert not eng.intake.is_running()
+    assert eng._flusher is None or not eng._flusher.is_alive()
+    late = eng.submit(FitRequest(*two_pulsars[0], maxiter=2))
+    assert late.status == "rejected"
+    assert late.reason == "draining"
+
+
+# -- saturation sweep smoke ------------------------------------------
+
+
+@pytest.mark.slow
+def test_arrival_sweep_async_smoke():
+    from pint_tpu.scripts.pint_serve_bench import run_arrival_sweep
+
+    rep = run_arrival_sweep(n_per_rate=8, fracs=(0.5, 1.0),
+                            max_batch=4, sizes=(48,), maxiter=2,
+                            producers=2, seed=0)
+    assert rep["engine"] == "async"
+    assert rep["producers"] == 2
+    assert rep["monotone_offered"]
+    assert rep["reqlife_nonterminal"] == 0
+    assert len(rep["rows"]) == 2
+    for row in rep["rows"]:
+        assert row["delivered"] + row["shed"] + row["errors"] == 8
+        assert row["errors"] == 0
+    assert "queue_bounded_by_inline_flush" \
+        not in rep["null_reasons"].values()
+    assert len(rep["schedule_sha256"]) == 64
