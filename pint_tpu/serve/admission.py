@@ -10,6 +10,10 @@ touches the queue, in one ladder:
    over its sustained rate is shed with reason ``tenant_quota`` no
    matter how empty the queue is: quota isolation is what keeps one
    hot tenant from converting shared headroom into everyone's p99.
+   The bucket refills at decision time but is debited only when the
+   request is actually admitted — a request shed by a later rung
+   never consumes quota, so throttling/backpressure can't push a
+   tenant into ``tenant_quota`` sheds on top.
 2. **SLO throttle** — :meth:`observe_slo` ingests the per-SLO state
    list the engine's :class:`obs.slo.BurnRateMonitor` produces
    (``ServeEngine.slo_check``). A tenant whose own availability or
@@ -104,18 +108,23 @@ class AdmissionController:
             t = self.clock() if now is None else float(now)
             self.decisions += 1
             rate = self._quota_rps(tenant)
+            tokens = None
             if rate is not None:
                 cap = max(1.0, rate * self.burst_s)
                 tokens, last = self._buckets.get(tenant, (cap, t))
                 tokens = min(cap, tokens + max(0.0, t - last) * rate)
+                # persist the refill now, but debit only on admission
+                # (below): a request shed by a later rung must not
+                # consume quota, or a throttled/backpressured tenant
+                # is double-penalized into tenant_quota sheds by
+                # traffic that never entered the queue
+                self._buckets[tenant] = (tokens, t)
                 if tokens < 1.0:
-                    self._buckets[tenant] = (tokens, t)
                     self.shed += 1
                     return AdmissionDecision(
                         False, "tenant_quota",
                         {"tenant": tenant, "quota_rps": rate,
                          "priority": priority})
-                self._buckets[tenant] = (tokens - 1.0, t)
             since = self._throttled.get(tenant)
             if since is not None and priority >= self.throttle_priority:
                 self.shed += 1
@@ -134,6 +143,8 @@ class AdmissionController:
                     {"tenant": tenant, "priority": priority,
                      "queue_depth": int(depth),
                      "soft_limit": int(self.soft_watermark * capacity)})
+            if tokens is not None:
+                self._buckets[tenant] = (tokens - 1.0, t)
             return AdmissionDecision(True)
 
     # -- SLO feedback ------------------------------------------------

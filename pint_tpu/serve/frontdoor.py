@@ -36,7 +36,11 @@ it is visible it may complete and commit — a commit whose intake
 never reached the log would replay a delivered request after a
 crash. Sheds after that point journal a commit too (exactly-once
 replay); admission sheds happen before journaling and complete
-synchronously, like the sync engine's submit-time rejections.
+synchronously, like the sync engine's submit-time rejections — but
+they still write a commit record when the request's intake is already
+on the log (recover() pre-marks replayed intakes via
+``journal.note_intake``), so a replay shed at admission can never
+replay again.
 
 Shutdown: :meth:`AsyncServeEngine.close` stops the intake, the
 flusher drains what is left (journal-synced), and the watchdog exits.
@@ -83,15 +87,20 @@ class IntakeQueue:
             return len(self._items)
 
     def offer(self, item):
-        """Non-blocking enqueue: False when the queue is full or the
-        intake is stopped — the caller sheds, that is the
-        backpressure signal."""
+        """Non-blocking enqueue. Returns None on success, else the
+        refusal cause decided inside the critical section:
+        ``"stopped"`` when the intake no longer accepts work
+        (shutdown) or ``"full"`` at capacity — so a stop() landing
+        between the caller's is_running() screen and the offer is
+        reported as shutdown, never misread as saturation."""
         with self._lock:
-            if not self.running or len(self._items) >= self.capacity:
-                return False
+            if not self.running:
+                return "stopped"
+            if len(self._items) >= self.capacity:
+                return "full"
             self._items.append(item)
             self._cv.notify()
-            return True
+            return None
 
     def take(self, timeout):
         """Dequeue one item (None on timeout/empty). The in-flight
@@ -220,10 +229,16 @@ class AsyncServeEngine(ServeEngine):
             capacity=self.intake.capacity, now=now)
         if not decision.admit:
             # admission sheds complete before the WAL sees the
-            # request — nothing to replay, nothing to commit
-            return self._shed(request, res, decision.reason,
-                              kind=request.kind, t=now, trace=trace,
-                              **decision.detail)
+            # request, so a FRESH submit has nothing to commit — but
+            # recover() pre-marks replayed intakes (note_intake)
+            # before re-submitting through this path, and a replay
+            # shed here without a commit record would replay again.
+            # _commit is a no-op unless the intake is journaled.
+            self._shed(request, res, decision.reason,
+                       kind=request.kind, t=now, trace=trace,
+                       **decision.detail)
+            self._commit(request, res)
+            return res
         forced = faultinject.fire("intake_overflow",
                                   request_id=request.request_id)
         if self.journal is not None:
@@ -232,19 +247,29 @@ class AsyncServeEngine(ServeEngine):
             # without its intake on disk replays a delivered request
             self.journal.record_intake(request)
         self._lc(request, "queued", t=now)
-        if forced is not None \
-                or not self.intake.offer((request, res, now, trace,
-                                          fault)):
-            detail = {"queue_depth": self.intake.depth(),
-                      "capacity": self.intake.capacity}
-            reason = "queue_full"
-            if forced is not None:
-                reason = "intake_overflow"
-                detail["injected_point"] = forced["point"]
-            self._shed(request, res, reason, kind=request.kind, t=now,
-                       trace=trace, **detail)
-            self._commit(request, res)  # journaled shed: exactly-once
-            return res
+        refused = None
+        if forced is None:
+            refused = self.intake.offer((request, res, now, trace,
+                                         fault))
+            if refused is None:
+                return res
+            if refused == "stopped":
+                # stop() landed between the is_running() screen above
+                # and the offer: report the shutdown, not saturation —
+                # the synchronous draining rejection the docstring
+                # promises (committed: the intake is journaled)
+                return self._reject(request, res, "draining",
+                                    request.kind,
+                                    health_state=self.health.state)
+        detail = {"queue_depth": self.intake.depth(),
+                  "capacity": self.intake.capacity}
+        reason = "queue_full"
+        if forced is not None:
+            reason = "intake_overflow"
+            detail["injected_point"] = forced["point"]
+        self._shed(request, res, reason, kind=request.kind, t=now,
+                   trace=trace, **detail)
+        self._commit(request, res)  # journaled shed: exactly-once
         return res
 
     def poll(self, now=None):
@@ -309,6 +334,13 @@ class AsyncServeEngine(ServeEngine):
                 try:
                     with self._work_mutex:
                         self._handle(item)
+                except Exception as exc:
+                    # a _handle escape must not strand the dequeued
+                    # request: without a terminal state its handle
+                    # polls forever and its journaled intake replays.
+                    # Complete it as an error and keep the flusher
+                    # alive — one bad request is not a worker fault.
+                    self._handle_crashed(item, exc)
                 finally:
                     intake.done_one()
                 continue
@@ -334,6 +366,34 @@ class AsyncServeEngine(ServeEngine):
         key, _ = screened
         if self.batcher.admit(key, request, res, t_sub, trace=trace):
             self._flush(key)
+
+    def _handle_crashed(self, item, exc):
+        """Terminal backstop for an unexpected exception escaping
+        :meth:`_handle` on the flusher thread: the dequeued request
+        gets its error status, telemetry record, terminal lifecycle
+        state, and journal commit, so no flusher bug can leave a
+        request pending with drain() reporting quiescence."""
+        request, res, _, trace, _ = item
+        self.telemetry.incr("flusher_handle_errors")
+        _flight.note("flusher_handle_error",
+                     request_id=request.request_id, error=repr(exc))
+        if res.done:
+            # _handle completed the request before the exception
+            # (e.g. a failure inside _flush after _fail ran): the
+            # terminal state is already exactly-one, leave it be
+            return
+        reason = f"{type(exc).__name__}: {exc}"
+        res.status = "error"
+        res.reason = reason
+        self.telemetry.incr("errors")
+        self.telemetry.record(request_id=request.request_id,
+                              kind=request.kind, status="error",
+                              reason=reason,
+                              tenant=getattr(request, "tenant",
+                                             "anon"), trace=trace)
+        self.health.note_request("error")
+        self._lc(request, "error", reason=reason)
+        self._commit(request, res)
 
     def _idle_tick(self):
         """Continuous batching: the intake went quiet, so flush every

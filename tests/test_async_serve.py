@@ -236,6 +236,89 @@ def test_intake_overflow_shed_is_committed(two_pulsars, tmp_path):
         eng.journal.close()
 
 
+# -- recovery: admission sheds of replays are committed --------------
+
+
+def test_replayed_admission_shed_is_committed(two_pulsars, tmp_path):
+    """recover() pre-marks replayed intakes (journal.note_intake)
+    before re-submitting them through submit(); a replay shed at
+    admission must still write a commit record, or the next recover()
+    replays — and may execute — it again."""
+    from pint_tpu.serve.journal import RequestJournal
+
+    wal = str(tmp_path / "wal")
+    req = FitRequest(*two_pulsars[0], maxiter=2, tenant="hot")
+    # a crashed process's journal: intake on disk, no commit
+    j = RequestJournal(wal)
+    j.record_intake(req)
+    j.sync()
+    j.close()
+
+    adm = AdmissionController()
+    adm.observe_slo(
+        [{"name": "tenant_hot_availability", "alerting": True}])
+    eng = AsyncServeEngine(max_batch=4, max_latency_s=1e9,
+                           bucket_floor=32, admission=adm,
+                           durable_dir=wal)
+    try:
+        rep = eng.recover()
+        assert rep["n_replayed"] == 1
+        h = rep["replayed"][req.request_id]
+        assert h.status == "shed"
+        assert h.reason == "slo_throttle"
+        jrep = eng.journal.replay()
+        assert req.request_id in jrep.committed
+        assert jrep.committed[req.request_id].get("status") == "shed"
+        assert all(p["rid"] != req.request_id for p in jrep.pending)
+        # idempotent: a second recover finds the commit and replays
+        # nothing — the shed request can never execute
+        rep2 = eng.recover()
+        assert rep2["n_replayed"] == 0
+    finally:
+        eng.close()
+        eng.journal.close()
+
+
+# -- flusher _handle crash: no stranded pending request --------------
+
+
+def test_flusher_handle_crash_completes_request(two_pulsars, tmp_path):
+    """An unexpected exception escaping _handle on the flusher thread
+    must complete the dequeued request as an error (terminal
+    lifecycle state + journal commit) instead of stranding it pending
+    forever — and must not kill the flusher."""
+    ledger = LifecycleLedger()
+    eng = AsyncServeEngine(max_batch=4, max_latency_s=1e9,
+                           bucket_floor=32, reqlife=ledger,
+                           durable_dir=str(tmp_path / "wal"))
+    try:
+        eng._screen = lambda *a, **kw: (_ for _ in ()).throw(
+            RuntimeError("boom"))
+        h = eng.submit(FitRequest(*two_pulsars[0], maxiter=2))
+        deadline = time.monotonic() + 10.0
+        while not h.done:
+            assert time.monotonic() < deadline, \
+                "crashed _handle stranded the request as pending"
+            time.sleep(0.01)
+        assert h.status == "error"
+        assert "boom" in h.reason
+        assert eng.telemetry.counters["flusher_handle_errors"] == 1
+        assert len(ledger.nonterminal_ids()) == 0
+        eng.journal.sync()
+        jrep = eng.journal.replay()
+        rid = h.request.request_id
+        assert rid in jrep.committed
+        assert jrep.committed[rid].get("status") == "error"
+        # the flusher survived the escape and still serves
+        del eng._screen
+        h2 = eng.submit(FitRequest(*two_pulsars[1], maxiter=2))
+        eng.drain()
+        assert h2.status == "ok"
+    finally:
+        eng.close()
+        eng.journal.close()
+
+
 # -- admission controller unit semantics -----------------------------
 
 
@@ -285,6 +368,80 @@ def test_admission_slo_throttle():
     adm.observe_slo(
         [{"name": "tenant_hot_availability", "alerting": False}])
     assert adm.decide(_fake_req("hot"), depth=0, capacity=64).admit
+
+
+def test_shed_rungs_do_not_debit_quota():
+    """A request shed by a later rung (slo_throttle / backpressure)
+    must not consume a quota token — a throttled or backpressured
+    tenant is not additionally pushed into tenant_quota sheds by
+    traffic that never entered the queue."""
+    t = [0.0]
+    adm = AdmissionController(quotas={"hot": 2.0}, burst_s=1.0,
+                              clock=lambda: t[0])
+    adm.observe_slo(
+        [{"name": "tenant_hot_availability", "alerting": True}])
+    for _ in range(5):
+        d = adm.decide(_fake_req("hot"), depth=0, capacity=64)
+        assert not d.admit and d.reason == "slo_throttle"
+    adm.observe_slo(
+        [{"name": "tenant_hot_availability", "alerting": False}])
+    # the throttled sheds consumed nothing: the full burst is intact
+    for _ in range(2):
+        assert adm.decide(_fake_req("hot"), depth=0, capacity=64).admit
+    d = adm.decide(_fake_req("hot"), depth=0, capacity=64)
+    assert not d.admit and d.reason == "tenant_quota"
+    # backpressure sheds don't debit either
+    t[0] += 1.0  # refill the burst
+    for _ in range(3):
+        d = adm.decide(_fake_req("hot", priority=PRIORITY_BATCH),
+                       depth=60, capacity=64)
+        assert not d.admit and d.reason == "backpressure"
+    for _ in range(2):
+        assert adm.decide(_fake_req("hot"), depth=0, capacity=64).admit
+
+
+# -- intake stop: shutdown race is draining, not queue_full ----------
+
+
+def test_offer_reports_stopped_vs_full():
+    from pint_tpu.serve.frontdoor import IntakeQueue
+
+    q = IntakeQueue(1)
+    assert q.offer("a") is None
+    assert q.offer("b") == "full"
+    q.stop()
+    assert q.offer("c") == "stopped"
+
+
+def test_stop_between_screen_and_offer_rejects_draining(two_pulsars,
+                                                        tmp_path):
+    """intake.stop() landing between submit's is_running() screen and
+    the offer must surface as the synchronous draining rejection (and
+    a journal commit), not masquerade as queue saturation in the
+    shed_queue_full counter."""
+    eng = AsyncServeEngine(max_batch=4, max_latency_s=1e9,
+                           bucket_floor=32,
+                           durable_dir=str(tmp_path / "wal"))
+    try:
+        eng.intake.stop()
+        # shadow is_running so submit's screen sees the pre-stop
+        # world — the exact race the offer must disambiguate
+        eng.intake.is_running = lambda: True
+        try:
+            h = eng.submit(FitRequest(*two_pulsars[0], maxiter=2))
+        finally:
+            del eng.intake.is_running
+        assert h.status == "rejected"
+        assert h.reason == "draining"
+        assert eng.telemetry.counters.get("shed_queue_full", 0) == 0
+        eng.journal.sync()
+        jrep = eng.journal.replay()
+        rid = h.request.request_id
+        assert rid in jrep.committed
+        assert jrep.committed[rid].get("status") == "rejected"
+    finally:
+        eng.close(drain=False)
+        eng.journal.close()
 
 
 # -- tenant isolation ------------------------------------------------
