@@ -1402,16 +1402,25 @@ def main():
         nonlocal lint_report
         try:
             from pint_tpu.analysis import (LintConfig, counts_by_rule,
-                                           run as lint_run, unsuppressed)
+                                           run_project, unsuppressed)
 
             pkg = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "pint_tpu")
-            findings = lint_run([pkg], config=LintConfig.default())
+            t0 = obs_clock.now()
+            findings, project = run_project([pkg],
+                                            config=LintConfig.default())
+            wall = obs_clock.now() - t0
             n_live = len(unsuppressed(findings))
+            graph = getattr(project, "lock_graph", None)
             lint_report = {
                 "unsuppressed": n_live,
                 "suppressed": len(findings) - n_live,
                 "counts_by_rule": counts_by_rule(findings),
+                "v2_wall_s": round(wall, 3),
+                "lock_edges": (len(graph.edges)
+                               if graph is not None else 0),
+                "flow_findings": sum(1 for f in findings
+                                     if f.rule == "precision-flow"),
             }
         except Exception as e:
             _stage(f"pintlint stage failed ({type(e).__name__}: {e}); "
@@ -1430,7 +1439,10 @@ def main():
         elif lint_report is not None:
             _stage(f"pintlint: {lint_report['unsuppressed']} "
                    f"unsuppressed, {lint_report['suppressed']} "
-                   f"suppressed {lint_report['counts_by_rule']}")
+                   f"suppressed, {lint_report['lock_edges']} lock "
+                   f"edges, whole-program pass "
+                   f"{lint_report['v2_wall_s']}s "
+                   f"{lint_report['counts_by_rule']}")
 
     # ------------------------------------------------------------------
     # regress stage: the perf-observatory gate over the repo's own
@@ -1921,6 +1933,12 @@ def main():
                                 if lint_report else None),
         "pintlint_counts_by_rule": (lint_report["counts_by_rule"]
                                     if lint_report else None),
+        "pintlint_v2_wall_s": (lint_report["v2_wall_s"]
+                               if lint_report else None),
+        "pintlint_lock_edges": (lint_report["lock_edges"]
+                                if lint_report else None),
+        "pintlint_flow_findings": (lint_report["flow_findings"]
+                                   if lint_report else None),
         "regress_ok": (regress_report["regress_ok"]
                        if regress_report else None),
         "regress_rounds": (regress_report["regress_rounds"]

@@ -13,18 +13,19 @@ Rule catalogue with bad/good examples: docs/lint_rules.md.
 
 from .config import LintConfig
 from .core import (Finding, Rule, RULES, all_rules, counts_by_rule,
-                   register, run, unsuppressed)
+                   register, run, run_project, unsuppressed)
 # importing the rule modules populates the registry
 from . import (rules_bench, rules_bucket, rules_budget,  # noqa: F401
-               rules_durable, rules_faults, rules_kernels, rules_locks,
-               rules_obs, rules_precision, rules_quality, rules_retrace,
-               rules_serve)
+               rules_durable, rules_faults, rules_flow, rules_kernels,
+               rules_locks, rules_lockorder, rules_obs,
+               rules_precision, rules_quality, rules_registry,
+               rules_retrace, rules_serve, rules_signature)
 from .report import json_report, text_report
 
 __all__ = [
     "Finding", "LintConfig", "Rule", "RULES", "all_rules",
-    "counts_by_rule", "json_report", "register", "run", "text_report",
-    "unsuppressed",
+    "counts_by_rule", "json_report", "register", "run", "run_project",
+    "text_report", "unsuppressed",
 ]
 
 

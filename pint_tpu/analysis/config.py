@@ -14,7 +14,14 @@ registries below plus the live fault-point tuple from
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
+
+# Repo root (two levels above this package): registered surfaces like
+# /bench.py and /benchmarks/ live outside the pint_tpu scan root, so
+# the registry-drift staleness check also looks here.
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
 
 # -- precision ---------------------------------------------------------
 
@@ -55,6 +62,33 @@ LOCKED_CLASSES = {
     # results (diverged, fit_metrics, ...) are caller-thread-only
     "PTAFleet": {"lock": "_lock",
                  "attrs": {"batches", "_batch_futures", "_prep_pool"}},
+    # the flusher work mutex serializes flush/idle generations against
+    # drain() and close(); it guards execution phases, not attribute
+    # state (attribute discipline on the front door lives in IntakeQueue
+    # / AdmissionController above), so no attrs are monitored — the
+    # entry exists for the lock-ORDER analysis, which needs to know the
+    # mutex's identity to order it against the collaborator locks taken
+    # underneath it.
+    "AsyncServeEngine": {"lock": "_work_mutex", "attrs": set()},
+    # observability: counters/ledgers written from serve worker threads
+    # and read by exporters. Mutators hold self._lock; the exempt attrs
+    # are injected collaborators (clock) handled globally.
+    "Counter": {"lock": "_lock", "attrs": None},
+    "Gauge": {"lock": "_lock", "attrs": None},
+    "Histogram": {"lock": "_lock", "attrs": None},
+    "Registry": {"lock": "_lock", "attrs": None},
+    "ProgramLedger": {"lock": "_lock", "attrs": None},
+    "Tracer": {"lock": "_lock", "attrs": None},
+    "DriftBoard": {"lock": "_lock", "attrs": None},
+    "LifecycleLedger": {"lock": "_lock", "attrs": None},
+    "BurnRateMonitor": {"lock": "_lock", "attrs": None},
+    "FitQualityLedger": {"lock": "_lock", "attrs": None},
+    "FlightRecorder": {"lock": "_lock", "attrs": None},
+    "RequestJournal": {"lock": "_lock", "attrs": None},
+    # durable tiers reached from under their in-memory caches' locks:
+    # ordering matters (ExecutableCache._lock -> Persistent..._lock).
+    "PersistentExecutableCache": {"lock": "_lock", "attrs": None},
+    "PackStore": {"lock": "_lock", "attrs": None},
 }
 
 # Attributes never treated as shared state even under attrs=None:
@@ -67,6 +101,42 @@ LOCKED_CLASS_EXEMPT_ATTRS = frozenset({"_lock", "clock", "_sleep"})
 LOCKED_GLOBALS = {
     "_PRECISION_AUTO_CACHE": "_PRECISION_AUTO_LOCK",
 }
+
+# -- precision flow (whole-program) -----------------------------------
+
+# Function-name patterns whose RESULTS are f32 at the source: Pallas
+# TPU kernels compute in f32/bf16 tiles, so anything a *_pallas kernel
+# returns is f32-tainted until an explicit astype(float64). The
+# precision-flow rule seeds its taint from these (plus astype/float32
+# literals) and tracks the value interprocedurally into F64_CRITICAL
+# sinks.
+F32_SOURCE_PATTERNS = (r"_pallas$",)
+
+# -- signature completeness (whole-program) ---------------------------
+
+# Classes whose jitted program tables are keyed by a shape signature:
+# the registered method must fingerprint every attribute the traced
+# closures read (and every self attr passed as a runtime argument at a
+# self._fns[...] dispatch). "exempt" lists host-only metadata attrs
+# that cannot affect compiled-program shape.
+SIGNATURE_CLASSES = {
+    # preps/_free_map/static/template are structure-determining, not
+    # shape-determining: PTABatch.structure_key fingerprints them
+    # (component set, free-param names, static scalar config), and every
+    # path that shares a _fns table across instances composes
+    # structure_key into its cache key alongside shape_signature
+    # (serve engine slot_key, pta persistent cache_key). Folding them
+    # into shape_signature would double-count and force spurious
+    # retraces on same-structure batches.
+    "PTABatch": {"signature": "shape_signature",
+                 "exempt": {"preps", "_free_map", "static", "template"}},
+    "ShapePlan": {"signature": "signature", "exempt": set()},
+}
+
+# Path suffix of THIS module: the registry-drift staleness half only
+# runs when the registry file itself is in the scan (linting one file
+# must not claim the whole registry is stale).
+REGISTRY_ANCHOR_SUFFIX = "analysis/config.py"
 
 # -- retrace / sync hazards -------------------------------------------
 
@@ -288,6 +358,12 @@ class LintConfig:
     quality_record_pattern: str = QUALITY_RECORD_PATTERN
     serve_state_modules: tuple = ()
     serve_state_record_pattern: str = SERVE_STATE_RECORD_PATTERN
+    # whole-program analyses (empty/falsy -> the rule is inert, so
+    # fixture configs built for per-file rules stay quiet)
+    f32_source_patterns: tuple = ()
+    signature_classes: dict = field(default_factory=dict)
+    registry_anchor_suffix: str = ""
+    registry_tree_roots: tuple = ()
 
     @classmethod
     def default(cls):
@@ -311,4 +387,8 @@ class LintConfig:
                    budget_meta_modules=BUDGET_META_MODULES,
                    budgeted_meta_keys=budgeted,
                    quality_signal_modules=QUALITY_SIGNAL_MODULES,
-                   serve_state_modules=SERVE_STATE_MODULES)
+                   serve_state_modules=SERVE_STATE_MODULES,
+                   f32_source_patterns=F32_SOURCE_PATTERNS,
+                   signature_classes=dict(SIGNATURE_CLASSES),
+                   registry_anchor_suffix=REGISTRY_ANCHOR_SUFFIX,
+                   registry_tree_roots=(_REPO_ROOT,))

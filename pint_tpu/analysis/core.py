@@ -107,18 +107,28 @@ class Project:
         self.config = config
         self.files = []          # FileContext per parsed file
         self.extra_findings = []  # parse failures etc.
+        self.index = None        # ProjectIndex after the project pass
+        self.lock_graph = None   # LockGraph from lock-order-cycle
 
 
 class Rule:
     """Base class: subclasses set ``id``/``family``/``rationale`` and
-    implement ``check_file`` (per file) and/or ``finish`` (after every
-    file was scanned — cross-file invariants)."""
+    implement ``check_file`` (per file), ``finish`` (after every file
+    was scanned — cross-file invariants), and/or ``check_project``
+    (whole-program rules: runs with the cross-file ``ProjectIndex``
+    after all files are parsed). Rules with ``whole_program = True``
+    only run when the scan requests the project pass — the --changed
+    inner loop skips them."""
 
     id = None
     family = None
     rationale = ""
+    whole_program = False
 
     def check_file(self, ctx):
+        pass
+
+    def check_project(self, project, index):
         pass
 
     def finish(self, project):
@@ -154,14 +164,27 @@ def iter_py_files(paths):
                     yield os.path.join(root, name)
 
 
-def run(paths, config=None, rules=None):
+def run(paths, config=None, rules=None, whole_program=True):
     """Lint ``paths`` (files or directory roots). Returns the full
     finding list — suppressed findings included, flagged — so callers
-    can gate on unsuppressed ones while still counting the rest."""
+    can gate on unsuppressed ones while still counting the rest.
+    ``whole_program=False`` skips the project-index pass and every
+    whole-program rule (the fast inner-loop / --changed mode)."""
+    findings, _ = run_project(paths, config=config, rules=rules,
+                              whole_program=whole_program)
+    return findings
+
+
+def run_project(paths, config=None, rules=None, whole_program=True):
+    """Like :func:`run` but also returns the ``Project`` — carrying
+    the built ``ProjectIndex`` (``project.index``) and per-rule
+    artifacts such as the lock-order graph (``project.lock_graph``)."""
     from .config import LintConfig
 
     config = config or LintConfig.default()
     rules = rules if rules is not None else all_rules()
+    if not whole_program:
+        rules = [r for r in rules if not r.whole_program]
     project = Project(config)
     base = os.path.commonpath([os.path.abspath(p) for p in paths]) \
         if paths else os.getcwd()
@@ -181,13 +204,20 @@ def run(paths, config=None, rules=None):
         for rule in rules:
             rule.check_file(ctx)
         project.files.append(ctx)
+    if whole_program and any(r.whole_program for r in rules):
+        from .project import build_index
+
+        project.index = build_index(project)
+        for rule in rules:
+            if rule.whole_program:
+                rule.check_project(project, project.index)
     for rule in rules:
         rule.finish(project)
     findings = list(project.extra_findings)
     for ctx in project.files:
         findings.extend(ctx.findings)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
-    return findings
+    return findings, project
 
 
 def unsuppressed(findings):

@@ -83,6 +83,26 @@ def _split_target(t):
         yield t
 
 
+def _thread_local_attrs(cls):
+    """Attrs assigned ``threading.local()`` anywhere in the class:
+    per-thread state is the canonical LOCK-FREE pattern, so mutations
+    through such an attribute need no lock."""
+    out = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        if not (isinstance(v, ast.Call)
+                and isinstance(v.func, ast.Attribute)
+                and v.func.attr == "local"):
+            continue
+        for t in node.targets:
+            attr = self_attr_root(t)
+            if attr is not None:
+                out.add(attr)
+    return out
+
+
 @register
 class LockDisciplineRule(Rule):
     """The serve engine, the pipelined fleet executor, and concurrent
@@ -111,6 +131,7 @@ class LockDisciplineRule(Rule):
         lock_attr = spec.get("lock", "_lock")
         monitored = spec.get("attrs")
         is_lock = _self_lock_matcher(lock_attr)
+        thread_local = _thread_local_attrs(cls)
         for func in cls.body:
             if not isinstance(func, (ast.FunctionDef,
                                      ast.AsyncFunctionDef)):
@@ -124,6 +145,8 @@ class LockDisciplineRule(Rule):
                 if attr is None or attr == lock_attr:
                     continue
                 if attr in ctx.config.locked_class_exempt_attrs:
+                    continue
+                if attr in thread_local:
                     continue
                 if monitored is not None and attr not in monitored:
                     continue

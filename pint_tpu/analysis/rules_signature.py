@@ -1,0 +1,220 @@
+"""signature-incomplete: cache-key completeness for traced programs.
+
+The zero-retrace serving contract keys compiled programs on shape
+signatures: ``PTABatch.shape_signature()`` fingerprints every array the
+program table's jitted closures touch, ``ShapePlan.signature()`` hashes
+the bucket geometry, and the ExecutableCache composes both. The
+soundness requirement is COMPLETENESS: every shape-affecting attribute
+a traced closure reads (or that is passed as a runtime argument at a
+program-table dispatch) must be folded into the signature — an attr
+read inside traced code that the key omits can change compiled-program
+shape without changing the key, silently serving a stale executable or
+retracing on every call.
+
+This rule checks that statically, per class registered in
+``SIGNATURE_CLASSES``:
+
+- **signature set**: ``self.X`` reads inside the registered signature
+  method, transitively through ``self.m()`` helper calls;
+- **covered set**: the signature set, plus attrs appearing in the
+  program-table KEY expression (``self._fns[key]`` — changing them
+  changes the key, which is safe by construction), plus per-class
+  exemptions for host-only metadata;
+- **checked set**: ``self.X`` reads inside jit-traced closures defined
+  in the class's methods (decorator, ``jax.jit(f)`` harvesting, or
+  storage into ``self._fns[...]``), again transitive through self
+  method calls — plus ``self.X`` runtime arguments at ``self._fns[...]
+  (...)`` dispatch sites.
+
+Anything in the checked set but not covered is a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Rule, register, self_attr_root
+from .rules_retrace import TracedIndex
+
+
+def _self_attr(node):
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+@register
+class SignatureCompletenessRule(Rule):
+    """A shape-affecting attribute read inside traced code but absent
+    from the program key can change the compiled program without
+    changing the key: either a silently stale executable (wrong
+    results) or a retrace on every call (the zero-recompile contract
+    gone). The signature must fingerprint everything the trace
+    reads."""
+
+    id = "signature-incomplete"
+    family = "retrace"
+    rationale = ("attr read inside jit-traced code but missing from "
+                 "the shape signature can change program shape "
+                 "without changing the cache key")
+    whole_program = True
+
+    def check_project(self, project, index):
+        config = project.config
+        if not config.signature_classes:
+            return
+        for qname in sorted(index.classes):
+            cls = index.classes[qname]
+            spec = config.signature_classes.get(cls.name)
+            if spec is None:
+                continue
+            self._check_class(index, cls, spec)
+
+    def _check_class(self, index, cls, spec):
+        sig_method = cls.find_method(index, spec["signature"])
+        if sig_method is None:
+            cls.module.ctx.report(
+                self.id, cls.node.lineno,
+                f"class {cls.name} is registered with signature "
+                f"method '{spec['signature']}' but does not define "
+                f"it")
+            return
+        exempt = set(spec.get("exempt", ())) | {"_fns"}
+        sig_reads = self._transitive_self_reads(index, cls, sig_method)
+        traced = TracedIndex(cls.module.ctx.tree)
+
+        for method in self._all_methods(index, cls):
+            key_attrs = self._key_attrs(method.node)
+            covered = sig_reads | key_attrs | exempt
+            for closure in method.nested.values():
+                if not self._is_traced(traced, method, closure):
+                    continue
+                reads = self._transitive_self_reads(
+                    index, cls, closure)
+                for attr in sorted(reads - covered):
+                    line = self._read_line(closure.node, attr)
+                    closure.ctx.report(
+                        self.id, line,
+                        f"traced closure '{closure.name}' in "
+                        f"{cls.name}.{method.name} reads self.{attr}, "
+                        f"which is not folded into "
+                        f"{cls.name}.{spec['signature']}() — a shape "
+                        f"change through it will not change the "
+                        f"cache key")
+            for node, attrs in self._dispatch_args(method.node):
+                for attr in sorted(attrs - covered):
+                    method.ctx.report(
+                        self.id, node.lineno,
+                        f"self.{attr} is passed as a runtime argument "
+                        f"at a program-table dispatch in "
+                        f"{cls.name}.{method.name} but is not folded "
+                        f"into {cls.name}.{spec['signature']}()")
+
+    @staticmethod
+    def _all_methods(index, cls):
+        seen, out = set(), []
+        for mro_cls in cls.mro(index):
+            for name, method in sorted(mro_cls.methods.items()):
+                if name not in seen:
+                    seen.add(name)
+                    out.append(method)
+        return out
+
+    def _is_traced(self, traced, method, closure):
+        if traced.is_traced_def(closure.node):
+            return True
+        # stored into the program table: self._fns[key] = closure (or
+        # a wrapper call mentioning it)
+        for sub in ast.walk(method.node):
+            if not isinstance(sub, ast.Assign):
+                continue
+            for tgt in sub.targets:
+                if (isinstance(tgt, ast.Subscript)
+                        and self_attr_root(tgt) == "_fns"):
+                    for ref in ast.walk(sub.value):
+                        if (isinstance(ref, ast.Name)
+                                and ref.id == closure.name):
+                            return True
+        return False
+
+    def _transitive_self_reads(self, index, cls, func):
+        """self-attr READS in ``func``, following self.m() calls into
+        other methods of the class (MRO-wide), memoized per class."""
+        methods = {}
+        for mro_cls in cls.mro(index):
+            for name in mro_cls.methods:
+                methods.setdefault(name, mro_cls.methods[name])
+        reads, seen = set(), set()
+        work = [func]
+        while work:
+            cur = work.pop()
+            if cur.qname in seen:
+                continue
+            seen.add(cur.qname)
+            for sub in ast.walk(cur.node):
+                attr = _self_attr(sub)
+                if attr is None:
+                    continue
+                if attr in methods:
+                    callee = methods[attr]
+                    if callee.qname not in seen:
+                        work.append(callee)
+                    continue
+                if isinstance(sub.ctx, ast.Load):
+                    reads.add(attr)
+        return reads
+
+    @staticmethod
+    def _key_attrs(method_node):
+        """self attrs participating in program-table keys: subscript
+        expressions of ``self._fns[...]`` plus the local ``key = ...``
+        assignments feeding them."""
+        key_exprs, key_names = [], set()
+        for sub in ast.walk(method_node):
+            if (isinstance(sub, ast.Subscript)
+                    and self_attr_root(sub.value) == "_fns"):
+                key_exprs.append(sub.slice)
+                if isinstance(sub.slice, ast.Name):
+                    key_names.add(sub.slice.id)
+        for sub in ast.walk(method_node):
+            if isinstance(sub, ast.Assign):
+                for tgt in sub.targets:
+                    if (isinstance(tgt, ast.Name)
+                            and tgt.id in key_names):
+                        key_exprs.append(sub.value)
+        out = set()
+        for expr in key_exprs:
+            for sub in ast.walk(expr):
+                attr = _self_attr(sub)
+                if attr is not None:
+                    out.add(attr)
+        return out
+
+    @staticmethod
+    def _dispatch_args(method_node):
+        """[(call node, {self attrs passed as runtime args})] for
+        ``self._fns[...](...)`` dispatch sites."""
+        out = []
+        for sub in ast.walk(method_node):
+            if not (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Subscript)
+                    and self_attr_root(sub.func) == "_fns"):
+                continue
+            attrs = set()
+            for arg in list(sub.args) + [kw.value
+                                         for kw in sub.keywords]:
+                for inner in ast.walk(arg):
+                    attr = _self_attr(inner)
+                    if attr is not None:
+                        attrs.add(attr)
+            out.append((sub, attrs))
+        return out
+
+    @staticmethod
+    def _read_line(closure_node, attr):
+        for sub in ast.walk(closure_node):
+            if _self_attr(sub) == attr:
+                return sub.lineno
+        return closure_node.lineno

@@ -167,7 +167,8 @@ class Tracer:
             annot.__enter__()
             return annot
         except Exception:
-            self.jax_annotations = False   # backend lacks profiler
+            with self._lock:
+                self.jax_annotations = False   # backend lacks profiler
             return None
 
     # -- inspection ----------------------------------------------------

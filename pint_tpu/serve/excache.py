@@ -297,7 +297,8 @@ class PersistentExecutableCache:
             return None
         t = threading.Thread(target=work, name="pex-prewarm",
                              daemon=True)
-        self._prewarm_thread = t
+        with self._lock:
+            self._prewarm_thread = t
         t.start()
         return t
 
@@ -384,7 +385,8 @@ class PersistentExecutableCache:
                 "platform": jax.default_backend(),
                 "jax_version": jax.__version__}
         if expect != want:
-            self.stale += 1
+            with self._lock:
+                self.stale += 1
             warnings.warn(
                 f"persisted executable {os.path.basename(path)} is "
                 f"stale ({expect} != {want}); recompiling")
@@ -393,7 +395,8 @@ class PersistentExecutableCache:
         return doc
 
     def _discard(self, path, why):
-        self.corrupt += 1
+        with self._lock:
+            self.corrupt += 1
         warnings.warn(
             f"persisted executable {os.path.basename(path)} unusable "
             f"({why}); deleting and recompiling")

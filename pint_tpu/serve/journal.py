@@ -116,7 +116,7 @@ class RequestJournal:
 
     # -- tail recovery -------------------------------------------------
 
-    def _recover_tail(self):
+    def _recover_tail_locked(self):
         """Truncate a torn/corrupt tail before the first append, so new
         frames never land after garbage (the scanner would stop at the
         garbage and silently hide them)."""
@@ -138,9 +138,9 @@ class RequestJournal:
                 fh.flush()
                 os.fsync(fh.fileno())
 
-    def _ensure_open(self):
+    def _ensure_open_locked(self):
         if self._fh is None:
-            self._recover_tail()
+            self._recover_tail_locked()
             self._fh = open(self.path, "ab")
         return self._fh
 
@@ -151,7 +151,7 @@ class RequestJournal:
         frame = MAGIC + _HEADER.pack(len(payload), zlib.crc32(payload)) \
             + payload
         with self._lock:
-            fh = self._ensure_open()
+            fh = self._ensure_open_locked()
             cut = faultinject.fire("journal_torn_write",
                                    rid=rec.get("rid"))
             if cut is not None:
@@ -248,7 +248,7 @@ class RequestJournal:
         with self._lock:
             if self._fh is not None:
                 self.sync()
-            self._recover_tail()
+            self._recover_tail_locked()
             try:
                 with open(self.path, "rb") as fh:
                     data = fh.read()

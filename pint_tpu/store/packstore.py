@@ -431,7 +431,8 @@ class PackStore:
             return None
         t = threading.Thread(target=work, name="ptpk-prewarm",
                              daemon=True)
-        self._prewarm_thread = t
+        with self._lock:
+            self._prewarm_thread = t
         t.start()
         return t
 

@@ -173,3 +173,171 @@ def assert_no_violations(violations):
     assert not violations, (
         "unsynchronized cross-thread writes detected:\n  "
         + "\n  ".join(repr(v) for v in violations))
+
+
+# -- runtime lock-ORDER recording -------------------------------------
+#
+# The dynamic counterpart of pintlint's whole-program lock-order-cycle
+# rule (pint_tpu/analysis/rules_lockorder.py). The static analysis
+# derives "acquire B while holding A" edges from with-blocks, resolved
+# calls, and the *_locked convention; this recorder observes the edges
+# a real multi-threaded scenario actually takes, so a test can assert
+# the union of both edge sets is still acyclic — runtime behaviour must
+# be a linear extension of the static DAG, never a contradiction of it.
+
+
+class LockOrderRecorder:
+    """Collects (held, acquired) label pairs across all RecordingLocks
+    sharing this recorder. Per-thread held stacks; first witness thread
+    name kept per edge."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.edges = {}            # (held, acquired) -> witness thread
+        self._local = threading.local()
+
+    def _held(self):
+        held = getattr(self._local, "held", None)
+        if held is None:
+            held = self._local.held = []
+        return held
+
+    def note_acquire(self, label):
+        held = self._held()
+        if held:
+            tname = threading.current_thread().name
+            with self._mu:
+                for h in held:
+                    self.edges.setdefault((h, label), tname)
+        held.append(label)
+
+    def note_release(self, label):
+        held = self._held()
+        if held and held[-1] == label:
+            held.pop()
+        elif label in held:        # out-of-order release; stay sane
+            held.remove(label)
+
+    def edge_set(self):
+        with self._mu:
+            return set(self.edges)
+
+
+class RecordingLock:
+    """Transparent proxy around a real Lock/RLock that reports
+    acquisition order to a :class:`LockOrderRecorder`. Reentrant
+    acquires (RLock) are depth-counted per thread so only the OUTERMOST
+    acquire/release records — nested re-entry is not an ordering edge.
+
+    Installed into ``obj.__dict__`` so a ``threading.Condition`` built
+    from the original lock at construction time keeps working: the
+    Condition holds the real lock directly and bypasses the proxy
+    (those acquisitions simply go unrecorded), while ``with
+    self._lock:`` sites route through it."""
+
+    def __init__(self, inner, label, recorder):
+        self._inner = inner
+        self._label = label
+        self._recorder = recorder
+        self._depth = threading.local()
+
+    def _bump(self, delta):
+        n = getattr(self._depth, "n", 0) + delta
+        self._depth.n = n
+        return n
+
+    def acquire(self, *args, **kwargs):
+        got = self._inner.acquire(*args, **kwargs)
+        if got and self._bump(+1) == 1:
+            self._recorder.note_acquire(self._label)
+        return got
+
+    def release(self):
+        if self._bump(-1) == 0:
+            self._recorder.note_release(self._label)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __getattr__(self, name):   # _is_owned, locked, ...
+        return getattr(self._inner, name)
+
+
+@contextmanager
+def record_order(*specs, recorder=None):
+    """Wrap each instance's lock with a RecordingLock for the duration.
+
+    ``specs`` are ``(obj, label)`` or ``(obj, label, lock_attr)``
+    tuples; ``label`` should match the static analyzer's node naming
+    ("ClassName.attr") so edge sets compare directly. Yields the
+    recorder; restores the original locks on exit."""
+    rec = recorder if recorder is not None else LockOrderRecorder()
+    saved = []
+    for spec in specs:
+        obj, label = spec[0], spec[1]
+        lock_attr = spec[2] if len(spec) > 2 else "_lock"
+        inner = obj.__dict__[lock_attr]
+        obj.__dict__[lock_attr] = RecordingLock(inner, label, rec)
+        saved.append((obj, lock_attr, inner))
+    try:
+        yield rec
+    finally:
+        for obj, lock_attr, inner in saved:
+            obj.__dict__[lock_attr] = inner
+
+
+def find_cycle(edges):
+    """First directed cycle in an edge iterable, as a node path
+    ``[a, ..., a]``, or None when the graph is acyclic."""
+    succ = {}
+    for a, b in edges:
+        succ.setdefault(a, set()).add(b)
+    color = {}                     # missing=white, 1=on stack, 2=done
+    parent = {}
+    for start in sorted(succ):
+        if color.get(start):
+            continue
+        color[start] = 1
+        stack = [(start, iter(sorted(succ.get(start, ()))))]
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for nxt in it:
+                c = color.get(nxt)
+                if c == 1:         # back edge: cycle nxt -> ... -> node
+                    path = [node]
+                    while path[-1] != nxt:
+                        path.append(parent[path[-1]])
+                    path.reverse()
+                    path.append(nxt)
+                    return path
+                if c is None:
+                    parent[nxt] = node
+                    color[nxt] = 1
+                    stack.append(
+                        (nxt, iter(sorted(succ.get(nxt, ())))))
+                    advanced = True
+                    break
+            if not advanced:
+                color[node] = 2
+                stack.pop()
+    return None
+
+
+def assert_order_consistent(runtime_edges, static_edges):
+    """Assert the union of runtime-observed and static lock-order
+    edges is acyclic. Returns the combined edge set. A cycle here means
+    the running system took locks in an order the static DAG forbids —
+    a latent deadlock the single test run happened to survive."""
+    combined = set(runtime_edges) | set(static_edges)
+    cycle = find_cycle(combined)
+    assert cycle is None, (
+        "runtime lock acquisition order contradicts the static "
+        "lock-order DAG; combined cycle: " + " -> ".join(cycle))
+    return combined

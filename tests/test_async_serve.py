@@ -151,6 +151,76 @@ def test_concurrent_stress_lock_discipline(two_pulsars):
     assert set(tenants) <= set(rows)
 
 
+# -- runtime lock-order vs static DAG --------------------------------
+
+
+def test_runtime_lock_order_consistent_with_static_dag(two_pulsars):
+    """Record real lock acquisition order while producer threads hammer
+    submit() and the flusher drains, then check the observed edges
+    against the static lock-order DAG from pintlint's whole-program
+    pass: the union of runtime and derived acquired-while-held edges
+    must stay acyclic. A cycle here means the running system took locks
+    in an order the static analysis forbids — a latent deadlock this
+    particular run merely survived."""
+    import os
+
+    import pint_tpu
+    from pint_tpu.analysis.core import run_project
+    from pint_tpu.analysis.rules_lockorder import LockOrderRule
+
+    from lockcheck import assert_order_consistent, record_order
+
+    pkg = os.path.dirname(pint_tpu.__file__)
+    findings, project = run_project([pkg], rules=[LockOrderRule()])
+    assert not [f for f in findings if not f.suppressed], \
+        "static lock-order cycles present; fix those first"
+    static_edges = set(project.lock_graph.edges)
+    assert static_edges, "static pass found no acquired-while-held edges"
+
+    eng = AsyncServeEngine(max_batch=4, max_latency_s=1e9,
+                           bucket_floor=32, max_queue=64)
+    eng.prewarm(_reqs(two_pulsars, 2))
+    specs = [
+        (eng, "AsyncServeEngine._work_mutex", "_work_mutex"),
+        (eng.intake, "IntakeQueue._lock"),
+        (eng.admission, "AdmissionController._lock"),
+        (eng.batcher, "MicroBatcher._lock"),
+        (eng.telemetry, "ServeTelemetry._lock"),
+        (eng.cache, "ExecutableCache._lock"),
+        (eng.health, "HealthMonitor._lock"),
+        (eng.breaker, "CircuitBreaker._lock"),
+    ]
+    n_producers, per_producer = 4, 6
+    handles = [[None] * per_producer for _ in range(n_producers)]
+
+    def producer(pid):
+        for k in range(per_producer):
+            req = FitRequest(*two_pulsars[(pid + k) % 2], maxiter=2,
+                             priority=(k % 3))
+            handles[pid][k] = eng.submit(req)
+
+    try:
+        with record_order(*specs) as rec:
+            threads = [threading.Thread(target=producer, args=(pid,))
+                       for pid in range(n_producers)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            eng.drain()
+    finally:
+        eng.close()
+
+    flat = [h for row in handles for h in row]
+    assert all(h.done for h in flat)
+    runtime = rec.edge_set()
+    assert runtime, "no acquisition-order edges observed at runtime"
+    # the flusher's work-mutex-held phase must have been exercised
+    assert any(held == "AsyncServeEngine._work_mutex"
+               for held, _ in runtime)
+    assert_order_consistent(runtime, static_edges)
+
+
 # -- flusher stall -> watchdog restart -------------------------------
 
 
