@@ -1714,6 +1714,140 @@ def main():
                    f"null p {gw_report['gw_null_p']:.3f}), "
                    f"{gw_report['gw_pairs_per_s']} pairs/s")
 
+    # -- incremental streaming-refit stage (ISSUE 20): kernel-level
+    # append-vs-refit speedup at the 670k-row scale, incremental-vs-
+    # scratch parity under the floored relative-diff convention, and
+    # served append_toas latency through a registered streaming lane.
+    # Own daemon thread + join timeout, skip with
+    # PINT_TPU_BENCH_SKIP_INCREMENTAL=1.
+    incremental_report = None
+
+    def _incremental_stage():
+        nonlocal incremental_report
+        try:
+            import tempfile
+            import time as _time
+
+            import jax as _jax
+
+            from pint_tpu.kernels import incremental as inc
+            from pint_tpu.models import get_model
+            from pint_tpu.serve import AppendToasRequest, ServeEngine
+            from pint_tpu.serve.metrics import percentile
+            from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+            # (a) kernel-level: fold 64 appended rows into a cached
+            # 670k-row normal state vs re-folding the whole row set
+            # from scratch over the same left-fold block partition
+            rng = np.random.default_rng(42)
+            n_base, n_app, K = 670_000, 64, 10
+            Xb = rng.standard_normal((n_base, K))
+            rb = rng.standard_normal(n_base) * 1e-6
+            wb = rng.uniform(0.5, 2.0, n_base) * 1e6
+            Xa = rng.standard_normal((n_app, K))
+            ra = rng.standard_normal(n_app) * 1e-6
+            wa = rng.uniform(0.5, 2.0, n_app) * 1e6
+            q = np.full(K, 1e-6)
+            chunks = [(Xb, rb, wb), (Xa, ra, wa)]
+            base = inc.build_normal(Xb, rb, wb, q=q)  # warms the jits
+
+            scratch_s, dx_sc = None, None
+            for _ in range(3):
+                t0 = _time.perf_counter()
+                dx_sc, _c2, _st, _i = inc.scratch_refit(chunks, q=q)
+                _jax.block_until_ready(dx_sc)
+                dt = _time.perf_counter() - t0
+                scratch_s = dt if scratch_s is None else min(scratch_s,
+                                                             dt)
+            inc_s, dx_in = None, None
+            for _ in range(3):
+                # fresh copy per rep: append mutates the cached state
+                st = inc.IncrementalNormal(base.A0, base.b, base.rNr,
+                                           q=base.q)
+                t0 = _time.perf_counter()
+                st.append(Xa, ra, wa)
+                dx_in, _c2, _info = st.solve()
+                _jax.block_until_ready(dx_in)
+                dt = _time.perf_counter() - t0
+                inc_s = dt if inc_s is None else min(inc_s, dt)
+
+            dx_in = np.asarray(dx_in)
+            dx_sc = np.asarray(dx_sc)
+            den = np.maximum(
+                np.abs(dx_sc),
+                np.finfo(np.float64).eps
+                * max(float(np.max(np.abs(dx_sc))), 1e-300))
+            parity = float(np.max(np.abs(dx_in - dx_sc) / den))
+
+            # (b) served append latency: a real lane, 8-TOA chunks
+            # through the journaled+delta-persisted append path
+            par = ("PSR INCR0\nRAJ 12:00:00.0\nDECJ 10:00:00.0\n"
+                   "F0 311.25 1\nF1 -4e-16 1\nPEPOCH 55500\n"
+                   "DM 12.5 1\n")
+            m = get_model(par)
+            mjds = np.sort(rng.uniform(54500, 56500, 64))
+            t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0,
+                                        freq_mhz=1400.0, obs="gbt",
+                                        add_noise=True, seed=7)
+            with tempfile.TemporaryDirectory() as d:
+                eng = ServeEngine(durable_dir=d)
+                eng.register_append_lane(m, t)
+                walls = []
+                lo = 56500.0
+                for i in range(24):
+                    cm = np.sort(rng.uniform(lo, lo + 5.0, 8))
+                    lo += 5.0
+                    ct = make_fake_toas_fromMJDs(
+                        cm, m, error_us=1.0, freq_mhz=1400.0,
+                        obs="gbt", add_noise=True, seed=100 + i)
+                    t0 = _time.perf_counter()
+                    r = eng.submit(AppendToasRequest(m, ct))
+                    dt = _time.perf_counter() - t0
+                    if r.status != "ok":
+                        raise RuntimeError(
+                            f"append failed: {r.reason}")
+                    if i >= 4:  # drop the compile/warmup head
+                        walls.append(dt)
+                p99 = percentile(walls, 99)
+                p50 = percentile(walls, 50)
+                escal = eng.streaming.counters()["escalated"]
+
+            incremental_report = {  # set LAST: completion marker
+                "incremental_vs_refit_speedup": round(
+                    scratch_s / inc_s, 1),
+                "incremental_parity_max_rel": parity,
+                "incremental_append_p99_s": round(p99, 4),
+                "incremental_append_p50_s": round(p50, 4),
+                "incremental_scratch_refit_s": round(scratch_s, 4),
+                "incremental_append_escalations": escal,
+            }
+        except Exception as e:
+            _stage(f"incremental stage failed ({type(e).__name__}: "
+                   f"{e}); headline JSON unaffected")
+
+    if os.environ.get("PINT_TPU_BENCH_SKIP_INCREMENTAL") == "1":
+        _stage("incremental stage skipped "
+               "(PINT_TPU_BENCH_SKIP_INCREMENTAL=1)")
+    else:
+        _stage("incremental: streaming-refit append vs scratch refit "
+               "at 670k rows + served append latency")
+        ti = threading.Thread(target=_incremental_stage, daemon=True)
+        ti.start()
+        ti.join(timeout=300)
+        if ti.is_alive():
+            incremental_report = None  # late finish must not race
+            _stage("incremental stage timed out; headline JSON "
+                   "unaffected")
+        elif incremental_report is not None:
+            _stage("incremental: %.0fx vs scratch refit, parity "
+                   "%.2e, append p99 %.1f ms" % (
+                       incremental_report[
+                           "incremental_vs_refit_speedup"],
+                       incremental_report[
+                           "incremental_parity_max_rel"],
+                       incremental_report[
+                           "incremental_append_p99_s"] * 1e3))
+
     total_toas = n_psr * n_toa
     rate = total_toas / gls_refit_s  # TOAs GLS-refit per second
     projected_670k = gls_refit_s * (670_000 / total_toas)
@@ -1977,6 +2111,24 @@ def main():
                          if gw_report else None),
         "gw_fleet_pairs": (gw_report["gw_fleet_pairs"]
                            if gw_report else None),
+        "incremental_vs_refit_speedup": (
+            incremental_report["incremental_vs_refit_speedup"]
+            if incremental_report else None),
+        "incremental_parity_max_rel": (
+            incremental_report["incremental_parity_max_rel"]
+            if incremental_report else None),
+        "incremental_append_p99_s": (
+            incremental_report["incremental_append_p99_s"]
+            if incremental_report else None),
+        "incremental_append_p50_s": (
+            incremental_report["incremental_append_p50_s"]
+            if incremental_report else None),
+        "incremental_scratch_refit_s": (
+            incremental_report["incremental_scratch_refit_s"]
+            if incremental_report else None),
+        "incremental_append_escalations": (
+            incremental_report["incremental_append_escalations"]
+            if incremental_report else None),
         "platform": platform,
     }
     meta.update(full_meta)
@@ -2029,6 +2181,8 @@ def main():
           if k.startswith(("gls_fused_", "fused_"))]),
         ("PINT_TPU_BENCH_SKIP_GW", gw_report,
          [k for k in meta if k.startswith("gw_")]),
+        ("PINT_TPU_BENCH_SKIP_INCREMENTAL", incremental_report,
+         [k for k in meta if k.startswith("incremental_")]),
     ):
         _reason = _stage_reason(_env, _rep)
         if _reason:

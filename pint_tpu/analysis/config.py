@@ -89,6 +89,13 @@ LOCKED_CLASSES = {
     # ordering matters (ExecutableCache._lock -> Persistent..._lock).
     "PersistentExecutableCache": {"lock": "_lock", "attrs": None},
     "PackStore": {"lock": "_lock", "attrs": None},
+    # streaming append lanes: serve worker threads append while
+    # register/recover touch the same lane table; the delta store's
+    # chain tips are reached from under the refitter's lock
+    # (StreamingRefitter._lock -> DeltaStore._lock, same direction as
+    # the ExecutableCache -> Persistent... edge).
+    "StreamingRefitter": {"lock": "_lock", "attrs": None},
+    "DeltaStore": {"lock": "_lock", "attrs": None},
 }
 
 # Attributes never treated as shared state even under attrs=None:
@@ -267,7 +274,7 @@ OBS_ALLOWED_PATH_MARKERS = ("/obs/", "/tests/", "/test_")
 # the atomic implementation.
 DURABLE_ARTIFACT_MODULES = (
     "/checkpoint.py", "/obs/recorder.py", "/serve/journal.py",
-    "/serve/excache.py", "/store/packstore.py",
+    "/serve/excache.py", "/store/packstore.py", "/store/deltas.py",
 )
 
 # -- kernel dispatch ---------------------------------------------------

@@ -1,7 +1,7 @@
 """Budget-coverage rule family.
 
 - meta-key-unbudgeted: a ``measured_*`` / ``serve_*`` / ``chaos_*``
-  / ``cold_start_*`` / ``gw_*`` meta key
+  / ``cold_start_*`` / ``gw_*`` / ``incremental_*`` meta key
   defined as a dict-literal key in a budget-governed module (bench.py)
   that the machine-readable budget file
   (``pint_tpu/obs/budgets.json``) does not know about — neither a
@@ -24,14 +24,16 @@ import re
 
 from .core import Rule, register
 
-_META_KEY = re.compile(r"^(measured_|serve_|chaos_|cold_start_|gw_)")
+_META_KEY = re.compile(
+    r"^(measured_|serve_|chaos_|cold_start_|gw_|incremental_)")
 
 
 @register
 class MetaKeyUnbudgetedRule(Rule):
     id = "meta-key-unbudgeted"
     family = "budget"
-    rationale = ("a measured_*/serve_*/chaos_*/cold_start_*/gw_* meta key "
+    rationale = ("a measured_*/serve_*/chaos_*/cold_start_*/gw_*/"
+                 "incremental_* meta key "
                  "absent from pint_tpu/obs/budgets.json is invisible "
                  "to the bench regression gate and can regress "
                  "silently")

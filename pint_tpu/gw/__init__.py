@@ -35,11 +35,12 @@ from . import correlate, hd, residuals  # noqa: F401
 from .correlate import correlation_matrix, correlation_sweep  # noqa: F401
 from .hd import (hd_curve, inject_gwb, optimal_statistic,  # noqa: F401
                  scramble_null)
-from .residuals import GWInputs, assemble, regrid, sky_positions  # noqa: F401
+from .residuals import (GWInputs, assemble, regrid,  # noqa: F401
+                        regrid_append, sky_positions)
 
 __all__ = [
     "GWInputs", "assemble", "correlate", "correlation_matrix",
     "correlation_sweep", "hd", "hd_curve", "inject_gwb",
-    "optimal_statistic", "regrid", "residuals", "scramble_null",
-    "sky_positions",
+    "optimal_statistic", "regrid", "regrid_append", "residuals",
+    "scramble_null", "sky_positions",
 ]

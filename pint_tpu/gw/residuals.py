@@ -163,4 +163,63 @@ def regrid(inputs, lattice_days=30.0, t0=None, t1=None):
     with np.errstate(invalid="ignore"):
         z = np.where(w > 0, u / np.where(w > 0, w, 1.0), 0.0)
     t_cells = t0 + dt * (np.arange(n_cells) + 0.5)
-    return GWLattice(inputs.labels, inputs.pos, z, w, t_cells)
+    lat = GWLattice(inputs.labels, inputs.pos, z, w, t_cells)
+    # raw weighted-residual accumulators, kept beside the derived z:
+    # regrid_append updates (w, u) additively and re-derives z, which
+    # is what makes an appended lattice bitwise-identical to a full
+    # regrid of the concatenated inputs (z = u/w would not survive a
+    # round-trip through z*w)
+    lat.u = u
+    return lat
+
+
+def regrid_append(lattice, label, times, resid, weights):
+    """Fold one pulsar's appended TOAs into an existing lattice —
+    the streaming-refit consumer: an ``append_toas`` request's
+    residual delta updates ONE row of the (P, M) lattice in O(r)
+    instead of re-running :func:`assemble` + :func:`regrid` over all
+    P pulsars' full row sets.
+
+    Exact additive update: ``w' = w + dw``, ``u' = u + du`` with the
+    per-cell ``np.add.at`` accumulation order identical to a full
+    regrid of base-then-appended concatenated inputs, so the returned
+    lattice's (w, u, z) are bitwise what :func:`regrid` would produce
+    from scratch (tests/test_incremental.py pins this). Appended
+    epochs past the current window GROW the lattice to the right
+    (new cells start at zero weight for every other pulsar); epochs
+    before the window raise — TOA streams append forward in time.
+
+    Returns a NEW GWLattice (the input is not mutated: pair-sweep
+    consumers may still hold it)."""
+    if label not in lattice.labels:
+        raise KeyError(f"unknown lattice pulsar {label!r}")
+    p = lattice.labels.index(label)
+    t = np.asarray(times, np.float64)
+    r = np.asarray(resid, np.float64)
+    wt = np.asarray(weights, np.float64)
+    if lattice.t_cells.size > 1:
+        dt = float(lattice.t_cells[1] - lattice.t_cells[0])
+    else:
+        raise ValueError("cannot infer cell width from a single-cell "
+                         "lattice; re-run regrid")
+    t0 = float(lattice.t_cells[0]) - dt / 2
+    cells = np.floor((t - t0) / dt).astype(np.int64)
+    if t.size and cells.min() < 0:
+        raise ValueError("appended TOAs precede the lattice window; "
+                         "streams append forward in time")
+    n_cells = max(lattice.n_cells,
+                  (int(cells.max()) + 1) if t.size else 0)
+    P = lattice.n_pulsars
+    w = np.zeros((P, n_cells))
+    u = np.zeros((P, n_cells))
+    w[:, :lattice.n_cells] = lattice.w
+    u[:, :lattice.n_cells] = getattr(
+        lattice, "u", lattice.z * lattice.w)
+    np.add.at(w[p], cells, wt)
+    np.add.at(u[p], cells, wt * r)
+    with np.errstate(invalid="ignore"):
+        z = np.where(w > 0, u / np.where(w > 0, w, 1.0), 0.0)
+    t_cells = t0 + dt * (np.arange(n_cells) + 0.5)
+    out = GWLattice(lattice.labels, lattice.pos, z, w, t_cells)
+    out.u = u
+    return out

@@ -1,0 +1,420 @@
+"""Incremental GLS: additive Gram deltas + rank-r factor updates.
+
+Append-heavy TOA traffic (a handful of new arrival times per pulsar
+per epoch) should not pay a full O(N K^2) repack-and-refit. The fused
+augmented tile of kernels/fusedgls.py already states why it does not
+have to: the GLS normal equations are ONE Gram of the whitened
+augmented rows ``[X | r | winv]``, and a Gram is additive over rows.
+Appending ``r_new`` rows therefore contributes
+
+    dG = xw_new^T xw_new        ((K+2, K+2), rank <= r_new)
+
+to the cached accumulator, so the refreshed normal matrix, RHS and
+whitened residual power are pure sums::
+
+    A' = A + dG[:K, :K]    b' = b + dG[:K, K]    rNr' = rNr + dG[K, K]
+
+(the prior diagonal ``diag(q^2)`` of the GLS normal matrix is
+row-count independent and rides along unchanged inside ``A``).
+
+Dual path mirroring fusedgls/seggram:
+
+- :func:`delta_gram_jnp` — bitwise-deterministic f64 jnp reference.
+- :func:`delta_gram_pallas` — the f32 Pallas tile: appended rows are
+  zero-padded to a sublane-aligned block (padding rows carry
+  ``winv=0`` and whiten to nothing) and pushed through
+  ``fused_block_gls_pallas`` as a single-block grid.
+- :func:`delta_gram` dispatches; a failed Pallas dispatch falls back
+  VISIBLY via kernels.fallback.note_pallas_fallback, never silently.
+
+Parity is by CONSTRUCTION, not by tolerance: both the incremental
+path and the from-scratch comparator accumulate their normal state
+through the same sequential left fold over the same block partition
+(:func:`fold_grams`), so after any append sequence the incremental
+``(A, b, rNr)`` is *bitwise identical* to a from-scratch pass over
+the concatenated rows — IEEE addition is deterministic and the two
+paths perform literally the same sequence of additions. The shared
+deterministic solve then maps identical state to identical
+parameters, which is what lets the serve path promise "an
+incremental lane never drifts from what a full refit would have
+produced" (tests/test_incremental.py pins the bit-identity; the
+bench's ``incremental_parity_max_rel`` <= 1e-15 budget is the
+regression-gated witness).
+
+On top of the delta sits the cached-factorization update.
+:class:`IncrementalNormal` holds ``(A, b, rNr)`` plus a Cholesky
+factor ``L`` of ``A``; :meth:`IncrementalNormal.append` refreshes
+``L`` by a classical rank-r hyperbolic-rotation Cholesky update
+(O(r K^2), no O(N) term), with a condition trigger — non-finite
+entries or a collapsed diagonal ratio — that falls back to a full
+refactor of the exact ``A'`` (counted in ``refactors``).
+:meth:`IncrementalNormal.solve` solves the *exact* accumulated
+normal equations through the updated factor plus iterative
+refinement; if the refinement residual will not contract (factor too
+stale/ill-conditioned) it falls back to the thresholded
+``fitter.gls_eigh_solve`` — the same solver the from-scratch f64 fit
+uses — so incremental parameters track a from-scratch fused refit to
+the <=1e-15 f64 tier pinned in ERRORBUDGET.md.
+"""
+
+from __future__ import annotations
+
+from .fallback import note_pallas_fallback
+from .seggram import _tpu_backend
+
+# TPU f32 tiles want the second-minor dimension in multiples of the
+# sublane width; appended-row counts (typically <= 64) are padded up
+# to this with winv=0 rows that whiten to zero.
+_SUBLANE = 8
+
+# refinement-residual acceptance for the factored solve: above this
+# the cached factor is declared stale and the solve re-routes through
+# the exact thresholded eigh (same guard philosophy as
+# fitter.relres_failed on the mixed path).
+_RELRES_TOL = 1e-12
+
+# diagonal-collapse trigger for the rank-r factor update: if the
+# updated factor's min/max diagonal ratio degrades below this
+# fraction of the pre-update ratio, refactor from the exact A'.
+_DIAG_DEGRADE = 1e-3
+
+
+def pad_append_rows(X, r, winv, multiple=_SUBLANE):
+    """Zero-pad appended rows up to ``multiple``. Padding rows carry
+    ``winv=0`` so they whiten to zero and drop out of the Gram."""
+    import jax.numpy as jnp
+
+    X = jnp.asarray(X)
+    r = jnp.asarray(r)
+    winv = jnp.asarray(winv)
+    n = X.shape[0]
+    npad = (-n) % multiple
+    if npad:
+        X = jnp.pad(X, ((0, npad), (0, 0)))
+        r = jnp.pad(r, (0, npad))
+        winv = jnp.pad(winv, (0, npad))
+    return X, r, winv
+
+
+def delta_gram_jnp(X, r, winv):
+    """f64 reference: (K+2, K+2) whitened Gram of the appended rows
+    ``[X | r | winv]`` (same augmented layout as fusedgls)."""
+    from .fusedgls import augment, fused_block_gls_jnp
+
+    X, r, winv = pad_append_rows(X, r, winv)
+    aug = augment(X, r, winv)
+    return fused_block_gls_jnp(aug, aug.shape[0])[0]
+
+
+def delta_gram_pallas(X, r, winv, interpret=False):
+    """Pallas path: the padded appended rows as ONE fused-GLS block
+    (f32 accumulate on the MXU), widened back to f64 for the additive
+    update outside."""
+    import jax.numpy as jnp
+
+    from .fusedgls import augment, fused_block_gls_pallas
+
+    X, r, winv = pad_append_rows(X, r, winv)
+    aug = augment(X, r, winv)
+    grams = fused_block_gls_pallas(aug, aug.shape[0],
+                                   interpret=interpret)
+    return grams[0].astype(jnp.float64)
+
+
+def delta_gram_f32_jnp(X, r, winv):
+    """f32 jnp emulation of the kernel numerics (mixed path on
+    backends without Pallas), f64 widen outside — mirrors
+    fusedgls.fused_segment_gls_f32_jnp."""
+    import jax.numpy as jnp
+
+    from .fusedgls import augment, fused_block_gls_jnp
+
+    X, r, winv = pad_append_rows(X, r, winv)
+    aug = augment(X, r, winv).astype(jnp.float32)
+    return fused_block_gls_jnp(aug, aug.shape[0])[0].astype(jnp.float64)
+
+
+def delta_gram(X, r, winv, precision="f64", interpret=False):
+    """Dispatch the appended-rows Gram delta.
+
+    ``precision="f64"`` always takes the jnp reference (the parity
+    tier); ``"mixed"`` takes the Pallas tile on TPU (or anywhere
+    under ``interpret=True``) and the f32 jnp emulation elsewhere.
+    """
+    if precision == "mixed":
+        if _tpu_backend() or interpret:
+            try:
+                return delta_gram_pallas(X, r, winv,
+                                         interpret=interpret)
+            except Exception as exc:  # mosaic/version quirks
+                note_pallas_fallback("incremental.delta_gram", exc)
+        return delta_gram_f32_jnp(X, r, winv)
+    return delta_gram_jnp(X, r, winv)
+
+
+def _chol_update_impl(L, V):
+    import jax
+    import jax.numpy as jnp
+
+    K = L.shape[0]
+    idx = jnp.arange(K)
+
+    def rank1(L, v):
+        def body(j, carry):
+            L, v = carry
+            ljj = L[j, j]
+            vj = v[j]
+            rad = jnp.sqrt(ljj * ljj + vj * vj)
+            c = rad / ljj
+            s = vj / ljj
+            below = idx > j
+            col = L[:, j]
+            newcol = jnp.where(below, (col + s * v) / c, col)
+            newcol = newcol.at[j].set(rad)
+            L = L.at[:, j].set(newcol)
+            v = jnp.where(below, c * v - s * newcol, v)
+            return L, v
+
+        L, _ = jax.lax.fori_loop(0, K, body, (L, v))
+        return L, None
+
+    L, _ = jax.lax.scan(rank1, L, V.T)
+    return L
+
+
+# module-level jit handle: chol_update sits on the per-append hot
+# path, and tracing through the scan-of-fori control flow costs
+# ~100 ms per call — orders of magnitude more than the O(r K^2)
+# update itself. A single cached jit (trace keyed on the stable
+# module-level impl + shapes) makes repeat appends pay only the
+# compiled kernel.
+_chol_update_jit = None
+
+
+def chol_update(L, V):
+    """Rank-r Cholesky update: returns ``L'`` with
+    ``L' L'^T = L L^T + V V^T`` via r sequential rank-1 updates
+    (Givens-style, Golub & Van Loan sec. 12.5). ``L`` (K, K) lower
+    triangular, ``V`` (K, r). O(r K^2); never touches the N rows."""
+    global _chol_update_jit
+    import jax
+    import jax.numpy as jnp
+
+    if _chol_update_jit is None:
+        _chol_update_jit = jax.jit(_chol_update_impl)
+    return _chol_update_jit(jnp.asarray(L), jnp.asarray(V))
+
+
+def _chol_solve(L, b):
+    """Two triangular solves through the cached factor."""
+    import jax.scipy.linalg as jsl
+
+    y = jsl.solve_triangular(L, b, lower=True)
+    return jsl.solve_triangular(L.T, y, lower=False)
+
+
+class IncrementalNormal:
+    """Cached GLS normal state ``(A0, b, rNr, L)`` under row appends.
+
+    ``A0`` is the accumulated design Gram WITHOUT the prior diagonal;
+    ``q`` holds the prior weights and ``diag(q^2)`` is applied once,
+    at factor/solve time. Keeping the prior out of the accumulator is
+    what preserves bit-identity with the from-scratch fold: the
+    incremental path then computes ``(fold(base) + d1 + d2) +
+    diag(q^2)`` — the exact addition sequence the scratch path
+    performs — instead of ``(fold(base) + diag(q^2)) + d1 + d2``.
+
+    ``L`` is the lower Cholesky factor of the full normal matrix,
+    refreshed per append by the rank-r update with a
+    condition-triggered full refactor. The exact accumulators are
+    always carried alongside the factor, so a refactor (or the eigh
+    fallback in :meth:`solve`) never loses information — the factor
+    is an accelerator, not the truth.
+    """
+
+    def __init__(self, A0, b, rNr, q=None):
+        import jax.numpy as jnp
+
+        self.A0 = jnp.asarray(A0, jnp.float64)
+        self.b = jnp.asarray(b, jnp.float64)
+        self.rNr = jnp.asarray(rNr, jnp.float64)
+        k = self.A0.shape[0]
+        if q is None:
+            q = jnp.zeros(k, jnp.float64)
+        self.q = jnp.asarray(q, jnp.float64)
+        self.n_appended = 0
+        self.appends = 0
+        self.refactors = 0
+        self.L = self._refactor()
+
+    @property
+    def A(self):
+        """The full normal matrix (prior applied once, here)."""
+        import jax.numpy as jnp
+
+        return self.A0 + jnp.diag(self.q * self.q)
+
+    def _refactor(self):
+        import jax.numpy as jnp
+
+        return jnp.linalg.cholesky(self.A)
+
+    @staticmethod
+    def _diag_ratio(L):
+        import jax.numpy as jnp
+
+        d = jnp.abs(jnp.diag(L))
+        return float(jnp.min(d) / jnp.max(d))
+
+    def append(self, X, r, winv, precision="f64", interpret=False):
+        """Fold appended rows in: additive Gram delta on the exact
+        accumulators, rank-r update on the factor. Returns the
+        (K+2, K+2) Gram delta (callers reuse it for residual-delta
+        consumers, e.g. the GW lattice)."""
+        import jax.numpy as jnp
+
+        k = self.A0.shape[0]
+        G = delta_gram(X, r, winv, precision=precision,
+                       interpret=interpret)
+        self.A0 = self.A0 + G[:k, :k]
+        self.b = self.b + G[:k, k]
+        self.rNr = self.rNr + G[k, k]
+        if self.L is None:
+            # a previous append left no usable factor (eigh regime);
+            # try a fresh factorization of the exact updated A before
+            # giving up on the fast path again
+            L = self._refactor()
+            self.refactors += 1
+            if not bool(jnp.all(jnp.isfinite(L))):
+                L = None
+            self.L = L
+            self.n_appended += int(X.shape[0])
+            self.appends += 1
+            return G
+        before = self._diag_ratio(self.L)
+        # the factor update needs the whitened rows themselves, not
+        # the Gram: dA = V V^T with V the (K, r) whitened design
+        Xp, rp, wp = pad_append_rows(X, r, winv)
+        V = (jnp.asarray(Xp, jnp.float64) * wp[:, None]).T
+        L = chol_update(self.L, V)
+        after = self._diag_ratio(L)
+        degraded = (not jnp.all(jnp.isfinite(L))
+                    or after < _DIAG_DEGRADE * before)
+        if degraded:
+            L = self._refactor()
+            self.refactors += 1
+            if not bool(jnp.all(jnp.isfinite(L))):
+                # exact A' itself is not SPD-factorable — the eigh
+                # fallback in solve() owns this regime
+                L = None
+        self.L = L
+        self.n_appended += int(X.shape[0])
+        self.appends += 1
+        return G
+
+    def solve(self, threshold=1e-12, refine=2):
+        """Solve the accumulated normal equations.
+
+        Fast path: triangular solves through the updated factor plus
+        ``refine`` iterative-refinement sweeps against the exact
+        ``A`` (each contracts the error by ~eps * kappa, recovering
+        full f64 accuracy from the drifting factor). If the final
+        relative residual exceeds the acceptance tol — stale or
+        indefinite factor — fall back to ``fitter.gls_eigh_solve``
+        on the exact accumulators, the identical solver the
+        from-scratch f64 fit uses. Returns ``(dx, chi2, info)``.
+        """
+        import jax.numpy as jnp
+
+        from ..fitter import gls_eigh_solve
+
+        A = self.A
+        dx = None
+        relres = float("inf")
+        if self.L is not None:
+            dx = _chol_solve(self.L, self.b)
+            for _ in range(refine):
+                dx = dx + _chol_solve(self.L, self.b - A @ dx)
+            bnorm = float(jnp.linalg.norm(self.b))
+            resid = float(jnp.linalg.norm(self.b - A @ dx))
+            relres = resid / bnorm if bnorm > 0 else resid
+        solver = "chol_update"
+        if dx is None or not bool(jnp.all(jnp.isfinite(dx))) \
+                or not relres <= _RELRES_TOL:
+            dx, _ = gls_eigh_solve(A, self.b, threshold=threshold)
+            solver = "eigh_refresh"
+        chi2 = float(self.rNr) - float(self.b @ dx)
+        return dx, chi2, {"solver": solver, "relres": relres,
+                          "refactors": self.refactors,
+                          "appends": self.appends,
+                          "n_appended": self.n_appended}
+
+
+def block_grams(X, r, winv, block):
+    """(nb, K+2, K+2) fused per-block Grams over rows padded (with
+    winv=0) to a ``block`` multiple — the canonical partition both
+    the incremental base state and the from-scratch comparator fold
+    over, so their additions associate identically."""
+    from .fusedgls import augment, fused_block_gls_jnp
+
+    X, r, winv = pad_append_rows(X, r, winv, multiple=block)
+    return fused_block_gls_jnp(augment(X, r, winv), block)
+
+
+def fold_grams(grams):
+    """Sequential LEFT fold of per-block Grams. This is the single
+    accumulation-order authority for the bit-identity contract: a
+    left fold over ``[base blocks..., d1, d2, ...]`` performs the
+    exact addition sequence ``((fold(base) + d1) + d2) + ...`` that
+    per-append delta application performs, so a tree-shaped
+    ``jnp.sum`` (whose association depends on XLA's reduction
+    schedule) must never replace it."""
+    import jax
+
+    def add(acc, g):
+        return acc + g, None
+
+    G, _ = jax.lax.scan(add, grams[0], grams[1:])
+    return G
+
+
+def scratch_normal(chunks, block):
+    """From-scratch fused comparator over ``chunks`` — a list of
+    ``(X, r, winv)`` row groups: the base tile first, then one chunk
+    per append in arrival order. The base chunk streams through the
+    fused tile at ``block`` granularity; each append chunk is its
+    own sublane-padded block (exactly what :func:`delta_gram`
+    computed at append time). Returns ``(A0, b0, rNr)`` WITHOUT the
+    prior diagonal — callers add ``diag(q^2)`` themselves, matching
+    the incremental path's base state."""
+    import jax.numpy as jnp
+
+    base = chunks[0]
+    grams = [block_grams(*base, block=block)]
+    for X, r, winv in chunks[1:]:
+        X, r, winv = pad_append_rows(X, r, winv)
+        grams.append(block_grams(X, r, winv, block=X.shape[0]))
+    G = fold_grams(jnp.concatenate(grams, axis=0))
+    k = base[0].shape[1]
+    return G[:k, :k], G[:k, k], G[k, k]
+
+
+def build_normal(X, r, winv, q, block=1024):
+    """Build the cached :class:`IncrementalNormal` base state from a
+    full row set: fused per-block Grams, left-folded, prior diagonal
+    ``diag(q^2)`` added once. Appends then ride on
+    :meth:`IncrementalNormal.append`."""
+    G = fold_grams(block_grams(X, r, winv, block=block))
+    k = X.shape[1]
+    return IncrementalNormal(G[:k, :k], G[:k, k], G[k, k], q=q)
+
+
+def scratch_refit(chunks, q, block=1024, threshold=1e-12, refine=2):
+    """The full from-scratch refit the incremental path must be
+    bit-identical to: :func:`scratch_normal` over all chunks, prior
+    diagonal, the SAME deterministic solve. This is also what a
+    drift-triggered lane escalation runs."""
+    A0, b0, rNr = scratch_normal(chunks, block)
+    state = IncrementalNormal(A0, b0, rNr, q=q)
+    dx, chi2, info = state.solve(threshold=threshold, refine=refine)
+    return dx, chi2, state, info

@@ -2831,7 +2831,8 @@ class PTAFleet:
     def gw_stage(self, xs=None, method="auto", maxiter=3,
                  lattice_days=30.0, orf="hd", n_scrambles=0,
                  scramble_mode="sky", seed=0, precision="f64",
-                 block=256, positions=None, interpret=False, **kw):
+                 block=256, positions=None, interpret=False,
+                 lattice=None, **kw):
         """End-to-end GW detection stage over this fleet (the
         pint_tpu/gw/ pipeline): fit every bucket (skipped when the
         fitted per-pulsar vectors ``xs`` are supplied), assemble
@@ -2844,16 +2845,28 @@ class PTAFleet:
         required for store-rebuilt fleets whose template models carry
         no real coordinates. Returns the optimal-statistic dict
         (amp2 / snr / pair sweep stats) plus lattice shape and, when
-        scrambling, the ``null`` block with its p-value."""
+        scrambling, the ``null`` block with its p-value.
+
+        ``lattice`` short-circuits the fit/assemble/regrid front half
+        with a caller-held GWLattice — the streaming-refit consumer:
+        ``append_toas`` traffic keeps a lattice current through
+        ``gw.regrid_append`` (one O(r) row update per append, bitwise
+        what a full regrid of the final dataset would build) and the
+        pair sweep runs directly on it instead of re-fitting the
+        fleet and re-binning every pulsar."""
         from .. import gw
 
         with obs_trace.span("gw.stage", n_psr=self.n, orf=orf,
-                            n_scrambles=n_scrambles):
-            if xs is None:
-                xs, _, _ = self.fit(method=method, maxiter=maxiter,
-                                    **kw)
-            inputs = gw.assemble(self, xs, positions=positions)
-            lat = gw.regrid(inputs, lattice_days=lattice_days)
+                            n_scrambles=n_scrambles,
+                            incremental=lattice is not None):
+            if lattice is not None:
+                lat = lattice
+            else:
+                if xs is None:
+                    xs, _, _ = self.fit(method=method,
+                                        maxiter=maxiter, **kw)
+                inputs = gw.assemble(self, xs, positions=positions)
+                lat = gw.regrid(inputs, lattice_days=lattice_days)
             out = gw.optimal_statistic(lat, orf=orf,
                                        precision=precision,
                                        block=block,

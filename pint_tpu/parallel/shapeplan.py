@@ -48,8 +48,12 @@ DEFAULT_COMPILE_BUDGET = 4
 # bump); any on-disk cache of packed arrays (store/, bench
 # .bench_cache) must fold this into its content signature so a
 # geometry change invalidates cleanly instead of rebuilding batches
-# from stale layouts.
-PACK_GEOMETRY_VERSION = 2
+# from stale layouts. v3: the append-friendly store manifest revision
+# (ISSUE 20) — base entries may now carry delta column segments
+# chained beside them (store/deltas.py), so pre-delta entries written
+# under v2 must invalidate visibly rather than be silently reused as
+# if they were chain bases.
+PACK_GEOMETRY_VERSION = 3
 # below this, vector lanes go idle and per-program overhead dominates
 DEFAULT_MIN_WIDTH = 1024
 # candidate-pool size for the ladder search: subsets of <= budget

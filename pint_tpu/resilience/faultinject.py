@@ -45,9 +45,14 @@ Injection points (site locations in parentheses):
 - ``process_kill`` — the serving process dies by SIGKILL at a named
   durability site (:func:`fire_kill` calls placed in
   ``serve.engine`` / ``serve.frontdoor`` / ``serve.journal`` /
-  ``serve.excache`` / ``store.packstore`` — ``store_write`` kills
-  just before the pack-store's atomic publish; ``flusher_take``
-  kills the async engine's flusher worker right after it dequeues a
+  ``serve.excache`` / ``store.packstore`` / ``store.deltas`` —
+  ``store_write`` kills just before the pack-store's atomic
+  publish; ``append_delta_write`` kills just before a delta
+  segment's atomic publish (the append-TOA chain: recovery must
+  see the previous chain tip or the complete new segment, never a
+  torn delta, and journal replay of the ``append_toas`` request
+  re-derives the same chain exactly-once); ``flusher_take`` kills
+  the async engine's flusher worker right after it dequeues a
   request, the flusher-death leg of the kill matrix; payload ``at``
   pins one of :data:`KILL_SITES`, omitted means the first site
   reached). The process does not get to clean up — that is the
@@ -96,7 +101,7 @@ POINTS = ("toa_nan", "toa_inf_error", "compile_fail", "dispatch_slow",
 # request dequeued but nothing flushed or committed.
 KILL_SITES = ("intake_append", "pre_commit", "mid_commit",
               "post_commit", "excache_store", "store_write",
-              "flusher_take")
+              "append_delta_write", "flusher_take")
 
 # the device-level failure domain (ISSUE 6): points that model a chip
 # / lane dying, hanging, or straggling rather than a bad request —

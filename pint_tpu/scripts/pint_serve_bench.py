@@ -791,6 +791,62 @@ def _run_chaos_child(config):
         # (bucket, batch) executable the stream compiled
         return [req(f"probe-{tag}-{i}") for i in range(lanes)]
 
+    def append_fixture():
+        """Deterministic streaming-lane fixture shared by the
+        append_delta_write legs ACROSS processes: same par file, same
+        seeded TOAs in every child, so the lane key, base content
+        signature, and per-append delta chain signatures agree
+        between the reference, killed, and recovered runs."""
+        import numpy as np
+
+        from pint_tpu.models import get_model
+        from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+        rng = np.random.default_rng(seed + 17)
+        par = ("PSR KILL0\nRAJ 6:00:00.0\nDECJ 5:00:00.0\n"
+               "F0 173.6 1\nF1 -3e-16 1\nPEPOCH 55400\nDM 21.0 1\n")
+        lane_model = get_model(par)
+        base_toas = make_fake_toas_fromMJDs(
+            np.sort(rng.uniform(54800, 56000, 64)), lane_model,
+            error_us=1.0, freq_mhz=1400.0, obs="gbt", add_noise=True,
+            seed=seed + 17)
+        chunks = []
+        lo = 56000.0
+        for i in range(int(config.get("n_appends", 4))):
+            mj = np.sort(rng.uniform(lo, lo + 5.0, 8))
+            lo += 5.0
+            chunks.append(make_fake_toas_fromMJDs(
+                mj, lane_model, error_us=1.0, freq_mhz=1400.0,
+                obs="gbt", add_noise=True, seed=seed + 100 + i))
+        return lane_model, base_toas, chunks
+
+    if mode == "serve" and config.get("append_stream"):
+        # the append_delta_write legs: stream AppendToasRequests
+        # through a registered lane instead of fit flushes. The armed
+        # child SIGKILLs itself inside a delta write (after=1 lets the
+        # first append's segment publish, so the chain on disk holds
+        # a real committed prefix when the kill lands); the unarmed
+        # variant is the digest ground truth for replay.
+        from pint_tpu.serve import AppendToasRequest
+
+        eng, model, toas, _ = bringup()
+        lane_model, base_toas, chunks = append_fixture()
+        eng.register_append_lane(lane_model, base_toas)
+        results = [eng.submit(AppendToasRequest(lane_model, c))
+                   for c in chunks]
+        save_serve_state(eng)
+        eng.journal.close()
+        atomic_write_json(config["out"], {
+            "mode": mode,
+            "statuses": {r.request.request_id: r.status
+                         for r in results},
+            "digests": {r.request.request_id: result_digest(r.value)
+                        for r in results},
+            "deltas": (eng.deltas.scan()
+                       if eng.deltas is not None else None),
+        })
+        return 0
+
     if mode == "serve":
         eng, model, toas, _ = bringup()
         results = eng.run_stream([req() for _ in range(n_requests)])
@@ -819,6 +875,14 @@ def _run_chaos_child(config):
     # result; the preceding bring-up (reported separately) is where
     # the persisted-cache rehydrate overlaps, per bringup()'s note
     eng, model, toas, bringup_s = bringup()
+    if config.get("append_stream"):
+        # the lane MUST be registered before recover(): replayed
+        # append_toas intakes resolve their lane by key, and
+        # registration is also where the persisted delta chain (the
+        # committed prefix the dead process left) folds back into the
+        # fresh base state
+        lane_model, base_toas, _chunks = append_fixture()
+        eng.register_append_lane(lane_model, base_toas)
     t0 = obs_clock.now()
     cold_probe = eng.run_stream(probe_batch(f"cold-{site}"))
     cold_first_result_s = obs_clock.now() - t0
@@ -857,6 +921,14 @@ def _run_chaos_child(config):
         # scan proves the re-put entry verifies end to end
         store_rep = {"scan": eng.store.scan(),
                      "counters": eng.store.counters()}
+    deltas_rep = None
+    if config.get("append_stream") and eng.deltas is not None:
+        # scanned AFTER recovery replayed the pending appends: a torn
+        # delta segment from the killed writer would surface as
+        # corrupt_or_stale > 0, and the streaming counters witness the
+        # committed prefix actually replaying through registration
+        deltas_rep = {"scan": eng.deltas.scan(),
+                      "counters": eng.streaming.counters()}
     if isinstance(eng, AsyncServeEngine):
         eng.close()
     eng.journal.close()
@@ -887,6 +959,7 @@ def _run_chaos_child(config):
         "compiles": snap["executables_compiled"],
         "cache": snap["cache"],
         "store": store_rep,
+        "deltas": deltas_rep,
     })
     return 0
 
@@ -912,7 +985,15 @@ def run_kill_chaos(sites=None, ntoa=8192, lanes=4, maxiter=40,
     - no torn pack-store artifact: the ``store_write`` site kills just
       before the packed-TOA store's atomic publish during bring-up;
       the restarted process must see a clean miss (zero corrupt-CRC
-      loads), rebuild live, and re-publish a verifying entry.
+      loads), rebuild live, and re-publish a verifying entry;
+    - no torn delta segment: the ``append_delta_write`` site streams
+      ``append_toas`` requests through a registered streaming lane
+      and kills inside the SECOND append's delta write (the first
+      segment is a committed on-disk prefix). The restarted process
+      re-registers the lane (replaying the committed prefix), replays
+      the pending append exactly-once, its result digest matches the
+      fault-free append reference bitwise, and the delta scan shows
+      zero corrupt-or-stale segments (ISSUE 20 acceptance).
 
     Each leg is a real separate process (fork/exec via subprocess);
     the kill is a genuine ``os.kill(getpid(), SIGKILL)`` fired from
@@ -984,6 +1065,25 @@ def run_kill_chaos(sites=None, ntoa=8192, lanes=4, maxiter=40,
         return report
     ref_digests = ref["digests"]
 
+    # -- append reference leg: fault-free streaming-lane digests ----
+    append_ref = None
+    if "append_delta_write" in sites:
+        aref_out = os.path.join(workdir, "append-ref.json")
+        aref_cfg = dict(base, mode="serve", tag="append-ref",
+                        append_stream=True,
+                        durable_dir=os.path.join(workdir, "append-ref"),
+                        excache_dir=shared_excache,
+                        store_dir=os.path.join(workdir,
+                                               "append-store-ref"),
+                        out=aref_out)
+        aref_rc, aref_err = child(aref_cfg)
+        append_ref = load_out(aref_out)
+        report["append_reference_ok"] = bool(aref_rc == 0
+                                             and append_ref is not None)
+        if not report["append_reference_ok"]:
+            report["append_reference_rc"] = aref_rc
+            report["append_reference_stderr"] = aref_err
+
     totals = {"lost": 0, "duplicated": 0, "replayed": 0,
               "digest_mismatches": 0}
     ratios, colds, warms = [], [], []
@@ -1006,17 +1106,31 @@ def run_kill_chaos(sites=None, ntoa=8192, lanes=4, maxiter=40,
         else:
             exdir = shared_excache
             spec = f"process_kill:at={site},after=1"
-        sdir = (os.path.join(workdir, "store-private")
-                if site == "store_write" else None)
+        if site == "store_write":
+            sdir = os.path.join(workdir, "store-private")
+        elif site == "append_delta_write":
+            # kill and recover legs share the delta store: the
+            # committed chain prefix the dead writer left IS the
+            # artifact under test
+            sdir = os.path.join(workdir, "append-store")
+        else:
+            sdir = None
+        extra = ({"append_stream": True}
+                 if site == "append_delta_write" else {})
+        if site == "append_delta_write" and append_ref is None:
+            report["sites"][site] = {"ok": False,
+                                     "reason": "append_ref_missing"}
+            continue
         kill_cfg = dict(base, mode="serve", tag=f"kill-{site}",
                         site=site, durable_dir=ddir, excache_dir=exdir,
                         store_dir=sdir,
-                        out=os.path.join(workdir, f"kill-{site}.json"))
+                        out=os.path.join(workdir, f"kill-{site}.json"),
+                        **extra)
         kill_rc, kill_err = child(kill_cfg, env_faults=spec)
         rec_out = os.path.join(workdir, f"recover-{site}.json")
         rec_cfg = dict(base, mode="recover", tag=f"recover-{site}",
                        site=site, durable_dir=ddir, excache_dir=exdir,
-                       store_dir=sdir, out=rec_out)
+                       store_dir=sdir, out=rec_out, **extra)
         rec_rc, rec_err = child(rec_cfg)
         rec = load_out(rec_out)
         entry = {"kill_rc": kill_rc, "recover_rc": rec_rc,
@@ -1025,10 +1139,12 @@ def run_kill_chaos(sites=None, ntoa=8192, lanes=4, maxiter=40,
             entry.update(ok=False, recover_stderr=rec_err)
             report["sites"][site] = entry
             continue
+        digest_truth = (append_ref["digests"]
+                        if site == "append_delta_write" else ref_digests)
         mismatches = [
             rid for rid, c in rec["committed"].items()
             if c["status"] == "ok"
-            and c["digest"] != ref_digests.get(rid)]
+            and c["digest"] != digest_truth.get(rid)]
         warm_cache = site != "excache_store"
         store_ok = True
         if site == "store_write":
@@ -1046,6 +1162,23 @@ def run_kill_chaos(sites=None, ntoa=8192, lanes=4, maxiter=40,
                             and cnt.get("corrupt") == 0
                             and cnt.get("puts", 0) >= 1)
             entry["store_ok"] = store_ok
+        if site == "append_delta_write":
+            drep = rec.get("deltas") or {}
+            dscan = drep.get("scan") or {}
+            dcnt = drep.get("counters") or {}
+            entry["delta_scan"] = dscan
+            entry["streaming_counters"] = dcnt
+            # torn-delta contract: the kill inside the second delta
+            # write left no corrupt/stale segment behind; the chain
+            # after recovery holds the committed prefix PLUS the
+            # replayed append (>= 2 valid segments), and registration
+            # demonstrably replayed the committed prefix rather than
+            # re-deriving it
+            store_ok = store_ok and bool(
+                dscan.get("corrupt_or_stale") == 0
+                and dscan.get("valid", 0) >= 2
+                and dcnt.get("replayed", 0) >= 1)
+            entry["delta_ok"] = store_ok
         ratio = rec["cold_first_result_s"] / max(rec["warm_refit_s"],
                                                  1e-9)
         entry.update(

@@ -29,8 +29,10 @@ from .journal import RequestJournal
 from .metrics import ServeTelemetry, percentile
 from .recovery import (restore_serve_state, result_digest,
                        save_serve_state)
-from .request import (FitRequest, PhasePredictRequest, ResidualRequest,
+from .request import (AppendToasRequest, FitRequest,
+                      PhasePredictRequest, ResidualRequest,
                       ServeResult, TimingRequest)
+from .streaming import StreamingRefitter
 
 __all__ = [
     "ServeEngine", "AsyncServeEngine", "IntakeQueue",
@@ -40,5 +42,6 @@ __all__ = [
     "PersistentExecutableCache", "RequestJournal", "save_serve_state",
     "restore_serve_state", "result_digest",
     "percentile", "pow2_bucket", "TimingRequest", "FitRequest",
-    "ResidualRequest", "PhasePredictRequest", "ServeResult",
+    "ResidualRequest", "PhasePredictRequest", "AppendToasRequest",
+    "ServeResult", "StreamingRefitter",
 ]

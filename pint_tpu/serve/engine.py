@@ -53,6 +53,7 @@ from .excache import ExecutableCache, PersistentExecutableCache
 from .journal import RequestJournal
 from .metrics import ServeTelemetry
 from .request import ServeResult, ensure_request_counter_above
+from .streaming import lane_key as streaming_lane_key
 
 
 class ServeEngine:
@@ -127,6 +128,25 @@ class ServeEngine:
 
             self.store = PackStore(store_dir)
             self.store.prewarm()
+        # append-delta store (store.DeltaStore): delta column segments
+        # for streaming append lanes live BESIDE the pack store (a
+        # subdirectory, so PackStore's *.ptp scan never sees them) —
+        # an append persists a small chained segment instead of
+        # rewriting the multi-hundred-MB base entry
+        if store_dir is None:
+            self.deltas = None
+        else:
+            from ..store import DeltaStore
+
+            self.deltas = DeltaStore(os.path.join(store_dir, "deltas"))
+        # streaming refit lanes (serve.streaming): registered per
+        # pulsar via register_append_lane, consumed by the "append"
+        # request kind. Works without a durable dir (lanes just aren't
+        # crash-persistent then).
+        from .streaming import StreamingRefitter
+
+        self.streaming = StreamingRefitter(deltas=self.deltas,
+                                           clock=clock, mesh=mesh)
         self.telemetry = ServeTelemetry()
         self.oversize_toas = oversize_toas
         self.mesh = mesh
@@ -473,6 +493,22 @@ class ServeEngine:
                 detail["injected_point"] = injected["point"]
             self._reject(request, res, "nonfinite_input", routing[0],
                          **detail)
+            return None
+        if routing[0] == "append":
+            # streaming appends execute immediately (never batched —
+            # see AppendToasRequest) with the spill path's durability
+            # contract: intake journaled and synced BEFORE the work
+            # runs, so a crash mid-append replays it exactly-once
+            # against the lane's delta chain
+            self.telemetry.incr("appends")
+            if self.journal is not None:
+                if not self.journal.has_intake(request.request_id):
+                    self.journal.record_intake(request)
+                self.journal.sync()
+            self._execute_append(request, res, routing, now,
+                                 trace=trace)
+            if self.journal is not None:
+                self.journal.sync()
             return None
         if policy.is_oversize(len(request.toas), self.oversize_toas):
             self.telemetry.incr("spilled_oversize")
@@ -1271,4 +1307,73 @@ class ServeEngine:
         self.health.note_request("ok")
         self._lc(request, "delivered", t=done, queue_wait_s=0.0,
                  execute_s=execute_s, spilled=True)
+        self._commit(request, res)
+
+    def register_append_lane(self, model, toas, precision="f64",
+                             sentinel=None, prewarm=True):
+        """Register one streaming append lane (serve.streaming) for
+        ``model`` over its base TOA table.
+
+        With a delta store, the lane's persisted chain is prewarm-
+        staged in the background FIRST, so the disk verify overlaps
+        the lane's registration compile; the chain is then replayed
+        into the fresh state — a recovered process must call this for
+        each lane BEFORE :meth:`recover`, so replayed ``append_toas``
+        intakes find their lane. Returns the lane key."""
+        if self.deltas is not None and prewarm:
+            from .streaming import StreamingRefitter as _SR
+
+            sig = _SR._base_signature(model, toas)
+            self.deltas.prewarm([(streaming_lane_key(model), sig)])
+        return self.streaming.register(model, toas,
+                                       precision=precision,
+                                       sentinel=sentinel)
+
+    def _execute_append(self, request, res, routing, submitted_at,
+                        trace=None):
+        """Execute one streaming append: fold the request's TOAs into
+        its registered lane (delta persisted before visibility),
+        solve from the updated cached factor. Escalations (drift
+        alarm, solver divergence, correlated-noise lanes) complete
+        the request with a full-refit value and are counted — the
+        lane is quarantined and rebuilt, not the request rejected."""
+        kind = routing[0]
+        t0 = self.clock()
+        self._lc(request, "executing", t=t0)
+        try:
+            value = self.streaming.append(request.model, request.toas,
+                                          rid=request.request_id)
+        except KeyError:
+            self._reject(request, res, "lane_unregistered", kind,
+                         lane=streaming_lane_key(request.model))
+            return
+        except Exception as e:
+            self._fail([(request, res, submitted_at, trace)], kind, e)
+            return
+        execute_s = self.clock() - t0
+        if value.get("escalated"):
+            self.telemetry.incr("append_escalated")
+            if value.get("escalation_reason") == "solver_diverge":
+                self.telemetry.incr("quarantined")
+        if value.get("replayed"):
+            self.telemetry.incr("append_replayed")
+        res.status = "ok"
+        res.value = value
+        done = self.clock()
+        rec = {"request_id": request.request_id, "kind": kind,
+               "status": "ok", "reason": None, "queue_wait_s": 0.0,
+               "pack_s": 0.0, "compile_s": None,
+               "execute_s": execute_s,
+               "total_s": done - submitted_at,
+               "lanes": 1, "bucket": None, "cold": False,
+               "degraded": bool(value.get("escalated")),
+               "spilled": False,
+               "tenant": getattr(request, "tenant", "anon"),
+               "trace": trace}
+        res.telemetry = rec
+        self.telemetry.record(**rec)
+        self.health.note_request("ok")
+        self._lc(request, "delivered", t=done, queue_wait_s=0.0,
+                 execute_s=execute_s,
+                 escalated=bool(value.get("escalated")))
         self._commit(request, res)

@@ -42,6 +42,13 @@ def resolve(request):
     kind = request.kind
     if kind in ("resid", "phase"):
         return kind, None, None, "f64"
+    if kind == "append":
+        # streaming appends never share a batched slot (the math is
+        # per-lane; see AppendToasRequest) but still resolve here so
+        # the slot key stays total over request kinds
+        precision = request.precision
+        check_precision(precision)
+        return kind, None, None, precision
     if kind != "fit":
         raise ValueError(f"unknown request kind {kind!r}")
     method = getattr(request, "method", "auto")

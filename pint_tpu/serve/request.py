@@ -94,6 +94,30 @@ class PhasePredictRequest(TimingRequest):
 
 
 @dataclass
+class AppendToasRequest(TimingRequest):
+    """Fold appended TOAs into a registered streaming lane
+    (serve.streaming.StreamingRefitter) instead of refitting from
+    scratch.
+
+    ``toas`` carries ONLY the new rows; the lane holds the base
+    dataset and its cached normal state, so execution costs one
+    additive Gram delta + rank-r factor update + small solve — the
+    incremental tier's latency budget is far below a refit. The lane
+    must have been registered (ServeEngine.register_append_lane)
+    before the first append; appends on stale lanes escalate to a
+    full refit via the drift sentinel / divergence policy.
+
+    Appends bypass the micro-batcher: each is journaled at intake
+    (WAL before visibility) and executed immediately, because the
+    lane's delta chain orders appends per pulsar — batching appends
+    across pulsars would add latency without saving any device work
+    (the math is per-lane, there is no shared executable to warm).
+    """
+
+    kind = "append"
+
+
+@dataclass
 class ServeResult:
     """Mutable handle returned by ServeEngine.submit; filled in when
     the request's slot flushes (or immediately on shed/spill/error).

@@ -1,0 +1,464 @@
+"""Streaming refits: cached per-lane GLS state under TOA appends.
+
+Observatories upload a handful of new TOAs per pulsar per epoch; the
+serve path must fold them in WITHOUT paying a full O(N K^2)
+repack-and-refit. This module owns that pipeline. Each registered
+lane (one pulsar) freezes a linearization — the model's free
+parameters, the column normalization of the whitened design — and
+caches the fused-tile normal state (kernels/incremental.py). An
+``append_toas`` request then costs: evaluate the new rows' design /
+residual / weight columns at the frozen linearization (a small
+padded batch, one warm executable shape per lane), one additive
+(K+2)x(K+2) Gram delta, a rank-r Cholesky factor update, and a K x K
+solve — microseconds to milliseconds against the seconds of a
+670k-row refit.
+
+Parity is the contract that makes the shortcut safe: the lane folds
+its accumulators through the same sequential left-fold block
+partition a from-scratch pass uses, so after ANY append sequence the
+incremental normal state is bitwise identical to rebuilding from the
+concatenated rows, and escalation (below) reproduces a fresh
+registration on the final dataset exactly (tests/test_incremental.py
+pins both).
+
+Escalation — when the lane goes stale, the incremental shortcut is
+surrendered, never stretched:
+
+- drift sentinels (obs/drift.py EWMA+CUSUM) watch the standardized
+  mean whitened residual of each appended batch; an alarm marks the
+  model stale and triggers a full refit over the merged dataset;
+- a ``solver_diverge`` fault (or a genuinely non-finite incremental
+  solve) quarantines the incremental result and escalates the same
+  way;
+- models with correlated-noise (basis_weight) components never get
+  an incremental lane: their red-noise Fourier basis columns are a
+  function of the batch's full time span, so appended rows cannot be
+  evaluated against a frozen basis — every append on such a lane is
+  a full refit by policy (the documented fallback tier).
+
+Durability: each append is persisted as a content-chained delta
+segment (store/deltas.py) BEFORE its result is visible, keyed by the
+journaled request id, so crash replay of an ``append_toas`` request
+re-derives the identical chain exactly-once instead of forking or
+double-applying. On registration a lane replays any persisted chain
+into its state, which is how a recovered process resumes append
+traffic without re-running host prep for the already-appended rows.
+"""
+
+from __future__ import annotations
+
+import threading
+import warnings
+
+import numpy as np
+
+from ..obs import drift as obs_drift
+from ..resilience import faultinject
+from . import policy
+
+__all__ = ["StreamingRefitter", "lane_key", "APPEND_PAD",
+           "BASE_BLOCK"]
+
+# every append chunk is padded to this many TOAs (winv=0 beyond the
+# real rows, which whiten to nothing) so a lane compiles exactly one
+# chunk-evaluation shape — the "<= 64 appended TOAs per pulsar"
+# traffic profile of the acceptance criteria
+APPEND_PAD = 64
+
+# base rows stream through the fused tile at this block granularity —
+# the left-fold partition the bit-identity contract folds over
+BASE_BLOCK = 1024
+
+# normalized-space ridge prior on every lane column. Real pulsar
+# normal matrices are near-singular (cond ~1e16 even column-
+# normalized: F0/F1/offset are nearly collinear over a finite span),
+# which is why the batch GLS path solves by THRESHOLDED eigh. The
+# incremental fast path needs a Cholesky-factorable A, so lanes carry
+# diag(q^2)=1e-12 — damping only directions the eigh floor
+# (fitter.GLS_EIG_FLOOR=3e-14) would truncate anyway. The parity
+# contract is unaffected: the from-scratch comparator (a fresh
+# registration / escalation) carries the identical ridge.
+LANE_RIDGE = 1e-6
+
+# one-step GLS re-linearization sweeps at registration, so the frozen
+# x0 appends linearize against is a CONVERGED solution (residuals at
+# noise level, drift statistics meaningful) rather than the raw
+# par-file values
+REGISTER_ITERS = 2
+
+# iterative-refinement sweeps per lane solve: each contracts the
+# factor-solve error by ~eps*kappa, and the ridged kappa can reach
+# ~1e12, so four sweeps are needed to pull relres under the 1e-12
+# acceptance tol (each is a KxK triangular solve — microseconds)
+SOLVE_REFINE = 4
+
+
+def lane_key(model):
+    """Stable lane id: the PSR name when the model has one (the
+    PTABatch._pulsar_labels convention), the object id otherwise."""
+    psr = getattr(model, "PSR", None)
+    val = getattr(psr, "value", None) if psr is not None else None
+    return str(val) if val else f"model-{id(model):x}"
+
+
+def _pad_len(n, multiple):
+    return max(multiple, -(-n // multiple) * multiple)
+
+
+class StreamingLane:
+    """One pulsar's cached incremental state. Internal: every field
+    is mutated under the owning refitter's ``_lock``."""
+
+    def __init__(self, key, model, toas, precision, incremental):
+        self.key = key
+        self.model = model
+        self.base_toas = toas
+        self.chunks = []  # appended TOA tables, arrival order
+        self.precision = precision
+        self.incremental = incremental
+        self.x = None  # frozen linearization (free-parameter vector)
+        self.norm = None  # frozen column normalization
+        self.free_names = None
+        self.state = None  # kernels.incremental.IncrementalNormal
+        self.base_signature = None
+        self.tip = None  # delta-chain tip signature
+        self.rows_fn = None  # warm chunk evaluator (one jit per lane)
+        self.sentinel = None
+        self.stale = False
+        self.escalations = 0
+        self.n_appended = 0
+
+
+class StreamingRefitter:
+    """Registry + math of the serve engine's append lanes.
+
+    Thread-safe: the sync engine's submitters and the async front
+    door's flusher execute appends concurrently with bring-up
+    registration — all lane state is mutated under ``_lock``. The
+    optional ``deltas`` store (store/deltas.py) persists each append
+    before its result is visible; ``clock`` follows the owning
+    engine's (monotonic) clock."""
+
+    def __init__(self, deltas=None, clock=None, mesh=None):
+        import time
+
+        self._lock = threading.RLock()
+        self.lanes = {}
+        self.deltas = deltas
+        self.clock = clock or time.monotonic
+        self.mesh = mesh
+        self.appends = 0
+        self.escalated = 0
+        self.replayed = 0
+
+    # -- lane math ----------------------------------------------------
+
+    @staticmethod
+    def _make_rows_fn(pta):
+        """One jitted evaluator per lane: (X_raw, r, winv) rows of a
+        single-pulsar batch at a frozen free-parameter vector. Reused
+        across that lane's append chunks (same padded shape, same
+        template/static closure), so only the first append pays the
+        trace+compile."""
+        import jax
+        import jax.numpy as jnp
+
+        resid_fn = pta._resid_fn()
+        phase_fn = pta._phase_fn()
+
+        def rows(xv, params, batch, prep):
+            p = pta._overlay(params, xv)
+            r, sig = resid_fn(p, batch, prep)
+
+            def phase_of(z):
+                return phase_fn(pta._overlay(params, z), batch, prep)
+
+            M = jax.jacfwd(phase_of)(xv) / p["F"][0]
+            M = jnp.concatenate(
+                [jnp.ones((M.shape[0], 1), M.dtype), M], axis=1)
+            return M, r, 1.0 / (sig * 1e-6)
+
+        return jax.jit(jax.vmap(rows))
+
+    def _eval_rows(self, lane, toas, pad):
+        """Evaluate one TOA table's rows at the lane's frozen
+        linearization; padded rows come back with winv=0."""
+        import jax.numpy as jnp
+
+        from ..parallel.pta import PTABatch
+
+        pta = PTABatch([lane.model], [toas], mesh=self.mesh,
+                       pad_toas=pad)
+        if lane.x is None:
+            lane.x = np.asarray(pta._x0())[0]
+            lane.free_names = [n for n, _, _ in pta.free_map()]
+        fn = lane.rows_fn
+        if fn is None:
+            fn = lane.rows_fn = self._make_rows_fn(pta)
+        try:
+            M, r, winv = fn(jnp.asarray(lane.x)[None, :], pta.params,
+                            pta.batch, pta.prep)
+        except Exception:
+            # a chunk batch whose tree structure drifted from the
+            # cached evaluator's (e.g. a different prep tier): rebuild
+            # the evaluator against this batch rather than fail the
+            # append
+            fn = lane.rows_fn = self._make_rows_fn(pta)
+            M, r, winv = fn(jnp.asarray(lane.x)[None, :], pta.params,
+                            pta.batch, pta.prep)
+        M = np.asarray(M[0], np.float64)
+        r = np.asarray(r[0], np.float64)
+        winv = np.asarray(winv[0], np.float64)
+        # zero the padded rows OUTRIGHT (not just their weights): a
+        # padded row whose design/residual evaluated non-finite would
+        # otherwise poison the Gram through 0 * nan
+        valid = np.arange(M.shape[0]) < int(pta.n_toas[0])
+        M = np.where(valid[:, None], M, 0.0)
+        r = np.where(valid, r, 0.0)
+        winv = np.where(valid, winv, 0.0)
+        return M, r, winv
+
+    def _build_state(self, lane):
+        """(Re)build the lane's cached normal state from its base
+        rows: frozen normalization from the base whitened design,
+        left-folded fused Grams, ridge prior (see LANE_RIDGE)."""
+        from ..fitter import column_norms
+        from ..kernels import incremental as inc
+
+        pad = _pad_len(len(lane.base_toas), APPEND_PAD)
+        M, r, winv = self._eval_rows(lane, lane.base_toas, pad)
+        norm = np.asarray(column_norms(M * winv[:, None]), np.float64)
+        lane.norm = norm
+        X = M / norm[None, :]
+        lane.state = inc.build_normal(
+            X, r, winv, q=np.full(X.shape[1], LANE_RIDGE),
+            block=min(BASE_BLOCK, _pad_len(X.shape[0], 8)))
+        lane.n_appended = 0
+
+    def _linearize(self, lane, iters=REGISTER_ITERS):
+        """Converge the lane's frozen linearization: ``iters``
+        one-step GLS sweeps (build state at x, solve, move x), then a
+        final state build at the converged x. Registration AND
+        escalation both run exactly this loop — the bit-identity
+        contract between an escalated lane and a fresh registration
+        on the merged dataset is a consequence."""
+        for _ in range(int(iters)):
+            self._build_state(lane)
+            x, _, _ = self._solve(lane)
+            if not np.all(np.isfinite(x)):
+                break  # keep the last finite linearization point
+            lane.x = np.asarray(x, np.float64)
+        self._build_state(lane)
+
+    def _chunk_arrays(self, lane, toas):
+        """The persisted/foldable arrays for one append chunk."""
+        M, r, winv = self._eval_rows(lane, toas, APPEND_PAD)
+        X = M / lane.norm[None, :]
+        return {"X": X, "r": r, "winv": winv}
+
+    def _solve(self, lane):
+        dxn, chi2, info = lane.state.solve(refine=SOLVE_REFINE)
+        dx_all = np.asarray(dxn) / lane.norm
+        x = lane.x - dx_all[1:]
+        return x, chi2, info
+
+    # -- public API ---------------------------------------------------
+
+    def register(self, model, toas, precision="f64", sentinel=None):
+        """Register one lane: freeze the linearization, build the
+        cached normal state, replay any persisted delta chain into
+        it. Correlated-noise models register as NON-incremental lanes
+        (every append escalates to a full refit by policy). Returns
+        the lane key."""
+        key = lane_key(model)
+        incremental = not policy.has_correlated_noise(model)
+        with self._lock:
+            lane = StreamingLane(key, model, toas, precision,
+                                 incremental)
+            lane.sentinel = sentinel or obs_drift.DriftSentinel()
+            if incremental:
+                self._linearize(lane)
+                lane.base_signature = self._base_signature(model, toas)
+                lane.tip = lane.base_signature
+                self._replay_chain(lane)
+            self.lanes[key] = lane
+        return key
+
+    @staticmethod
+    def _base_signature(model, toas):
+        from ..store.packstore import content_signature
+
+        return content_signature([model], [toas], lane="stream")
+
+    def _replay_chain(self, lane):
+        """Fold a recovered process's persisted delta chain back into
+        the freshly built base state — appends survive restarts
+        without re-running host prep for the already-appended rows."""
+        if self.deltas is None:
+            return
+        chain = self.deltas.load_chain(lane.key, lane.base_signature)
+        for chain_sig, arrays in chain:
+            lane.state.append(arrays["X"], arrays["r"],
+                              arrays["winv"],
+                              precision=lane.precision)
+            lane.n_appended += int(np.count_nonzero(arrays["winv"]))
+            lane.tip = chain_sig
+            with self._lock:
+                self.replayed += 1
+
+    def lane(self, model):
+        with self._lock:
+            return self.lanes.get(lane_key(model))
+
+    def append(self, model, toas, rid=""):
+        """Fold one appended TOA table into the model's lane.
+
+        Returns the result payload dict (params at the refreshed
+        solve, chi2, solver/escalation provenance). Raises KeyError
+        for an unregistered lane — the engine maps that to a
+        structured error so the journaled request still commits
+        exactly-once."""
+        with self._lock:
+            key = lane_key(model)
+            lane = self.lanes.get(key)
+            if lane is None:
+                raise KeyError(f"no streaming lane registered for "
+                               f"{key!r}")
+            self.appends += 1
+            if not lane.incremental:
+                # correlated-noise fallback tier: every append is a
+                # full refit (documented in ERRORBUDGET / the serving
+                # tutorial)
+                lane.chunks.append(toas)
+                return self._full_refit(lane, reason="correlated_noise")
+            arrays = self._chunk_arrays(lane, toas)
+            replayed = False
+            if self.deltas is not None:
+                # durable BEFORE visible: the chain link lands (or is
+                # recognized as already landed — crash replay) before
+                # any result is computed from it
+                tip, replayed = self.deltas.append(
+                    lane.key, lane.tip, arrays, rid=rid)
+                lane.tip = tip
+            if replayed:
+                self.replayed += 1
+            else:
+                lane.chunks.append(toas)
+                lane.state.append(arrays["X"], arrays["r"],
+                                  arrays["winv"],
+                                  precision=lane.precision)
+                lane.n_appended += int(
+                    np.count_nonzero(arrays["winv"]))
+            fault = faultinject.fire("solver_diverge", lane=key,
+                                     path="incremental")
+            x, chi2, info = self._solve(lane)
+            diverged = (fault is not None
+                        or not np.all(np.isfinite(x)))
+            alarm = None
+            if not diverged:
+                stat = self._drift_stat(arrays)
+                alarm = lane.sentinel.observe(stat)
+            if diverged or alarm is not None:
+                reason = ("solver_diverge" if diverged
+                          else "drift_alarm")
+                lane.stale = True
+                return self._escalate(lane, reason=reason,
+                                      alarm=alarm)
+            return {"x": x, "chi2": chi2,
+                    "free_names": list(lane.free_names),
+                    "solver": info["solver"],
+                    "relres": info["relres"],
+                    "refactors": info["refactors"],
+                    "escalated": False, "replayed": replayed,
+                    "chain": lane.tip,
+                    "n_appended": lane.n_appended}
+
+    @staticmethod
+    def _drift_stat(arrays):
+        """Standardized mean whitened residual of one appended batch
+        — ~N(0,1) while the frozen model still describes the new
+        TOAs, drifting away as the model goes stale. The drift
+        sentinel's EWMA+CUSUM watches this series."""
+        z = arrays["r"] * arrays["winv"]
+        n = max(1, int(np.count_nonzero(arrays["winv"])))
+        return float(np.sum(z) / np.sqrt(n))
+
+    def _escalate(self, lane, reason, alarm=None):
+        """Full refit over the merged dataset: rebuild the lane
+        exactly as a fresh registration on base+appended TOAs would —
+        the bit-identity contract — then solve. The incremental
+        shortcut is surrendered, never stretched past its trust
+        region."""
+        with self._lock:
+            self.escalated += 1
+        lane.escalations += 1
+        warnings.warn(
+            f"streaming lane {lane.key!r} escalated to full refit "
+            f"({reason}); incremental state rebuilt from the merged "
+            f"dataset")
+        self._rebuild(lane)
+        x, chi2, info = self._solve(lane)
+        lane.stale = False
+        return {"x": x, "chi2": chi2,
+                "free_names": list(lane.free_names),
+                "solver": "full_refit", "relres": info["relres"],
+                "refactors": info["refactors"], "escalated": True,
+                "escalation_reason": reason,
+                "drift_alarm": alarm, "replayed": False,
+                "chain": lane.tip, "n_appended": 0}
+
+    def _rebuild(self, lane):
+        """Merge base + appended TOA tables into a new base and
+        rebuild the cached state from scratch (identical code path to
+        a fresh registration on the final dataset). When the appended
+        tables are not in-process (post-restart lanes rebuilt from
+        the delta chain), the exact accumulators are already the
+        from-scratch values — the refactor in _build_state's stead is
+        a full eigh-refresh of the cached factor."""
+        from ..toa import merge_TOAs
+
+        if lane.chunks:
+            merged = merge_TOAs([lane.base_toas] + list(lane.chunks))
+            lane.base_toas = merged
+            lane.chunks = []
+            lane.rows_fn = None  # base shape changed
+            lane.x = None  # re-linearize from the model params, as a
+            lane.norm = None  # fresh registration would
+            self._linearize(lane)
+            lane.base_signature = self._base_signature(lane.model,
+                                                       merged)
+            lane.tip = lane.base_signature
+        else:
+            # chain-recovered lane: accumulators are exact; refresh
+            # the factorization from them
+            lane.state.L = lane.state._refactor()
+            lane.state.refactors += 1
+
+    def _full_refit(self, lane, reason):
+        """The non-incremental (correlated-noise) tier: a straight
+        PTABatch GLS refit over the merged dataset."""
+        from ..parallel.pta import PTABatch
+        from ..toa import merge_TOAs
+
+        with self._lock:
+            self.escalated += 1
+        lane.escalations += 1
+        merged = merge_TOAs([lane.base_toas] + list(lane.chunks))
+        pad = _pad_len(len(merged), APPEND_PAD)
+        pta = PTABatch([lane.model], [merged], mesh=self.mesh,
+                       pad_toas=pad)
+        x, chi2, cov = pta.gls_fit(maxiter=2,
+                                   precision=lane.precision)
+        return {"x": np.asarray(x)[0],
+                "chi2": float(np.asarray(chi2)[0]),
+                "free_names": [n for n, _, _ in pta.free_map()],
+                "solver": "full_refit",
+                "escalated": True, "escalation_reason": reason,
+                "replayed": False, "chain": None,
+                "n_appended": 0}
+
+    def counters(self):
+        with self._lock:
+            return {"lanes": len(self.lanes), "appends": self.appends,
+                    "escalated": self.escalated,
+                    "replayed": self.replayed}

@@ -9,6 +9,12 @@ failure-handling contract. Public surface::
     fleet = PTAFleet(models, toas_list, toa_bucket="plan", store=store)
 """
 
+from .deltas import (  # noqa: F401
+    DeltaStore,
+    chain_signature,
+    DELTA_MAGIC,
+    DELTA_FORMAT_VERSION,
+)
 from .packstore import (  # noqa: F401
     PackStore,
     content_signature,
@@ -20,4 +26,6 @@ from .packstore import (  # noqa: F401
 __all__ = [
     "PackStore", "content_signature", "store_identity",
     "STORE_MAGIC", "STORE_FORMAT_VERSION",
+    "DeltaStore", "chain_signature", "DELTA_MAGIC",
+    "DELTA_FORMAT_VERSION",
 ]

@@ -1,0 +1,468 @@
+"""Incremental streaming refits (ISSUE 20): kernel bit-identity,
+delta-chain durability, and the serve append path.
+
+The load-bearing contract is parity by CONSTRUCTION: the incremental
+normal state folds the same block Grams through the same sequential
+left fold as the from-scratch comparator, so accumulators — and the
+parameters solved from them — are bitwise identical, not merely
+close. Escalation (drift alarm / solver divergence) must likewise be
+bitwise what a fresh registration on the merged dataset produces.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from pint_tpu.kernels import incremental as inc
+
+
+def _rows(rng, n, k):
+    X = rng.standard_normal((n, k))
+    r = rng.standard_normal(n) * 1e-6
+    winv = rng.uniform(0.5, 2.0, n) * 1e6
+    return X, r, winv
+
+
+def _chunks(seed=0, n_base=3000, k=6, appends=(5, 64, 17)):
+    rng = np.random.default_rng(seed)
+    out = [_rows(rng, n_base, k)]
+    out.extend(_rows(rng, n, k) for n in appends)
+    return out
+
+
+# -- kernel bit-identity ------------------------------------------------
+
+
+def test_incremental_accumulators_bitwise_vs_scratch():
+    chunks = _chunks()
+    k = chunks[0][0].shape[1]
+    q = np.full(k, 1e-6)
+    state = inc.build_normal(*chunks[0], q=q)
+    for X, r, winv in chunks[1:]:
+        state.append(X, r, winv)
+    A0, b0, rNr = inc.scratch_normal(chunks, block=1024)
+    assert np.array_equal(np.asarray(state.A0), np.asarray(A0))
+    assert np.array_equal(np.asarray(state.b), np.asarray(b0))
+    assert np.array_equal(np.asarray(state.rNr), np.asarray(rNr))
+
+
+def test_incremental_parity_budget_on_clone_append():
+    """The bench parity scenario and its acceptance gate: a state
+    cloned from persisted accumulators (L=None) appends one block
+    and must match the scratch refit within the floored relative
+    parity budget (incremental_parity_max_rel <= 1e-15)."""
+    chunks = _chunks(seed=3, appends=(64,))
+    k = chunks[0][0].shape[1]
+    q = np.full(k, 1e-6)
+    base = inc.build_normal(*chunks[0], q=q)
+    state = inc.IncrementalNormal(base.A0, base.b, base.rNr, q=base.q)
+    state.append(*chunks[1])
+    dx_i, chi2_i, info_i = state.solve()
+    dx_s, chi2_s, _st, info_s = inc.scratch_refit(chunks, q=q)
+    assert info_i["solver"] == info_s["solver"]
+    ref = np.asarray(dx_s)
+    den = np.maximum(
+        np.abs(ref),
+        np.finfo(np.float64).eps * max(float(np.max(np.abs(ref))),
+                                       1e-300))
+    assert np.max(np.abs(np.asarray(dx_i) - ref) / den) <= 1e-15
+    assert abs(chi2_i - chi2_s) <= 1e-12 * max(abs(chi2_s), 1e-300)
+
+
+def test_incremental_multi_append_parity_vs_scratch_refit():
+    """Chained chol_update appends solve through the rank-r-updated
+    factor, which may differ from a fresh factor by ULPs in the
+    smallest components — gate on the norm-scaled parity budget."""
+    chunks = _chunks(seed=3)
+    k = chunks[0][0].shape[1]
+    q = np.full(k, 1e-6)
+    state = inc.build_normal(*chunks[0], q=q)
+    for X, r, winv in chunks[1:]:
+        state.append(X, r, winv)
+    dx_i, chi2_i, info_i = state.solve()
+    dx_s, chi2_s, _st, info_s = inc.scratch_refit(chunks, q=q)
+    assert info_i["solver"] == info_s["solver"]
+    ref = np.asarray(dx_s)
+    scale = max(float(np.max(np.abs(ref))), 1e-300)
+    assert np.max(np.abs(np.asarray(dx_i) - ref)) <= 1e-15 * scale
+    assert abs(chi2_i - chi2_s) <= 1e-12 * max(abs(chi2_s), 1e-300)
+
+
+def test_chol_update_factorizes_the_updated_normal():
+    rng = np.random.default_rng(7)
+    chunks = _chunks(seed=7, appends=(32,))
+    k = chunks[0][0].shape[1]
+    state = inc.build_normal(*chunks[0], q=np.full(k, 1e-3))
+    X, r, winv = chunks[1]
+    state.append(X, r, winv)
+    L = np.asarray(state.L)
+    A = np.asarray(state.A)
+    assert np.allclose(L @ L.T, A, rtol=1e-10, atol=1e-10 * np.abs(A).max())
+    dx, _, info = state.solve()
+    assert info["solver"] == "chol_update"
+    assert info["relres"] <= 1e-12
+    ref = np.linalg.solve(A, np.asarray(state.b))
+    den = np.maximum(np.abs(ref), 1e-30)
+    assert np.max(np.abs(np.asarray(dx) - ref) / den) < 1e-9
+    del rng
+
+
+def test_append_survives_unfactorable_normal():
+    """An indefinite A (no Cholesky factor exists) must route
+    through the eigh fallback, and the NEXT append must not crash
+    on the absent factor (the L-None guard re-attempts a fresh
+    refactor instead of rank-updating nothing)."""
+    rng = np.random.default_rng(11)
+    k = 6
+    state = inc.IncrementalNormal(-np.eye(k), np.ones(k), 1.0,
+                                  q=np.zeros(k))
+    Xa, ra, wa = _rows(rng, 8, k)
+    # a small append keeps A indefinite: chol stays impossible
+    state.append(Xa * 1e-8, ra, wa * 1e-12)
+    dx, chi2, info = state.solve()
+    assert info["solver"] == "eigh_refresh"
+    assert np.all(np.isfinite(np.asarray(dx)))
+    state.append(Xa * 1e-8, ra, wa * 1e-12)  # must not raise on L=None
+    dx2, _, info2 = state.solve()
+    assert info2["solver"] == "eigh_refresh"
+    assert np.all(np.isfinite(np.asarray(dx2)))
+
+
+def test_delta_gram_pallas_interpret_matches_f64(pallas_interpret):
+    X, r, winv = _rows(np.random.default_rng(5), 24, 6)
+    ref = np.asarray(inc.delta_gram(X, r, winv, precision="f64"))
+    got = np.asarray(inc.delta_gram(X, r, winv, precision="mixed",
+                                    interpret=pallas_interpret))
+    scale = max(np.abs(ref).max(), 1.0)
+    assert np.allclose(got, ref, atol=5e-5 * scale)
+
+
+# -- delta store --------------------------------------------------------
+
+
+def _arrays(rng, n=16, k=5):
+    X, r, winv = _rows(rng, n, k)
+    return {"X": X, "r": r, "winv": winv}
+
+
+def test_delta_chain_roundtrip_and_replay(tmp_path):
+    from pint_tpu.store import DeltaStore
+
+    ds = DeltaStore(tmp_path)
+    rng = np.random.default_rng(0)
+    base = "base-sig"
+    a1, a2 = _arrays(rng), _arrays(rng)
+    tip1, rep1 = ds.append("J0000+0000", base, a1, rid="req-0")
+    assert not rep1
+    tip2, rep2 = ds.append("J0000+0000", tip1, a2, rid="req-1")
+    assert not rep2 and tip2 != tip1
+    # crash replay of the newest link: same rid + payload -> no new
+    # segment, existing tip returned
+    tip2b, rep2b = ds.append("J0000+0000", tip1, a2, rid="req-1")
+    assert rep2b and tip2b == tip2
+    assert ds.counters()["replays"] == 1
+    chain = ds.load_chain("J0000+0000", base)
+    assert [sig for sig, _ in chain] == [tip1, tip2]
+    for (_, got), want in zip(chain, (a1, a2)):
+        for name in ("X", "r", "winv"):
+            assert np.array_equal(got[name], want[name])
+    assert ds.scan() == {"segments": 2, "valid": 2,
+                         "corrupt_or_stale": 0,
+                         "bytes": ds.scan()["bytes"]}
+
+
+def test_delta_append_rejects_diverged_parent(tmp_path):
+    from pint_tpu.store import DeltaStore
+
+    ds = DeltaStore(tmp_path)
+    rng = np.random.default_rng(1)
+    tip, _ = ds.append("J1", "base", _arrays(rng), rid="r0")
+    with pytest.raises(ValueError, match="chain"):
+        ds.append("J1", "not-the-tip", _arrays(rng), rid="r1")
+
+
+def test_delta_chain_invalidates_corrupt_suffix(tmp_path):
+    from pint_tpu.store import DeltaStore
+
+    ds = DeltaStore(tmp_path)
+    rng = np.random.default_rng(2)
+    tip1, _ = ds.append("J2", "base", _arrays(rng), rid="r0")
+    tip2, _ = ds.append("J2", tip1, _arrays(rng), rid="r1")
+    tip3, _ = ds.append("J2", tip2, _arrays(rng), rid="r2")
+    paths = ds._chain_paths("J2")
+    with open(paths[1], "r+b") as fh:  # corrupt the middle segment
+        fh.seek(40)
+        fh.write(b"\xff\xff\xff\xff")
+    with pytest.warns(UserWarning, match="delta chain broken"):
+        chain = ds.load_chain("J2", "base")
+    # verified prefix only; the corrupt segment AND its successor die
+    assert [sig for sig, _ in chain] == [tip1]
+    assert ds.scan()["segments"] == 1
+
+
+def test_delta_prewarm_stages_verified_chain(tmp_path):
+    from pint_tpu.store import DeltaStore
+
+    ds = DeltaStore(tmp_path)
+    rng = np.random.default_rng(3)
+    tip, _ = ds.append("J3", "base", _arrays(rng), rid="r0")
+    t = ds.prewarm([("J3", "base")], background=True)
+    if t is not None:
+        t.join(timeout=30)
+    chain = ds.load_chain("J3", "base")
+    assert [sig for sig, _ in chain] == [tip]
+    assert ds.counters()["prewarm_hits"] == 1
+
+
+# -- streaming lanes ----------------------------------------------------
+
+
+_PAR = """\
+PSR TSTR0
+RAJ 11:00:00.0
+DECJ 8:00:00.0
+F0 289.5 1
+F1 -3.2e-16 1
+PEPOCH 55500
+DM 15.0 1
+"""
+
+
+def _lane_fixture(seed=0, n_base=48, chunk_sizes=(6, 8)):
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    rng = np.random.default_rng(seed)
+    model = get_model(_PAR)
+    base = make_fake_toas_fromMJDs(
+        np.sort(rng.uniform(54800, 56000, n_base)), model,
+        error_us=1.0, freq_mhz=1400.0, obs="gbt", add_noise=True,
+        seed=seed)
+    chunks, lo = [], 56000.0
+    for i, n in enumerate(chunk_sizes):
+        mj = np.sort(rng.uniform(lo, lo + 10.0, n))
+        lo += 10.0
+        chunks.append(make_fake_toas_fromMJDs(
+            mj, model, error_us=1.0, freq_mhz=1400.0, obs="gbt",
+            add_noise=True, seed=seed + 100 + i))
+    return model, base, chunks
+
+
+def _merge(base, chunks):
+    from pint_tpu.toa import merge_TOAs
+
+    return merge_TOAs([base] + list(chunks))
+
+
+def test_streaming_rounds_then_escalation_bitwise_vs_fresh():
+    """R rounds of incremental appends, then a drift-triggered full
+    refit: the escalated lane must be bitwise what a FRESH
+    registration on the merged dataset produces (the escalation
+    bit-identity contract)."""
+    from pint_tpu.obs.drift import DriftSentinel
+    from pint_tpu.serve.streaming import StreamingRefitter
+
+    model, base, chunks = _lane_fixture(seed=4, chunk_sizes=(6, 8, 5))
+    # min_n=1, tiny trip: the LAST append's drift statistic always
+    # alarms, forcing the escalation path deterministically
+    sr = StreamingRefitter()
+    sr.register(model, base,
+                sentinel=DriftSentinel(min_n=1, z_trip=1e-12))
+    results = [sr.append(model, c, rid=f"r{i}")
+               for i, c in enumerate(chunks)]
+    final = results[-1]
+    assert final["escalated"] and final["solver"] == "full_refit"
+    assert sr.counters()["escalated"] >= 1
+
+    model2, base2, chunks2 = _lane_fixture(seed=4,
+                                           chunk_sizes=(6, 8, 5))
+    fresh = StreamingRefitter()
+    fresh.register(model2, _merge(base2, chunks2))
+    lane_f = fresh.lane(model2)
+    x_f, chi2_f, _ = fresh._solve(lane_f)
+    assert np.array_equal(final["x"], x_f)
+    assert final["chi2"] == chi2_f
+
+
+def test_streaming_solver_diverge_quarantines_and_escalates():
+    """An injected solver_diverge mid-append must complete the
+    request with a full-refit value — bitwise the fresh-registration
+    result — never propagate the quarantined incremental solve."""
+    from pint_tpu.resilience import faultinject
+    from pint_tpu.serve.streaming import StreamingRefitter
+
+    model, base, chunks = _lane_fixture(seed=9, chunk_sizes=(7,))
+    sr = StreamingRefitter()
+    sr.register(model, base)
+    with faultinject.inject("solver_diverge"):
+        with pytest.warns(UserWarning, match="escalated"):
+            out = sr.append(model, chunks[0], rid="r0")
+    assert out["escalated"]
+    assert out["escalation_reason"] == "solver_diverge"
+
+    model2, base2, chunks2 = _lane_fixture(seed=9, chunk_sizes=(7,))
+    fresh = StreamingRefitter()
+    fresh.register(model2, _merge(base2, chunks2))
+    x_f, chi2_f, _ = fresh._solve(fresh.lane(model2))
+    assert np.array_equal(out["x"], x_f)
+    assert out["chi2"] == chi2_f
+
+
+def test_streaming_incremental_stays_on_fast_path():
+    from pint_tpu.serve.streaming import StreamingRefitter
+
+    model, base, chunks = _lane_fixture(seed=6)
+    sr = StreamingRefitter()
+    sr.register(model, base)
+    for i, c in enumerate(chunks):
+        out = sr.append(model, c, rid=f"r{i}")
+        assert not out["escalated"]
+        assert out["solver"] in ("chol_update", "eigh_refresh")
+        assert np.all(np.isfinite(out["x"]))
+    assert sr.counters() == {"lanes": 1, "appends": len(chunks),
+                             "escalated": 0, "replayed": 0}
+
+
+def test_streaming_unregistered_lane_raises_keyerror():
+    from pint_tpu.serve.streaming import StreamingRefitter
+
+    model, base, chunks = _lane_fixture(seed=5, chunk_sizes=(4,))
+    with pytest.raises(KeyError, match="no streaming lane"):
+        StreamingRefitter().append(model, chunks[0], rid="r0")
+
+
+def test_streaming_chain_replay_bitwise_across_restart(tmp_path):
+    """Process-restart durability: a second refitter over the same
+    delta store re-registers the lane, replays the persisted chain,
+    and solves to bitwise the first process's answer."""
+    from pint_tpu.store import DeltaStore
+    from pint_tpu.serve.streaming import StreamingRefitter
+
+    model, base, chunks = _lane_fixture(seed=8)
+    sr1 = StreamingRefitter(deltas=DeltaStore(tmp_path))
+    sr1.register(model, base)
+    out1 = None
+    for i, c in enumerate(chunks):
+        out1 = sr1.append(model, c, rid=f"r{i}")
+
+    model2, base2, _ = _lane_fixture(seed=8)
+    sr2 = StreamingRefitter(deltas=DeltaStore(tmp_path))
+    sr2.register(model2, base2)
+    assert sr2.counters()["replayed"] == len(chunks)
+    lane2 = sr2.lane(model2)
+    assert lane2.tip == out1["chain"]
+    x2, chi2_2, _ = sr2._solve(lane2)
+    assert np.array_equal(out1["x"], x2)
+    assert out1["chi2"] == chi2_2
+
+
+# -- serve engine integration ------------------------------------------
+
+
+def test_engine_append_requests_end_to_end(tmp_path):
+    from pint_tpu.serve import AppendToasRequest, ServeEngine
+
+    model, base, chunks = _lane_fixture(seed=10)
+    eng = ServeEngine(durable_dir=os.fspath(tmp_path))
+    eng.register_append_lane(model, base)
+    for i, c in enumerate(chunks):
+        res = eng.submit(AppendToasRequest(model, c))
+        assert res.status == "ok"
+        assert res.telemetry["kind"] == "append"
+        assert np.all(np.isfinite(res.value["x"]))
+    snap = eng.snapshot()
+    assert snap["counters"].get("appends") == len(chunks)
+    # second engine over the same durable dir: nothing pending (all
+    # committed), chain replays into the re-registered lane
+    eng.journal.close()
+    eng2 = ServeEngine(durable_dir=os.fspath(tmp_path))
+    model2, base2, _ = _lane_fixture(seed=10)
+    eng2.register_append_lane(model2, base2)
+    rep = eng2.recover()
+    assert rep["n_replayed"] == 0
+    assert eng2.streaming.counters()["replayed"] == len(chunks)
+    assert eng2.deltas.scan()["corrupt_or_stale"] == 0
+    eng2.journal.close()
+
+
+def test_engine_append_unregistered_lane_rejected(tmp_path):
+    from pint_tpu.serve import AppendToasRequest, ServeEngine
+
+    model, base, chunks = _lane_fixture(seed=12, chunk_sizes=(4,))
+    eng = ServeEngine(durable_dir=os.fspath(tmp_path))
+    res = eng.submit(AppendToasRequest(model, chunks[0]))
+    assert res.status == "rejected"
+    assert res.reason == "lane_unregistered"
+    eng.journal.close()
+
+
+def test_engine_recovers_pending_append_exactly_once(tmp_path):
+    """A journaled-but-uncommitted append (the crash window between
+    intake sync and commit) must replay on recover() and land the
+    same chain the live path would have."""
+    from pint_tpu.serve import AppendToasRequest, ServeEngine
+
+    model, base, chunks = _lane_fixture(seed=13, chunk_sizes=(5, 5))
+    eng = ServeEngine(durable_dir=os.fspath(tmp_path))
+    eng.register_append_lane(model, base)
+    live = eng.submit(AppendToasRequest(model, chunks[0]))
+    assert live.status == "ok"
+    # simulate the crash: journal the second append's intake without
+    # executing it, as the dead process's WAL would have
+    pending = AppendToasRequest(model, chunks[1])
+    eng.journal.record_intake(pending)
+    eng.journal.sync()
+    eng.journal.close()
+
+    model2, base2, _ = _lane_fixture(seed=13, chunk_sizes=(5, 5))
+    eng2 = ServeEngine(durable_dir=os.fspath(tmp_path))
+    eng2.register_append_lane(model2, base2)
+    rep = eng2.recover()
+    assert rep["n_replayed"] == 1
+    (rid, res), = rep["replayed"].items()
+    assert rid == pending.request_id and res.status == "ok"
+    assert eng2.deltas.scan() ["valid"] == 2
+    # idempotent: a second recover finds everything committed
+    rep2 = eng2.recover()
+    assert rep2["n_replayed"] == 0
+    eng2.journal.close()
+
+
+# -- GW lattice incremental consumer -----------------------------------
+
+
+def test_regrid_append_bitwise_vs_full_regrid():
+    from pint_tpu.gw.residuals import GWInputs, regrid, regrid_append
+
+    rng = np.random.default_rng(14)
+    labels = ["A", "B"]
+    pos = np.eye(3)[:2]
+    times = [np.sort(rng.uniform(54000, 55000, 40)) for _ in range(2)]
+    resid = [rng.standard_normal(40) * 1e-7 for _ in range(2)]
+    weights = [rng.uniform(1e12, 2e12, 40) for _ in range(2)]
+    base = regrid(GWInputs(labels, pos, times, resid, weights),
+                  lattice_days=30.0)
+    # appended epochs past the window: the lattice must GROW
+    t_new = np.sort(rng.uniform(55000, 55400, 12))
+    r_new = rng.standard_normal(12) * 1e-7
+    w_new = rng.uniform(1e12, 2e12, 12)
+    grown = regrid_append(base, "B", t_new, r_new, w_new)
+    assert grown.n_cells > base.n_cells
+
+    full = regrid(GWInputs(
+        labels, pos,
+        [times[0], np.concatenate([times[1], t_new])],
+        [resid[0], np.concatenate([resid[1], r_new])],
+        [weights[0], np.concatenate([weights[1], w_new])]),
+        lattice_days=30.0,
+        t0=float(base.t_cells[0] - 15.0),
+        t1=float(grown.t_cells[-1] - 15.0))
+    assert np.array_equal(grown.w, full.w)
+    assert np.array_equal(grown.u, full.u)
+    assert np.array_equal(grown.z, full.z)
+
+    with pytest.raises(ValueError, match="forward in time"):
+        regrid_append(base, "A", [53000.0], [0.0], [1.0])
+    with pytest.raises(KeyError):
+        regrid_append(base, "NOPE", [], [], [])
