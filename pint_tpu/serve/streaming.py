@@ -43,6 +43,13 @@ re-derives the identical chain exactly-once instead of forking or
 double-applying. On registration a lane replays any persisted chain
 into its state, which is how a recovered process resumes append
 traffic without re-running host prep for the already-appended rows.
+An escalation that merges in-process chunks into a new base re-roots
+the chain (DeltaStore.reset_lane): the old segments could never
+verify against the merged base signature, and left behind they would
+wedge every later append on the parent-divergence guard. Lanes that
+escalated after a chain replay keep their chain instead — their
+accumulators are refreshed in place, because the replayed rows exist
+only as accumulators and a merge would silently drop them.
 """
 
 from __future__ import annotations
@@ -106,10 +113,16 @@ def _pad_len(n, multiple):
 
 
 class StreamingLane:
-    """One pulsar's cached incremental state. Internal: every field
-    is mutated under the owning refitter's ``_lock``."""
+    """One pulsar's cached incremental state. Internal: after the lane
+    is published in the refitter's registry, every field is mutated
+    under the lane's own ``_lock`` (pintlint LOCKED_CLASSES;
+    registration mutates the not-yet-published lane unlocked from the
+    constructing thread). Per-lane locking is what lets appends on
+    independent lanes run concurrently — one lane's multi-second
+    escalation must not stall another lane's microsecond append."""
 
     def __init__(self, key, model, toas, precision, incremental):
+        self._lock = threading.RLock()
         self.key = key
         self.model = model
         self.base_toas = toas
@@ -127,6 +140,10 @@ class StreamingLane:
         self.stale = False
         self.escalations = 0
         self.n_appended = 0
+        # segments folded in from the persisted chain at registration:
+        # rows the lane holds only as accumulators, with no TOA table
+        # to rebuild from (see _rebuild's escalation policy)
+        self.replayed_segments = 0
 
 
 class StreamingRefitter:
@@ -134,10 +151,15 @@ class StreamingRefitter:
 
     Thread-safe: the sync engine's submitters and the async front
     door's flusher execute appends concurrently with bring-up
-    registration — all lane state is mutated under ``_lock``. The
-    optional ``deltas`` store (store/deltas.py) persists each append
-    before its result is visible; ``clock`` follows the owning
-    engine's (monotonic) clock."""
+    registration. ``_lock`` covers only the lane REGISTRY and the
+    refitter counters; each lane's math and delta IO runs under the
+    lane's own lock (StreamingLane._lock), so appends on independent
+    lanes proceed concurrently. Lock ordering is one-way —
+    StreamingLane._lock -> {StreamingRefitter._lock, DeltaStore._lock}
+    — and nothing acquires a lane lock while holding the refitter
+    lock. The optional ``deltas`` store (store/deltas.py) persists
+    each append before its result is visible; ``clock`` follows the
+    owning engine's (monotonic) clock."""
 
     def __init__(self, deltas=None, clock=None, mesh=None):
         import time
@@ -272,15 +294,17 @@ class StreamingRefitter:
         the lane key."""
         key = lane_key(model)
         incremental = not policy.has_correlated_noise(model)
+        lane = StreamingLane(key, model, toas, precision, incremental)
+        lane.sentinel = sentinel or obs_drift.DriftSentinel()
+        # build before publication: the lane is invisible until the
+        # registry insert, so the registration compile and chain
+        # replay never stall append traffic on OTHER lanes
+        if incremental:
+            self._linearize(lane)
+            lane.base_signature = self._base_signature(model, toas)
+            lane.tip = lane.base_signature
+            self._replay_chain(lane)
         with self._lock:
-            lane = StreamingLane(key, model, toas, precision,
-                                 incremental)
-            lane.sentinel = sentinel or obs_drift.DriftSentinel()
-            if incremental:
-                self._linearize(lane)
-                lane.base_signature = self._base_signature(model, toas)
-                lane.tip = lane.base_signature
-                self._replay_chain(lane)
             self.lanes[key] = lane
         return key
 
@@ -303,6 +327,7 @@ class StreamingRefitter:
                               precision=lane.precision)
             lane.n_appended += int(np.count_nonzero(arrays["winv"]))
             lane.tip = chain_sig
+            lane.replayed_segments += 1
             with self._lock:
                 self.replayed += 1
 
@@ -317,14 +342,21 @@ class StreamingRefitter:
         solve, chi2, solver/escalation provenance). Raises KeyError
         for an unregistered lane — the engine maps that to a
         structured error so the journaled request still commits
-        exactly-once."""
+        exactly-once.
+
+        The refitter lock covers only the registry lookup and the
+        append counter; the per-lane work — row evaluation, delta
+        publish, solve, even a full-refit escalation — runs under the
+        lane's own lock, so appends on unrelated lanes never queue
+        behind it."""
+        key = lane_key(model)
         with self._lock:
-            key = lane_key(model)
             lane = self.lanes.get(key)
             if lane is None:
                 raise KeyError(f"no streaming lane registered for "
                                f"{key!r}")
             self.appends += 1
+        with lane._lock:
             if not lane.incremental:
                 # correlated-noise fallback tier: every append is a
                 # full refit (documented in ERRORBUDGET / the serving
@@ -341,7 +373,8 @@ class StreamingRefitter:
                     lane.key, lane.tip, arrays, rid=rid)
                 lane.tip = tip
             if replayed:
-                self.replayed += 1
+                with self._lock:
+                    self.replayed += 1
             else:
                 lane.chunks.append(toas)
                 lane.state.append(arrays["X"], arrays["r"],
@@ -405,19 +438,30 @@ class StreamingRefitter:
                 "refactors": info["refactors"], "escalated": True,
                 "escalation_reason": reason,
                 "drift_alarm": alarm, "replayed": False,
-                "chain": lane.tip, "n_appended": 0}
+                "chain": lane.tip, "n_appended": lane.n_appended}
 
     def _rebuild(self, lane):
         """Merge base + appended TOA tables into a new base and
         rebuild the cached state from scratch (identical code path to
-        a fresh registration on the final dataset). When the appended
-        tables are not in-process (post-restart lanes rebuilt from
-        the delta chain), the exact accumulators are already the
-        from-scratch values — the refactor in _build_state's stead is
-        a full eigh-refresh of the cached factor."""
+        a fresh registration on the final dataset). The persisted
+        delta chain is re-rooted in the same stroke: the old segments
+        are rooted at the surrendered base signature, so left on disk
+        they would diverge from the merged lane's tip and permanently
+        fail the parent guard on the very next append — reset_lane
+        deletes them visibly and the next append starts a fresh chain
+        at the merged base's signature.
+
+        When any appended rows are not in-process as TOA tables —
+        post-restart lanes whose chain replay folded accumulators the
+        lane cannot re-evaluate — merging only ``lane.chunks`` would
+        silently DROP the replayed rows from the rebuilt state. Such
+        lanes (and chunk-less ones) keep their exact accumulators and
+        their on-disk chain; the refactor in _build_state's stead is
+        a full eigh-refresh of the cached factor (the documented
+        no-relinearization tier for recovered lanes)."""
         from ..toa import merge_TOAs
 
-        if lane.chunks:
+        if lane.chunks and not lane.replayed_segments:
             merged = merge_TOAs([lane.base_toas] + list(lane.chunks))
             lane.base_toas = merged
             lane.chunks = []
@@ -425,12 +469,14 @@ class StreamingRefitter:
             lane.x = None  # re-linearize from the model params, as a
             lane.norm = None  # fresh registration would
             self._linearize(lane)
+            if self.deltas is not None:
+                self.deltas.reset_lane(lane.key)
             lane.base_signature = self._base_signature(lane.model,
                                                        merged)
             lane.tip = lane.base_signature
         else:
             # chain-recovered lane: accumulators are exact; refresh
-            # the factorization from them
+            # the factorization from them (chain and tip stay valid)
             lane.state.L = lane.state._refactor()
             lane.state.refactors += 1
 

@@ -152,24 +152,36 @@ class DeltaStore:
         kill site fires before the atomic publish, so a crash there
         leaves the previous tip."""
         paths = self._chain_paths(lane)
-        seq = len(paths)
-        tip = None
-        if paths:
+        last = tip = None
+        while paths:
             entry = self._load_verified(paths[-1])
             if entry is not None:
                 last, _ = entry
-                if last["chain"] == chain_signature(
-                        last["parent"], arrays, last.get("rid", "")) \
-                        and last.get("rid", "") == str(rid):
-                    with self._lock:
-                        self.replays += 1
-                    return last["chain"], True
                 tip = last["chain"]
-        if seq and tip is not None and parent != tip:
-            # the caller's view of the chain has diverged from disk
-            raise ValueError(
-                f"append parent {parent!r} is not the lane chain "
-                f"tip {tip!r}")
+                break
+            # the newest persisted segment is unreadable: a segment
+            # published after it could never verify (its on-disk
+            # predecessor is broken), so load_chain would later delete
+            # the COMMITTED new link silently — post-commit data loss.
+            # Treat it as the broken chain it is: invalidate the
+            # unreadable tip visibly and chain to the newest verified
+            # predecessor instead.
+            self._invalidate_from(paths, len(paths) - 1,
+                                  "unreadable chain tip")
+            paths.pop()
+        seq = len(paths)
+        if last is not None:
+            if last["chain"] == chain_signature(
+                    last["parent"], arrays, last.get("rid", "")) \
+                    and last.get("rid", "") == str(rid):
+                with self._lock:
+                    self.replays += 1
+                return last["chain"], True
+            if parent != tip:
+                # the caller's view of the chain has diverged from disk
+                raise ValueError(
+                    f"append parent {parent!r} is not the lane chain "
+                    f"tip {tip!r}")
         chain = chain_signature(parent, arrays, rid)
         blob = self._encode(lane, seq, parent, chain, arrays, rid)
         path = self._path(lane, seq, chain)
@@ -214,6 +226,29 @@ class DeltaStore:
             parts.append(arr.tobytes())
             pos = d["offset"] + d["nbytes"]
         return b"".join(parts)
+
+    def reset_lane(self, lane):
+        """Drop every persisted segment for ``lane`` — the escalation
+        re-root. A full refit merges the appended rows into a NEW base
+        (new content signature, new linearization), so the old chain —
+        rooted at the surrendered base signature — can never verify
+        against the rebuilt lane; left on disk it would wedge every
+        subsequent append on the parent-divergence guard. Deletion is
+        visible (the standard broken-chain warning), any prewarm
+        staging for the lane is discarded, and the next append roots a
+        fresh chain at the merged base's signature. Restart durability
+        for the merged rows then rests on the caller re-registering the
+        lane over its current dataset (the journal replays only
+        uncommitted appends)."""
+        paths = self._chain_paths(lane)
+        if paths:
+            self._invalidate_from(
+                paths, 0, "lane escalated to a full refit; chain "
+                "re-rooted at the merged base")
+        digest = self._lane_digest(lane)
+        with self._lock:
+            for key in [k for k in self._prewarmed if k[0] == digest]:
+                del self._prewarmed[key]
 
     # -- read path ----------------------------------------------------
 
@@ -280,10 +315,11 @@ class DeltaStore:
             parent = manifest["chain"]
         return out
 
-    def _load_verified(self, path):
+    def _load_verified(self, path, count=True):
         """One segment: magic, manifest CRC, identity, column CRCs.
         Returns (manifest, {name: array}) or None (counted corrupt /
-        stale; the chain walker owns deletion)."""
+        stale unless ``count=False``; the chain walker owns
+        deletion)."""
         try:
             with open(path, "rb") as fh:
                 raw = fh.read()
@@ -291,22 +327,22 @@ class DeltaStore:
             return None
         head = len(DELTA_MAGIC) + _DELTA_HEADER.size
         if len(raw) < head or raw[:len(DELTA_MAGIC)] != DELTA_MAGIC:
-            self._note_bad("corrupt")
+            self._note_bad("corrupt", count)
             return None
         mlen, mcrc = _DELTA_HEADER.unpack(raw[len(DELTA_MAGIC):head])
         mjson = raw[head:head + mlen]
         if len(mjson) != mlen or zlib.crc32(mjson) != mcrc:
-            self._note_bad("corrupt")
+            self._note_bad("corrupt", count)
             return None
         try:
             manifest = json.loads(mjson)
         except ValueError:
-            self._note_bad("corrupt")
+            self._note_bad("corrupt", count)
             return None
         ident = dict(store_identity(),
                      delta_format=DELTA_FORMAT_VERSION)
         if manifest.get("identity") != ident:
-            self._note_bad("stale")
+            self._note_bad("stale", count)
             return None
         base = _align_up(head + mlen)
         arrays = {}
@@ -315,14 +351,16 @@ class DeltaStore:
             col = raw[lo:lo + d["nbytes"]]
             if len(col) != d["nbytes"] or \
                     zlib.crc32(col) != d["crc32"]:
-                self._note_bad("corrupt")
+                self._note_bad("corrupt", count)
                 return None
             arrays[d["name"]] = np.frombuffer(
                 col, dtype=np.dtype(d["dtype"])
             ).reshape(d["shape"])
         return manifest, arrays
 
-    def _note_bad(self, kind):
+    def _note_bad(self, kind, count=True):
+        if not count:
+            return
         with self._lock:
             if kind == "stale":
                 self.stale += 1
@@ -385,9 +423,12 @@ class DeltaStore:
         """Classify every on-disk segment without staging or deleting:
         returns {"segments", "valid", "corrupt_or_stale", "bytes"}.
         The kill-chaos recover leg asserts ``corrupt_or_stale == 0``
-        — a SIGKILL mid-append must never leave a torn delta."""
+        — a SIGKILL mid-append must never leave a torn delta. Scan is
+        a health probe, not traffic: it counts locally (count=False)
+        and never touches the shared corrupt/stale counters, so
+        increments from a concurrent load_chain/prewarm survive a
+        scan running beside them."""
         segments = valid = bad = nbytes = 0
-        before = (self.corrupt, self.stale)
         try:
             names = [n for n in os.listdir(self.directory)
                      if n.endswith(".ptpd")]
@@ -400,14 +441,10 @@ class DeltaStore:
                 nbytes += os.path.getsize(path)
             except OSError:
                 pass
-            if self._load_verified(path) is not None:
+            if self._load_verified(path, count=False) is not None:
                 valid += 1
             else:
                 bad += 1
-        with self._lock:
-            # scan is a health probe, not traffic: undo its effect on
-            # the corruption counters so telemetry stays causal
-            self.corrupt, self.stale = before
         return {"segments": segments, "valid": valid,
                 "corrupt_or_stale": bad, "bytes": nbytes}
 

@@ -215,6 +215,61 @@ def test_delta_prewarm_stages_verified_chain(tmp_path):
     assert ds.counters()["prewarm_hits"] == 1
 
 
+def test_delta_append_over_unreadable_tip_invalidates_visibly(tmp_path):
+    """An unreadable newest segment must not let append publish a link
+    whose on-disk predecessor can never verify (load_chain would later
+    delete the committed link silently).  append invalidates the torn
+    tip loudly and chains onto the newest verified predecessor."""
+    from pint_tpu.store import DeltaStore
+
+    ds = DeltaStore(tmp_path)
+    rng = np.random.default_rng(21)
+    t1, _ = ds.append("J9", "base", _arrays(rng), rid="r0")
+    t2, _ = ds.append("J9", t1, _arrays(rng), rid="r1")
+    os.truncate(ds._chain_paths("J9")[-1], 6)  # tear the newest segment
+    a3 = _arrays(rng)
+    with pytest.warns(UserWarning, match="delta chain broken"):
+        t3, replay = ds.append("J9", t1, a3, rid="r2")
+    assert not replay and t3 not in (t1, t2)
+    # the published segment verifies end to end: nothing left for
+    # load_chain to silently drop
+    chain = ds.load_chain("J9", "base")
+    assert [sig for sig, _ in chain] == [t1, t3]
+    assert ds.scan()["corrupt_or_stale"] == 0
+    # a caller whose in-memory tip WAS the torn segment diverges loudly
+    with pytest.raises(ValueError, match="chain tip"):
+        ds.append("J9", t2, _arrays(rng), rid="r3")
+
+
+def test_delta_scan_keeps_concurrent_corruption_counts(tmp_path,
+                                                       monkeypatch):
+    """scan() must count corruption locally: a concurrent reader's
+    corrupt increment landing mid-scan survives instead of being
+    clobbered by a snapshot/restore of the shared counters."""
+    from pint_tpu.store import DeltaStore
+
+    ds = DeltaStore(tmp_path)
+    rng = np.random.default_rng(22)
+    ds.append("J10", "base", _arrays(rng), rid="r0")
+    os.truncate(ds._chain_paths("J10")[0], 6)
+    orig = DeltaStore._load_verified
+    fired = []
+
+    def racing(self, path, count=True):
+        # simulate a load_chain on another thread landing a corruption
+        # count while scan's verification loop is mid-flight
+        if not count and not fired:
+            fired.append(True)
+            self._note_bad("corrupt")
+        return orig(self, path, count=count)
+
+    monkeypatch.setattr(DeltaStore, "_load_verified", racing)
+    rep = ds.scan()
+    assert rep["segments"] == 1 and rep["corrupt_or_stale"] == 1
+    # the concurrent increment survives; scan itself added none
+    assert ds.counters()["corrupt"] == 1
+
+
 # -- streaming lanes ----------------------------------------------------
 
 
@@ -229,12 +284,12 @@ DM 15.0 1
 """
 
 
-def _lane_fixture(seed=0, n_base=48, chunk_sizes=(6, 8)):
+def _lane_fixture(seed=0, n_base=48, chunk_sizes=(6, 8), psr="TSTR0"):
     from pint_tpu.models import get_model
     from pint_tpu.simulation import make_fake_toas_fromMJDs
 
     rng = np.random.default_rng(seed)
-    model = get_model(_PAR)
+    model = get_model(_PAR.replace("TSTR0", psr))
     base = make_fake_toas_fromMJDs(
         np.sort(rng.uniform(54800, 56000, n_base)), model,
         error_us=1.0, freq_mhz=1400.0, obs="gbt", add_noise=True,
@@ -355,6 +410,171 @@ def test_streaming_chain_replay_bitwise_across_restart(tmp_path):
     x2, chi2_2, _ = sr2._solve(lane2)
     assert np.array_equal(out1["x"], x2)
     assert out1["chi2"] == chi2_2
+
+
+def test_streaming_escalation_rechains_deltas_and_appends_resume(
+        tmp_path):
+    """Escalation on a delta-backed lane re-roots the persisted chain
+    at the merged base: the old segments are invalidated visibly and
+    the NEXT append must succeed on a fresh chain (previously it hit
+    the parent-divergence guard and bricked the lane)."""
+    from pint_tpu.resilience import faultinject
+    from pint_tpu.store import DeltaStore
+    from pint_tpu.serve.streaming import StreamingRefitter
+
+    model, base, chunks = _lane_fixture(seed=15, chunk_sizes=(6, 5, 4))
+    ds = DeltaStore(tmp_path)
+    sr = StreamingRefitter(deltas=ds)
+    sr.register(model, base)
+    out1 = sr.append(model, chunks[0], rid="r0")
+    assert not out1["escalated"] and ds.scan()["segments"] == 1
+
+    with faultinject.inject("solver_diverge"):
+        with pytest.warns(UserWarning, match="escalated"):
+            out2 = sr.append(model, chunks[1], rid="r1")
+    assert out2["escalated"]
+    lane = sr.lane(model)
+    # old chain deleted, lane re-rooted at the merged base signature
+    assert ds.scan()["segments"] == 0
+    assert lane.tip == lane.base_signature
+
+    out3 = sr.append(model, chunks[2], rid="r2")
+    assert not out3["escalated"]
+    assert np.all(np.isfinite(out3["x"]))
+    chain = ds.load_chain(lane.key, lane.base_signature)
+    assert [sig for sig, _ in chain] == [out3["chain"]]
+
+    # same math as an escalated delta-less lane: the re-root is pure
+    # bookkeeping, never a numeric fork
+    model2, base2, chunks2 = _lane_fixture(seed=15,
+                                           chunk_sizes=(6, 5, 4))
+    ref = StreamingRefitter()
+    ref.register(model2, base2)
+    ref.append(model2, chunks2[0], rid="r0")
+    with faultinject.inject("solver_diverge"):
+        with pytest.warns(UserWarning, match="escalated"):
+            ref.append(model2, chunks2[1], rid="r1")
+    out3_ref = ref.append(model2, chunks2[2], rid="r2")
+    assert np.array_equal(out3["x"], out3_ref["x"])
+    assert out3["chi2"] == out3_ref["chi2"]
+
+
+def test_streaming_escalation_after_replay_keeps_chain_and_rows(
+        tmp_path):
+    """A lane restored via chain replay holds its replayed rows only as
+    accumulators: escalating it must refresh in place — keeping the
+    persisted chain and every replayed row — not merge base+chunks
+    (which would silently drop the replayed rows and delete their
+    durable segments)."""
+    from pint_tpu.resilience import faultinject
+    from pint_tpu.store import DeltaStore
+    from pint_tpu.serve.streaming import StreamingRefitter
+
+    model, base, chunks = _lane_fixture(seed=16,
+                                        chunk_sizes=(5, 4, 6, 4, 5))
+    sr1 = StreamingRefitter(deltas=DeltaStore(tmp_path))
+    sr1.register(model, base)
+    for i, c in enumerate(chunks[:2]):
+        sr1.append(model, c, rid=f"r{i}")
+
+    model2, base2, chunks2 = _lane_fixture(seed=16,
+                                           chunk_sizes=(5, 4, 6, 4, 5))
+    ds2 = DeltaStore(tmp_path)
+    sr2 = StreamingRefitter(deltas=ds2)
+    sr2.register(model2, base2)
+    lane = sr2.lane(model2)
+    assert lane.replayed_segments == 2
+    out3 = sr2.append(model2, chunks2[2], rid="r2")
+    assert not out3["escalated"]
+    n_rows = lane.n_appended
+
+    with faultinject.inject("solver_diverge"):
+        with pytest.warns(UserWarning, match="escalated"):
+            out4 = sr2.append(model2, chunks2[3], rid="r3")
+    assert out4["escalated"]
+    # chain intact (2 replayed + 2 live segments), tip NOT re-rooted
+    assert ds2.scan()["segments"] == 4
+    assert lane.tip == out4["chain"] != lane.base_signature
+    # every replayed row still in the state
+    assert out4["n_appended"] == lane.n_appended > n_rows
+    assert np.all(np.isfinite(out4["x"]))
+
+    # and the next append chains cleanly onto the surviving tip
+    out5 = sr2.append(model2, chunks2[4], rid="r4")
+    assert not out5["escalated"]
+    assert ds2.scan()["segments"] == 5
+
+
+def test_streaming_concurrent_lanes_lock_discipline(tmp_path):
+    """Appends on independent lanes run under per-lane locks: two
+    threads hammer two lanes while lockcheck instrumentation records
+    attribute writes and acquisition order.  No unsynchronized write,
+    no refitter-lock-held -> lane-lock edge (the inversion that would
+    re-serialize all lanes), and the observed edge set stays acyclic."""
+    import threading
+
+    from lockcheck import (assert_no_violations, find_cycle, instrument,
+                           record_order)
+
+    from pint_tpu.store import DeltaStore
+    from pint_tpu.serve.streaming import (StreamingLane,
+                                          StreamingRefitter)
+
+    ma, base_a, chunks_a = _lane_fixture(seed=17, chunk_sizes=(4, 5, 4))
+    mb, base_b, chunks_b = _lane_fixture(seed=18, chunk_sizes=(5, 4, 5),
+                                         psr="TSTR1")
+    ds = DeltaStore(tmp_path)
+    sr = StreamingRefitter(deltas=ds)
+    sr.register(ma, base_a)
+    sr.register(mb, base_b)
+    lane_a, lane_b = sr.lane(ma), sr.lane(mb)
+
+    errors = []
+
+    def worker(model, chunks, tag):
+        try:
+            for i, c in enumerate(chunks):
+                out = sr.append(model, c, rid=f"{tag}-{i}")
+                assert np.all(np.isfinite(out["x"]))
+        except Exception as exc:  # pragma: no cover - surfaced below
+            errors.append(exc)
+
+    ref_violations, lane_violations = [], []
+    specs = [(sr, "StreamingRefitter._lock"),
+             (lane_a, "StreamingLane._lock"),
+             (lane_b, "StreamingLane._lock"),
+             (ds, "DeltaStore._lock")]
+    with instrument(StreamingRefitter, ref_violations,
+                    dict_attrs=("lanes",), instances=(sr,)):
+        with instrument(StreamingLane, lane_violations,
+                        instances=(lane_a, lane_b)):
+            with record_order(*specs) as rec:
+                threads = [
+                    threading.Thread(target=worker,
+                                     args=(ma, chunks_a, "a")),
+                    threading.Thread(target=worker,
+                                     args=(mb, chunks_b, "b")),
+                ]
+                for th in threads:
+                    th.start()
+                for th in threads:
+                    th.join()
+
+    assert not errors, errors
+    assert_no_violations(ref_violations)
+    assert_no_violations(lane_violations)
+    assert sr.counters()["appends"] == len(chunks_a) + len(chunks_b)
+    assert sr.counters()["escalated"] == 0
+
+    runtime = rec.edge_set()
+    assert find_cycle(runtime) is None
+    # the refitter lock is never held across per-lane work — the old
+    # global-serialization edge must not reappear
+    assert ("StreamingRefitter._lock",
+            "StreamingLane._lock") not in runtime
+    # per-lane work publishes its delta segment while holding the lane
+    # lock: the one-way ordering the registry documents
+    assert ("StreamingLane._lock", "DeltaStore._lock") in runtime
 
 
 # -- serve engine integration ------------------------------------------
